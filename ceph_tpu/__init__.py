@@ -1,0 +1,26 @@
+"""ceph_tpu — a TPU-native distributed object-storage framework.
+
+A from-scratch, TPU-first implementation of the capabilities of Ceph
+(reference: /root/reference, surveyed in SURVEY.md): a RADOS-style reliable
+autonomic object store — hash-based placement, replication and erasure
+coding, peering/recovery, monitors, messengers, a local object store, a
+client library, and observability — with the erasure-code math running as
+batched JAX/Pallas GF(2^8) kernels on TPU.
+
+Layout (mirrors SURVEY.md §2's component inventory, re-designed TPU-first):
+
+- ``ceph_tpu.ops``      — GF(2^8) math, Pallas/JAX EC kernels, crc32c.
+- ``ceph_tpu.ec``       — ErasureCodeInterface-shaped plugin API + registry +
+                          plugins (jerasure-, isa-, lrc-, shec-, clay-shaped).
+- ``ceph_tpu.models``   — flagship end-to-end EC "models": batched stripe
+                          codec pipelines (the compute graphs the TPU runs).
+- ``ceph_tpu.parallel`` — device meshes, placement (CRUSH-equivalent),
+                          sharded/distributed encode paths.
+- ``ceph_tpu.utils``    — buffers, config, logging, perf counters, codec.
+- ``ceph_tpu.osd``      — object store (memstore), transactions, PG backends.
+- ``ceph_tpu.msg``      — messenger (Policy/Dispatcher semantics).
+- ``ceph_tpu.mon``      — monitor-lite: cluster maps, epochs, health.
+- ``ceph_tpu.client``   — librados-like API, objecter, striper.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,76 @@
+// crc32c (Castagnoli, reflected poly 0x82F63B78) with runtime HW dispatch —
+// the role of the reference's src/common/crc32c_intel_fast.c / crc32c_aarch64.c
+// per-arch impls behind ceph_crc32c (Checksummer, bufferlist cached crcs).
+// Software path: slice-by-8 tables.  HW path: SSE4.2 crc32 instruction.
+
+#include <stddef.h>
+#include <stdint.h>
+#include <string.h>
+
+#if defined(__x86_64__)
+#include <nmmintrin.h>
+#endif
+
+static uint32_t T[8][256];
+static int t_init = 0;
+static int have_sse42 = 0;
+
+// Called once from ct_init() (which Python invokes under a lock) so the
+// lazy path below never races; kept lazy too for direct C users.
+extern "C" void ct_crc32c_init(void) {
+  if (t_init) return;
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int j = 0; j < 8; j++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    T[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int s = 1; s < 8; s++)
+      T[s][i] = (T[s - 1][i] >> 8) ^ T[0][T[s - 1][i] & 0xff];
+#if defined(__x86_64__)
+  have_sse42 = __builtin_cpu_supports("sse4.2") ? 1 : 0;
+#endif
+  t_init = 1;
+}
+
+static uint32_t crc32c_sw(uint32_t crc, const uint8_t* p, size_t len) {
+  crc = ~crc;
+  while (len >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    w ^= crc;
+    crc = T[7][w & 0xff] ^ T[6][(w >> 8) & 0xff] ^ T[5][(w >> 16) & 0xff] ^
+          T[4][(w >> 24) & 0xff] ^ T[3][(w >> 32) & 0xff] ^
+          T[2][(w >> 40) & 0xff] ^ T[1][(w >> 48) & 0xff] ^ T[0][w >> 56];
+    p += 8;
+    len -= 8;
+  }
+  while (len--) crc = (crc >> 8) ^ T[0][(crc ^ *p++) & 0xff];
+  return ~crc;
+}
+
+#if defined(__x86_64__)
+__attribute__((target("sse4.2"))) static uint32_t crc32c_hw(uint32_t crc,
+                                                            const uint8_t* p,
+                                                            size_t len) {
+  uint64_t c = ~crc;
+  while (len >= 8) {
+    uint64_t w;
+    memcpy(&w, p, 8);
+    c = _mm_crc32_u64(c, w);
+    p += 8;
+    len -= 8;
+  }
+  uint32_t c32 = (uint32_t)c;
+  while (len--) c32 = _mm_crc32_u8(c32, *p++);
+  return ~c32;
+}
+#endif
+
+extern "C" uint32_t ct_crc32c(uint32_t crc, const uint8_t* data, size_t len) {
+  ct_crc32c_init();
+#if defined(__x86_64__)
+  if (have_sse42) return crc32c_hw(crc, data, len);
+#endif
+  return crc32c_sw(crc, data, len);
+}

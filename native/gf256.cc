@@ -1,0 +1,247 @@
+// GF(2^8) tables, matrix construction, and portable region kernels.
+// See gf256.h for the role of this library.  Matrix constructions must stay
+// byte-identical to ceph_tpu/ops/gf256.py (the numpy oracle).
+
+#include "gf256.h"
+
+#include <string.h>
+
+static uint8_t GF_EXP[512];
+static int GF_LOG[256];
+static uint8_t GF_INV[256];
+static uint8_t GF_MUL[256][256];
+static int g_have_avx2 = 0;
+static int g_inited = 0;
+
+// AVX2 region multiply-accumulate, defined in gf256_avx2.cc (built -mavx2).
+extern "C" void ct_region_mac_avx2(uint8_t* dst, const uint8_t* src,
+                                   size_t len, const uint8_t* lo,
+                                   const uint8_t* hi);
+
+#if !defined(__x86_64__)
+// Only x86_64 builds compile the AVX2 TU (see Makefile); everywhere else
+// g_have_avx2 stays 0 so this stub is never reached — it only satisfies
+// the linker.
+extern "C" void ct_region_mac_avx2(uint8_t*, const uint8_t*, size_t,
+                                   const uint8_t*, const uint8_t*) {}
+#endif
+
+// crc32c.cc
+extern "C" void ct_crc32c_init(void);
+
+int ct_init(void) {
+  if (g_inited) return g_have_avx2;
+  int x = 1;
+  for (int i = 0; i < 255; i++) {
+    GF_EXP[i] = (uint8_t)x;
+    GF_LOG[x] = i;
+    x <<= 1;
+    if (x & 0x100) x ^= 0x11d;
+  }
+  for (int i = 255; i < 512; i++) GF_EXP[i] = GF_EXP[i - 255];
+  GF_INV[0] = 0;
+  for (int a = 1; a < 256; a++) GF_INV[a] = GF_EXP[255 - GF_LOG[a]];
+  for (int a = 0; a < 256; a++)
+    for (int b = 0; b < 256; b++)
+      GF_MUL[a][b] = (a && b) ? GF_EXP[GF_LOG[a] + GF_LOG[b]] : 0;
+#if defined(__x86_64__)
+  g_have_avx2 = __builtin_cpu_supports("avx2") ? 1 : 0;
+#endif
+  ct_crc32c_init();
+  g_inited = 1;
+  return g_have_avx2;
+}
+
+uint8_t ct_gf_mul(uint8_t a, uint8_t b) { return GF_MUL[a][b]; }
+uint8_t ct_gf_inv(uint8_t a) { return GF_INV[a]; }
+
+// ---------------------------------------------------------------------------
+// Matrices
+// ---------------------------------------------------------------------------
+
+static int extended_vandermonde(int rows, int cols, uint8_t* V) {
+  if (rows > 257 || cols > rows) return -1;
+  memset(V, 0, (size_t)rows * cols);
+  V[0] = 1;
+  if (rows == 1) return 0;
+  V[(size_t)(rows - 1) * cols + (cols - 1)] = 1;
+  for (int i = 1; i < rows - 1; i++) {
+    uint8_t acc = 1;
+    for (int j = 0; j < cols; j++) {
+      V[(size_t)i * cols + j] = acc;
+      acc = GF_MUL[acc][(uint8_t)i];
+    }
+  }
+  return 0;
+}
+
+int ct_mat_inv(int n, const uint8_t* a, uint8_t* out) {
+  // Gauss-Jordan on [A | I]; column count 2n.
+  uint8_t aug[256 * 512];
+  if (n > 256) return -1;
+  for (int i = 0; i < n; i++) {
+    for (int j = 0; j < n; j++) aug[i * 2 * n + j] = a[i * n + j];
+    for (int j = 0; j < n; j++) aug[i * 2 * n + n + j] = (i == j);
+  }
+  int w = 2 * n;
+  for (int col = 0; col < n; col++) {
+    int piv = col;
+    while (piv < n && aug[piv * w + col] == 0) piv++;
+    if (piv == n) return -1;
+    if (piv != col)
+      for (int j = 0; j < w; j++) {
+        uint8_t t = aug[col * w + j];
+        aug[col * w + j] = aug[piv * w + j];
+        aug[piv * w + j] = t;
+      }
+    uint8_t ip = GF_INV[aug[col * w + col]];
+    for (int j = 0; j < w; j++) aug[col * w + j] = GF_MUL[ip][aug[col * w + j]];
+    for (int r = 0; r < n; r++) {
+      uint8_t f = aug[r * w + col];
+      if (r != col && f) {
+        for (int j = 0; j < w; j++) aug[r * w + j] ^= GF_MUL[f][aug[col * w + j]];
+      }
+    }
+  }
+  for (int i = 0; i < n; i++)
+    for (int j = 0; j < n; j++) out[i * n + j] = aug[i * w + n + j];
+  return 0;
+}
+
+int ct_vandermonde_matrix(int k, int m, uint8_t* out) {
+  uint8_t V[257 * 256], top_inv[256 * 256];
+  if (extended_vandermonde(k + m, k, V) != 0) return -1;
+  if (ct_mat_inv(k, V, top_inv) != 0) return -1;
+  // C = V_bottom @ top_inv, then normalise rows by their first element.
+  for (int i = 0; i < m; i++) {
+    const uint8_t* vrow = V + (size_t)(k + i) * k;
+    for (int j = 0; j < k; j++) {
+      uint8_t acc = 0;
+      for (int t = 0; t < k; t++) acc ^= GF_MUL[vrow[t]][top_inv[t * k + j]];
+      out[i * k + j] = acc;
+    }
+    uint8_t f = out[i * k];
+    if (f != 0 && f != 1) {
+      uint8_t fi = GF_INV[f];
+      for (int j = 0; j < k; j++) out[i * k + j] = GF_MUL[fi][out[i * k + j]];
+    }
+  }
+  return 0;
+}
+
+int ct_cauchy_matrix(int k, int m, uint8_t* out) {
+  if (k + m > 256) return -1;
+  for (int i = 0; i < m; i++)
+    for (int j = 0; j < k; j++) out[i * k + j] = GF_INV[(uint8_t)(i ^ (m + j))];
+  return 0;
+}
+
+static int bitmatrix_row_cost(const uint8_t* row, int k) {
+  // total ones in the 8x8 GF(2) expansion of each coefficient
+  int cost = 0;
+  for (int j = 0; j < k; j++)
+    for (int s = 0; s < 8; s++)
+      cost += __builtin_popcount(GF_MUL[row[j]][(uint8_t)(1 << s)]);
+  return cost;
+}
+
+int ct_cauchy_good_matrix(int k, int m, uint8_t* out) {
+  if (ct_cauchy_matrix(k, m, out) != 0) return -1;
+  // column scale so row 0 is all ones
+  for (int j = 0; j < k; j++) {
+    uint8_t ci = GF_INV[out[j]];
+    for (int i = 0; i < m; i++) out[i * k + j] = GF_MUL[out[i * k + j]][ci];
+  }
+  uint8_t row[256];
+  for (int i = 1; i < m; i++) {
+    int best_f = 1, best_cost = -1;
+    for (int f = 1; f < 256; f++) {
+      for (int j = 0; j < k; j++) row[j] = GF_MUL[(uint8_t)f][out[i * k + j]];
+      int cost = bitmatrix_row_cost(row, k);
+      if (best_cost < 0 || cost < best_cost) {
+        best_f = f;
+        best_cost = cost;
+      }
+    }
+    for (int j = 0; j < k; j++)
+      out[i * k + j] = GF_MUL[(uint8_t)best_f][out[i * k + j]];
+  }
+  return 0;
+}
+
+int ct_decode_matrix(const uint8_t* C, int k, int m, const int* avail,
+                     uint8_t* out) {
+  uint8_t rows[256 * 256];
+  if (k <= 0 || k > 256 || m < 0 || k + m > 256) return -2;
+  for (int r = 0; r < k; r++) {
+    int id = avail[r];
+    if (id < 0 || id >= k + m) return -1;
+    for (int j = 0; j < k; j++)
+      rows[r * k + j] = (id < k) ? (uint8_t)(id == j) : C[(id - k) * k + j];
+  }
+  return ct_mat_inv(k, rows, out);
+}
+
+// ---------------------------------------------------------------------------
+// Region kernels
+// ---------------------------------------------------------------------------
+
+static void build_nibble_tables(uint8_t coef, uint8_t lo[16], uint8_t hi[16]) {
+  for (int n = 0; n < 16; n++) {
+    lo[n] = GF_MUL[coef][n];
+    hi[n] = GF_MUL[coef][n << 4];
+  }
+}
+
+static void region_mac_portable(uint8_t* dst, const uint8_t* src, size_t len,
+                                uint8_t coef) {
+  if (coef == 0) return;
+  if (coef == 1) {
+    size_t i = 0;
+    for (; i + 8 <= len; i += 8) {
+      uint64_t a, b;
+      memcpy(&a, dst + i, 8);
+      memcpy(&b, src + i, 8);
+      a ^= b;
+      memcpy(dst + i, &a, 8);
+    }
+    for (; i < len; i++) dst[i] ^= src[i];
+    return;
+  }
+  uint8_t lo[16], hi[16];
+  build_nibble_tables(coef, lo, hi);
+  for (size_t i = 0; i < len; i++) {
+    uint8_t b = src[i];
+    dst[i] ^= (uint8_t)(lo[b & 15] ^ hi[b >> 4]);
+  }
+}
+
+void ct_region_mac(uint8_t* dst, const uint8_t* src, size_t len, uint8_t coef) {
+  if (coef == 0) return;
+  if (g_have_avx2 && coef != 1 && len >= 64) {
+    uint8_t lo[16], hi[16];
+    build_nibble_tables(coef, lo, hi);
+    ct_region_mac_avx2(dst, src, len, lo, hi);
+    return;
+  }
+  region_mac_portable(dst, src, len, coef);
+}
+
+void ct_encode(const uint8_t* G, int m, int k, const uint8_t* data,
+               uint8_t* parity, size_t L) {
+  memset(parity, 0, (size_t)m * L);
+  for (int i = 0; i < m; i++)
+    for (int j = 0; j < k; j++)
+      ct_region_mac(parity + (size_t)i * L, data + (size_t)j * L, L,
+                    G[i * k + j]);
+}
+
+void ct_encode_ptrs(const uint8_t* G, int m, int k,
+                    const uint8_t* const* data_rows, uint8_t* const* out_rows,
+                    size_t L) {
+  for (int i = 0; i < m; i++) {
+    memset(out_rows[i], 0, L);
+    for (int j = 0; j < k; j++)
+      ct_region_mac(out_rows[i], data_rows[j], L, G[i * k + j]);
+  }
+}

@@ -1,0 +1,66 @@
+// GF(2^8) erasure-code math — native reference + SIMD region kernels.
+//
+// This is the C++ equivalent of the GF math the reference system gets from
+// its absent jerasure/gf-complete/ISA-L submodules (see SURVEY.md preamble;
+// wrappers at /root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.cc
+// and isa/ErasureCodeIsa.cc).  It serves three roles in ceph_tpu:
+//   1. byte-exactness oracle for the TPU Pallas kernels,
+//   2. the single-socket CPU baseline for BASELINE.md's speedup metric,
+//   3. the host-side fallback encode path of the `tpu` EC plugin.
+//
+// Field: GF(2^8), primitive polynomial 0x11d (gf-complete w=8 / ISA-L field).
+
+#ifndef CEPH_TPU_GF256_H
+#define CEPH_TPU_GF256_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// One-time table init; safe to call repeatedly.  Returns 1 if an AVX2
+// region path was selected (runtime CPU dispatch, mirroring the reference's
+// arch probing in src/arch/ + crc32c_intel_fast.c).
+int ct_init(void);
+
+uint8_t ct_gf_mul(uint8_t a, uint8_t b);
+uint8_t ct_gf_inv(uint8_t a);  // a != 0
+
+// --- matrices (row-major uint8) -------------------------------------------
+// Systematic Vandermonde-derived RS coding matrix (m x k) — the construction
+// behind jerasure's reed_sol_van technique.  Must match
+// ceph_tpu.ops.gf256.vandermonde_matrix byte-for-byte.
+int ct_vandermonde_matrix(int k, int m, uint8_t* out);
+// Cauchy matrix C[i][j] = inv(i ^ (m + j)) (jerasure cauchy_orig points).
+int ct_cauchy_matrix(int k, int m, uint8_t* out);
+// Density-optimised Cauchy (jerasure cauchy_good intent); matches numpy.
+int ct_cauchy_good_matrix(int k, int m, uint8_t* out);
+// Gauss-Jordan inverse of n x n; returns 0 ok, -1 singular.
+int ct_mat_inv(int n, const uint8_t* a, uint8_t* out);
+// Inverse of the k rows of [I; C] selected by `avail` (first k entries).
+int ct_decode_matrix(const uint8_t* C, int k, int m, const int* avail,
+                     uint8_t* out);
+
+// --- region ops (the hot loop; ref hot path ECUtil.cc:488-514) ------------
+// dst ^= coef * src over `len` bytes.
+void ct_region_mac(uint8_t* dst, const uint8_t* src, size_t len, uint8_t coef);
+// parity(m x L) = G(m x k) * data(k x L); rows contiguous, parity zeroed here.
+void ct_encode(const uint8_t* G, int m, int k, const uint8_t* data,
+               uint8_t* parity, size_t L);
+// Same but with arbitrary row pointers (for decode gather of survivors).
+void ct_encode_ptrs(const uint8_t* G, int m, int k,
+                    const uint8_t* const* data_rows, uint8_t* const* out_rows,
+                    size_t L);
+
+// --- checksums ------------------------------------------------------------
+// crc32c (Castagnoli, reflected, as Ceph's Checksummer/bufferlist use);
+// HW SSE4.2 when available, sliced table fallback.
+uint32_t ct_crc32c(uint32_t crc, const uint8_t* data, size_t len);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  // CEPH_TPU_GF256_H

@@ -1,0 +1,15 @@
+"""Test configuration: force a virtual 8-device CPU mesh before jax imports.
+
+Multi-chip hardware is not available in CI; sharding/collective paths are
+validated on a virtual CPU mesh (mirrors how the reference tests multi-node
+logic in one process with mock messengers — SURVEY.md §4 tier 2).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()

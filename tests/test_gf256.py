@@ -1,0 +1,119 @@
+"""Algebraic property tests for the GF(2^8) reference implementation."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf256 as gf
+
+
+RNG = np.random.default_rng(0xCEF)
+
+
+def test_exp_log_roundtrip():
+    for a in range(1, 256):
+        assert gf.GF_EXP[gf.GF_LOG[a]] == a
+
+
+def test_mul_identity_zero():
+    a = np.arange(256, dtype=np.uint8)
+    assert np.all(gf.gf_mul(a, 1) == a)
+    assert np.all(gf.gf_mul(a, 0) == 0)
+
+
+def test_mul_matches_carryless_polynomial_mul():
+    def slow_mul(a, b):
+        p = 0
+        for i in range(8):
+            if (b >> i) & 1:
+                p ^= a << i
+        for i in range(15, 7, -1):
+            if (p >> i) & 1:
+                p ^= gf.GF_POLY << (i - 8)
+        return p
+
+    for _ in range(2000):
+        a, b = int(RNG.integers(256)), int(RNG.integers(256))
+        assert int(gf.gf_mul(a, b)) == slow_mul(a, b), (a, b)
+
+
+def test_mul_commutative_associative_distributive():
+    a = RNG.integers(0, 256, 64).astype(np.uint8)
+    b = RNG.integers(0, 256, 64).astype(np.uint8)
+    c = RNG.integers(0, 256, 64).astype(np.uint8)
+    assert np.all(gf.gf_mul(a, b) == gf.gf_mul(b, a))
+    assert np.all(gf.gf_mul(gf.gf_mul(a, b), c) == gf.gf_mul(a, gf.gf_mul(b, c)))
+    assert np.all(gf.gf_mul(a, b ^ c) == (gf.gf_mul(a, b) ^ gf.gf_mul(a, c)))
+
+
+def test_inverse():
+    a = np.arange(1, 256, dtype=np.uint8)
+    assert np.all(gf.gf_mul(a, gf.gf_inv(a)) == 1)
+    with pytest.raises(ZeroDivisionError):
+        gf.gf_inv(0)
+
+
+def test_matrix_inverse_roundtrip():
+    for n in (1, 2, 5, 8):
+        for _ in range(10):
+            A = RNG.integers(0, 256, (n, n)).astype(np.uint8)
+            try:
+                Ainv = gf.gf_mat_inv(A)
+            except np.linalg.LinAlgError:
+                continue
+            assert np.array_equal(gf.gf_matmul(A, Ainv), np.eye(n, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (2, 2), (3, 2), (4, 2), (8, 3), (8, 4), (10, 4)])
+@pytest.mark.parametrize("maker", ["vandermonde", "cauchy", "cauchy_good"])
+def test_coding_matrices_are_mds(k, m, maker):
+    """Every k x k submatrix of [I; C] must be invertible (MDS property)."""
+    import itertools
+
+    C = getattr(gf, f"{maker}_matrix")(k, m)
+    assert C.shape == (m, k)
+    full = np.concatenate([np.eye(k, dtype=np.uint8), C])
+    combos = list(itertools.combinations(range(k + m), k))
+    if len(combos) > 150:
+        idx = RNG.choice(len(combos), 150, replace=False)
+        combos = [combos[i] for i in idx]
+    for rows in combos:
+        gf.gf_mat_inv(full[list(rows)])  # raises if singular
+
+
+def test_vandermonde_first_row_mostly_ones():
+    C = gf.vandermonde_matrix(8, 3)
+    assert np.all(C[:, 0] == 1)
+
+
+def test_encode_decode_roundtrip_all_erasure_patterns():
+    import itertools
+
+    k, m, L = 8, 3, 64
+    C = gf.vandermonde_matrix(k, m)
+    data = RNG.integers(0, 256, (k, L)).astype(np.uint8)
+    parity = gf.encode_region(C, data)
+    stack = np.concatenate([data, parity])
+    for erased in itertools.combinations(range(k + m), m):
+        available = [i for i in range(k + m) if i not in erased]
+        D = gf.decode_matrix(C, k, available)
+        rec = gf.gf_matmul(D, stack[available[:k]])
+        assert np.array_equal(rec, data), f"erasures {erased}"
+
+
+def test_bitmatrix_equivalent_to_gf_matmul():
+    k, m, L = 8, 3, 256
+    for maker in (gf.vandermonde_matrix, gf.cauchy_matrix, gf.cauchy_good_matrix):
+        C = maker(k, m)
+        B = gf.bitmatrix(C)
+        assert B.shape == (8 * m, 8 * k)
+        data = RNG.integers(0, 256, (k, L)).astype(np.uint8)
+        want = gf.encode_region(C, data)
+        planes = gf.bytes_to_bitplanes(data)
+        out_planes = (B.astype(np.int32) @ planes.astype(np.int32)) & 1
+        got = gf.bitplanes_to_bytes(out_planes.astype(np.uint8))
+        assert np.array_equal(got, want)
+
+
+def test_bitplane_roundtrip():
+    d = RNG.integers(0, 256, (5, 33)).astype(np.uint8)
+    assert np.array_equal(gf.bitplanes_to_bytes(gf.bytes_to_bitplanes(d)), d)

@@ -1,0 +1,97 @@
+"""Byte-exact cross-check: native C++ library vs the numpy GF oracle."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf256 as gf
+from ceph_tpu.ops import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library failed to build")
+
+RNG = np.random.default_rng(7)
+
+
+def test_scalar_mul_inv_match():
+    L = native.lib()
+    for _ in range(3000):
+        a, b = int(RNG.integers(256)), int(RNG.integers(256))
+        assert L.ct_gf_mul(a, b) == int(gf.gf_mul(a, b))
+    for a in range(1, 256):
+        assert L.ct_gf_inv(a) == int(gf.gf_inv(a))
+
+
+@pytest.mark.parametrize("k,m", [(2, 1), (2, 2), (4, 2), (8, 3), (8, 4), (10, 4)])
+def test_matrices_match_numpy(k, m):
+    assert np.array_equal(native.vandermonde_matrix(k, m),
+                          gf.vandermonde_matrix(k, m))
+    assert np.array_equal(native.cauchy_matrix(k, m), gf.cauchy_matrix(k, m))
+    assert np.array_equal(native.cauchy_good_matrix(k, m),
+                          gf.cauchy_good_matrix(k, m))
+
+
+def test_mat_inv_matches():
+    for n in (2, 4, 8):
+        A = RNG.integers(0, 256, (n, n)).astype(np.uint8)
+        try:
+            want = gf.gf_mat_inv(A)
+        except np.linalg.LinAlgError:
+            with pytest.raises(np.linalg.LinAlgError):
+                native.mat_inv(A)
+            continue
+        assert np.array_equal(native.mat_inv(A), want)
+
+
+@pytest.mark.parametrize("L", [1, 63, 64, 4096, 100_001])
+def test_encode_region_matches(L):
+    k, m = 8, 3
+    C = gf.vandermonde_matrix(k, m)
+    data = RNG.integers(0, 256, (k, L)).astype(np.uint8)
+    assert np.array_equal(native.encode_region(C, data),
+                          gf.encode_region(C, data))
+
+
+def test_decode_matrix_and_reconstruct():
+    k, m, L = 8, 3, 4096
+    C = gf.cauchy_good_matrix(k, m)
+    data = RNG.integers(0, 256, (k, L)).astype(np.uint8)
+    parity = native.encode_region(C, data)
+    stack = np.concatenate([data, parity])
+    available = [0, 2, 4, 5, 6, 7, 8, 10]  # erased 1, 3, 9
+    D = native.decode_matrix(C, k, available)
+    assert np.array_equal(D, gf.decode_matrix(C, k, available))
+    rec = native.encode_region(D, stack[available])
+    assert np.array_equal(rec, data)
+
+
+def test_encode_region_ptrs_gather():
+    """Pointer-gather encode (decode-path shape) matches contiguous encode."""
+    k, m, L = 6, 2, 8192
+    C = gf.cauchy_matrix(k, m)
+    rows = [np.ascontiguousarray(RNG.integers(0, 256, L).astype(np.uint8))
+            for _ in range(k)]
+    want = gf.encode_region(C, np.stack(rows))
+    got = native.encode_region_ptrs(C, rows, L)
+    assert np.array_equal(got, want)
+
+
+def test_region_mac_validation():
+    dst = np.zeros(64, dtype=np.uint8)
+    with pytest.raises(ValueError):
+        native.region_mac(dst, np.zeros(16, dtype=np.uint8), 3)
+    with pytest.raises(TypeError):
+        native.region_mac(np.zeros(8), np.zeros(8), 2)
+    with pytest.raises(ValueError):
+        native.decode_matrix(gf.cauchy_matrix(4, 2), 4, [0, 1, 2, 99])
+
+
+def test_crc32c_known_vectors():
+    # standard crc32c test vector (RFC 3720 / Ceph ceph_crc32c semantics):
+    # crc32c of "123456789" with initial crc 0 (unreflected seed 0) is
+    # 0xE3069283; with Ceph's typical -1 seed the value differs.
+    assert native.crc32c(b"123456789", crc=0) == 0xE3069283
+    # incremental == one-shot
+    a = RNG.integers(0, 256, 10_000).astype(np.uint8).tobytes()
+    c1 = native.crc32c(a)
+    c2 = native.crc32c(a[5000:], crc=native.crc32c(a[:5000]))
+    assert c1 == c2
