@@ -1,0 +1,16 @@
+"""Erasure-code engine: plugin interface, registry, and builtin plugins.
+
+The equivalent of the reference's src/erasure-code/ layer (SURVEY.md §2.1):
+ErasureCodeInterface -> interface.ErasureCode, ErasureCodePluginRegistry ->
+registry, jerasure/isa/lrc/shec/clay plugins -> plugin_*.py modules.
+"""
+
+from .interface import (ChunkMap, ErasureCode, ErasureCodeError, Flags,
+                        Profile, EC_ALIGN_SIZE, SIMD_ALIGN)
+from .registry import factory, preload, register, registered
+
+__all__ = [
+    "ChunkMap", "ErasureCode", "ErasureCodeError", "Flags", "Profile",
+    "EC_ALIGN_SIZE", "SIMD_ALIGN", "factory", "preload", "register",
+    "registered",
+]

@@ -1,0 +1,134 @@
+"""Shared implementation of GF(2^8) matrix codes (RS/Cauchy families).
+
+The role jerasure's matrix techniques and ISA-L's ec_encode_data play for
+the reference plugins (wrappers ErasureCodeJerasure.cc:121-240,
+ErasureCodeIsa.cc:290-563): hold an (m, k) coding matrix, multiply regions
+through a backend — numpy oracle, native C++ (AVX2), or JAX/TPU — and build
+cached inverted decode matrices per erasure signature (the reference's
+ErasureCodeIsaTableCache LRU, ErasureCodeIsa.cc:513-563).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..ops import gf256
+from ..ops import native
+from .interface import ChunkMap, ErasureCode, ErasureCodeError, Flags
+
+
+def _pick_backend(name: str) -> str:
+    if name == "auto":
+        return "native" if native.available() else "numpy"
+    if name not in ("native", "numpy", "jax"):
+        raise ErasureCodeError(f"unknown backend {name!r}")
+    return name
+
+
+class MatrixErasureCode(ErasureCode):
+    """Systematic GF(2^8) matrix code over a pluggable region backend."""
+
+    #: subclasses set this in _init_from_profile
+    matrix: np.ndarray
+
+    def _init_matrix_backend(self) -> None:
+        self._backend = _pick_backend(self.profile.get("backend", "auto"))
+        self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
+        # compiled-kernel cache keyed by matrix bytes (encode matrix plus
+        # decode matrices), so repeated decodes reuse their compilation
+        self._jax_ops: dict[bytes, object] = {}
+        if self._backend == "jax":
+            self._jax_matmul(self.matrix)  # build the encode op eagerly
+
+    def _jax_matmul(self, M: np.ndarray):
+        key = M.tobytes() + bytes(M.shape)
+        op = self._jax_ops.get(key)
+        if op is None:
+            from ..ops import ec_kernels  # deferred: jax import is heavy
+            op = ec_kernels.RegionMatmul(M)
+            if len(self._jax_ops) > 64:
+                self._jax_ops.pop(next(iter(self._jax_ops)))
+            self._jax_ops[key] = op
+        return op
+
+    def get_flags(self) -> Flags:
+        return (Flags.PARITY_DELTA_OPTIMIZATION | Flags.ZERO_PADDING |
+                Flags.OPTIMIZED_SUPPORTED | Flags.PARTIAL_READ_OPTIMIZATION |
+                Flags.PARTIAL_WRITE_OPTIMIZATION)
+
+    # -- region multiply through the selected backend ----------------------
+    def _matmul(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        if self._backend == "native":
+            return native.encode_region(M, rows)
+        if self._backend == "jax":
+            return np.asarray(self._jax_matmul(M)(rows))
+        return gf256.encode_region(M, rows)
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        if data_chunks.shape[0] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {data_chunks.shape[0]}")
+        return self._matmul(self.matrix, data_chunks)
+
+    def _get_decode_matrix(self, available: Sequence[int]) -> np.ndarray:
+        key = tuple(available[: self.k])
+        hit = self._decode_cache.get(key)
+        if hit is None:
+            hit = gf256.decode_matrix(self.matrix, self.k, list(key))
+            if len(self._decode_cache) > 256:  # signature LRU, ref :513-563
+                self._decode_cache.pop(next(iter(self._decode_cache)))
+            self._decode_cache[key] = hit
+        return hit
+
+    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
+        avail = sorted(i for i in chunks if i < self.chunk_count)
+        if len(avail) < self.k:
+            raise ErasureCodeError(
+                f"cannot decode: only {len(avail)} of {self.k} chunks")
+        use = avail[: self.k]
+        L = chunks[use[0]].shape[-1]
+        stack = np.stack([np.ascontiguousarray(chunks[i], dtype=np.uint8)
+                          for i in use])
+        out: ChunkMap = {}
+        want_data = [i for i in want if i < self.k]
+        want_parity = [i for i in want if i >= self.k]
+        data_full: np.ndarray | None = None
+        if want_data or want_parity:
+            missing_data = [i for i in range(self.k) if i not in chunks]
+            if missing_data or want_parity:
+                D = self._get_decode_matrix(use)
+                if want_parity or len(missing_data) > 1:
+                    data_full = self._matmul(D, stack)
+                else:
+                    # single-row recovery: multiply only the needed rows
+                    data_full = np.zeros((self.k, L), dtype=np.uint8)
+                    sub = self._matmul(D[want_data], stack)
+                    for r, i in enumerate(want_data):
+                        data_full[i] = sub[r]
+            for i in want_data:
+                out[i] = chunks[i] if i in chunks else data_full[i]
+        if want_parity:
+            parity = self._matmul(self.matrix[[i - self.k for i in want_parity]],
+                                  data_full)
+            for r, i in enumerate(want_parity):
+                out[i] = parity[r]
+        return out
+
+    # -- parity delta (RMW write path; ref ErasureCodeJerasure.h:115-122,
+    # ECUtil.cc:519-566 encode_parity_delta) ------------------------------
+    def apply_delta(self, delta: np.ndarray, data_shard: int,
+                    parity_chunks: ChunkMap) -> None:
+        if not 0 <= data_shard < self.k:
+            raise ErasureCodeError(f"not a data shard: {data_shard}")
+        delta = np.ascontiguousarray(delta, dtype=np.uint8)
+        for pid, buf in parity_chunks.items():
+            if not self.k <= pid < self.chunk_count:
+                raise ErasureCodeError(f"not a parity shard: {pid}")
+            coef = int(self.matrix[pid - self.k, data_shard])
+            if self._backend == "native":
+                native.region_mac(buf, delta, coef)
+            else:
+                buf ^= gf256.gf_mul(np.uint8(coef), delta)

@@ -1,0 +1,37 @@
+"""ISA-L-shaped plugin: Vandermonde or Cauchy RS (the reference's default
+plugin for new pools since Tentacle — PendingReleaseNotes:403-409).
+
+Mirrors /root/reference/src/erasure-code/isa/ErasureCodeIsa.cc: matrix
+choice (:598-658), decode-table caching per erasure signature (:513-563 —
+implemented in MatrixErasureCode._get_decode_matrix), and the single-erasure
+pure-XOR fast path (:396,451 — falls out of the kernel's coefficient-1 XOR
+fast path here).  The ec_encode_data SIMD loops of the absent isa-l
+submodule are ceph_tpu.ops.native / ops.ec_kernels.
+"""
+
+from __future__ import annotations
+
+from ..ops import gf256
+from .interface import ErasureCodeError, profile_int
+from .matrix_code import MatrixErasureCode
+from .registry import register
+
+PLUGIN_API_VERSION = 1
+
+DEFAULT_K = 7
+DEFAULT_M = 3
+
+
+@register("isa")
+class IsaCode(MatrixErasureCode):
+    def _init_from_profile(self) -> None:
+        self.k = profile_int(self.profile, "k", DEFAULT_K)
+        self.m = profile_int(self.profile, "m", DEFAULT_M)
+        self.technique = self.profile.get("technique", "reed_sol_van")
+        if self.technique == "reed_sol_van":
+            self.matrix = gf256.vandermonde_matrix(self.k, self.m)
+        elif self.technique == "cauchy":
+            self.matrix = gf256.cauchy_matrix(self.k, self.m)
+        else:
+            raise ErasureCodeError(f"unknown technique {self.technique!r}")
+        self._init_matrix_backend()

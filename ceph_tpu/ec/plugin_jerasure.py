@@ -1,0 +1,68 @@
+"""jerasure-compatible plugin: matrix RS/Cauchy techniques.
+
+Mirrors the technique surface of the reference's jerasure plugin wrapper
+(/root/reference/src/erasure-code/jerasure/ErasureCodePluginJerasure.cc:40-66
+technique switch; ErasureCodeJerasure.h:135-336 per-technique classes;
+defaults k=7, m=3, w=8 ref ErasureCodeJerasure.h:143-145).  The GF math the
+reference dlopens from the absent jerasure/gf-complete submodules is
+provided by ceph_tpu.ops (numpy oracle / native AVX2 / JAX kernels).
+
+Techniques:
+- reed_sol_van   — systematic Vandermonde-derived RS (w=8)
+- reed_sol_r6_op — RAID-6 specialisation (m=2): P = XOR, Q = sum 2^j d_j
+- cauchy_orig    — Cauchy matrix, jerasure point convention
+- cauchy_good    — Cauchy matrix, bit-matrix density optimised
+- liberation / blaum_roth / liber8tion — packed-word bit-matrix codes of the
+  reference; NOT implemented (w in {7, 31, 8-with-bitpacking} schedules are
+  CPU-word-oriented and off the TPU design path) — selecting them raises.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import gf256
+from .interface import ErasureCodeError, profile_int
+from .matrix_code import MatrixErasureCode
+from .registry import register
+
+PLUGIN_API_VERSION = 1
+
+DEFAULT_K = 7
+DEFAULT_M = 3
+
+TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good",
+              "liberation", "blaum_roth", "liber8tion")
+
+
+@register("jerasure")
+class JerasureCode(MatrixErasureCode):
+    def _init_from_profile(self) -> None:
+        self.k = profile_int(self.profile, "k", DEFAULT_K)
+        self.m = profile_int(self.profile, "m", DEFAULT_M)
+        w = profile_int(self.profile, "w", 8)
+        if w != 8:
+            raise ErasureCodeError(
+                f"w={w} unsupported: the TPU build implements GF(2^8) only "
+                "(byte-oriented; other word sizes are CPU-schedule oriented)")
+        self.technique = self.profile.get("technique", "reed_sol_van")
+        if self.technique not in TECHNIQUES:
+            raise ErasureCodeError(f"unknown technique {self.technique!r}")
+        if self.technique == "reed_sol_van":
+            self.matrix = gf256.vandermonde_matrix(self.k, self.m)
+        elif self.technique == "reed_sol_r6_op":
+            if self.m != 2:
+                raise ErasureCodeError("reed_sol_r6_op requires m=2")
+            M = np.ones((2, self.k), dtype=np.uint8)
+            for j in range(self.k):
+                M[1, j] = gf256.gf_pow(2, j)
+            self.matrix = M
+        elif self.technique == "cauchy_orig":
+            self.matrix = gf256.cauchy_matrix(self.k, self.m)
+        elif self.technique == "cauchy_good":
+            self.matrix = gf256.cauchy_good_matrix(self.k, self.m)
+        else:
+            raise ErasureCodeError(
+                f"technique {self.technique!r} is not implemented in the "
+                "TPU build (bit-packed word schedule)")
+        self._init_matrix_backend()

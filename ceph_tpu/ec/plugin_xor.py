@@ -1,0 +1,25 @@
+"""Minimal XOR example plugin (k data + 1 parity).
+
+The in-tree fake plugin the reference uses for registry/unit tests
+(/root/reference/src/test/erasure-code/ErasureCodeExample.h) — kept both as
+a registry test subject and as the cheapest m=1 code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .interface import profile_int
+from .matrix_code import MatrixErasureCode
+from .registry import register
+
+PLUGIN_API_VERSION = 1
+
+
+@register("xor")
+class XorCode(MatrixErasureCode):
+    def _init_from_profile(self) -> None:
+        self.k = profile_int(self.profile, "k", 2)
+        self.m = 1
+        self.matrix = np.ones((1, self.k), dtype=np.uint8)
+        self._init_matrix_backend()

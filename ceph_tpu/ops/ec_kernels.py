@@ -1,0 +1,171 @@
+"""JAX/Pallas GF(2^8) region kernels — the TPU erasure-code hot path.
+
+This is the TPU-native replacement for the SIMD region kernels the
+reference gets from jerasure/gf-complete/ISA-L (the hot loop behind
+ECUtil.cc:488-514's encode_chunks and the benchmark's encode loop,
+ceph_erasure_code_benchmark.cc:186-191).
+
+Formulation
+-----------
+A GF(2^8) multiply by a *constant* c is GF(2)-linear on the bits of the
+operand:  c*b = XOR_s bit_s(b) * (c * x^s).  Working on uint32 lanes that
+each hold 4 independent bytes of a chunk:
+
+    y32 ^= ((x32 >> s) & 0x01010101) * byte(c * x^s)      for s in 0..7
+
+— the shifted mask extracts bit s of each byte into its low bit-position,
+and the integer multiply broadcasts the constant byte into every byte slot
+with no carries (mask bytes are 0/1, products fit a byte).  The whole
+(m, k) matrix multiply unrolls at trace time into a static chain of
+shift/and/mul/xor VPU ops: no gathers, no tables, no data-dependent control
+flow — exactly what XLA/Mosaic want.  Coefficient 0 contributes nothing and
+coefficient 1 is a single XOR, so XOR-heavy matrices (Vandermonde row 0,
+cauchy_good's all-ones row) cost almost nothing — the same optimisation
+jerasure's XOR-schedule (cauchy_good) path performs on CPUs.
+
+The same trace builds three ways: a Pallas TPU kernel (data staged through
+VMEM in blocks), the identical jnp graph for CPU/debug, and Pallas
+interpret mode for CI coverage of the kernel itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256
+
+_MASK = 0x01010101  # low bit of each byte lane in a uint32
+
+
+def _terms(M: np.ndarray) -> tuple[tuple[tuple[int, int, int], ...], ...]:
+    """Static per-output-row term lists: row i -> ((j, s, v), ...) with
+    v = M[i,j] * x^s != 0; a (j, -1, 0) entry marks a plain XOR (coef 1)."""
+    M = np.asarray(M, dtype=np.uint8)
+    rows = []
+    for i in range(M.shape[0]):
+        row: list[tuple[int, int, int]] = []
+        for j in range(M.shape[1]):
+            c = int(M[i, j])
+            if c == 0:
+                continue
+            if c == 1:
+                row.append((j, -1, 0))
+                continue
+            for s in range(8):
+                v = int(gf256.gf_mul(c, 1 << s))
+                if v:
+                    row.append((j, s, v))
+        rows.append(tuple(row))
+    return tuple(rows)
+
+
+def _accumulate_row(x, terms):
+    """XOR-accumulate one output row from input rows x (c, n) uint32."""
+    acc = None
+    for j, s, v in terms:
+        xj = x[j : j + 1, :]
+        t = xj if s < 0 else (
+            (xj >> jnp.uint32(s)) & jnp.uint32(_MASK)) * jnp.uint32(v)
+        acc = t if acc is None else acc ^ t
+    if acc is None:
+        return jnp.zeros_like(x[0:1, :])
+    return acc
+
+
+def _rows_op(x, terms_all):
+    return jnp.concatenate([_accumulate_row(x, t) for t in terms_all], axis=0)
+
+
+def _pallas_region_kernel(terms_all):
+    def kernel(x_ref, o_ref):
+        o_ref[...] = _rows_op(x_ref[...], terms_all)
+
+    return kernel
+
+
+class RegionMatmul:
+    """out(r, L) = M(r, c) @ data(c, L) over GF(2^8), JAX-compiled.
+
+    ``data`` is uint8 with L a multiple of 4; stripes batch by widening L
+    (columns are independent), which is how the stripe batcher feeds many
+    stripes per launch (SURVEY.md §5 long-context analogue: a stripe batch
+    is a (c, batch*chunk) tensor).
+    """
+
+    # VMEM block: BLOCK uint32 lanes per row (32 KiB/row at 8192)
+    BLOCK = 8192
+
+    def __init__(self, M: np.ndarray, *, interpret: bool = False):
+        """``interpret=True`` forces the Pallas kernel in interpret mode
+        (CI coverage of the kernel body off-TPU); otherwise the Pallas
+        path runs compiled on TPU and the identical jnp graph elsewhere."""
+        self.M = np.ascontiguousarray(M, dtype=np.uint8)
+        self.r, self.c = self.M.shape
+        self._terms = _terms(self.M)
+        on_tpu = jax.default_backend() == "tpu"
+        self._interpret = interpret and not on_tpu
+        self._use_pallas = on_tpu or self._interpret
+        self._shape_cache: dict[int, object] = {}
+
+    def _compiled(self, n4: int):
+        fn = self._shape_cache.get(n4)
+        if fn is None:
+            fn = self._build(n4)
+            if len(self._shape_cache) >= 16:
+                self._shape_cache.pop(next(iter(self._shape_cache)))
+            self._shape_cache[n4] = fn
+        return fn
+
+    def _build(self, n4: int):
+        terms_all = self._terms
+        r = self.r
+
+        if self._use_pallas:
+            from jax.experimental import pallas as pl
+
+            block = min(self.BLOCK, n4)
+            grid = (n4 // block,)
+            kernel = _pallas_region_kernel(terms_all)
+
+            interpret = self._interpret
+
+            def run(x32):
+                return pl.pallas_call(
+                    kernel,
+                    out_shape=jax.ShapeDtypeStruct((r, n4), jnp.uint32),
+                    grid=grid,
+                    in_specs=[pl.BlockSpec((self.c, block), lambda g: (0, g))],
+                    out_specs=pl.BlockSpec((r, block), lambda g: (0, g)),
+                    interpret=interpret,
+                )(x32)
+        else:
+            def run(x32):
+                return _rows_op(x32, terms_all)
+
+        @jax.jit
+        def fn(data_u8):
+            x32 = jax.lax.bitcast_convert_type(
+                data_u8.reshape(self.c, n4, 4), jnp.uint32)
+            y32 = run(x32)
+            return jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(
+                r, n4 * 4)
+
+        return fn
+
+    def __call__(self, data) -> jax.Array:
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        if data.ndim != 2 or data.shape[0] != self.c:
+            raise ValueError(f"expected ({self.c}, L) data, got {data.shape}")
+        L = data.shape[1]
+        if L == 0:
+            return jnp.zeros((self.r, 0), dtype=jnp.uint8)
+        # uint32 tiling wants multiples of 128 lanes (512 bytes); beyond one
+        # block, round up to a whole block so the grid divides evenly.
+        quantum = 512 if L <= 4 * self.BLOCK else 4 * self.BLOCK
+        pad = (-L) % quantum
+        if pad:
+            data = jnp.pad(data, ((0, 0), (0, pad)))
+        out = self._compiled((L + pad) // 4)(data)
+        return out[:, :L] if pad else out

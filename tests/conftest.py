@@ -1,15 +1,36 @@
-"""Test configuration: force a virtual 8-device CPU mesh before jax imports.
+"""Test configuration: force a hermetic 8-device CPU mesh.
 
 Multi-chip hardware is not available in CI; sharding/collective paths are
 validated on a virtual CPU mesh (mirrors how the reference tests multi-node
 logic in one process with mock messengers — SURVEY.md §4 tier 2).
+
+The surrounding environment may point JAX at a real TPU through the axon
+tunnel (PYTHONPATH sitecustomize registers the 'axon' PJRT plugin in every
+interpreter, and its backend factory gets initialised even when
+JAX_PLATFORMS=cpu).  Initialising that backend opens a blocking TCP tunnel,
+so tests must drop the factory before any jax backend initialisation.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 prev = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in prev:
     os.environ["XLA_FLAGS"] = (
         prev + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax
+
+    # sitecustomize imports jax before this file runs, snapshotting
+    # JAX_PLATFORMS=axon into the live config — the env var alone is
+    # ignored by an already-imported jax.
+    jax.config.update("jax_platforms", "cpu")
+    import jax._src.xla_bridge as _xb
+
+    # deregister the axon PJRT factory: it gets initialised (and opens
+    # the blocking tunnel) even when it is not the selected platform.
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # jax absent or internals moved; env vars still set
+    pass
