@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Headline benchmark: EC encode GB/s, TPU vs single-socket CPU baseline.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+Protocol (BASELINE.md): k=8, m=3 Reed-Solomon (reed_sol_van construction),
+1 MiB stripes, batched; GB/s counts source data bytes.  value is the TPU
+end-to-end number (host in -> encoded chunks out, staging included);
+vs_baseline divides by our measured single-thread CPU (AVX2) throughput on
+the same buffers — the stand-in for single-socket jerasure, whose sources
+are absent submodules of the reference (SURVEY.md preamble).
+
+The TPU leg runs in a subprocess with a hard timeout: the axon TPU tunnel
+can wedge, and the driver must never hang here.  On TPU failure the line
+reports the CPU number with the metric labelled accordingly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+K, M = 8, 3
+STRIPE = 1024 * 1024
+TPU_TIMEOUT_S = int(os.environ.get("BENCH_TPU_TIMEOUT", "900"))
+
+
+def cpu_baseline_gbps() -> float:
+    import numpy as np
+
+    from ceph_tpu.ops import gf256, native
+
+    Mx = gf256.vandermonde_matrix(K, M)
+    chunk = STRIPE // K
+    batch = 64
+    data = np.random.default_rng(0).integers(
+        0, 256, (K, batch * chunk), dtype=np.uint8)
+    native.encode_region(Mx, data)  # warm
+    reps, t0 = 0, time.perf_counter()
+    while time.perf_counter() - t0 < 3.0:
+        native.encode_region(Mx, data)
+        reps += 1
+    dt = time.perf_counter() - t0
+    return reps * data.nbytes / dt / 2**30
+
+
+def tpu_gbps() -> dict | None:
+    cmd = [sys.executable, "-m", "ceph_tpu.tools.bench_tpu",
+           "--k", str(K), "--m", str(M), "--stripe-bytes", str(STRIPE),
+           "--batch", "64", "--reps", "10"]
+    try:
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=TPU_TIMEOUT_S,
+            cwd=os.path.dirname(os.path.abspath(__file__)) or ".",
+        )
+    except subprocess.TimeoutExpired:
+        print("bench: TPU worker timed out (tunnel wedged?)", file=sys.stderr)
+        return None
+    if out.returncode != 0:
+        print(f"bench: TPU worker failed:\n{out.stderr[-2000:]}",
+              file=sys.stderr)
+        return None
+    try:
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        print(f"bench: bad TPU worker output: {out.stdout[-500:]}",
+              file=sys.stderr)
+        return None
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    cpu = cpu_baseline_gbps()
+    print(f"bench: cpu single-thread baseline {cpu:.2f} GB/s", file=sys.stderr)
+    dev = tpu_gbps()
+    if dev is not None:
+        print(f"bench: device detail {json.dumps(dev)}", file=sys.stderr)
+        backend = dev.get("backend", "?")
+        value = dev["end_to_end_gbps"]
+        metric = (f"EC encode GB/s (k={K},m={M}, 1MiB stripes, "
+                  f"{backend} end-to-end; kernel-only "
+                  f"{dev['kernel_gbps']:.1f})")
+    else:
+        value = cpu
+        metric = (f"EC encode GB/s (k={K},m={M}, 1MiB stripes, "
+                  "cpu-fallback: TPU unavailable)")
+    print(json.dumps({
+        "metric": metric,
+        "value": round(value, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(value / cpu, 3) if cpu > 0 else None,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,7 @@
+"""Flagship compute pipelines ("models"): the batched stripe codecs the TPU
+actually runs — encode/decode graphs built from the EC kernels, plus their
+distributed (meshed) variants in ceph_tpu.parallel."""
+
+from .stripe_codec import StripeCodec
+
+__all__ = ["StripeCodec"]

@@ -85,6 +85,25 @@ def _pallas_region_kernel(terms_all):
     return kernel
 
 
+def gf_matmul_graph(M: np.ndarray):
+    """Return a pure, jit-friendly fn(data (c, L) uint8) -> (r, L) uint8
+    computing M @ data over GF(2^8) as a plain jnp graph (no pallas_call),
+    for embedding inside larger jitted/shard_mapped programs (L % 4 == 0)."""
+    terms_all = _terms(M)
+    r, c = np.asarray(M).shape
+
+    def fn(data_u8):
+        if data_u8.shape[0] != c:
+            raise ValueError(f"expected {c} rows, got {data_u8.shape[0]}")
+        n4 = data_u8.shape[-1] // 4
+        x32 = jax.lax.bitcast_convert_type(
+            data_u8.reshape(c, n4, 4), jnp.uint32)
+        y32 = _rows_op(x32, terms_all)
+        return jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(r, n4 * 4)
+
+    return fn
+
+
 class RegionMatmul:
     """out(r, L) = M(r, c) @ data(c, L) over GF(2^8), JAX-compiled.
 
@@ -141,8 +160,9 @@ class RegionMatmul:
                     interpret=interpret,
                 )(x32)
         else:
-            def run(x32):
-                return _rows_op(x32, terms_all)
+            # identical math as a plain jnp graph — shared with
+            # gf_matmul_graph so the lane-packing logic lives once
+            return jax.jit(gf_matmul_graph(self.M))
 
         @jax.jit
         def fn(data_u8):

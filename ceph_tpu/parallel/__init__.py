@@ -1,0 +1,9 @@
+"""Parallelism layer: device meshes, shardings, and the distributed EC
+write/recovery steps (the TPU mapping of SURVEY.md §2.8's strategies —
+stripe batch = data parallel, shard axis = tensor parallel, collectives
+over ICI instead of the reference's messenger fan-out)."""
+
+from .mesh import make_mesh
+from .distributed import DistributedStripeEC
+
+__all__ = ["make_mesh", "DistributedStripeEC"]

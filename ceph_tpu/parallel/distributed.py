@@ -1,0 +1,154 @@
+"""Distributed stripe EC over a device mesh — the ICI-native "cluster".
+
+The TPU mapping of the reference's distributed write path (SURVEY.md §3.1
+EC variant: ECBackend.submit_transaction -> per-shard MOSDECSubOpWrite
+fan-out over the cluster messenger) re-designed for SPMD over a
+("dp", "shard") mesh:
+
+- encode runs **column-sharded** ("sp": each device holds a slice of every
+  chunk's columns — the striping/sequence-parallel analogue, SURVEY.md §5),
+  so parity is computed with zero communication;
+- chunk *placement* is one `all_to_all` that re-lays the stripe from
+  column-sharded to row-sharded ownership (each shard device ends up
+  owning whole chunks — the acting-set fan-out, but as a single ICI
+  collective instead of k+m messenger sends);
+- rebalance/backfill movement is a `ppermute` of chunk rows around the
+  shard ring (the chunk_mapping/pg-remap analogue, ECUtil.h:477-517);
+- degraded reads `all_gather` the surviving rows and decode locally (the
+  ReadPipeline fan-in, ECCommon.h:352-420);
+- cluster-wide stats (bytes/digest) reduce with `psum` (the PGStats ->
+  mgr report analogue).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..models.stripe_codec import StripeCodec
+
+
+class DistributedStripeEC:
+    """Distributed EC pipeline for a StripeCodec over a ("dp","shard") mesh.
+
+    Data model: a batch of stripes (B, k, L) uint8.  B is sharded over
+    "dp"; L over "shard" during compute; after placement each shard device
+    owns S/n_shard whole chunk rows, where S pads k+m up to a multiple of
+    the shard axis (spare rows are zero — "spare OSD" slots).
+    """
+
+    def __init__(self, codec: StripeCodec, mesh: Mesh):
+        self.codec = codec
+        self.mesh = mesh
+        self.n_shard = mesh.shape["shard"]
+        self.n_dp = mesh.shape["dp"]
+        km = codec.k + codec.m
+        self.S = -(-km // self.n_shard) * self.n_shard
+        self.spare_rows = self.S - km
+
+    # ---------------- write ----------------
+    def make_write_step(self):
+        """jit-able fn(data (B, k, L)) -> (stack (B, S, L), digest scalar).
+
+        Output sharding: stack rows over "shard" (chunk ownership), batch
+        over "dp"; digest is a psum-reduced uint32 scrub digest.
+        """
+        k, m, S = self.codec.k, self.codec.m, self.S
+        enc = self.codec.encode_graph()
+
+        def local(d):  # (b, k, Lloc) on one device
+            b, _, Ll = d.shape
+            folded = d.transpose(1, 0, 2).reshape(k, b * Ll)
+            par = enc(folded).reshape(m, b, Ll).transpose(1, 0, 2)
+            zeros = jnp.zeros((b, S - k - m, Ll), jnp.uint8)
+            stack = jnp.concatenate([d, par, zeros], axis=1)  # (b, S, Ll)
+            # placement: column-sharded -> row-sharded chunk ownership
+            stack = jax.lax.all_to_all(stack, "shard", split_axis=1,
+                                       concat_axis=2, tiled=True)
+            # scrub digest: cluster-wide reduction of encoded bytes
+            digest = jax.lax.psum(
+                jnp.sum(par.astype(jnp.uint32)), ("dp", "shard"))
+            return stack, digest
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=P("dp", None, "shard"),
+            out_specs=(P("dp", "shard", None), P()),
+        )
+
+    # ---------------- rebalance / backfill ----------------
+    def make_rebalance_step(self, rotate: int = 1):
+        """jit-able fn(stack (B, S, L)) -> stack with chunk-row ownership
+        rotated `rotate` positions around the shard ring (ppermute) — the
+        movement primitive behind pg-remap/backfill."""
+        n = self.n_shard
+
+        def local(stack_local):
+            perm = [(i, (i + rotate) % n) for i in range(n)]
+            return jax.lax.ppermute(stack_local, "shard", perm)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=P("dp", "shard", None),
+            out_specs=P("dp", "shard", None),
+        )
+
+    # ---------------- degraded read / recovery ----------------
+    def make_recovery_step(self, available: Sequence[int]):
+        """jit-able fn(stack (B, S, L)) -> data (B, k, L) decoding from the
+        static erasure signature `available` (>= k surviving chunk ids).
+
+        all_gathers surviving rows over the shard axis (the fan-in read),
+        decodes locally with the inverted matrix, returns column-sharded
+        data (ready for re-encode or client return).
+        """
+        k = self.codec.k
+        use = list(available)[:k]
+        dec = self.codec.decode_graph(use)
+
+        def local(stack_local):  # (b, S/n, L) — whole rows owned locally
+            b = stack_local.shape[0]
+            # inverse of the write placement: row-sharded -> column-sharded
+            # (each device sends every peer only the column slice it will
+            # decode — less ICI traffic than a full-row all_gather, and no
+            # decode work is discarded)
+            full = jax.lax.all_to_all(stack_local, "shard", split_axis=2,
+                                      concat_axis=1, tiled=True)  # (b,S,L/n)
+            Ll = full.shape[2]
+            surv = full[:, jnp.asarray(use), :]  # (b, k, L/n) static gather
+            folded = surv.transpose(1, 0, 2).reshape(k, b * Ll)
+            return dec(folded).reshape(k, b, Ll).transpose(1, 0, 2)
+
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=P("dp", "shard", None),
+            out_specs=P("dp", None, "shard"),
+        )
+
+    # ---------------- convenience: jitted end-to-end step ----------------
+    @functools.cached_property
+    def write_step(self):
+        return jax.jit(self.make_write_step())
+
+    def recovery_step(self, available: Sequence[int]):
+        """Jitted recovery step, cached per erasure signature (the decode
+        table cache of the reference, ErasureCodeIsa.cc:513-563)."""
+        key = tuple(available)
+        cache = self.__dict__.setdefault("_recovery_cache", {})
+        fn = cache.get(key)
+        if fn is None:
+            fn = jax.jit(self.make_recovery_step(available))
+            if len(cache) > 128:
+                cache.pop(next(iter(cache)))
+            cache[key] = fn
+        return fn

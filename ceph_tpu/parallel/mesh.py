@@ -1,0 +1,38 @@
+"""Device-mesh helpers.
+
+The cluster topology layer: where the reference wires messengers between
+OSD processes (src/ceph_osd.cc:550-630), the TPU build arranges devices in
+a jax.sharding.Mesh whose axes carry the parallelism strategies — "dp"
+(stripe batches, the PG-parallel analogue) x "shard" (chunk shards of one
+stripe, the acting-set analogue).  Collectives over these axes ride ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: int | None = None,
+              shard_axis: int | None = None,
+              devices=None) -> Mesh:
+    """Build a ("dp", "shard") mesh over the first n devices.
+
+    shard_axis defaults to the largest power-of-two divisor of n that is
+    <= 4 when n is small (keeping a nontrivial dp axis), so an 8-device CI
+    mesh becomes (dp=2, shard=4).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    devices = devices[:n]
+    if shard_axis is None:
+        shard_axis = 1
+        while shard_axis * 2 <= min(4, n) and n % (shard_axis * 2) == 0:
+            shard_axis *= 2
+    if n % shard_axis:
+        raise ValueError(f"{n} devices not divisible by shard={shard_axis}")
+    arr = np.array(devices).reshape(n // shard_axis, shard_axis)
+    return Mesh(arr, ("dp", "shard"))

@@ -1,0 +1,82 @@
+"""Device-side EC bench worker: run the batched encode pipeline on the
+default JAX backend and print one JSON line.
+
+Run as a subprocess by bench.py so a wedged TPU tunnel (axon) can be
+timed out without hanging the driver.  Measures both:
+- end_to_end_gbps: host numpy in -> device -> encode -> host chunks out
+  (the BASELINE.md rule: staging included), and
+- kernel_gbps: device-resident encode only (block_until_ready).
+GB/s counts source data bytes (iterations x size / elapsed / 2^30),
+matching the reference tool's convention (ceph_erasure_code_benchmark.cc:193).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--k", type=int, default=8)
+    p.add_argument("--m", type=int, default=3)
+    p.add_argument("--stripe-bytes", type=int, default=1024 * 1024)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--technique", default="reed_sol_van")
+    args = p.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    from ceph_tpu.ops import gf256
+    from ceph_tpu.ops.ec_kernels import RegionMatmul
+
+    if args.technique == "reed_sol_van":
+        M = gf256.vandermonde_matrix(args.k, args.m)
+    elif args.technique == "cauchy_good":
+        M = gf256.cauchy_good_matrix(args.k, args.m)
+    else:
+        M = gf256.cauchy_matrix(args.k, args.m)
+    op = RegionMatmul(M)
+
+    chunk = args.stripe_bytes // args.k
+    cols = args.batch * chunk  # stripes fold into the column axis
+    rng = np.random.default_rng(0)
+    host = rng.integers(0, 256, (args.k, cols), dtype=np.uint8)
+    nbytes = host.nbytes
+
+    # warm: compile + first transfer
+    np.asarray(op(host))
+
+    # end-to-end: host in -> parity back on host
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        np.asarray(op(host))
+    e2e = time.perf_counter() - t0
+
+    # kernel-only: device-resident input, parity left on device
+    dev = jax.device_put(host)
+    op(dev).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(args.reps):
+        op(dev).block_until_ready()
+    kern = time.perf_counter() - t0
+
+    print(json.dumps({
+        "backend": backend,
+        "k": args.k, "m": args.m, "stripe_bytes": args.stripe_bytes,
+        "batch": args.batch, "reps": args.reps,
+        "bytes_per_rep": nbytes,
+        "end_to_end_gbps": args.reps * nbytes / e2e / 2**30,
+        "kernel_gbps": args.reps * nbytes / kern / 2**30,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

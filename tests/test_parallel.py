@@ -1,0 +1,84 @@
+"""Distributed EC pipeline tests on the virtual 8-device CPU mesh.
+
+The multi-node-logic-in-one-process tier of the reference's test strategy
+(SURVEY.md §4 tier 2 — ECPeeringTestFixture style), with the mesh standing
+in for the cluster.
+"""
+
+import numpy as np
+import pytest
+import jax
+
+from ceph_tpu.models.stripe_codec import StripeCodec
+from ceph_tpu.parallel import DistributedStripeEC, make_mesh
+from ceph_tpu.ops import gf256
+
+RNG = np.random.default_rng(9)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def dec(mesh):
+    return DistributedStripeEC(StripeCodec(8, 3), mesh)
+
+
+def test_mesh_axes(mesh):
+    assert mesh.shape == {"dp": 2, "shard": 4}
+
+
+def test_write_step_systematic_and_parity(dec):
+    B, L = 4, 1024
+    data = RNG.integers(0, 256, (B, 8, L), dtype=np.uint8)
+    stack, digest = dec.write_step(data)
+    stack = np.asarray(stack)
+    assert stack.shape == (B, 12, L)  # k+m=11 padded to 12 shard rows
+    np.testing.assert_array_equal(stack[:, :8], data)
+    # parity rows match the single-device oracle per stripe
+    for b in range(B):
+        want = gf256.encode_region(dec.codec.matrix, data[b])
+        np.testing.assert_array_equal(stack[b, 8:11], want)
+    # spare row is zero
+    assert not stack[:, 11].any()
+    assert int(np.asarray(digest)) == int(stack[:, 8:11].astype(np.uint64).sum())
+
+
+def test_rebalance_roundtrip(dec):
+    B, L = 2, 512
+    data = RNG.integers(0, 256, (B, 8, L), dtype=np.uint8)
+    stack, _ = dec.write_step(data)
+    rot = jax.jit(dec.make_rebalance_step(1))
+    unrot = jax.jit(dec.make_rebalance_step(-1))
+    back = np.asarray(unrot(rot(stack)))
+    np.testing.assert_array_equal(back, np.asarray(stack))
+
+
+@pytest.mark.parametrize("erased", [(1, 4, 9), (0, 1, 2), (8, 9, 10)])
+def test_recovery_step(dec, erased):
+    B, L = 2, 512
+    data = RNG.integers(0, 256, (B, 8, L), dtype=np.uint8)
+    stack, _ = dec.write_step(data)
+    available = [i for i in range(11) if i not in erased][:8]
+    rec = np.asarray(dec.recovery_step(available)(stack))
+    np.testing.assert_array_equal(rec, data)
+
+
+def test_graft_entry_single():
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = np.asarray(jax.jit(fn)(*args))
+    k, n = args[0].shape
+    assert out.shape == (3, n)
+    want = gf256.encode_region(gf256.vandermonde_matrix(8, 3), args[0])
+    np.testing.assert_array_equal(out, want)
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
+    g.dryrun_multichip(4)
