@@ -1,0 +1,20 @@
+"""Core runtime (the reference's src/common layer, SURVEY.md §2.2):
+buffers, versioned wire codec, typed config, structured logging, perf
+counters, throttles, interval algebra, op tracking."""
+
+from .buffer import Buffer, BufferList
+from .codec import Decoder, Encoder, Encodable
+from .config import Config, Option, OptionLevel, default_config
+from .interval import IntervalSet
+from .log import ClusterLogger, dout, global_logger
+from .perf import (CounterType, PerfCounters, PerfCountersCollection,
+                   global_perf)
+from .throttle import Throttle
+from .tracked_op import OpTracker
+
+__all__ = [
+    "Buffer", "BufferList", "Decoder", "Encoder", "Encodable", "Config",
+    "Option", "OptionLevel", "default_config", "IntervalSet",
+    "ClusterLogger", "dout", "global_logger", "CounterType", "PerfCounters",
+    "PerfCountersCollection", "global_perf", "Throttle", "OpTracker",
+]

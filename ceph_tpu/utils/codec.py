@@ -1,0 +1,160 @@
+"""Versioned binary codec — the wire/disk encoding layer.
+
+The capability of the reference's src/include/encoding.h + denc.h
+(SURVEY.md layer 2): every struct encodes with a (version, compat,
+length)-framed section so old decoders can skip unknown tails
+(ENCODE_START/FINISH semantics) and new decoders can reject
+incompatibility.  The format here is its own little-endian framing, not
+the reference's — only the contract is mirrored:
+
+    [u8 version][u8 compat][u32 payload_len][payload...]
+
+Primitives are little-endian fixed width; varints deliberately avoided
+(predictable layout; bulk data rides Buffers, not the codec).
+"""
+
+from __future__ import annotations
+
+import struct
+from abc import ABC, abstractmethod
+from typing import Any, Callable, TypeVar
+
+T = TypeVar("T")
+
+
+class CodecError(Exception):
+    pass
+
+
+class Encoder:
+    __slots__ = ("_parts",)
+
+    def __init__(self):
+        self._parts: list[bytes] = []
+
+    # -- primitives --------------------------------------------------------
+    def u8(self, v: int): self._parts.append(struct.pack("<B", v))
+    def u16(self, v: int): self._parts.append(struct.pack("<H", v))
+    def u32(self, v: int): self._parts.append(struct.pack("<I", v))
+    def u64(self, v: int): self._parts.append(struct.pack("<Q", v))
+    def i64(self, v: int): self._parts.append(struct.pack("<q", v))
+    def f64(self, v: float): self._parts.append(struct.pack("<d", v))
+    def boolean(self, v: bool): self.u8(1 if v else 0)
+
+    def blob(self, v: bytes):
+        self.u32(len(v))
+        self._parts.append(bytes(v))
+
+    def string(self, v: str):
+        self.blob(v.encode("utf-8"))
+
+    def seq(self, items, item_fn: Callable[["Encoder", Any], None]):
+        items = list(items)
+        self.u32(len(items))
+        for it in items:
+            item_fn(self, it)
+
+    def mapping(self, d: dict, key_fn, val_fn):
+        self.u32(len(d))
+        for k in sorted(d):
+            key_fn(self, k)
+            val_fn(self, d[k])
+
+    def optional(self, v, fn):
+        self.boolean(v is not None)
+        if v is not None:
+            fn(self, v)
+
+    def obj(self, v: "Encodable"):
+        v.encode(self)
+
+    # -- versioned section (ENCODE_START/FINISH) ---------------------------
+    def versioned(self, version: int, compat: int,
+                  body: Callable[["Encoder"], None]):
+        sub = Encoder()
+        body(sub)
+        payload = sub.tobytes()
+        self.u8(version)
+        self.u8(compat)
+        self.blob(payload)
+
+    def tobytes(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Decoder:
+    __slots__ = ("_buf", "_pos")
+
+    def __init__(self, data: bytes):
+        self._buf = bytes(data)
+        self._pos = 0
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._buf):
+            raise CodecError(f"decode past end (+{n} at {self._pos}/"
+                             f"{len(self._buf)})")
+        b = self._buf[self._pos:self._pos + n]
+        self._pos += n
+        return b
+
+    def u8(self) -> int: return self._take(1)[0]
+    def u16(self) -> int: return struct.unpack("<H", self._take(2))[0]
+    def u32(self) -> int: return struct.unpack("<I", self._take(4))[0]
+    def u64(self) -> int: return struct.unpack("<Q", self._take(8))[0]
+    def i64(self) -> int: return struct.unpack("<q", self._take(8))[0]
+    def f64(self) -> float: return struct.unpack("<d", self._take(8))[0]
+    def boolean(self) -> bool: return self.u8() != 0
+
+    def blob(self) -> bytes:
+        return self._take(self.u32())
+
+    def string(self) -> str:
+        return self.blob().decode("utf-8")
+
+    def seq(self, item_fn: Callable[["Decoder"], T]) -> list[T]:
+        return [item_fn(self) for _ in range(self.u32())]
+
+    def mapping(self, key_fn, val_fn) -> dict:
+        return {key_fn(self): val_fn(self) for _ in range(self.u32())}
+
+    def optional(self, fn):
+        return fn(self) if self.boolean() else None
+
+    def remaining(self) -> int:
+        return len(self._buf) - self._pos
+
+    # -- versioned section (DECODE_START/FINISH) ---------------------------
+    def versioned(self, my_version: int,
+                  body: Callable[["Decoder", int], T]) -> T:
+        """Decode a versioned section.  `body(dec, struct_version)` reads
+        what it understands; any unknown tail is skipped (forward compat).
+        Raises if the encoder demanded more than we support (compat >
+        my_version)."""
+        version = self.u8()
+        compat = self.u8()
+        payload = self.blob()
+        if compat > my_version:
+            raise CodecError(
+                f"incompatible encoding: needs >= v{compat}, have v{my_version}")
+        sub = Decoder(payload)
+        return body(sub, version)
+
+
+class Encodable(ABC):
+    """Objects with versioned encode/decode (the struct encoding trait)."""
+
+    @abstractmethod
+    def encode(self, enc: Encoder) -> None: ...
+
+    @classmethod
+    @abstractmethod
+    def decode(cls, dec: Decoder) -> "Encodable": ...
+
+    def encode_bytes(self) -> bytes:
+        e = Encoder()
+        self.encode(e)
+        return e.tobytes()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls.decode(Decoder(data))

@@ -1,0 +1,192 @@
+"""Typed configuration from a single option schema.
+
+The capability of the reference's config system (src/common/config.cc +
+options/*.yaml.in codegen + md_config_obs_t observers — SURVEY.md §2.2 and
+§5 Config/flags): one declarative schema source produces typed accessors,
+validation, self-documentation, and runtime-change observers.  Here the
+schema source is Python Option declarations (the yaml->codegen step
+collapses away); layering is defaults < file < env < runtime overrides,
+mirroring ceph.conf < env < cli < admin-socket.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+
+class OptionLevel(enum.Enum):
+    BASIC = "basic"
+    ADVANCED = "advanced"
+    DEV = "dev"
+
+
+class ConfigError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class Option:
+    """One typed option (the reference's Option yaml entry)."""
+
+    name: str
+    type: type  # int | float | bool | str
+    default: Any
+    level: OptionLevel = OptionLevel.ADVANCED
+    desc: str = ""
+    min: Any = None
+    max: Any = None
+    enum_values: tuple = ()
+    see_also: tuple = ()
+    startup: bool = False  # cannot change at runtime (flags: [startup])
+
+    def validate(self, value: Any) -> Any:
+        try:
+            if self.type is bool and isinstance(value, str):
+                if value.lower() in ("true", "1", "yes", "on"):
+                    value = True
+                elif value.lower() in ("false", "0", "no", "off"):
+                    value = False
+                else:
+                    raise ValueError(value)
+            else:
+                value = self.type(value)
+        except (TypeError, ValueError) as e:
+            raise ConfigError(
+                f"{self.name}: {value!r} is not {self.type.__name__}") from e
+        if self.min is not None and value < self.min:
+            raise ConfigError(f"{self.name}: {value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ConfigError(f"{self.name}: {value} > max {self.max}")
+        if self.enum_values and value not in self.enum_values:
+            raise ConfigError(
+                f"{self.name}: {value!r} not in {self.enum_values}")
+        return value
+
+
+class Config:
+    """Typed config instance over a schema (md_config_t + config_proxy)."""
+
+    def __init__(self, schema: Iterable[Option]):
+        self._schema: dict[str, Option] = {o.name: o for o in schema}
+        self._values: dict[str, Any] = {}
+        self._observers: dict[str, list[Callable[[str, Any], None]]] = {}
+        self._lock = threading.RLock()
+        self._started = False
+
+    # -- access ------------------------------------------------------------
+    def get(self, name: str) -> Any:
+        opt = self._opt(name)
+        with self._lock:
+            return self._values.get(name, opt.default)
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any) -> None:
+        opt = self._opt(name)
+        value = opt.validate(value)
+        with self._lock:
+            if self._started and opt.startup:
+                raise ConfigError(f"{name} can only be set at startup")
+            self._values[name] = value
+            observers = list(self._observers.get(name, ()))
+        for cb in observers:
+            cb(name, value)
+
+    def mark_started(self) -> None:
+        """After this, startup-flagged options are frozen."""
+        self._started = True
+
+    # -- bulk layers -------------------------------------------------------
+    def apply_dict(self, values: dict[str, Any]) -> None:
+        for k, v in values.items():
+            self.set(k, v)
+
+    def apply_env(self, prefix: str = "CEPH_TPU_") -> None:
+        for k, v in os.environ.items():
+            if k.startswith(prefix):
+                name = k[len(prefix):].lower()
+                if name in self._schema:
+                    self.set(name, v)
+
+    def apply_file(self, path: str) -> None:
+        """JSON config file ({"option": value, ...})."""
+        with open(path) as f:
+            self.apply_dict(json.load(f))
+
+    # -- observers (md_config_obs_t) ---------------------------------------
+    def observe(self, name: str, cb: Callable[[str, Any], None]) -> None:
+        self._opt(name)
+        with self._lock:
+            self._observers.setdefault(name, []).append(cb)
+
+    # -- introspection (`config help`) -------------------------------------
+    def help(self, name: str) -> dict:
+        o = self._opt(name)
+        return {
+            "name": o.name, "type": o.type.__name__, "default": o.default,
+            "level": o.level.value, "desc": o.desc, "min": o.min,
+            "max": o.max, "enum_values": list(o.enum_values),
+            "see_also": list(o.see_also), "startup": o.startup,
+            "current": self.get(name),
+        }
+
+    def dump(self) -> dict[str, Any]:
+        with self._lock:
+            return {n: self._values.get(n, o.default)
+                    for n, o in sorted(self._schema.items())}
+
+    def schema(self) -> dict[str, Option]:
+        return dict(self._schema)
+
+    def _opt(self, name: str) -> Option:
+        opt = self._schema.get(name)
+        if opt is None:
+            raise ConfigError(f"unknown option {name!r}")
+        return opt
+
+
+# ---------------------------------------------------------------------------
+# The framework's option schema (the options/*.yaml.in equivalent).
+# Components extend this list as they land.
+# ---------------------------------------------------------------------------
+
+OPTIONS: list[Option] = [
+    Option("ec_plugin", str, "tpu", OptionLevel.BASIC,
+           "default erasure-code plugin for new pools",
+           enum_values=("tpu", "jerasure", "isa", "xor", "lrc", "shec",
+                        "clay")),
+    Option("ec_backend", str, "auto", OptionLevel.ADVANCED,
+           "region math backend", enum_values=("auto", "native", "numpy",
+                                               "jax")),
+    Option("osd_pool_default_size", int, 3, OptionLevel.BASIC,
+           "default replica count", min=1, max=32),
+    Option("osd_pool_default_pg_num", int, 32, OptionLevel.BASIC,
+           "default PG count per pool", min=1, max=65536),
+    Option("osd_heartbeat_interval", float, 0.5, OptionLevel.ADVANCED,
+           "seconds between peer heartbeats", min=0.01, max=60.0),
+    Option("osd_heartbeat_grace", float, 3.0, OptionLevel.ADVANCED,
+           "base grace before reporting a peer down", min=0.1, max=600.0),
+    Option("mon_osd_min_down_reporters", int, 2, OptionLevel.ADVANCED,
+           "distinct reporters required to mark an osd down", min=1),
+    Option("osd_op_num_shards", int, 4, OptionLevel.ADVANCED,
+           "op scheduler shard queues per osd", min=1, max=64),
+    Option("osd_client_message_cap", int, 256, OptionLevel.ADVANCED,
+           "max in-flight client messages per osd (throttle)", min=1),
+    Option("log_level", int, 1, OptionLevel.BASIC,
+           "default log verbosity", min=-1, max=20),
+    Option("log_recent_size", int, 10000, OptionLevel.DEV,
+           "ring size of recent log entries kept for crash dump", min=100,
+           startup=True),
+    Option("ec_stripe_batch", int, 64, OptionLevel.ADVANCED,
+           "stripes batched per device EC launch", min=1, max=4096),
+]
+
+
+def default_config() -> Config:
+    return Config(OPTIONS)

@@ -1,0 +1,109 @@
+"""Interval algebra: IntervalSet over [start, end) extents.
+
+The capability of the reference's interval_set/interval_map
+(src/include/interval_set.h, src/common/interval_map.h — SURVEY.md §2.2),
+the substrate of extent maps (extent_map = interval_map<u64, bufferlist>,
+ECUtil.h:60-62) and recovery/scrub range bookkeeping.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterator
+
+
+class IntervalSet:
+    """Sorted, coalesced set of half-open integer intervals."""
+
+    def __init__(self, intervals=None):
+        self._starts: list[int] = []
+        self._ends: list[int] = []
+        if intervals:
+            for s, e in intervals:
+                self.insert(s, e - s)
+
+    # -- mutation ----------------------------------------------------------
+    def insert(self, start: int, length: int) -> None:
+        if length <= 0:
+            return
+        end = start + length
+        i = bisect.bisect_left(self._ends, start)  # first iv ending >= start
+        j = bisect.bisect_right(self._starts, end)  # last iv starting <= end
+        if i < j:  # overlaps/touches [i, j)
+            start = min(start, self._starts[i])
+            end = max(end, self._ends[j - 1])
+        self._starts[i:j] = [start]
+        self._ends[i:j] = [end]
+
+    def erase(self, start: int, length: int) -> None:
+        if length <= 0:
+            return
+        end = start + length
+        new_s, new_e = [], []
+        for s, e in zip(self._starts, self._ends):
+            if e <= start or s >= end:
+                new_s.append(s)
+                new_e.append(e)
+                continue
+            if s < start:
+                new_s.append(s)
+                new_e.append(start)
+            if e > end:
+                new_s.append(end)
+                new_e.append(e)
+        self._starts, self._ends = new_s, new_e
+
+    def union(self, other: "IntervalSet") -> "IntervalSet":
+        out = IntervalSet(self)
+        for s, e in other:
+            out.insert(s, e - s)
+        return out
+
+    def intersect(self, other: "IntervalSet") -> "IntervalSet":
+        out = IntervalSet()
+        for s1, e1 in self:
+            for s2, e2 in other:
+                s, e = max(s1, s2), min(e1, e2)
+                if s < e:
+                    out.insert(s, e - s)
+        return out
+
+    # -- queries -----------------------------------------------------------
+    def contains(self, start: int, length: int = 1) -> bool:
+        end = start + length
+        i = bisect.bisect_right(self._starts, start) - 1
+        return i >= 0 and self._ends[i] >= end and self._starts[i] <= start
+
+    def intersects(self, start: int, length: int) -> bool:
+        if length <= 0:
+            return False
+        end = start + length
+        i = bisect.bisect_left(self._ends, start + 1)
+        return i < len(self._starts) and self._starts[i] < end
+
+    def size(self) -> int:
+        return sum(e - s for s, e in self)
+
+    def num_intervals(self) -> int:
+        return len(self._starts)
+
+    def empty(self) -> bool:
+        return not self._starts
+
+    def range_start(self) -> int:
+        return self._starts[0]
+
+    def range_end(self) -> int:
+        return self._ends[-1]
+
+    def __iter__(self) -> Iterator[tuple[int, int]]:
+        return iter(zip(self._starts, self._ends))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, IntervalSet)
+                and self._starts == other._starts
+                and self._ends == other._ends)
+
+    def __repr__(self) -> str:
+        ivs = ", ".join(f"[{s},{e})" for s, e in self)
+        return f"IntervalSet({ivs})"

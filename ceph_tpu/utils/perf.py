@@ -1,0 +1,156 @@
+"""Perf counters: typed metrics registries with structured dump.
+
+The capability of the reference's PerfCounters machinery
+(src/common/perf_counters.h types :44-52, labeled counters
+perf_counters_key.h, collection + admin-socket `perf dump`,
+perf_histogram.h — SURVEY.md §2.2): every component registers typed
+counters; a process-wide collection dumps them all as one document
+(what mgr/prometheus scrape in the reference).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+import threading
+import time
+from typing import Iterable
+
+
+class CounterType(enum.Enum):
+    U64 = "u64"            # gauge (settable)
+    COUNTER = "counter"    # monotonic increments
+    TIME = "time"          # accumulated seconds
+    LONGRUNAVG = "longrunavg"  # sum + count -> average
+    HISTOGRAM = "histogram"    # pow-2 bucket counts
+
+
+class _Counter:
+    __slots__ = ("name", "type", "desc", "value", "sum", "count", "buckets")
+
+    def __init__(self, name: str, ctype: CounterType, desc: str):
+        self.name = name
+        self.type = ctype
+        self.desc = desc
+        self.value = 0
+        self.sum = 0.0
+        self.count = 0
+        self.buckets = [0] * 64 if ctype == CounterType.HISTOGRAM else None
+
+
+class PerfCounters:
+    """One component's counters (a PerfCounters instance)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: dict[str, _Counter] = {}
+        self._lock = threading.Lock()
+
+    def add(self, name: str, ctype: CounterType = CounterType.COUNTER,
+            desc: str = "") -> None:
+        with self._lock:
+            self._counters[name] = _Counter(name, ctype, desc)
+
+    def add_many(self, names: Iterable[str],
+                 ctype: CounterType = CounterType.COUNTER) -> None:
+        for n in names:
+            self.add(n, ctype)
+
+    def _get(self, name: str) -> _Counter:
+        c = self._counters.get(name)
+        if c is None:
+            raise KeyError(f"{self.name}: no counter {name!r}")
+        return c
+
+    def inc(self, name: str, by: int = 1) -> None:
+        c = self._get(name)
+        with self._lock:
+            c.value += by
+
+    def set(self, name: str, value) -> None:
+        c = self._get(name)
+        with self._lock:
+            c.value = value
+
+    def tinc(self, name: str, seconds: float) -> None:
+        c = self._get(name)
+        with self._lock:
+            c.sum += seconds
+            c.count += 1
+
+    def time(self, name: str):
+        """Context manager accumulating elapsed seconds."""
+        pc = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                pc.tinc(name, time.perf_counter() - self._t0)
+                return False
+
+        return _Timer()
+
+    def hinc(self, name: str, value: float) -> None:
+        c = self._get(name)
+        b = min(63, max(0, int(math.log2(value)) + 1) if value >= 1 else 0)
+        with self._lock:
+            c.buckets[b] += 1
+            c.count += 1
+
+    def avg(self, name: str) -> float:
+        c = self._get(name)
+        return c.sum / c.count if c.count else 0.0
+
+    def get(self, name: str):
+        return self._get(name).value
+
+    def dump(self) -> dict:
+        out = {}
+        with self._lock:
+            for n, c in sorted(self._counters.items()):
+                if c.type in (CounterType.U64, CounterType.COUNTER):
+                    out[n] = c.value
+                elif c.type == CounterType.TIME:
+                    out[n] = {"sum_seconds": c.sum, "count": c.count}
+                elif c.type == CounterType.LONGRUNAVG:
+                    out[n] = {"sum": c.sum, "count": c.count,
+                              "avg": c.sum / c.count if c.count else 0.0}
+                else:
+                    nz = {i: v for i, v in enumerate(c.buckets) if v}
+                    out[n] = {"buckets_pow2": nz, "count": c.count}
+        return out
+
+
+class PerfCountersCollection:
+    """Process-wide registry (perf_counters_collection + `perf dump`)."""
+
+    def __init__(self):
+        self._registries: dict[str, PerfCounters] = {}
+        self._lock = threading.Lock()
+
+    def create(self, name: str) -> PerfCounters:
+        with self._lock:
+            pc = self._registries.get(name)
+            if pc is None:
+                pc = PerfCounters(name)
+                self._registries[name] = pc
+            return pc
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._registries.pop(name, None)
+
+    def dump(self) -> dict:
+        with self._lock:
+            regs = dict(self._registries)
+        return {n: r.dump() for n, r in sorted(regs.items())}
+
+
+_GLOBAL = PerfCountersCollection()
+
+
+def global_perf() -> PerfCountersCollection:
+    return _GLOBAL

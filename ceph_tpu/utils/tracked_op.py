@@ -1,0 +1,94 @@
+"""Op tracking: per-op event timelines, in-flight dump, slow-op detection.
+
+The capability of the reference's TrackedOp/OpTracker
+(src/common/TrackedOp.{h,cc} — SURVEY.md §2.2): every in-flight operation
+records timestamped state marks; operators can dump in-flight and historic
+ops; ops exceeding a threshold are counted as slow.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+
+class TrackedOp:
+    __slots__ = ("tracker", "op_id", "desc", "start", "events", "done")
+
+    def __init__(self, tracker: "OpTracker", op_id: int, desc: str):
+        self.tracker = tracker
+        self.op_id = op_id
+        self.desc = desc
+        self.start = time.time()
+        self.events: list[tuple[float, str]] = [(self.start, "initiated")]
+        self.done = False
+
+    def mark(self, event: str) -> None:
+        self.events.append((time.time(), event))
+
+    def finish(self) -> None:
+        if not self.done:
+            self.mark("done")
+            self.done = True
+            self.tracker._finish(self)
+
+    def age(self) -> float:
+        return time.time() - self.start
+
+    def dump(self) -> dict:
+        return {
+            "id": self.op_id, "description": self.desc,
+            "age_seconds": self.age(), "done": self.done,
+            "events": [{"at": t, "event": e} for t, e in self.events],
+        }
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 256, slow_op_seconds: float = 5.0):
+        self._ids = itertools.count(1)
+        self._inflight: dict[int, TrackedOp] = {}
+        self._history: collections.deque[dict] = collections.deque(
+            maxlen=history_size)
+        self._slow_threshold = slow_op_seconds
+        self._slow_count = 0
+        self._lock = threading.Lock()
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, next(self._ids), desc)
+        with self._lock:
+            self._inflight[op.op_id] = op
+        return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._inflight.pop(op.op_id, None)
+            if op.age() >= self._slow_threshold:
+                self._slow_count += 1
+            self._history.append(op.dump())
+
+    def dump_ops_in_flight(self) -> list[dict]:
+        with self._lock:
+            return [o.dump() for o in self._inflight.values()]
+
+    def dump_historic_ops(self) -> list[dict]:
+        with self._lock:
+            return list(self._history)
+
+    def slow_ops(self) -> list[dict]:
+        """Currently in-flight ops past the slow threshold."""
+        with self._lock:
+            return [o.dump() for o in self._inflight.values()
+                    if o.age() >= self._slow_threshold]
+
+    @property
+    def slow_op_count(self) -> int:
+        return self._slow_count
