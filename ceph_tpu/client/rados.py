@@ -1,0 +1,182 @@
+"""RadosClient: the librados-shaped client + objecter.
+
+The capability of the reference's client stack (librados IoCtx API
+src/librados/librados_c.cc; Objecter op engine src/osdc/Objecter.cc:
+op_submit :2412 -> _calc_target :3082 computes the PG/primary from the
+osdmap via CRUSH -> _send_op :3597, resend on map change): the client
+subscribes to the monitor for maps, computes placement itself (pure
+function of the map — no lookup service), sends MOSDOp to the primary,
+and retries with a refreshed map on ESTALE/timeout.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+
+from ..mon.maps import OSDMap
+from ..msg.messages import (MMapPush, MMonCommand, MMonCommandReply,
+                            MMonSubscribe, MOSDOp, MOSDOpReply)
+from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..utils.log import dout
+
+
+class RadosError(Exception):
+    def __init__(self, code: int, what: str = ""):
+        super().__init__(f"rados error {code}: {what}")
+        self.code = code
+
+
+class TimeoutError_(RadosError):
+    def __init__(self, what: str):
+        super().__init__(-110, what)  # ETIMEDOUT
+
+
+class RadosClient(Dispatcher):
+    def __init__(self, network: LocalNetwork, name: str = "client.0",
+                 mon: str = "mon.0", timeout: float = 10.0):
+        self.name = name
+        self.mon = mon
+        self.timeout = timeout
+        self.messenger = Messenger(network, name, Policy.lossless_peer())
+        self.messenger.add_dispatcher(self)
+        self.osdmap: OSDMap | None = None
+        self._tids = itertools.count(1)
+        self._waiters: dict[int, threading.Event] = {}
+        self._replies: dict[int, object] = {}
+        self._map_cond = threading.Condition()
+
+    # ------------------------------------------------------------ lifecycle
+    def connect(self) -> "RadosClient":
+        self.messenger.start()
+        self.messenger.send_message(self.mon, MMonSubscribe("osdmap"))
+        with self._map_cond:
+            if not self._map_cond.wait_for(
+                    lambda: self.osdmap is not None, timeout=self.timeout):
+                raise TimeoutError_("no osdmap from monitor")
+        return self
+
+    def close(self) -> None:
+        self.messenger.shutdown()
+
+    # ------------------------------------------------------------- dispatch
+    def ms_dispatch(self, conn, msg) -> bool:
+        if isinstance(msg, MMapPush):
+            with self._map_cond:
+                m = OSDMap.decode_bytes(msg.map_bytes)
+                if self.osdmap is None or m.epoch > self.osdmap.epoch:
+                    self.osdmap = m
+                self._map_cond.notify_all()
+            return True
+        if isinstance(msg, (MOSDOpReply, MMonCommandReply)):
+            ev = self._waiters.get(msg.tid)
+            if ev is not None:
+                self._replies[msg.tid] = msg
+                ev.set()
+            return True
+        return False
+
+    # ------------------------------------------------------------ plumbing
+    def _rpc(self, target: str, msg, tid: int, timeout: float | None = None):
+        ev = threading.Event()
+        self._waiters[tid] = ev
+        try:
+            self.messenger.send_message(target, msg)
+            if not ev.wait(timeout or self.timeout):
+                raise TimeoutError_(f"rpc to {target} tid {tid}")
+            return self._replies.pop(tid)
+        finally:
+            self._waiters.pop(tid, None)
+            self._replies.pop(tid, None)
+
+    def _wait_epoch_past(self, epoch: int, timeout: float) -> None:
+        with self._map_cond:
+            self._map_cond.wait_for(
+                lambda: self.osdmap is not None
+                and self.osdmap.epoch > epoch, timeout=timeout)
+
+    # ----------------------------------------------------------- mon admin
+    def mon_command(self, cmd: dict) -> dict:
+        tid = next(self._tids)
+        reply = self._rpc(self.mon, MMonCommand(tid, cmd), tid)
+        if reply.result != 0:
+            raise RadosError(reply.result, str(reply.data))
+        return reply.data
+
+    def create_pool(self, name: str, kind: str = "replicated",
+                    size: int = 3, pg_num: int = 8,
+                    ec_profile: dict | None = None) -> int:
+        data = self.mon_command({
+            "prefix": "osd pool create", "name": name, "kind": kind,
+            "size": size, "pg_num": pg_num, "ec_profile": ec_profile or {}})
+        # placement changes with the new pool; wait for our map to catch up
+        self._wait_epoch_past(0, self.timeout)
+        with self._map_cond:
+            self._map_cond.wait_for(
+                lambda: data["pool_id"] in self.osdmap.pools,
+                timeout=self.timeout)
+        return data["pool_id"]
+
+    def status(self) -> dict:
+        return self.mon_command({"prefix": "status"})
+
+    # ------------------------------------------------------------ object IO
+    def _pool_id(self, pool_name: str) -> int:
+        if self.osdmap is None:
+            raise RadosError(-108, "not connected")
+        for p in self.osdmap.pools.values():
+            if p.name == pool_name:
+                return p.pool_id
+        raise RadosError(-2, f"no pool {pool_name!r}")
+
+    def _primary_for(self, pool_id: int, oid: str) -> str:
+        seed = self.osdmap.object_to_pg(pool_id, oid)
+        up = self.osdmap.pg_to_up_osds(pool_id, seed)
+        for u in up:
+            if u is not None:
+                return f"osd.{u}"
+        raise RadosError(-5, f"pg {pool_id}.{seed:x} has no up osds")
+
+    def _op(self, pool_name: str, oid: str, op: str, data: bytes = b"",
+            offset: int = 0, length: int = 0):
+        pool_id = self._pool_id(pool_name)
+        last_error: RadosError | None = None
+        for attempt in range(8):
+            target = self._primary_for(pool_id, oid)
+            tid = next(self._tids)
+            m = MOSDOp(tid, self.name, pool_id, oid, op, offset, length,
+                       data, self.osdmap.epoch)
+            try:
+                reply = self._rpc(target, m, tid)
+            except TimeoutError_ as e:
+                # primary may have died; wait for a newer map and retry
+                # (the Objecter resend-on-map-change behaviour)
+                dout("client", 5)("%s: rpc timeout to %s, retrying",
+                                 self.name, target)
+                last_error = e
+                self._wait_epoch_past(self.osdmap.epoch, self.timeout)
+                continue
+            if reply.result == -116:  # ESTALE: not primary under its map
+                self._wait_epoch_past(min(self.osdmap.epoch, reply.epoch - 1),
+                                      self.timeout)
+                last_error = RadosError(-116, "stale map")
+                continue
+            if reply.result < 0:
+                raise RadosError(reply.result, f"{op} {pool_name}/{oid}")
+            return reply
+        raise last_error or RadosError(-5, "retries exhausted")
+
+    def write_full(self, pool: str, oid: str, data: bytes) -> int:
+        return self._op(pool, oid, "write", bytes(data)).version
+
+    def read(self, pool: str, oid: str, offset: int = 0,
+             length: int = 0) -> bytes:
+        return self._op(pool, oid, "read", offset=offset,
+                        length=length).data
+
+    def remove(self, pool: str, oid: str) -> None:
+        self._op(pool, oid, "remove")
+
+    def stat(self, pool: str, oid: str) -> int:
+        reply = self._op(pool, oid, "stat")
+        return int.from_bytes(reply.data, "little")

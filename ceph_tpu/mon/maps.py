@@ -1,0 +1,187 @@
+"""Cluster maps: OSDMap with epochs, pools, device states, placement.
+
+The capability of the reference's OSDMap (src/osd/OSDMap.{h,cc}: epochs +
+incrementals, up/in states and weights, pool table, pg_to_up_acting_osds
+:3143 combining CRUSH output with overrides) re-shaped for the TPU build:
+the map embeds a PlacementMap (CRUSH-equivalent) and is an Encodable so it
+travels the messenger and persists in the monitor store.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+
+from ..parallel.placement import PlacementMap, hash_combine, pg_of_object
+from ..utils.codec import Decoder, Encodable, Encoder
+
+
+@dataclass
+class PoolSpec(Encodable):
+    pool_id: int
+    name: str
+    kind: str = "replicated"  # replicated | ec
+    size: int = 3             # replicas, or k+m for ec
+    min_size: int = 2
+    pg_num: int = 32
+    ec_profile: dict = field(default_factory=dict)
+
+    VERSION, COMPAT = 1, 1
+
+    def encode(self, enc: Encoder) -> None:
+        def body(e: Encoder):
+            e.u64(self.pool_id)
+            e.string(self.name)
+            e.string(self.kind)
+            e.u32(self.size)
+            e.u32(self.min_size)
+            e.u32(self.pg_num)
+            e.mapping(self.ec_profile, Encoder.string, Encoder.string)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "PoolSpec":
+        def body(d: Decoder, v: int):
+            return cls(d.u64(), d.string(), d.string(), d.u32(), d.u32(),
+                       d.u32(), d.mapping(Decoder.string, Decoder.string))
+        return dec.versioned(cls.VERSION, body)
+
+
+@dataclass
+class OsdInfo(Encodable):
+    osd_id: int
+    up: bool = False
+    in_cluster: bool = True
+    weight: float = 1.0
+    host: str = ""
+    addr: str = ""  # messenger address
+
+    VERSION, COMPAT = 1, 1
+
+    def encode(self, enc: Encoder) -> None:
+        def body(e: Encoder):
+            e.u32(self.osd_id)
+            e.boolean(self.up)
+            e.boolean(self.in_cluster)
+            e.f64(self.weight)
+            e.string(self.host)
+            e.string(self.addr)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "OsdInfo":
+        def body(d: Decoder, v: int):
+            return cls(d.u32(), d.boolean(), d.boolean(), d.f64(),
+                       d.string(), d.string())
+        return dec.versioned(cls.VERSION, body)
+
+
+class OSDMap(Encodable):
+    """Epoch-versioned cluster map; placement is a pure function of it."""
+
+    VERSION, COMPAT = 1, 1
+
+    def __init__(self):
+        self.epoch = 0
+        self.osds: dict[int, OsdInfo] = {}
+        self.pools: dict[int, PoolSpec] = {}
+        self.next_pool_id = 1
+
+    # -- mutation (monitor-side; bumps epoch through Monitor) --------------
+    def add_osd(self, osd_id: int, host: str, addr: str = "",
+                weight: float = 1.0) -> None:
+        self.osds[osd_id] = OsdInfo(osd_id, up=False, in_cluster=True,
+                                    weight=weight, host=host, addr=addr)
+
+    def mark_up(self, osd_id: int, addr: str = "") -> None:
+        info = self.osds[osd_id]
+        info.up = True
+        if addr:
+            info.addr = addr
+
+    def mark_down(self, osd_id: int) -> None:
+        if osd_id in self.osds:
+            self.osds[osd_id].up = False
+
+    def mark_out(self, osd_id: int) -> None:
+        if osd_id in self.osds:
+            self.osds[osd_id].in_cluster = False
+
+    def add_pool(self, spec: PoolSpec) -> None:
+        self.pools[spec.pool_id] = spec
+        self.next_pool_id = max(self.next_pool_id, spec.pool_id + 1)
+
+    # -- placement (client AND server evaluate this identically) ----------
+    def placement(self) -> PlacementMap:
+        pm = PlacementMap()
+        for o in self.osds.values():
+            if o.in_cluster:
+                pm.add_device(o.osd_id, o.weight, o.host)
+        return pm
+
+    def pg_to_osds(self, pool_id: int, pg_seed: int) -> list[int]:
+        """Raw placement: ordered device ids for this PG (the
+        _pg_to_raw_osds step)."""
+        pool = self.pools[pool_id]
+        key = hash_combine("pg", pool_id, pg_seed)
+        return self.placement().select(key, pool.size)
+
+    def pg_to_up_osds(self, pool_id: int, pg_seed: int) -> list[int]:
+        """Up set: raw placement with down devices re-drawn (the up/acting
+        derivation; pg_temp overrides come in with async recovery).  For EC
+        pools, positions are shard ids, so a down device leaves a hole
+        (None) rather than shifting shards."""
+        pool = self.pools[pool_id]
+        key = hash_combine("pg", pool_id, pg_seed)
+        pm = self.placement()
+
+        def down(dev_id: int) -> bool:
+            o = self.osds.get(dev_id)
+            return o is None or not o.up
+
+        raw = pm.select(key, pool.size)
+        if pool.kind == "ec":
+            # keep shard positions stable; holes where devices are down
+            healthy = pm.select(key, pool.size, reject=down)
+            out: list[int | None] = []
+            spares = [d for d in healthy if d not in raw]
+            for d in raw:
+                if not down(d):
+                    out.append(d)
+                else:
+                    out.append(spares.pop(0) if spares else None)
+            return out
+        return pm.select(key, pool.size, reject=down)
+
+    def object_to_pg(self, pool_id: int, name: str) -> int:
+        return pg_of_object(name, self.pools[pool_id].pg_num)
+
+    def up_osds(self) -> list[int]:
+        return sorted(o.osd_id for o in self.osds.values() if o.up)
+
+    def deepcopy(self) -> "OSDMap":
+        return copy.deepcopy(self)
+
+    # -- encoding ----------------------------------------------------------
+    def encode(self, enc: Encoder) -> None:
+        def body(e: Encoder):
+            e.u64(self.epoch)
+            e.seq(sorted(self.osds.values(), key=lambda o: o.osd_id),
+                  lambda ee, o: o.encode(ee))
+            e.seq(sorted(self.pools.values(), key=lambda p: p.pool_id),
+                  lambda ee, p: p.encode(ee))
+            e.u64(self.next_pool_id)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "OSDMap":
+        def body(d: Decoder, v: int):
+            m = cls()
+            m.epoch = d.u64()
+            for o in d.seq(OsdInfo.decode):
+                m.osds[o.osd_id] = o
+            for p in d.seq(PoolSpec.decode):
+                m.pools[p.pool_id] = p
+            m.next_pool_id = d.u64()
+            return m
+        return dec.versioned(cls.VERSION, body)

@@ -1,0 +1,224 @@
+"""Monitor-lite: the control plane's single source of cluster-map truth.
+
+The capability of the reference's Monitor + PaxosService stack
+(src/mon/Monitor.cc command dispatch, OSDMonitor map mutations incl.
+prepare_failure :3393 with reporter thresholds and adaptive grace
+:3261-3266, pool create -> EC profile -> plugin factory :1977,
+MonitorDBStore versioned persistence — SURVEY.md §2.4), scoped for this
+round to a single monitor: every map mutation is a versioned commit in a
+MonStore (the Paxos log's shape, so a multi-mon Paxos/Raft quorum can
+replace the single writer without changing callers), and new epochs push
+to all subscribers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from .. import ec
+from ..msg.messages import (MFailureReport, MMapPush, MMonCommand,
+                            MMonCommandReply, MMonSubscribe, MOSDBoot)
+from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..utils.config import Config, default_config
+from ..utils.log import dout
+from .maps import OSDMap, PoolSpec
+
+
+class MonStore:
+    """Versioned commit log + latest-state KV (MonitorDBStore's shape)."""
+
+    def __init__(self):
+        self.version = 0
+        self.log: list[tuple[int, str, bytes]] = []
+        self.kv: dict[str, bytes] = {}
+
+    def commit(self, key: str, value: bytes, desc: str) -> int:
+        self.version += 1
+        self.log.append((self.version, desc, value))
+        self.kv[key] = value
+        return self.version
+
+
+class MonitorLite(Dispatcher):
+    def __init__(self, network: LocalNetwork, name: str = "mon.0",
+                 cfg: Config | None = None):
+        self.name = name
+        self.cfg = cfg or default_config()
+        self.messenger = Messenger(network, name, Policy.stateless_server())
+        self.messenger.add_dispatcher(self)
+        self.store = MonStore()
+        self.osdmap = OSDMap()
+        self._subscribers: set[str] = set()
+        # failure accounting: target -> reporter -> (first, last) stamps
+        self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
+        self._boot_times: dict[int, float] = {}
+        self._lock = threading.RLock()
+        self._handlers = {
+            MOSDBoot: self._handle_boot,
+            MMonSubscribe: self._handle_subscribe,
+            MFailureReport: self._handle_failure,
+            MMonCommand: self._handle_command,
+        }
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self.messenger.start()
+
+    def stop(self) -> None:
+        self.messenger.shutdown()
+
+    # ------------------------------------------------------------- dispatch
+    def ms_dispatch(self, conn, msg) -> bool:
+        handler = self._handlers.get(type(msg))
+        if handler is None:
+            return False
+        handler(conn, msg)
+        return True
+
+    # ------------------------------------------------------------ map flow
+    def _commit_map(self, desc: str) -> None:
+        self.osdmap.epoch = self.store.version + 1
+        raw = self.osdmap.encode_bytes()
+        self.store.commit("osdmap", raw, desc)
+        dout("mon", 3)("epoch %d: %s", self.osdmap.epoch, desc)
+        push = MMapPush(self.osdmap.epoch, raw)
+        for sub in list(self._subscribers):
+            self.messenger.send_message(sub, push)
+
+    def _handle_boot(self, conn, m: MOSDBoot) -> None:
+        with self._lock:
+            if m.osd_id not in self.osdmap.osds:
+                self.osdmap.add_osd(m.osd_id, m.host, m.addr)
+            self.osdmap.mark_up(m.osd_id, m.addr)
+            self._boot_times[m.osd_id] = time.time()
+            self._failure_reports.pop(m.osd_id, None)
+            self._subscribers.add(m.addr)
+            self._commit_map(f"osd.{m.osd_id} boot")
+
+    def _handle_subscribe(self, conn, m: MMonSubscribe) -> None:
+        with self._lock:
+            self._subscribers.add(conn.peer)
+            if self.osdmap.epoch > 0:
+                conn.send(MMapPush(self.osdmap.epoch,
+                                   self.osdmap.encode_bytes()))
+
+    # -- failure detection (prepare_failure / check_failure role) ----------
+    def _grace_for(self, target: int) -> float:
+        """Adaptive grace: base + log-ish scale by uptime (the intent of
+        OSDMonitor::get_grace_time — long-stable daemons get more slack)."""
+        base = self.cfg["osd_heartbeat_grace"]
+        uptime = time.time() - self._boot_times.get(target, time.time())
+        return base + min(base, uptime / 600.0)
+
+    def _handle_failure(self, conn, m: MFailureReport) -> None:
+        with self._lock:
+            info = self.osdmap.osds.get(m.target)
+            if info is None or not info.up:
+                return
+            now = time.time()
+            reps = self._failure_reports.setdefault(m.target, {})
+            first, _ = reps.get(m.reporter, (now, now))
+            reps[m.reporter] = (first, now)
+            # prune stale reporters
+            for r in [r for r, (_, last) in reps.items()
+                      if now - last > 4 * self.cfg["osd_heartbeat_grace"]]:
+                del reps[r]
+            distinct = len(reps)
+            longest = max(now - f for f, _ in reps.values())
+            # reports must SPAN a window, not just arrive in a burst —
+            # protects against one stale-stamp flurry marking a daemon down
+            if (distinct >= self.cfg["mon_osd_min_down_reporters"]
+                    and longest >= self._grace_for(m.target) / 4
+                    and m.failed_for >= self._grace_for(m.target)):
+                self.osdmap.mark_down(m.target)
+                del self._failure_reports[m.target]
+                self._commit_map(
+                    f"osd.{m.target} down ({distinct} reporters)")
+
+    # ------------------------------------------------------------- commands
+    def _handle_command(self, conn, m: MMonCommand) -> None:
+        try:
+            result, data = self._run_command(m.cmd)
+        except Exception as e:  # noqa: BLE001 - commands must not kill mon
+            result, data = -22, {"error": repr(e)}
+        conn.send(MMonCommandReply(m.tid, result, data))
+
+    def _run_command(self, cmd: dict):
+        prefix = cmd.get("prefix")
+        if prefix == "osd pool create":
+            return self._pool_create(cmd)
+        if prefix == "osd down":
+            target = int(cmd["id"])
+            with self._lock:
+                self.osdmap.mark_down(target)
+                self._commit_map(f"osd.{target} down (forced)")
+            return 0, {}
+        if prefix == "osd out":
+            target = int(cmd["id"])
+            with self._lock:
+                self.osdmap.mark_out(target)
+                self._commit_map(f"osd.{target} out")
+            return 0, {}
+        if prefix == "osd dump":
+            return 0, self._dump()
+        if prefix == "status":
+            up = self.osdmap.up_osds()
+            return 0, {"epoch": self.osdmap.epoch,
+                       "num_osds": len(self.osdmap.osds),
+                       "num_up": len(up),
+                       "pools": sorted(p.name for p in
+                                       self.osdmap.pools.values()),
+                       "health": "HEALTH_OK" if len(up) == len(
+                           self.osdmap.osds) else "HEALTH_WARN"}
+        return -22, {"error": f"unknown command {prefix!r}"}
+
+    def _pool_create(self, cmd: dict):
+        name = cmd["name"]
+        with self._lock:
+            if any(p.name == name for p in self.osdmap.pools.values()):
+                return -17, {"error": f"pool {name!r} exists"}
+            kind = cmd.get("kind", "replicated")
+            pg_num = int(cmd.get("pg_num",
+                                 self.cfg["osd_pool_default_pg_num"]))
+            if kind == "ec":
+                # profiles are string->string on the wire; coerce up front
+                # so a malformed profile can never poison map encoding
+                profile = {str(k): str(v) for k, v in
+                           (cmd.get("ec_profile") or {}).items()}
+                plugin = profile.get("plugin", self.cfg["ec_plugin"])
+                # validate the profile by instantiating the plugin — the
+                # OSDMonitor::get_erasure_code step (:1977)
+                codec = ec.factory(plugin, {k: v for k, v in profile.items()
+                                            if k != "plugin"})
+                size = codec.k + codec.m
+                min_size = codec.k
+            else:
+                profile = {}
+                size = int(cmd.get("size", self.cfg["osd_pool_default_size"]))
+                min_size = max(1, size - 1)
+            spec = PoolSpec(self.osdmap.next_pool_id, name, kind, size,
+                            min_size, pg_num, profile)
+            self.osdmap.add_pool(spec)
+            try:
+                self._commit_map(f"pool create {name} ({kind})")
+            except Exception:
+                # never leave a phantom pool that wedges future commits
+                self.osdmap.pools.pop(spec.pool_id, None)
+                raise
+            return 0, {"pool_id": spec.pool_id, "size": size,
+                       "pg_num": pg_num}
+
+    def _dump(self) -> dict:
+        return {
+            "epoch": self.osdmap.epoch,
+            "osds": [{"id": o.osd_id, "up": o.up, "in": o.in_cluster,
+                      "host": o.host, "weight": o.weight}
+                     for o in sorted(self.osdmap.osds.values(),
+                                     key=lambda x: x.osd_id)],
+            "pools": [{"id": p.pool_id, "name": p.name, "kind": p.kind,
+                       "size": p.size, "pg_num": p.pg_num,
+                       "ec_profile": dict(p.ec_profile)}
+                      for p in sorted(self.osdmap.pools.values(),
+                                      key=lambda x: x.pool_id)],
+        }

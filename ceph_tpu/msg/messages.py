@@ -1,0 +1,239 @@
+"""Message types for the client/OSD/monitor protocols.
+
+The role of the reference's src/messages/ (M* classes over the wire codec
+— SURVEY.md layer 2) for the TPU build's protocols: client IO (MOSDOp /
+MOSDOpReply, ref MOSDOp), shard sub-ops (MSubWrite/MSubRead — the role of
+MOSDRepOp and MOSDECSubOpWrite/Read, ref src/osd/ECMsgTypes.h), heartbeats
+and failure reports (MOSDPing / MFailureReport, ref OSD::handle_osd_ping +
+MOSDFailure), map distribution (MMapPush), monitor commands, and
+peering/recovery (MPGQuery/MPGInfo/MPGPush).
+
+All are dataclasses; the wire-critical ones are Encodable (versioned
+codec).  In-proc transports pass the objects; wire transports call
+encode_message/decode_message.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..utils.codec import Decoder, Encodable, Encoder
+
+
+@dataclass(frozen=True, order=True)
+class PgId:
+    pool: int
+    seed: int
+
+    def __str__(self) -> str:
+        return f"{self.pool}.{self.seed:x}"
+
+
+# --------------------------------------------------------------- client IO
+@dataclass
+class MOSDOp(Encodable):
+    tid: int
+    client: str
+    pool: int
+    oid: str
+    op: str  # write | read | remove | stat
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+    epoch: int = 0  # client's map epoch (staleness check)
+
+    VERSION, COMPAT = 1, 1
+
+    def encode(self, enc: Encoder) -> None:
+        def body(e):
+            e.u64(self.tid); e.string(self.client); e.u64(self.pool)
+            e.string(self.oid); e.string(self.op); e.u64(self.offset)
+            e.u64(self.length); e.blob(self.data); e.u64(self.epoch)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "MOSDOp":
+        def body(d, v):
+            return cls(d.u64(), d.string(), d.u64(), d.string(), d.string(),
+                       d.u64(), d.u64(), d.blob(), d.u64())
+        return dec.versioned(cls.VERSION, body)
+
+
+@dataclass
+class MOSDOpReply(Encodable):
+    tid: int
+    result: int  # 0 ok, negative errno-style
+    data: bytes = b""
+    version: int = 0
+    epoch: int = 0  # responder's map epoch (client refreshes if newer)
+
+    VERSION, COMPAT = 1, 1
+
+    def encode(self, enc: Encoder) -> None:
+        def body(e):
+            e.u64(self.tid); e.i64(self.result); e.blob(self.data)
+            e.u64(self.version); e.u64(self.epoch)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "MOSDOpReply":
+        def body(d, v):
+            return cls(d.u64(), d.i64(), d.blob(), d.u64(), d.u64())
+        return dec.versioned(cls.VERSION, body)
+
+
+# ------------------------------------------------------------- shard subops
+@dataclass
+class MSubWrite:
+    """Primary -> shard OSD write (MOSDRepOp / MOSDECSubOpWrite role)."""
+
+    tid: int
+    pgid: PgId
+    oid: str
+    shard: int          # -1 replicated, >=0 EC shard id
+    version: int
+    op: str             # write | remove
+    data: bytes = b""
+    attrs: dict = field(default_factory=dict)
+
+
+@dataclass
+class MSubWriteReply:
+    tid: int
+    pgid: PgId
+    shard: int
+    from_osd: int
+    result: int = 0
+
+
+@dataclass
+class MSubRead:
+    """Primary -> shard OSD read (ECSubRead role)."""
+
+    tid: int
+    pgid: PgId
+    oid: str
+    shard: int
+
+
+@dataclass
+class MSubReadReply:
+    tid: int
+    pgid: PgId
+    oid: str
+    shard: int
+    from_osd: int
+    result: int = 0
+    data: bytes = b""
+    attrs: dict = field(default_factory=dict)
+
+
+# ------------------------------------------------------- health / heartbeat
+@dataclass
+class MOSDPing:
+    sender: int
+    epoch: int
+    stamp: float
+
+
+@dataclass
+class MOSDPingReply:
+    sender: int
+    stamp: float
+
+
+@dataclass
+class MFailureReport:
+    target: int
+    reporter: int
+    epoch: int
+    failed_for: float
+
+
+# ---------------------------------------------------------------- maps/mon
+@dataclass
+class MMapPush:
+    """Monitor -> subscriber: full map (incrementals are future work)."""
+
+    epoch: int
+    map_bytes: bytes  # encoded OSDMap (travels the versioned codec)
+
+
+@dataclass
+class MMonSubscribe:
+    what: str = "osdmap"
+
+
+@dataclass
+class MOSDBoot:
+    osd_id: int
+    host: str
+    addr: str
+
+
+@dataclass
+class MMonCommand:
+    tid: int
+    cmd: dict
+
+
+@dataclass
+class MMonCommandReply:
+    tid: int
+    result: int
+    data: dict = field(default_factory=dict)
+
+
+# --------------------------------------------------------- peering/recovery
+@dataclass
+class MPGQuery:
+    """Primary -> peer: send me your object inventory for this PG."""
+
+    pgid: PgId
+    epoch: int
+
+
+@dataclass
+class MPGInfo:
+    pgid: PgId
+    from_osd: int
+    shard: int
+    objects: dict  # (name, shard) -> version
+    tombstones: dict = field(default_factory=dict)  # name -> delete version
+
+
+@dataclass
+class MPGPull:
+    """Primary -> peer: send me these whole objects (I am behind)."""
+
+    pgid: PgId
+    names: list
+
+
+@dataclass
+class MPGPush:
+    """Recovery payload: full objects (log-based delta is future work)."""
+
+    pgid: PgId
+    shard: int
+    objects: dict  # name -> (version, data bytes[, total_len])
+    deletes: dict = field(default_factory=dict)  # name -> delete version
+
+
+# ------------------------------------------------------------ wire helpers
+_WIRE_TYPES: dict[int, type] = {1: MOSDOp, 2: MOSDOpReply}
+_WIRE_IDS = {t: i for i, t in _WIRE_TYPES.items()}
+
+
+def encode_message(msg) -> bytes:
+    """Frame an Encodable message for a wire transport."""
+    e = Encoder()
+    e.u16(_WIRE_IDS[type(msg)])
+    msg.encode(e)
+    return e.tobytes()
+
+
+def decode_message(data: bytes):
+    d = Decoder(data)
+    t = _WIRE_TYPES[d.u16()]
+    return t.decode(d)

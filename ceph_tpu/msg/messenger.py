@@ -1,0 +1,204 @@
+"""Entity-addressed messengers over a pluggable transport.
+
+Capability map to the reference (src/msg/ — SURVEY.md §2.3):
+- Messenger::create / bind / connect -> Messenger over a Network
+- Dispatcher::ms_dispatch / ms_handle_reset -> Dispatcher
+- Policy (lossy/lossless, throttler) -> Policy (+ message-cap throttle)
+- AsyncMessenger worker threads -> one dispatch thread per messenger
+  (sharded workers are a scale knob, not a semantics change)
+- msgr failure injection (ms inject socket failures) -> LocalNetwork
+  drop_rate / partitions / latency knobs, used by thrasher tests
+
+The LocalNetwork transport delivers Python message objects in-process.
+Messages are Encodable; wire transports encode them with the versioned
+codec (ceph_tpu.utils.codec) — the framing contract stands in for
+ProtocolV2 (session resume at this layer is future work; LocalNetwork
+queues are lossless by construction unless told to drop).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..utils.log import dout
+from ..utils.throttle import Throttle
+
+
+@dataclass
+class Policy:
+    lossy: bool = False
+    server: bool = False
+    throttler_cap: int = 0  # 0 = unthrottled
+
+    @staticmethod
+    def lossless_peer() -> "Policy":
+        return Policy(lossy=False)
+
+    @staticmethod
+    def stateless_server(cap: int = 0) -> "Policy":
+        return Policy(lossy=True, server=True, throttler_cap=cap)
+
+
+class Dispatcher:
+    """Receive-side interface (ms_dispatch / ms_fast_dispatch role)."""
+
+    def ms_dispatch(self, conn: "Connection", msg) -> bool:
+        raise NotImplementedError
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        pass
+
+
+class Connection:
+    """Send handle to one peer (Connection::send_message role)."""
+
+    def __init__(self, messenger: "Messenger", peer: str):
+        self.messenger = messenger
+        self.peer = peer
+
+    def send(self, msg) -> bool:
+        return self.messenger.network.deliver(self.messenger.name,
+                                              self.peer, msg)
+
+    def __repr__(self):
+        return f"Connection({self.messenger.name} -> {self.peer})"
+
+
+class LocalNetwork:
+    """In-proc transport: entity name -> messenger registry + faults."""
+
+    def __init__(self, seed: int = 0):
+        self._entities: dict[str, "Messenger"] = {}
+        self._lock = threading.RLock()
+        self.drop_rate = 0.0
+        self.latency = 0.0
+        self._partitions: set[frozenset[str]] = set()
+        self._rng = random.Random(seed)
+        self.dropped = 0
+
+    # -- registry ----------------------------------------------------------
+    def register(self, m: "Messenger") -> None:
+        with self._lock:
+            if m.name in self._entities:
+                raise ValueError(f"entity {m.name!r} already bound")
+            self._entities[m.name] = m
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._entities.pop(name, None)
+
+    def lookup(self, name: str) -> "Messenger | None":
+        with self._lock:
+            return self._entities.get(name)
+
+    # -- fault injection (the msgr-failures knobs) -------------------------
+    def partition(self, a: str, b: str) -> None:
+        self._partitions.add(frozenset((a, b)))
+
+    def heal(self, a: str | None = None, b: str | None = None) -> None:
+        if a is None:
+            self._partitions.clear()
+        else:
+            self._partitions.discard(frozenset((a, b)))
+
+    def _blocked(self, src: str, dst: str) -> bool:
+        if frozenset((src, dst)) in self._partitions:
+            return True
+        return self.drop_rate > 0 and self._rng.random() < self.drop_rate
+
+    # -- delivery ----------------------------------------------------------
+    def deliver(self, src: str, dst: str, msg) -> bool:
+        target = self.lookup(dst)
+        if target is None or target._stopped:
+            return False
+        if self._blocked(src, dst):
+            self.dropped += 1
+            dout("msg", 10)("dropped %s -> %s: %s", src, dst,
+                            type(msg).__name__)
+            return True  # silently dropped, like a lossy wire
+        if self.latency:
+            time.sleep(self.latency)
+        return target._enqueue(src, msg)
+
+
+class Messenger:
+    """One entity's endpoint: a dispatch queue + worker thread."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, network: LocalNetwork, name: str,
+                 policy: Policy | None = None):
+        self.network = network
+        self.name = name
+        self.policy = policy or Policy()
+        self._dispatchers: list[Dispatcher] = []
+        self._queue: queue.Queue = queue.Queue()
+        self._stopped = False
+        self._throttle = (Throttle(f"{name}.msgs", self.policy.throttler_cap)
+                          if self.policy.throttler_cap else None)
+        self._thread: threading.Thread | None = None
+        network.register(self)
+
+    # -- lifecycle ---------------------------------------------------------
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self._dispatchers.append(d)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name=f"ms-{self.name}",
+                daemon=True)
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stopped = True
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=5)
+        self.network.unregister(self.name)
+
+    # -- sending -----------------------------------------------------------
+    def connect(self, peer: str) -> Connection:
+        return Connection(self, peer)
+
+    def send_message(self, peer: str, msg) -> bool:
+        return self.connect(peer).send(msg)
+
+    # -- receiving ---------------------------------------------------------
+    def _enqueue(self, src: str, msg) -> bool:
+        if self._stopped:
+            return False
+        if self._throttle and not self._throttle.try_get():
+            # backpressure: lossy servers drop, lossless block briefly
+            if self.policy.lossy:
+                self.network.dropped += 1
+                return True
+            self._throttle.get(1, timeout=5)
+        self._queue.put((src, msg))
+        return True
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            src, msg = item
+            conn = Connection(self, src)
+            try:
+                for d in self._dispatchers:
+                    if d.ms_dispatch(conn, msg):
+                        break
+                else:
+                    dout("msg", 0)("%s: unhandled %s from %s", self.name,
+                                   type(msg).__name__, src)
+            except Exception as e:  # noqa: BLE001 - daemon must survive
+                dout("msg", 0)("%s: dispatch error on %s from %s: %r",
+                               self.name, type(msg).__name__, src, e)
+            finally:
+                if self._throttle:
+                    self._throttle.put()

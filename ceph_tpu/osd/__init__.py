@@ -1,0 +1,2 @@
+"""OSD data plane: object stores, transactions, PGs, backends, daemons
+(the reference's src/os + src/osd layers, SURVEY.md §2.5-2.6)."""

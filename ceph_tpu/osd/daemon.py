@@ -1,0 +1,789 @@
+"""OSD daemon: the data-plane server.
+
+The role of the reference's OSD + PrimaryLogPG + PGBackend stack
+(src/osd/OSD.cc op ingress :7690->dequeue :9979; PrimaryLogPG::do_op :2588
+/ do_osd_ops :6163; ReplicatedBackend primary-copy 2PC; ECBackend shard
+fan-out ECCommon.cc:950-1090; heartbeats OSD.cc:5823; peering/recovery
+PeeringState — SURVEY.md §2.5) collapsed into one single-dispatch-thread
+daemon per OSD:
+
+- client ops arrive on the messenger dispatch thread and run as
+  non-blocking state machines (pending write/read tables keyed by tid —
+  the in_progress_ops role of ECCommon);
+- replicated pools: primary applies locally, fans MSubWrite to replicas,
+  acks the client when all commit (primary-copy 2PC);
+- EC pools: primary splits+encodes the stripe through the pool's EC plugin
+  (the TPU kernels underneath), fans shard writes, and reads/decodes with
+  reconstruction when shards are missing (degraded reads);
+- heartbeats ping peers; silence past the grace window produces failure
+  reports to the monitor (adaptive grace is monitor-side);
+- on map change the primary runs recovery-lite: inventory peers
+  (MPGQuery/MPGInfo), push stale/missing whole objects, and rebuild EC
+  shards onto spare devices from k survivors.  (Log-based delta recovery
+  and rollback generations are the next widening step; versions are
+  tracked per object now.)
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .. import ec
+from ..mon.maps import OSDMap
+from ..msg.messages import (MFailureReport, MMapPush, MOSDBoot, MOSDOp,
+                            MOSDOpReply, MOSDPing, MOSDPingReply, MPGInfo,
+                            MPGPull, MPGPush, MPGQuery, MSubRead,
+                            MSubReadReply, MSubWrite, MSubWriteReply, PgId)
+from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..utils.config import Config, default_config
+from ..utils.log import dout
+from ..utils.perf import CounterType, global_perf
+from ..utils.tracked_op import OpTracker
+from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
+                          Transaction)
+
+EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
+
+
+@dataclass
+class _PendingWrite:
+    client: str
+    client_tid: int
+    acks_needed: int
+    version: int
+    failed: int = 0
+    stamp: float = field(default_factory=time.time)
+
+
+@dataclass
+class _PendingRead:
+    client: str | None
+    client_tid: int
+    pool: int
+    oid: str
+    total_shards: int
+    chunks: dict = field(default_factory=dict)  # shard -> np.uint8 array
+    attrs: dict = field(default_factory=dict)   # merged shard attrs (len/v)
+    replies: int = 0
+    offset: int = 0
+    length: int = 0
+    # recovery reads carry a completion callback instead of a client
+    on_done: object = None
+    stamp: float = field(default_factory=time.time)
+
+
+class OSDDaemon(Dispatcher):
+    def __init__(self, osd_id: int, network: LocalNetwork,
+                 mon: str = "mon.0", store: ObjectStore | None = None,
+                 cfg: Config | None = None, host: str | None = None):
+        self.osd_id = osd_id
+        self.name = f"osd.{osd_id}"
+        self.host = host or f"host{osd_id}"
+        self.mon = mon
+        self.cfg = cfg or default_config()
+        self.store = store or ObjectStore.create("memstore")
+        self.store.mount()
+        self.messenger = Messenger(
+            network, self.name,
+            Policy.stateless_server(self.cfg["osd_client_message_cap"]))
+        self.messenger.add_dispatcher(self)
+        self.osdmap: OSDMap | None = None
+        self._tids = itertools.count(1)
+        self._pending_writes: dict[int, _PendingWrite] = {}
+        self._pending_reads: dict[int, _PendingRead] = {}
+        self._pg_versions: dict[PgId, int] = {}
+        self._ec_codecs: dict[int, ec.ErasureCode] = {}
+        self._hb_last: dict[int, float] = {}
+        self._hb_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._tombstones: dict[PgId, dict[str, int]] = {}
+        self.op_tracker = OpTracker()
+        self._handlers = {
+            MMapPush: self._handle_map,
+            MOSDOp: self._handle_client_op,
+            MSubWrite: self._handle_sub_write,
+            MSubWriteReply: self._handle_sub_write_reply,
+            MSubRead: self._handle_sub_read,
+            MSubReadReply: self._handle_sub_read_reply,
+            MOSDPing: self._handle_ping,
+            MOSDPingReply: self._handle_ping_reply,
+            MPGQuery: self._handle_pg_query,
+            MPGInfo: self._handle_pg_info,
+            MPGPull: self._handle_pg_pull,
+            MPGPush: self._handle_pg_push,
+        }
+        self.perf = global_perf().create(self.name)
+        self.perf.add_many(["op_w", "op_r", "op_rw_bytes", "subop_w",
+                            "subop_r", "recovery_push", "failure_reports"])
+        self.perf.add("op_lat", CounterType.TIME)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        self.messenger.start()
+        self.messenger.send_message(
+            self.mon, MOSDBoot(self.osd_id, self.host, self.name))
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, name=f"hb-{self.name}", daemon=True)
+        self._hb_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.messenger.shutdown()
+
+    # ------------------------------------------------------------- dispatch
+    def ms_dispatch(self, conn, msg) -> bool:
+        handler = self._handlers.get(type(msg))
+        if handler is None:
+            return False
+        handler(conn, msg)
+        return True
+
+    # ------------------------------------------------------------- mapping
+    def _handle_map(self, conn, msg: MMapPush) -> None:
+        newmap = OSDMap.decode_bytes(msg.map_bytes)
+        old = self.osdmap
+        if old is not None and newmap.epoch <= old.epoch:
+            return
+        self.osdmap = newmap
+        dout("osd", 5)("%s: map epoch %d", self.name, newmap.epoch)
+        # forget heartbeat stamps for peers that (re)joined: a stale
+        # pre-death stamp must not flash a revived daemon back down
+        for peer, info in newmap.osds.items():
+            was_up = old is not None and old.osds.get(peer) is not None \
+                and old.osds[peer].up
+            if info.up and not was_up:
+                self._hb_last.pop(peer, None)
+            if not info.up:
+                self._hb_last.pop(peer, None)
+        # if the map says I am down but I am alive, re-assert (osd re-boot)
+        me = newmap.osds.get(self.osd_id)
+        if me is not None and not me.up and not self._stop.is_set():
+            self.messenger.send_message(
+                self.mon, MOSDBoot(self.osd_id, self.host, self.name))
+        self._ensure_collections()
+        if old is None or newmap.epoch > old.epoch:
+            self._start_recovery()
+
+    def _pools_pgs_for_me(self):
+        """(pool, pg_seed, up_set, my_positions) for PGs mapping to me."""
+        if self.osdmap is None:
+            return
+        for pool_id, pool in self.osdmap.pools.items():
+            for seed in range(pool.pg_num):
+                up = self.osdmap.pg_to_up_osds(pool_id, seed)
+                if self.osd_id in [u for u in up if u is not None]:
+                    yield pool_id, seed, up
+
+    def _ensure_collections(self) -> None:
+        have = set(self.store.list_collections())
+        for pool_id, seed, _up in self._pools_pgs_for_me():
+            cid = CollectionId(pool_id, seed)
+            if cid not in have:
+                tx = Transaction().create_collection(cid)
+                self.store.queue_transaction(tx)
+
+    def _primary_of(self, up: list) -> int | None:
+        for u in up:
+            if u is not None:
+                return u
+        return None
+
+    # ----------------------------------------------------------- client ops
+    def _handle_client_op(self, conn, m: MOSDOp) -> None:
+        if self.osdmap is None or m.pool not in self.osdmap.pools:
+            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=0))
+            return
+        pool = self.osdmap.pools[m.pool]
+        seed = self.osdmap.object_to_pg(m.pool, m.oid)
+        up = self.osdmap.pg_to_up_osds(m.pool, seed)
+        if self._primary_of(up) != self.osd_id:
+            conn.send(MOSDOpReply(m.tid, ESTALE, epoch=self.osdmap.epoch))
+            return
+        pgid = PgId(m.pool, seed)
+        self.perf.inc("op_rw_bytes", len(m.data))
+        with self.op_tracker.create(f"{m.op} {m.oid}") as op:
+            if pool.kind == "ec":
+                if m.op == "write":
+                    self.perf.inc("op_w")
+                    self._ec_write(conn, m, pgid, up)
+                elif m.op == "read":
+                    self.perf.inc("op_r")
+                    self._ec_read(conn, m, pgid, up)
+                elif m.op == "remove":
+                    self._ec_remove(conn, m, pgid, up)
+                elif m.op == "stat":
+                    self._stat(conn, m, pgid, shard=0)
+                else:
+                    conn.send(MOSDOpReply(m.tid, EINVAL,
+                                          epoch=self.osdmap.epoch))
+            else:
+                if m.op == "write":
+                    self.perf.inc("op_w")
+                    self._rep_write(conn, m, pgid, up)
+                elif m.op == "read":
+                    self.perf.inc("op_r")
+                    self._rep_read(conn, m, pgid)
+                elif m.op == "remove":
+                    self._rep_remove(conn, m, pgid, up)
+                elif m.op == "stat":
+                    self._stat(conn, m, pgid, shard=-1)
+                else:
+                    conn.send(MOSDOpReply(m.tid, EINVAL,
+                                          epoch=self.osdmap.epoch))
+            op.mark("dispatched")
+
+    def _next_version(self, pgid: PgId) -> int:
+        v = self._pg_versions.get(pgid, 0) + 1
+        self._pg_versions[pgid] = v
+        return v
+
+    def _record_tombstone(self, pgid: PgId, name: str, version: int) -> None:
+        """Deletion marker so recovery never resurrects removed objects
+        (the role of PGLog delete entries)."""
+        ts = self._tombstones.setdefault(pgid, {})
+        ts[name] = max(ts.get(name, 0), version)
+
+    # -- replicated pool ---------------------------------------------------
+    def _rep_write(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+        version = self._next_version(pgid)
+        self._apply_write(pgid, m.oid, -1, m.data,
+                          {"v": version, "len": len(m.data)})
+        peers = [u for u in up if u is not None and u != self.osd_id]
+        tid = next(self._tids)
+        if not peers:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            return
+        self._pending_writes[tid] = _PendingWrite(
+            m.client, m.tid, len(peers), version)
+        for peer in peers:
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MSubWrite(tid, pgid, m.oid, -1, version, "write", m.data))
+
+    def _rep_read(self, conn, m: MOSDOp, pgid: PgId) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        try:
+            bl = self.store.read(cid, ObjectId(m.oid))
+            data = bl.to_bytes()
+            if m.length:
+                data = data[m.offset:m.offset + m.length]
+            elif m.offset:
+                data = data[m.offset:]
+            conn.send(MOSDOpReply(m.tid, 0, data=data,
+                                  epoch=self.osdmap.epoch))
+        except NoSuchObject:
+            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
+
+    def _rep_remove(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+        version = self._next_version(pgid)
+        cid = CollectionId(pgid.pool, pgid.seed)
+        if not self.store.exists(cid, ObjectId(m.oid)):
+            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
+            return
+        self.store.queue_transaction(
+            Transaction().remove(cid, ObjectId(m.oid)))
+        self._record_tombstone(pgid, m.oid, version)
+        peers = [u for u in up if u is not None and u != self.osd_id]
+        tid = next(self._tids)
+        if not peers:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            return
+        self._pending_writes[tid] = _PendingWrite(
+            m.client, m.tid, len(peers), version)
+        for peer in peers:
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MSubWrite(tid, pgid, m.oid, -1, version, "remove"))
+
+    def _stat(self, conn, m: MOSDOp, pgid: PgId, shard: int) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        oid = ObjectId(m.oid, shard=shard)
+        # EC stat falls back across shards (primary may not hold shard 0)
+        candidates = [oid] if shard < 0 else [
+            ObjectId(m.oid, shard=s)
+            for s in range(self.osdmap.pools[pgid.pool].size)]
+        for cand in candidates:
+            try:
+                attrs = self.store.getattrs(cid, cand)
+            except NoSuchObject:
+                continue
+            size = int(attrs.get("len", 0))
+            conn.send(MOSDOpReply(m.tid, 0,
+                                  data=size.to_bytes(8, "little"),
+                                  epoch=self.osdmap.epoch))
+            return
+        conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
+
+    # -- EC pool -----------------------------------------------------------
+    def _pool_codec(self, pool_id: int) -> ec.ErasureCode:
+        codec = self._ec_codecs.get(pool_id)
+        if codec is None:
+            pool = self.osdmap.pools[pool_id]
+            profile = dict(pool.ec_profile)
+            plugin = profile.pop("plugin", self.cfg["ec_plugin"])
+            profile.setdefault("backend", self.cfg["ec_backend"])
+            codec = ec.factory(plugin, profile)
+            self._ec_codecs[pool_id] = codec
+        return codec
+
+    def _ec_write(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+        codec = self._pool_codec(pgid.pool)
+        alive = [u for u in up if u is not None]
+        if len(alive) < codec.k:
+            conn.send(MOSDOpReply(m.tid, EIO, epoch=self.osdmap.epoch))
+            return
+        version = self._next_version(pgid)
+        chunks = codec.encode(m.data)
+        attrs = {"v": version, "len": len(m.data)}
+        tid = next(self._tids)
+        remote = 0
+        for shard, osd in enumerate(up):
+            if osd is None:
+                continue  # degraded write: hole shard skipped
+            data = chunks[shard].tobytes()
+            if osd == self.osd_id:
+                self._apply_write(pgid, m.oid, shard, data, attrs)
+            else:
+                remote += 1
+                self.messenger.send_message(
+                    f"osd.{osd}",
+                    MSubWrite(tid, pgid, m.oid, shard, version, "write",
+                              data, dict(attrs)))
+        if remote == 0:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            return
+        self._pending_writes[tid] = _PendingWrite(
+            m.client, m.tid, remote, version)
+
+    def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+        tid = next(self._tids)
+        pr = _PendingRead(m.client, m.tid, pgid.pool, m.oid,
+                          total_shards=sum(1 for u in up if u is not None),
+                          offset=m.offset, length=m.length)
+        self._pending_reads[tid] = pr
+        self._fan_shard_reads(tid, pgid, m.oid, up)
+
+    def _fan_shard_reads(self, tid: int, pgid: PgId, oid: str,
+                         up: list) -> None:
+        for shard, osd in enumerate(up):
+            if osd is None:
+                continue
+            if osd == self.osd_id:
+                self._deliver_local_shard_read(tid, pgid, oid, shard)
+            else:
+                self.messenger.send_message(
+                    f"osd.{osd}", MSubRead(tid, pgid, oid, shard))
+
+    def _deliver_local_shard_read(self, tid, pgid, oid, shard) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        try:
+            data = self.store.read(cid, ObjectId(oid, shard=shard)).to_bytes()
+            attrs = self.store.getattrs(cid, ObjectId(oid, shard=shard))
+            result = 0
+        except NoSuchObject:
+            data, attrs, result = b"", {}, ENOENT
+        self._on_shard_read(tid, shard, result, data, attrs)
+
+    def _handle_sub_read(self, conn, m: MSubRead) -> None:
+        self.perf.inc("subop_r")
+        cid = CollectionId(m.pgid.pool, m.pgid.seed)
+        try:
+            data = self.store.read(cid, ObjectId(m.oid, shard=m.shard))
+            attrs = self.store.getattrs(cid, ObjectId(m.oid, shard=m.shard))
+            conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
+                                    self.osd_id, 0, data.to_bytes(), attrs))
+        except NoSuchObject:
+            conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
+                                    self.osd_id, ENOENT))
+
+    def _handle_sub_read_reply(self, conn, m: MSubReadReply) -> None:
+        self._on_shard_read(m.tid, m.shard, m.result, m.data, m.attrs)
+
+    def _on_shard_read(self, tid, shard, result, data, attrs) -> None:
+        pr = self._pending_reads.get(tid)
+        if pr is None:
+            return
+        pr.replies += 1
+        if result == 0:
+            pr.chunks[shard] = np.frombuffer(data, dtype=np.uint8)
+            if attrs:
+                pr.attrs.update(attrs)
+        if pr.replies >= pr.total_shards:
+            del self._pending_reads[tid]
+            self._finish_ec_read(pr)
+
+    def _finish_ec_read(self, pr: _PendingRead) -> None:
+        codec = self._pool_codec(pr.pool)
+        done = pr.on_done
+        epoch = self.osdmap.epoch if self.osdmap else 0
+        if len(pr.chunks) < codec.k:
+            # no shard at all anywhere -> the object does not exist;
+            # some-but-too-few shards -> unrecoverable (EIO)
+            err = ENOENT if not pr.chunks else EIO
+            if done:
+                done(None)
+            elif pr.client:
+                self.messenger.send_message(
+                    pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch))
+            return
+        # total length rides shard attrs; recompute from any shard
+        total = self._ec_total_len(pr)
+        data_ids = list(range(codec.k))
+        if all(i in pr.chunks for i in data_ids):
+            out = np.concatenate([pr.chunks[i] for i in data_ids])
+        else:
+            decoded = codec.decode(
+                data_ids, {i: c for i, c in pr.chunks.items()})
+            out = np.concatenate([decoded[i] for i in data_ids])
+        payload = out.tobytes()[:total] if total is not None else out.tobytes()
+        if pr.length:
+            payload = payload[pr.offset:pr.offset + pr.length]
+        elif pr.offset:
+            payload = payload[pr.offset:]
+        if done:
+            done(pr)
+        elif pr.client:
+            self.messenger.send_message(
+                pr.client,
+                MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch))
+
+    def _ec_total_len(self, pr: _PendingRead) -> int | None:
+        if "len" in pr.attrs:
+            return int(pr.attrs["len"])
+        if self.osdmap is None:
+            return None
+        seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
+        cid = CollectionId(pr.pool, seed)
+        for shard in list(pr.chunks) + list(range(
+                self.osdmap.pools[pr.pool].size)):
+            try:
+                attrs = self.store.getattrs(cid, ObjectId(pr.oid,
+                                                          shard=shard))
+                if "len" in attrs:
+                    return int(attrs["len"])
+            except NoSuchObject:
+                continue
+        return None
+
+    def _ec_remove(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+        version = self._next_version(pgid)
+        self._record_tombstone(pgid, m.oid, version)
+        tid = next(self._tids)
+        remote = 0
+        for shard, osd in enumerate(up):
+            if osd is None:
+                continue
+            if osd == self.osd_id:
+                cid = CollectionId(pgid.pool, pgid.seed)
+                oid = ObjectId(m.oid, shard=shard)
+                if self.store.exists(cid, oid):
+                    self.store.queue_transaction(
+                        Transaction().remove(cid, oid))
+            else:
+                remote += 1
+                self.messenger.send_message(
+                    f"osd.{osd}",
+                    MSubWrite(tid, pgid, m.oid, shard, version, "remove"))
+        if remote == 0:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+        else:
+            self._pending_writes[tid] = _PendingWrite(
+                m.client, m.tid, remote, version)
+
+    # -- sub-op handling (shard/replica side) ------------------------------
+    def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
+                     attrs: dict) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid, shard=shard)
+        tx = Transaction()
+        if cid not in self.store.list_collections():
+            tx.create_collection(cid)
+        tx.touch(cid, obj)
+        tx.truncate(cid, obj, 0)
+        tx.write(cid, obj, 0, data)
+        tx.setattrs(cid, obj, {k: v for k, v in attrs.items()})
+        self.store.queue_transaction(tx)
+
+    def _handle_sub_write(self, conn, m: MSubWrite) -> None:
+        self.perf.inc("subop_w")
+        if m.op == "write":
+            self._apply_write(m.pgid, m.oid, m.shard, m.data,
+                              dict(m.attrs, v=m.version))
+        elif m.op == "remove":
+            cid = CollectionId(m.pgid.pool, m.pgid.seed)
+            obj = ObjectId(m.oid, shard=m.shard)
+            if self.store.exists(cid, obj):
+                self.store.queue_transaction(Transaction().remove(cid, obj))
+            self._record_tombstone(m.pgid, m.oid, m.version)
+        self._pg_versions[m.pgid] = max(
+            self._pg_versions.get(m.pgid, 0), m.version)
+        conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
+
+    def _handle_sub_write_reply(self, conn, m: MSubWriteReply) -> None:
+        pw = self._pending_writes.get(m.tid)
+        if pw is None:
+            return
+        if m.result != 0:
+            pw.failed += 1
+        pw.acks_needed -= 1
+        if pw.acks_needed <= 0:
+            del self._pending_writes[m.tid]
+            result = EIO if pw.failed else 0
+            self.messenger.send_message(
+                pw.client,
+                MOSDOpReply(pw.client_tid, result, version=pw.version,
+                            epoch=self.osdmap.epoch if self.osdmap else 0))
+
+    # ----------------------------------------------------------- heartbeats
+    def _heartbeat_loop(self) -> None:
+        interval = self.cfg["osd_heartbeat_interval"]
+        grace = self.cfg["osd_heartbeat_grace"]
+        while not self._stop.wait(interval):
+            if self.osdmap is None:
+                continue
+            now = time.time()
+            self._sweep_pending(now)
+            for peer in self.osdmap.up_osds():
+                if peer == self.osd_id:
+                    continue
+                self.messenger.send_message(
+                    f"osd.{peer}",
+                    MOSDPing(self.osd_id, self.osdmap.epoch, now))
+                last = self._hb_last.get(peer)
+                if last is not None and now - last > grace:
+                    self.perf.inc("failure_reports")
+                    self.messenger.send_message(
+                        self.mon,
+                        MFailureReport(peer, self.osd_id,
+                                       self.osdmap.epoch, now - last))
+
+    def _sweep_pending(self, now: float, max_age: float = 5.0) -> None:
+        """Fail ops whose sub-ops never completed (peer died mid-op) so
+        clients get an error instead of a timeout and tables don't leak."""
+        epoch = self.osdmap.epoch if self.osdmap else 0
+        for tid, pw in list(self._pending_writes.items()):
+            if now - pw.stamp > max_age:
+                self._pending_writes.pop(tid, None)
+                self.messenger.send_message(
+                    pw.client, MOSDOpReply(pw.client_tid, EIO,
+                                           version=pw.version, epoch=epoch))
+        for tid, pr in list(self._pending_reads.items()):
+            if now - pr.stamp > max_age:
+                self._pending_reads.pop(tid, None)
+                self._finish_ec_read(pr)  # decodes if >= k arrived, else err
+
+    def _handle_ping(self, conn, m: MOSDPing) -> None:
+        conn.send(MOSDPingReply(self.osd_id, m.stamp))
+
+    def _handle_ping_reply(self, conn, m: MOSDPingReply) -> None:
+        self._hb_last[m.sender] = time.time()
+
+    # ------------------------------------------------------ peering/recovery
+    def _start_recovery(self) -> None:
+        """Primary-side: inventory peers for my PGs (recovery-lite)."""
+        for pool_id, seed, up in self._pools_pgs_for_me():
+            if self._primary_of(up) != self.osd_id:
+                continue
+            pgid = PgId(pool_id, seed)
+            for osd in up:
+                if osd is not None and osd != self.osd_id:
+                    self.messenger.send_message(
+                        f"osd.{osd}", MPGQuery(pgid, self.osdmap.epoch))
+            # also reconcile my own shard inventory immediately
+            self._handle_pg_info(None, self._my_pg_info(pgid))
+
+    def _my_pg_info(self, pgid: PgId) -> MPGInfo:
+        return MPGInfo(pgid, self.osd_id, -2, self._inventory(pgid),
+                       dict(self._tombstones.get(pgid, {})))
+
+    def _inventory(self, pgid: PgId) -> dict:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        out = {}
+        try:
+            for oid in self.store.list_objects(cid):
+                attrs = self.store.getattrs(cid, oid)
+                v = attrs.get("v", 0)
+                out[(oid.name, oid.shard)] = v
+        except Exception:  # noqa: BLE001 - collection may not exist yet
+            pass
+        return out
+
+    def _handle_pg_query(self, conn, m: MPGQuery) -> None:
+        conn.send(MPGInfo(m.pgid, self.osd_id, -2, self._inventory(m.pgid),
+                          dict(self._tombstones.get(m.pgid, {}))))
+
+    def _handle_pg_info(self, conn, m: MPGInfo) -> None:
+        """Primary: compare a peer's inventory against authority and
+        schedule pushes for missing/stale objects."""
+        if self.osdmap is None or m.pgid.pool not in self.osdmap.pools:
+            return
+        pool = self.osdmap.pools[m.pgid.pool]
+        up = self.osdmap.pg_to_up_osds(m.pgid.pool, m.pgid.seed)
+        if self._primary_of(up) != self.osd_id:
+            return
+        peer_inv = m.objects
+        my_inv = self._inventory(m.pgid)
+        # merge tombstone knowledge both ways (deletes must win races)
+        for name, v in m.tombstones.items():
+            self._record_tombstone(m.pgid, name, v)
+        dead = self._tombstones.get(m.pgid, {})
+        if pool.kind == "ec":
+            self._recover_ec(m.pgid, pool, up, m.from_osd, peer_inv, my_inv,
+                             dead)
+        else:
+            self._recover_replicated(m.pgid, up, m.from_osd, peer_inv,
+                                     my_inv, dead)
+
+    def _recover_replicated(self, pgid, up, peer, peer_inv, my_inv,
+                            dead) -> None:
+        if peer == self.osd_id:
+            return
+        cid = CollectionId(pgid.pool, pgid.seed)
+        push, pull, deletes = {}, [], {}
+        for (name, shard), v in my_inv.items():
+            if dead.get(name, -1) >= v:
+                continue  # deleted; never resurrect
+            pv = peer_inv.get((name, shard), -1)
+            if pv < v:
+                data = self.store.read(cid, ObjectId(name, shard)).to_bytes()
+                push[name] = (v, data)
+        for (name, shard), pv in peer_inv.items():
+            if dead.get(name, -1) >= pv:
+                deletes[name] = dead[name]  # peer missed the remove
+            elif my_inv.get((name, shard), -1) < pv:
+                pull.append(name)
+        # locally apply missed removes too
+        for (name, shard), v in my_inv.items():
+            if dead.get(name, -1) >= v:
+                obj = ObjectId(name, shard)
+                if self.store.exists(cid, obj):
+                    self.store.queue_transaction(
+                        Transaction().remove(cid, obj))
+        if push or deletes:
+            self.perf.inc("recovery_push", len(push))
+            self.messenger.send_message(
+                f"osd.{peer}", MPGPush(pgid, -1, push, deletes))
+        if pull:
+            # the primary itself is behind (e.g. revived empty): pull
+            self.messenger.send_message(
+                f"osd.{peer}", MPGPull(pgid, pull))
+
+    def _handle_pg_pull(self, conn, m: MPGPull) -> None:
+        cid = CollectionId(m.pgid.pool, m.pgid.seed)
+        push = {}
+        for name in m.names:
+            try:
+                data = self.store.read(cid, ObjectId(name)).to_bytes()
+                attrs = self.store.getattrs(cid, ObjectId(name))
+                push[name] = (int(attrs.get("v", 0)), data)
+            except NoSuchObject:
+                continue
+        if push:
+            conn.send(MPGPush(m.pgid, -1, push))
+
+    def _recover_ec(self, pgid, pool, up, peer, peer_inv, my_inv,
+                    dead) -> None:
+        """Rebuild missing shards on `peer` from k survivors."""
+        # authority object set: union of all shard inventories we know of
+        # (primary's own + this peer's); keyed by name -> version
+        names: dict[str, int] = {}
+        for (name, _s), v in list(my_inv.items()) + list(peer_inv.items()):
+            names[name] = max(names.get(name, -1), v)
+        # deletes win: drop dead names from recovery, purge stray shards
+        deletes = {}
+        for name in list(names):
+            if dead.get(name, -1) >= names[name]:
+                deletes[name] = dead[name]
+                del names[name]
+        if deletes:
+            cid = CollectionId(pgid.pool, pgid.seed)
+            for name in deletes:
+                for (iname, shard), _v in list(my_inv.items()):
+                    if iname == name:
+                        obj = ObjectId(name, shard=shard)
+                        if self.store.exists(cid, obj):
+                            self.store.queue_transaction(
+                                Transaction().remove(cid, obj))
+            if peer != self.osd_id:
+                self.messenger.send_message(
+                    f"osd.{peer}", MPGPush(pgid, -3, {}, deletes))
+        for shard, osd in enumerate(up):
+            if osd == peer:
+                for name, version in names.items():
+                    if peer_inv.get((name, shard), -1) >= version:
+                        continue  # peer current for its shard
+                    self._rebuild_shard(pgid, name, shard, peer, version)
+            elif osd == self.osd_id:
+                # the peer's inventory may reveal objects where MY OWN
+                # shard is missing/stale (e.g. primary revived empty)
+                for name, version in names.items():
+                    if my_inv.get((name, shard), -1) >= version:
+                        continue
+                    self._rebuild_shard(pgid, name, shard, self.osd_id,
+                                        version)
+
+    def _rebuild_shard(self, pgid, name, shard, peer, version) -> None:
+        """Reconstruct one shard from k survivors, then push it."""
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        codec = self._pool_codec(pgid.pool)
+        tid = next(self._tids)
+
+        def on_done(pr) -> None:
+            if pr is None:
+                return
+            chunks = pr.chunks
+            if shard in chunks:
+                rebuilt = chunks[shard]
+            else:
+                out = codec.decode([shard], dict(chunks))
+                rebuilt = out[shard]
+            total = self._ec_total_len(pr)
+            self.perf.inc("recovery_push")
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MPGPush(pgid, shard,
+                        {name: (version, rebuilt.tobytes(), total)}))
+
+        pr = _PendingRead(None, 0, pgid.pool, name,
+                          total_shards=sum(1 for u in up
+                                           if u is not None and u != peer),
+                          on_done=on_done)
+        self._pending_reads[tid] = pr
+        fan_up = [None if u == peer else u for u in up]
+        self._fan_shard_reads(tid, pgid, name, fan_up)
+
+    def _handle_pg_push(self, conn, m: MPGPush) -> None:
+        cid = CollectionId(m.pgid.pool, m.pgid.seed)
+        for name, version in m.deletes.items():
+            self._record_tombstone(m.pgid, name, version)
+            for oid in (list(self.store.list_objects(cid))
+                        if cid in self.store.list_collections() else []):
+                if oid.name == name:
+                    self.store.queue_transaction(
+                        Transaction().remove(cid, oid))
+        dead = self._tombstones.get(m.pgid, {})
+        for name, payload in m.objects.items():
+            if dead.get(name, -1) >= payload[0]:
+                continue  # delete raced ahead of this push
+            if m.shard >= 0:
+                version, data, total = payload
+                attrs = {"v": version}
+                if total is not None:
+                    attrs["len"] = total
+                self._apply_write(m.pgid, name, m.shard, data, attrs)
+            else:
+                version, data = payload
+                self._apply_write(m.pgid, name, -1, data,
+                                  {"v": version, "len": len(data)})
+        self._pg_versions[m.pgid] = max(
+            self._pg_versions.get(m.pgid, 0),
+            max((p[0] for p in m.objects.values()), default=0))

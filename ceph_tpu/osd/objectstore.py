@@ -1,0 +1,362 @@
+"""ObjectStore: local object storage API + in-memory implementation.
+
+The capability of the reference's ObjectStore layer (src/os/ObjectStore.h —
+collections of objects, atomic Transactions with ordered op-codes,
+queue_transactions with commit callbacks :241, factory create
+src/os/ObjectStore.cc:28) with MemStore (src/os/memstore/MemStore.cc) as
+the first backend — the reference's own test/fake backend and the minimal
+slice target (SURVEY.md §7.3).  A BlueStore-shaped durable backend slots in
+behind the same factory later.
+
+Objects are keyed by (pool, shard, name) — the ghobject role: shard id
+distinguishes EC shard copies, generation supports EC rollback (deferred).
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from ..utils.buffer import BufferList
+
+
+class StoreError(Exception):
+    pass
+
+
+class NoSuchObject(StoreError):
+    pass
+
+
+class NoSuchCollection(StoreError):
+    pass
+
+
+@dataclass(frozen=True, order=True)
+class ObjectId:
+    """ghobject-shaped key: name + shard (EC) + snapshot generation."""
+
+    name: str
+    shard: int = -1  # -1 = whole object / replicated (NO_SHARD)
+    generation: int = -1
+
+    def __str__(self) -> str:
+        s = self.name
+        if self.shard >= 0:
+            s += f"(s{self.shard})"
+        if self.generation >= 0:
+            s += f"(g{self.generation})"
+        return s
+
+
+@dataclass(frozen=True, order=True)
+class CollectionId:
+    """One PG's object namespace (coll_t)."""
+
+    pool: int
+    pg_seed: int
+
+    def __str__(self) -> str:
+        return f"{self.pool}.{self.pg_seed:x}"
+
+
+class TxOp(enum.Enum):
+    TOUCH = "touch"
+    WRITE = "write"
+    ZERO = "zero"
+    TRUNCATE = "truncate"
+    REMOVE = "remove"
+    SETATTRS = "setattrs"
+    RMATTR = "rmattr"
+    OMAP_SETKEYS = "omap_setkeys"
+    OMAP_RMKEYS = "omap_rmkeys"
+    CLONE = "clone"
+    CREATE_COLLECTION = "create_collection"
+    REMOVE_COLLECTION = "remove_collection"
+
+
+@dataclass
+class Transaction:
+    """Ordered list of mutations applied atomically (Transaction.h)."""
+
+    ops: list[tuple] = field(default_factory=list)
+
+    def touch(self, cid, oid):
+        self.ops.append((TxOp.TOUCH, cid, oid))
+        return self
+
+    def write(self, cid, oid, offset: int, data):
+        if not isinstance(data, BufferList):
+            data = BufferList(data)
+        self.ops.append((TxOp.WRITE, cid, oid, offset, data))
+        return self
+
+    def zero(self, cid, oid, offset: int, length: int):
+        self.ops.append((TxOp.ZERO, cid, oid, offset, length))
+        return self
+
+    def truncate(self, cid, oid, size: int):
+        self.ops.append((TxOp.TRUNCATE, cid, oid, size))
+        return self
+
+    def remove(self, cid, oid):
+        self.ops.append((TxOp.REMOVE, cid, oid))
+        return self
+
+    def setattrs(self, cid, oid, attrs: dict[str, bytes]):
+        self.ops.append((TxOp.SETATTRS, cid, oid, dict(attrs)))
+        return self
+
+    def rmattr(self, cid, oid, name: str):
+        self.ops.append((TxOp.RMATTR, cid, oid, name))
+        return self
+
+    def omap_setkeys(self, cid, oid, kv: dict[str, bytes]):
+        self.ops.append((TxOp.OMAP_SETKEYS, cid, oid, dict(kv)))
+        return self
+
+    def omap_rmkeys(self, cid, oid, keys):
+        self.ops.append((TxOp.OMAP_RMKEYS, cid, oid, list(keys)))
+        return self
+
+    def clone(self, cid, src, dst):
+        self.ops.append((TxOp.CLONE, cid, src, dst))
+        return self
+
+    def create_collection(self, cid):
+        self.ops.append((TxOp.CREATE_COLLECTION, cid))
+        return self
+
+    def remove_collection(self, cid):
+        self.ops.append((TxOp.REMOVE_COLLECTION, cid))
+        return self
+
+    def append(self, other: "Transaction"):
+        self.ops.extend(other.ops)
+        return self
+
+    def empty(self) -> bool:
+        return not self.ops
+
+
+class ObjectStore:
+    """Abstract store; see MemStore below."""
+
+    @staticmethod
+    def create(kind: str, **kw) -> "ObjectStore":
+        """Factory (ObjectStore::create): 'memstore' today; 'filestore'
+        (durable, WAL-backed) is the planned second backend."""
+        if kind == "memstore":
+            return MemStore(**kw)
+        raise StoreError(f"unknown objectstore backend {kind!r}")
+
+    # -- lifecycle ---------------------------------------------------------
+    def mount(self) -> None: ...
+    def umount(self) -> None: ...
+
+    # -- mutation ----------------------------------------------------------
+    def queue_transaction(self, tx: Transaction,
+                          on_commit: Callable[[], None] | None = None) -> None:
+        raise NotImplementedError
+
+    # -- queries -----------------------------------------------------------
+    def read(self, cid, oid, offset: int = 0,
+             length: int | None = None) -> BufferList:
+        raise NotImplementedError
+
+    def stat(self, cid, oid) -> dict:
+        raise NotImplementedError
+
+    def exists(self, cid, oid) -> bool:
+        raise NotImplementedError
+
+    def getattrs(self, cid, oid) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def omap_get(self, cid, oid) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def list_objects(self, cid) -> list[ObjectId]:
+        raise NotImplementedError
+
+    def list_collections(self) -> list[CollectionId]:
+        raise NotImplementedError
+
+
+class _Obj:
+    __slots__ = ("data", "attrs", "omap")
+
+    def __init__(self):
+        self.data = bytearray()
+        self.attrs: dict[str, bytes] = {}
+        self.omap: dict[str, bytes] = {}
+
+
+class MemStore(ObjectStore):
+    """In-RAM ObjectStore with atomic transactions (MemStore.cc role)."""
+
+    def __init__(self):
+        self._colls: dict[CollectionId, dict[ObjectId, _Obj]] = {}
+        self._lock = threading.RLock()
+        self._mounted = False
+
+    def mount(self) -> None:
+        self._mounted = True
+
+    def umount(self) -> None:
+        self._mounted = False
+
+    # -- transaction application (atomic under the store lock) -------------
+    def queue_transaction(self, tx: Transaction,
+                          on_commit: Callable[[], None] | None = None) -> None:
+        with self._lock:
+            # validate-then-apply gives all-or-nothing semantics; track
+            # objects/collections materialised earlier in this SAME tx so
+            # e.g. touch-then-truncate sequences validate
+            created: set[tuple] = set()
+            for op in tx.ops:
+                self._check(op, created)
+            for op in tx.ops:
+                self._apply(op)
+        if on_commit:
+            on_commit()
+
+    def _coll(self, cid) -> dict[ObjectId, _Obj]:
+        c = self._colls.get(cid)
+        if c is None:
+            raise NoSuchCollection(str(cid))
+        return c
+
+    _CREATES = (TxOp.TOUCH, TxOp.WRITE, TxOp.ZERO, TxOp.SETATTRS,
+                TxOp.OMAP_SETKEYS, TxOp.TRUNCATE)
+
+    def _check(self, op, created: set) -> None:
+        kind = op[0]
+        if kind == TxOp.CREATE_COLLECTION:
+            created.add(("coll", op[1]))
+            return
+        if kind == TxOp.REMOVE_COLLECTION:
+            if ("coll", op[1]) not in created:
+                self._coll(op[1])
+            return
+        cid = op[1]
+        if ("coll", cid) in created:
+            coll = self._colls.get(cid, {})
+        else:
+            coll = self._coll(cid)
+
+        def have(oid) -> bool:
+            return oid in coll or ("obj", cid, oid) in created
+
+        if kind == TxOp.CLONE:
+            if not have(op[2]):
+                raise NoSuchObject(str(op[2]))
+            created.add(("obj", cid, op[3]))
+            return
+        if kind in (TxOp.REMOVE, TxOp.RMATTR,
+                    TxOp.OMAP_RMKEYS) and not have(op[2]):
+            raise NoSuchObject(str(op[2]))
+        if kind in self._CREATES:
+            created.add(("obj", cid, op[2]))
+
+    def _apply(self, op) -> None:
+        kind = op[0]
+        if kind == TxOp.CREATE_COLLECTION:
+            self._colls.setdefault(op[1], {})
+            return
+        if kind == TxOp.REMOVE_COLLECTION:
+            self._colls.pop(op[1], None)
+            return
+        cid, oid = op[1], op[2]
+        coll = self._coll(cid)
+        if kind == TxOp.TOUCH:
+            coll.setdefault(oid, _Obj())
+        elif kind == TxOp.WRITE:
+            _, _, _, offset, data = op
+            o = coll.setdefault(oid, _Obj())
+            raw = data.to_bytes()
+            end = offset + len(raw)
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[offset:end] = raw
+        elif kind == TxOp.ZERO:
+            _, _, _, offset, length = op
+            o = coll.setdefault(oid, _Obj())
+            end = offset + length
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[offset:end] = b"\0" * length
+        elif kind == TxOp.TRUNCATE:
+            o = coll.setdefault(oid, _Obj())
+            size = op[3]
+            if len(o.data) > size:
+                del o.data[size:]
+            else:
+                o.data.extend(b"\0" * (size - len(o.data)))
+        elif kind == TxOp.REMOVE:
+            coll.pop(oid, None)
+        elif kind == TxOp.SETATTRS:
+            coll.setdefault(oid, _Obj()).attrs.update(op[3])
+        elif kind == TxOp.RMATTR:
+            coll[oid].attrs.pop(op[3], None)
+        elif kind == TxOp.OMAP_SETKEYS:
+            coll.setdefault(oid, _Obj()).omap.update(op[3])
+        elif kind == TxOp.OMAP_RMKEYS:
+            o = coll[oid]
+            for k in op[3]:
+                o.omap.pop(k, None)
+        elif kind == TxOp.CLONE:
+            src = coll[op[2]]
+            dst = coll.setdefault(op[3], _Obj())
+            dst.data = bytearray(src.data)
+            dst.attrs = dict(src.attrs)
+            dst.omap = dict(src.omap)
+        else:  # pragma: no cover
+            raise StoreError(f"unknown tx op {kind}")
+
+    # -- reads -------------------------------------------------------------
+    def _obj(self, cid, oid) -> _Obj:
+        with self._lock:
+            coll = self._coll(cid)
+            o = coll.get(oid)
+            if o is None:
+                raise NoSuchObject(f"{cid}/{oid}")
+            return o
+
+    def read(self, cid, oid, offset: int = 0,
+             length: int | None = None) -> BufferList:
+        o = self._obj(cid, oid)
+        with self._lock:
+            data = bytes(o.data[offset:None if length is None
+                                else offset + length])
+        return BufferList(data)
+
+    def stat(self, cid, oid) -> dict:
+        o = self._obj(cid, oid)
+        return {"size": len(o.data), "attrs": len(o.attrs),
+                "omap": len(o.omap)}
+
+    def exists(self, cid, oid) -> bool:
+        with self._lock:
+            try:
+                return oid in self._coll(cid)
+            except NoSuchCollection:
+                return False
+
+    def getattrs(self, cid, oid) -> dict[str, bytes]:
+        return dict(self._obj(cid, oid).attrs)
+
+    def omap_get(self, cid, oid) -> dict[str, bytes]:
+        return dict(self._obj(cid, oid).omap)
+
+    def list_objects(self, cid) -> list[ObjectId]:
+        with self._lock:
+            return sorted(self._coll(cid))
+
+    def list_collections(self) -> list[CollectionId]:
+        with self._lock:
+            return sorted(self._colls)

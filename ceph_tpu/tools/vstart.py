@@ -1,0 +1,95 @@
+"""MiniCluster: the vstart.sh analogue — a full cluster in one process.
+
+The reference boots mon+mgr+osd daemons from the build dir for development
+and standalone tests (src/vstart.sh; qa/standalone/ceph-helpers.sh).  Here
+a MiniCluster wires MonitorLite + N OSDDaemons + clients over a
+LocalNetwork (in-proc messenger), with kill/revive and fault-injection
+helpers shaped like the thrasher/ceph-helpers verbs.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..client.rados import RadosClient
+from ..mon.monitor import MonitorLite
+from ..msg.messenger import LocalNetwork
+from ..osd.daemon import OSDDaemon
+from ..utils.config import Config, default_config
+
+
+class MiniCluster:
+    def __init__(self, n_osds: int = 3, cfg: Config | None = None,
+                 hosts_per_osd: bool = True):
+        self.cfg = cfg or default_config()
+        self.network = LocalNetwork()
+        self.mon = MonitorLite(self.network, cfg=self.cfg)
+        self.osds: dict[int, OSDDaemon] = {}
+        self.clients: list[RadosClient] = []
+        self._n = n_osds
+        self._hosts_per_osd = hosts_per_osd
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "MiniCluster":
+        self.mon.start()
+        for i in range(self._n):
+            self.add_osd(i)
+        self.wait_for_up(self._n)
+        return self
+
+    def add_osd(self, osd_id: int) -> OSDDaemon:
+        host = f"host{osd_id}" if self._hosts_per_osd else "host0"
+        osd = OSDDaemon(osd_id, self.network, cfg=self.cfg, host=host)
+        self.osds[osd_id] = osd
+        osd.start()
+        return osd
+
+    def client(self, idx: int | None = None) -> RadosClient:
+        idx = len(self.clients) if idx is None else idx
+        c = RadosClient(self.network, f"client.{idx}").connect()
+        self.clients.append(c)
+        return c
+
+    def stop(self) -> None:
+        for c in self.clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001
+                pass
+        for o in self.osds.values():
+            o.stop()
+        self.mon.stop()
+
+    # ------------------------------------------------------------- helpers
+    def wait_for_up(self, n: int, timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if len(self.mon.osdmap.up_osds()) >= n:
+                return
+            time.sleep(0.01)
+        raise TimeoutError(f"only {len(self.mon.osdmap.up_osds())}/{n} up")
+
+    def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.mon.osdmap.epoch >= epoch:
+                return
+            time.sleep(0.01)
+        raise TimeoutError(f"epoch {self.mon.osdmap.epoch} < {epoch}")
+
+    def kill_osd(self, osd_id: int, mark_down: bool = True) -> None:
+        """Hard-kill a daemon (kill_daemon in ceph-helpers).  With
+        mark_down=False the cluster must notice via heartbeats."""
+        osd = self.osds.pop(osd_id, None)
+        if osd:
+            osd.stop()
+        if mark_down and self.clients:
+            self.clients[0].mon_command({"prefix": "osd down",
+                                         "id": osd_id})
+
+    def revive_osd(self, osd_id: int) -> OSDDaemon:
+        return self.add_osd(osd_id)
+
+    def settle(self, seconds: float = 0.2) -> None:
+        """Let in-flight dispatch/recovery drain (tests only)."""
+        time.sleep(seconds)
