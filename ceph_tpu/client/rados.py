@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 
 from ..mon.maps import OSDMap
 from ..msg.messages import (MMapPush, MMonCommand, MMonCommandReply,
@@ -157,8 +158,11 @@ class RadosClient(Dispatcher):
                 self._wait_epoch_past(self.osdmap.epoch, self.timeout)
                 continue
             if reply.result == -116:  # ESTALE: not primary under its map
-                self._wait_epoch_past(min(self.osdmap.epoch, reply.epoch - 1),
-                                      self.timeout)
+                if reply.epoch > self.osdmap.epoch:
+                    self._wait_epoch_past(reply.epoch - 1, self.timeout)
+                else:
+                    # the OSD is the stale one; give its map time to arrive
+                    time.sleep(0.05 * (attempt + 1))
                 last_error = RadosError(-116, "stale map")
                 continue
             if reply.result < 0:

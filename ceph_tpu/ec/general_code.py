@@ -1,0 +1,173 @@
+"""General (possibly non-MDS) systematic matrix codes.
+
+LRC and SHEC are systematic codes whose parity rows do NOT form an MDS
+matrix — not every k-subset of surviving chunks can decode.  This base
+class holds the full (n, k) generator stack [I; P] and decodes by finding
+an invertible k-row subset among survivors (rank-greedy selection with the
+caller's preferred order first) — the generalisation of the reference's
+per-erasure-signature matrix inversion (jerasure matrix_decode / LRC layer
+fallback, ref src/erasure-code/lrc/ErasureCodeLrc.cc minimum_to_decode
+trying cheapest layers first).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..ops import gf256
+from .interface import ChunkMap, ErasureCodeError
+from .matrix_code import MatrixErasureCode
+
+
+def independent_rows(full: np.ndarray, candidates: list[int],
+                     k: int) -> list[int] | None:
+    """Greedy rank-building selection of k independent rows (GF(2^8))."""
+    chosen: list[int] = []
+    for rid in candidates:
+        if len(chosen) == k:
+            break
+        if _gf_rank(full[chosen + [rid]]) > len(chosen):
+            chosen.append(rid)
+    return chosen if len(chosen) == k else None
+
+
+def _gf_rref(M: np.ndarray) -> np.ndarray:
+    M = M.copy()
+    rows, cols = M.shape
+    mt = gf256.mul_table()
+    r = 0
+    for c in range(cols):
+        piv = None
+        for i in range(r, rows):
+            if M[i, c]:
+                piv = i
+                break
+        if piv is None:
+            continue
+        M[[r, piv]] = M[[piv, r]]
+        M[r] = mt[gf256.inv_table()[M[r, c]], M[r]]
+        for i in range(rows):
+            if i != r and M[i, c]:
+                M[i] ^= mt[M[i, c], M[r]]
+        r += 1
+        if r == rows:
+            break
+    # move zero rows to the bottom
+    nz = [i for i in range(rows) if M[i].any()]
+    z = [i for i in range(rows) if not M[i].any()]
+    return M[nz + z]
+
+
+def _gf_rank(M: np.ndarray) -> int:
+    R = _gf_rref(M)
+    return int(sum(1 for i in range(R.shape[0]) if R[i].any()))
+
+
+class GeneralMatrixCode(MatrixErasureCode):
+    """Systematic code over a full (n, k) generator stack [I; P]."""
+
+    #: subclasses set full generator stack; parity block = rows [k:]
+    full: np.ndarray
+
+    def _init_general(self) -> None:
+        self.matrix = np.ascontiguousarray(self.full[self.k:])
+        self._init_matrix_backend()
+
+    # -- chunk-space repair equations (the locality machinery) -------------
+    def repair_equations(self) -> list[dict[int, int]]:
+        """GF-linear relations among CHUNKS: each dict {chunk_id: coef}
+        satisfies XOR_i coef_i * chunk_i = 0.  The default is one equation
+        per parity row (parity = combination of data chunks); locality
+        codes override/extend with narrower relations (LRC's group XORs) —
+        single failures then repair from one equation instead of a k-wide
+        inversion."""
+        eqs = []
+        for j in range(self.m):
+            eq = {self.k + j: 1}
+            for c in range(self.k):
+                if self.full[self.k + j, c]:
+                    eq[c] = int(self.full[self.k + j, c])
+            eqs.append(eq)
+        return eqs
+
+    def _cheap_repair_eq(self, missing: int,
+                         avail: set[int]) -> dict[int, int] | None:
+        """Smallest repair equation covering `missing` with all other
+        participants available."""
+        best = None
+        for eq in self.repair_equations():
+            if missing not in eq:
+                continue
+            others = [i for i in eq if i != missing]
+            if all(i in avail for i in others):
+                if best is None or len(eq) < len(best):
+                    best = eq
+        return best
+
+    def _apply_repair_eq(self, eq: dict[int, int], missing: int,
+                         chunks: ChunkMap) -> np.ndarray:
+        acc = None
+        for i, coef in eq.items():
+            if i == missing:
+                continue
+            t = gf256.gf_mul(np.uint8(coef),
+                             np.asarray(chunks[i], dtype=np.uint8))
+            acc = t if acc is None else acc ^ t
+        return gf256.gf_mul(gf256.inv_table()[eq[missing]], acc)
+
+    # -- decode preference order (subclasses refine for locality) ----------
+    def _decode_candidates(self, want: Sequence[int],
+                           available: Sequence[int]) -> list[int]:
+        """Order in which surviving rows should be tried."""
+        avail = sorted(available)
+        return ([i for i in avail if i < self.k]
+                + [i for i in avail if i >= self.k])
+
+    def minimum_to_decode(self, want, available):
+        want_s, avail_s = set(want), set(available)
+        if want_s <= avail_s:
+            return sorted(want_s)
+        missing = sorted(want_s - avail_s)
+        if len(missing) == 1:
+            eq = self._cheap_repair_eq(missing[0], avail_s)
+            if eq is not None:
+                return sorted((set(eq) - {missing[0]})
+                              | (want_s & avail_s))
+        rows = independent_rows(
+            self.full, self._decode_candidates(want, available), self.k)
+        if rows is None:
+            raise ErasureCodeError(
+                f"cannot decode {sorted(want_s)} from {sorted(avail_s)}")
+        return sorted(set(rows) | (want_s & avail_s))
+
+    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
+        avail = [i for i in chunks if i < self.chunk_count]
+        missing = [i for i in want if i not in chunks]
+        if len(missing) == 1:
+            eq = self._cheap_repair_eq(missing[0], set(avail))
+            if eq is not None:
+                out = {i: chunks[i] for i in want if i in chunks}
+                out[missing[0]] = self._apply_repair_eq(
+                    eq, missing[0], chunks)
+                return out
+        rows = independent_rows(
+            self.full, self._decode_candidates(want, avail), self.k)
+        if rows is None:
+            raise ErasureCodeError(
+                f"cannot decode {sorted(want)} from {sorted(avail)}")
+        sub = self.full[rows]
+        D = gf256.gf_mat_inv(sub)
+        stack = np.stack([np.ascontiguousarray(chunks[i], dtype=np.uint8)
+                          for i in rows])
+        data = self._matmul(D, stack)
+        out: ChunkMap = {}
+        for i in want:
+            if i in chunks:
+                out[i] = chunks[i]
+            elif i < self.k:
+                out[i] = data[i]
+            else:
+                out[i] = self._matmul(self.full[[i]], data)[0]
+        return out

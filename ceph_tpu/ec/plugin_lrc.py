@@ -1,0 +1,103 @@
+"""LRC plugin: locally-repairable layered code.
+
+The capability of the reference's lrc plugin
+(/root/reference/src/erasure-code/lrc/ErasureCodeLrc.{h,cc}: layered
+chunk-pattern profiles, minimum_to_decode preferring the cheapest layer).
+This build implements the common simple form `k=K m=M l=L`: K data chunks,
+M global Reed-Solomon parities, and one local XOR parity per group of L
+consecutive chunks taken over the (data + global) sequence — so a single
+lost chunk repairs from its L-1 group neighbours instead of K chunks
+(the locality win), and multi-failures fall back to the global layer.
+
+Chunk layout: [0..k) data, [k..k+m) global parity,
+[k+m..k+m+(k+m)/l) local parity (group g covers chunks [g*l, (g+1)*l)).
+Requires l to divide k+m.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import gf256
+from .general_code import GeneralMatrixCode
+from .interface import ErasureCodeError, profile_int
+from .registry import register
+
+PLUGIN_API_VERSION = 1
+
+
+@register("lrc")
+class LrcCode(GeneralMatrixCode):
+    def _init_from_profile(self) -> None:
+        self.k = profile_int(self.profile, "k", 4)
+        self.global_m = profile_int(self.profile, "m", 2)
+        self.l = profile_int(self.profile, "l", 3)
+        if self.l <= 0 or (self.k + self.global_m) % self.l:
+            raise ErasureCodeError(
+                f"l={self.l} must divide k+m={self.k + self.global_m}")
+        self.groups = (self.k + self.global_m) // self.l
+        # total parity chunks = global + local
+        self.m = self.global_m + self.groups
+        k, gm = self.k, self.global_m
+        C = gf256.vandermonde_matrix(k, gm)  # global parities
+        # full stack rows for data+global, then local XOR rows over groups
+        dg = np.concatenate([np.eye(k, dtype=np.uint8), C])  # (k+gm, k)
+        local = np.zeros((self.groups, k), dtype=np.uint8)
+        for g in range(self.groups):
+            for member in range(g * self.l, (g + 1) * self.l):
+                local[g] ^= dg[member]
+        self.full = np.concatenate([dg, local])
+        self._init_general()
+
+    def get_flags(self):
+        from .interface import Flags
+        return super().get_flags() & ~Flags.PARITY_DELTA_OPTIMIZATION
+
+    def repair_equations(self):
+        """Group XOR relations (local = XOR of its l members, members may
+        be data OR global-parity chunks) + the global parity relations."""
+        eqs = super().repair_equations()
+        for g in range(self.groups):
+            eq = {self.k + self.global_m + g: 1}
+            for member in range(g * self.l, (g + 1) * self.l):
+                eq[member] = 1
+            eqs.append(eq)
+        return eqs
+
+    def _group_of(self, chunk: int) -> int | None:
+        """Locality group of a data/global chunk (None for local parities)."""
+        if chunk < self.k + self.global_m:
+            return chunk // self.l
+        return None
+
+    def _decode_candidates(self, want, available):
+        """Prefer the failed chunk's group members (local repair), then
+        data, then global, then other locals — the cheapest-layer-first
+        rule of the reference's LRC minimum_to_decode."""
+        avail = set(available)
+        missing = [i for i in want if i not in avail]
+        order: list[int] = []
+
+        def add(ids):
+            for i in ids:
+                if i in avail and i not in order:
+                    order.append(i)
+
+        for miss in missing:
+            g = self._group_of(miss)
+            if g is None and miss >= self.k + self.global_m:
+                g = miss - (self.k + self.global_m)
+            if g is not None:
+                add(range(g * self.l, min((g + 1) * self.l,
+                                          self.k + self.global_m)))
+                add([self.k + self.global_m + g])
+        add(range(self.k))
+        add(range(self.k, self.k + self.global_m))
+        add(range(self.k + self.global_m, self.chunk_count))
+        return order
+
+    def repair_cost(self, chunk: int, available) -> int:
+        """Chunks read to repair a single failure (locality metric)."""
+        return len(self.minimum_to_decode([chunk],
+                                          [i for i in available
+                                           if i != chunk]))

@@ -1,0 +1,158 @@
+"""Stripe geometry and write planning.
+
+The capability of the reference's ECUtil stripe layer
+(/root/reference/src/osd/ECUtil.h: stripe_info_t :452-800 — stripe_width /
+chunk_size bookkeeping, chunk_mapping permutation + reverse :477-517, the
+ro-offset <-> shard-offset coordinate algebra :614-795, EC_ALIGN_SIZE=4096
+:33) plus the write-plan decision of ECTransaction (ECTransaction.h:30-66
+WritePlanObj: full-stripe encode vs partial write vs parity delta), shaped
+for the TPU build: geometry is pure data (friendly to batching stripes
+into device tensors), extents are IntervalSets.
+
+"ro" (raw object) space is the client's contiguous byte stream; it maps
+RAID-0-style onto k data shards in `chunk_size` units:
+ro byte x lives at shard (x // chunk_size) % k, offset
+(x // stripe_width) * chunk_size + x % chunk_size.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..utils.interval import IntervalSet
+from .interface import EC_ALIGN_SIZE, Flags
+
+
+@dataclass(frozen=True)
+class StripeInfo:
+    k: int
+    m: int
+    chunk_size: int
+    chunk_mapping: tuple = ()  # raw shard index -> stored shard id
+
+    def __post_init__(self):
+        if self.chunk_size <= 0 or self.chunk_size % EC_ALIGN_SIZE:
+            raise ValueError(
+                f"chunk_size {self.chunk_size} must be a positive multiple "
+                f"of {EC_ALIGN_SIZE}")
+        if self.chunk_mapping:
+            if sorted(self.chunk_mapping) != list(range(self.k + self.m)):
+                raise ValueError("chunk_mapping must permute 0..k+m-1")
+
+    # -- geometry ----------------------------------------------------------
+    @property
+    def stripe_width(self) -> int:
+        return self.k * self.chunk_size
+
+    @property
+    def chunk_count(self) -> int:
+        return self.k + self.m
+
+    def shard_of(self, raw_index: int) -> int:
+        """Apply the chunk_mapping permutation (identity if unset)."""
+        return self.chunk_mapping[raw_index] if self.chunk_mapping \
+            else raw_index
+
+    def raw_of(self, shard: int) -> int:
+        """Reverse permutation (ECUtil's reverse chunk_mapping)."""
+        if not self.chunk_mapping:
+            return shard
+        return self.chunk_mapping.index(shard)
+
+    # -- coordinate algebra (ro <-> shard) ---------------------------------
+    def ro_to_shard(self, ro_off: int) -> tuple[int, int]:
+        """ro byte -> (shard id, shard offset)."""
+        stripe, within = divmod(ro_off, self.stripe_width)
+        raw_shard, chunk_off = divmod(within, self.chunk_size)
+        return (self.shard_of(raw_shard),
+                stripe * self.chunk_size + chunk_off)
+
+    def shard_to_ro(self, shard: int, shard_off: int) -> int:
+        """(data shard id, shard offset) -> ro byte."""
+        raw = self.raw_of(shard)
+        if raw >= self.k:
+            raise ValueError(f"shard {shard} is parity; no ro address")
+        stripe, chunk_off = divmod(shard_off, self.chunk_size)
+        return (stripe * self.stripe_width + raw * self.chunk_size
+                + chunk_off)
+
+    def ro_range_to_shard_extents(self, off: int,
+                                  length: int) -> dict[int, IntervalSet]:
+        """ro byte range -> per-data-shard IntervalSets of shard offsets
+        (the shard_extent_set_t construction)."""
+        out: dict[int, IntervalSet] = {}
+        end = off + length
+        while off < end:
+            shard, soff = self.ro_to_shard(off)
+            take = min(self.chunk_size - soff % self.chunk_size, end - off)
+            out.setdefault(shard, IntervalSet()).insert(soff, take)
+            off += take
+        return out
+
+    def aligned_ro_range(self, off: int, length: int) -> tuple[int, int]:
+        """Expand an ro range to page-aligned full-stripe-row boundaries
+        (the pad_and_rebuild_to_ec_align step, ECUtil.cc:749)."""
+        start = (off // self.stripe_width) * self.stripe_width
+        end = -(-(off + length) // self.stripe_width) * self.stripe_width
+        return start, end - start
+
+    def object_chunk_size(self, object_size: int) -> int:
+        """Per-shard bytes for an object (full stripes, zero padded)."""
+        stripes = -(-object_size // self.stripe_width)
+        return stripes * self.chunk_size
+
+
+# ---------------------------------------------------------------------------
+# Write planning (the ECTransaction WritePlan decision table)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class WritePlan:
+    """How to execute an overwrite of [off, off+length) on an object."""
+
+    mode: str                 # "full_stripe" | "parity_delta" | "rmw"
+    read_extents: dict        # shard -> IntervalSet needed before writing
+    touched_shards: tuple     # data shards being modified
+    aligned_off: int
+    aligned_len: int
+
+
+def plan_write(si: StripeInfo, object_size: int, off: int, length: int,
+               flags: Flags) -> WritePlan:
+    """Decide full-stripe encode vs parity-delta vs read-modify-write,
+    mirroring the decision inputs of ECTransaction.h:30-66 (plugin flags +
+    geometry).  Rules:
+    - writes covering whole stripe rows (or growing the object) need no
+      reads: full_stripe;
+    - sub-stripe overwrites with PARITY_DELTA support read only the old
+      bytes being overwritten (delta = old ^ new folded into parity);
+    - otherwise read the rest of each touched stripe row and re-encode.
+    """
+    aligned_off, aligned_len = si.aligned_ro_range(off, length)
+    touched = si.ro_range_to_shard_extents(off, length)
+    covers_rows = off % si.stripe_width == 0 and (
+        length % si.stripe_width == 0 or off + length >= object_size)
+    # appends are read-free only when the touched rows hold NO live data
+    # (object ends at or before the aligned row start)
+    if covers_rows or object_size <= aligned_off:
+        return WritePlan("full_stripe", {}, tuple(sorted(touched)),
+                         aligned_off, aligned_len)
+    if flags & Flags.PARITY_DELTA_OPTIMIZATION:
+        return WritePlan("parity_delta", touched, tuple(sorted(touched)),
+                         aligned_off, aligned_len)
+    # rmw: read the untouched remainder of each affected stripe row
+    need: dict[int, IntervalSet] = {}
+    row0 = aligned_off // si.stripe_width
+    rows = aligned_len // si.stripe_width
+    for shard in range(si.k):
+        sid = si.shard_of(shard)
+        iv = IntervalSet()
+        iv.insert(row0 * si.chunk_size, rows * si.chunk_size)
+        written = touched.get(sid)
+        if written:
+            for s, e in written:
+                iv.erase(s, e - s)
+        if not iv.empty():
+            need[sid] = iv
+    return WritePlan("rmw", need, tuple(sorted(touched)),
+                     aligned_off, aligned_len)

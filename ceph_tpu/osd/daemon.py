@@ -72,6 +72,7 @@ class _PendingRead:
     replies: int = 0
     offset: int = 0
     length: int = 0
+    stat_only: bool = False  # reply with the object length, not data
     # recovery reads carry a completion callback instead of a client
     on_done: object = None
     stamp: float = field(default_factory=time.time)
@@ -94,6 +95,9 @@ class OSDDaemon(Dispatcher):
         self.messenger.add_dispatcher(self)
         self.osdmap: OSDMap | None = None
         self._tids = itertools.count(1)
+        # pending tables are touched by the dispatch thread AND the
+        # heartbeat sweep; ownership transfers happen under this lock
+        self._pending_lock = threading.Lock()
         self._pending_writes: dict[int, _PendingWrite] = {}
         self._pending_reads: dict[int, _PendingRead] = {}
         self._pg_versions: dict[PgId, int] = {}
@@ -305,7 +309,7 @@ class OSDDaemon(Dispatcher):
     def _stat(self, conn, m: MOSDOp, pgid: PgId, shard: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         oid = ObjectId(m.oid, shard=shard)
-        # EC stat falls back across shards (primary may not hold shard 0)
+        # EC stat probes local shards first (primary may hold any shard)
         candidates = [oid] if shard < 0 else [
             ObjectId(m.oid, shard=s)
             for s in range(self.osdmap.pools[pgid.pool].size)]
@@ -318,6 +322,18 @@ class OSDDaemon(Dispatcher):
             conn.send(MOSDOpReply(m.tid, 0,
                                   data=size.to_bytes(8, "little"),
                                   epoch=self.osdmap.epoch))
+            return
+        if shard >= 0:
+            # recovery window: this primary holds nothing yet — ask the
+            # shard holders (stat must agree with the readable object)
+            up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+            tid = next(self._tids)
+            pr = _PendingRead(m.client, m.tid, pgid.pool, m.oid,
+                              total_shards=sum(1 for u in up
+                                               if u is not None),
+                              stat_only=True)
+            self._pending_reads[tid] = pr
+            self._fan_shard_reads(tid, pgid, m.oid, up)
             return
         conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
 
@@ -408,17 +424,22 @@ class OSDDaemon(Dispatcher):
         self._on_shard_read(m.tid, m.shard, m.result, m.data, m.attrs)
 
     def _on_shard_read(self, tid, shard, result, data, attrs) -> None:
-        pr = self._pending_reads.get(tid)
-        if pr is None:
-            return
-        pr.replies += 1
-        if result == 0:
-            pr.chunks[shard] = np.frombuffer(data, dtype=np.uint8)
-            if attrs:
-                pr.attrs.update(attrs)
-        if pr.replies >= pr.total_shards:
-            del self._pending_reads[tid]
-            self._finish_ec_read(pr)
+        with self._pending_lock:
+            pr = self._pending_reads.get(tid)
+            if pr is None:
+                return
+            pr.replies += 1
+            if result == 0:
+                pr.chunks[shard] = np.frombuffer(data, dtype=np.uint8)
+                if attrs:
+                    pr.attrs.update(attrs)
+            # finish as soon as enough chunks to decode are present — no
+            # waiting for parity stragglers (the ReadPipeline returns at k)
+            k = self._pool_codec(pr.pool).k
+            if len(pr.chunks) < k and pr.replies < pr.total_shards:
+                return
+            self._pending_reads.pop(tid, None)
+        self._finish_ec_read(pr)
 
     def _finish_ec_read(self, pr: _PendingRead) -> None:
         codec = self._pool_codec(pr.pool)
@@ -436,6 +457,15 @@ class OSDDaemon(Dispatcher):
             return
         # total length rides shard attrs; recompute from any shard
         total = self._ec_total_len(pr)
+        if pr.stat_only:
+            if pr.client:
+                size = int(total or 0)
+                self.messenger.send_message(
+                    pr.client,
+                    MOSDOpReply(pr.client_tid, 0,
+                                data=size.to_bytes(8, "little"),
+                                epoch=epoch))
+            return
         data_ids = list(range(codec.k))
         if all(i in pr.chunks for i in data_ids):
             out = np.concatenate([pr.chunks[i] for i in data_ids])
@@ -529,19 +559,21 @@ class OSDDaemon(Dispatcher):
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
 
     def _handle_sub_write_reply(self, conn, m: MSubWriteReply) -> None:
-        pw = self._pending_writes.get(m.tid)
-        if pw is None:
-            return
-        if m.result != 0:
-            pw.failed += 1
-        pw.acks_needed -= 1
-        if pw.acks_needed <= 0:
-            del self._pending_writes[m.tid]
-            result = EIO if pw.failed else 0
-            self.messenger.send_message(
-                pw.client,
-                MOSDOpReply(pw.client_tid, result, version=pw.version,
-                            epoch=self.osdmap.epoch if self.osdmap else 0))
+        with self._pending_lock:
+            pw = self._pending_writes.get(m.tid)
+            if pw is None:
+                return
+            if m.result != 0:
+                pw.failed += 1
+            pw.acks_needed -= 1
+            if pw.acks_needed > 0:
+                return
+            self._pending_writes.pop(m.tid, None)
+        result = EIO if pw.failed else 0
+        self.messenger.send_message(
+            pw.client,
+            MOSDOpReply(pw.client_tid, result, version=pw.version,
+                        epoch=self.osdmap.epoch if self.osdmap else 0))
 
     # ----------------------------------------------------------- heartbeats
     def _heartbeat_loop(self) -> None:
@@ -558,8 +590,10 @@ class OSDDaemon(Dispatcher):
                 self.messenger.send_message(
                     f"osd.{peer}",
                     MOSDPing(self.osd_id, self.osdmap.epoch, now))
-                last = self._hb_last.get(peer)
-                if last is not None and now - last > grace:
+                # seed the clock at first observation so a peer that never
+                # answers a single ping still gets reported
+                last = self._hb_last.setdefault(peer, now)
+                if now - last > grace:
                     self.perf.inc("failure_reports")
                     self.messenger.send_message(
                         self.mon,
@@ -570,16 +604,22 @@ class OSDDaemon(Dispatcher):
         """Fail ops whose sub-ops never completed (peer died mid-op) so
         clients get an error instead of a timeout and tables don't leak."""
         epoch = self.osdmap.epoch if self.osdmap else 0
-        for tid, pw in list(self._pending_writes.items()):
-            if now - pw.stamp > max_age:
-                self._pending_writes.pop(tid, None)
-                self.messenger.send_message(
-                    pw.client, MOSDOpReply(pw.client_tid, EIO,
-                                           version=pw.version, epoch=epoch))
-        for tid, pr in list(self._pending_reads.items()):
-            if now - pr.stamp > max_age:
-                self._pending_reads.pop(tid, None)
-                self._finish_ec_read(pr)  # decodes if >= k arrived, else err
+        expired_w, expired_r = [], []
+        with self._pending_lock:
+            for tid, pw in list(self._pending_writes.items()):
+                if now - pw.stamp > max_age:
+                    self._pending_writes.pop(tid, None)
+                    expired_w.append(pw)
+            for tid, pr in list(self._pending_reads.items()):
+                if now - pr.stamp > max_age:
+                    self._pending_reads.pop(tid, None)
+                    expired_r.append(pr)
+        for pw in expired_w:
+            self.messenger.send_message(
+                pw.client, MOSDOpReply(pw.client_tid, EIO,
+                                       version=pw.version, epoch=epoch))
+        for pr in expired_r:
+            self._finish_ec_read(pr)  # decodes if >= k arrived, else err
 
     def _handle_ping(self, conn, m: MOSDPing) -> None:
         conn.send(MOSDPingReply(self.osd_id, m.stamp))
