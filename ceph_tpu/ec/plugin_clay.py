@@ -1,0 +1,291 @@
+"""CLAY plugin: coupled-layer MSR code with sub-chunking.
+
+The capability of the reference's clay plugin
+(/root/reference/src/erasure-code/clay/ErasureCodeClay.{h,cc}: k data, m
+parity, d helpers; get_sub_chunk_count() :71, minimum_to_decode returning
+sub-chunk ranges for bandwidth-optimal repair, REQUIRE_SUB_CHUNKS flag).
+
+This is an original implementation of the published coupled-layer
+construction (Clay codes, FAST'18): with q = d-k+1 and t = n/q, each chunk
+is alpha = q^t sub-chunks; node (x, y) on a q x t grid stores coupled
+symbols C related to an "uncoupled" virtual codeword U by pairwise
+invertible transforms within each column, and every z-plane of U is a
+codeword of a scalar (n, k) MDS code.  Single-node repair with d = n-1
+helpers reads only alpha/q sub-chunks from each helper (the MSR bandwidth
+point) instead of whole chunks.
+
+Round-1 scope: q*t == n configurations (covers the BASELINE config
+k=8 m=4 d=11 -> q=4, t=3, alpha=64) and d = n-1 repair; other (k, m, d)
+raise with a clear message.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..ops import gf256
+from .interface import (SIMD_ALIGN, ChunkMap, ErasureCodeError, Flags,
+                        profile_int)
+from .matrix_code import MatrixErasureCode
+from .registry import register
+
+PLUGIN_API_VERSION = 1
+
+GAMMA = 2  # coupling coefficient; needs gamma^2 != 1
+
+
+@register("clay")
+class ClayCode(MatrixErasureCode):
+    def _init_from_profile(self) -> None:
+        self.k = profile_int(self.profile, "k", 8)
+        self.m = profile_int(self.profile, "m", 4)
+        n = self.k + self.m
+        self.d = profile_int(self.profile, "d", n - 1)
+        if not self.k < n:
+            raise ErasureCodeError("need m >= 1")
+        if not self.k < self.d <= n - 1:
+            raise ErasureCodeError(f"need k < d <= k+m-1, got d={self.d}")
+        self.q = self.d - self.k + 1
+        if n % self.q:
+            raise ErasureCodeError(
+                f"clay (TPU build) needs q=d-k+1 ({self.q}) to divide "
+                f"k+m ({n}); shortened configs are future work")
+        self.t = n // self.q
+        self.alpha = self.q ** self.t
+        # scalar MDS code across each z-plane
+        self.matrix = gf256.vandermonde_matrix(self.k, self.m)
+        self.full = np.concatenate(
+            [np.eye(self.k, dtype=np.uint8), self.matrix])
+        # parity-check H = [P | I]: H @ u = 0 for plane codewords
+        self.H = np.concatenate(
+            [self.matrix, np.eye(self.m, dtype=np.uint8)], axis=1)
+        g2 = int(gf256.gf_mul(GAMMA, GAMMA))
+        self._inv_det = int(gf256.gf_inv(1 ^ g2))  # 1/(1 ^ gamma^2)
+        self._init_matrix_backend()
+
+    # -- identity ----------------------------------------------------------
+    def get_sub_chunk_count(self) -> int:
+        return self.alpha
+
+    def get_flags(self) -> Flags:
+        return (Flags.ZERO_PADDING | Flags.REQUIRE_SUB_CHUNKS)
+
+    def get_minimum_granularity(self) -> int:
+        return self.alpha
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        base = super().get_chunk_size(stripe_width)
+        # chunks must split evenly into alpha aligned sub-chunks
+        quantum = self.alpha * SIMD_ALIGN
+        return -(-base // quantum) * quantum
+
+    # -- coordinate helpers ------------------------------------------------
+    def _xy(self, node: int) -> tuple[int, int]:
+        return node % self.q, node // self.q
+
+    def _node(self, x: int, y: int) -> int:
+        return y * self.q + x
+
+    def _digit(self, z: int, y: int) -> int:
+        return (z // self.q ** y) % self.q
+
+    def _set_digit(self, z: int, y: int, v: int) -> int:
+        return z + (v - self._digit(z, y)) * self.q ** y
+
+    # -- pairwise coupling -------------------------------------------------
+    def _pair(self, node: int, z: int) -> tuple[int, int] | None:
+        """Partner (node', z') of symbol (node, z); None if unpaired."""
+        x, y = self._xy(node)
+        zy = self._digit(z, y)
+        if zy == x:
+            return None
+        return self._node(zy, y), self._set_digit(z, y, x)
+
+    @staticmethod
+    def _gmul(c: int, arr: np.ndarray) -> np.ndarray:
+        return gf256.gf_mul(np.uint8(c), arr)
+
+    # -- core: recover erased C given alive C (also the encode) ------------
+    def _decode_symbols(self, C: dict[int, np.ndarray],
+                        erased: list[int], L: int) -> dict[int, np.ndarray]:
+        """C: alive node -> (alpha, L) sub-chunk array.  Returns C for
+        erased nodes.  IS-ordered plane-by-plane recovery of the uncoupled
+        codeword U, then re-coupling."""
+        n = self.k + self.m
+        q, t, alpha = self.q, self.t, self.alpha
+        E = set(erased)
+        if len(E) > self.m:
+            raise ErasureCodeError(f"{len(E)} erasures > m={self.m}")
+        U = np.zeros((n, alpha, L), dtype=np.uint8)
+        # intersection score of each plane
+        def IS(z: int) -> int:
+            return sum(1 for y in range(t)
+                       if self._node(self._digit(z, y), y) in E)
+
+        planes = sorted(range(alpha), key=IS)
+        alive = [i for i in range(n) if i not in E]
+        # decode matrix: recover erased U symbols of a plane from k alive
+        use = alive[: self.k]
+        D = gf256.decode_matrix(self.matrix, self.k, use)
+        F_er = self.full[sorted(E)] if E else None
+        for z in planes:
+            # 1) U of alive nodes in this plane
+            for node in alive:
+                p = self._pair(node, z)
+                if p is None:
+                    U[node, z] = C[node][z]
+                else:
+                    pn, pz = p
+                    if pn in E:
+                        # partner erased: its U at pz is already known
+                        # (IS(pz) == IS(z) - 1, processed earlier)
+                        U[node, z] = C[node][z] ^ self._gmul(GAMMA,
+                                                            U[pn, pz])
+                    else:
+                        both = C[node][z] ^ self._gmul(GAMMA, C[pn][pz])
+                        U[node, z] = self._gmul(self._inv_det, both)
+            # 2) MDS-recover U of erased nodes in this plane
+            if E:
+                known = np.stack([U[i, z] for i in use])
+                msg = gf256.gf_matmul(D, known)
+                rec = gf256.gf_matmul(F_er, msg)
+                for r, node in enumerate(sorted(E)):
+                    U[node, z] = rec[r]
+        # 3) re-couple: C of erased nodes
+        out: dict[int, np.ndarray] = {}
+        for node in sorted(E):
+            buf = np.zeros((alpha, L), dtype=np.uint8)
+            for z in range(alpha):
+                p = self._pair(node, z)
+                if p is None:
+                    buf[z] = U[node, z]
+                else:
+                    pn, pz = p
+                    buf[z] = U[node, z] ^ self._gmul(GAMMA, U[pn, pz])
+            out[node] = buf
+        return out
+
+    # -- public API --------------------------------------------------------
+    def _split(self, chunk: np.ndarray) -> np.ndarray:
+        L = chunk.shape[-1]
+        if L % self.alpha:
+            raise ErasureCodeError(
+                f"chunk length {L} not divisible by alpha={self.alpha}")
+        return np.ascontiguousarray(chunk, dtype=np.uint8).reshape(
+            self.alpha, L // self.alpha)
+
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        if data_chunks.shape[0] != self.k:
+            raise ErasureCodeError(
+                f"expected {self.k} data chunks, got {data_chunks.shape[0]}")
+        L = data_chunks.shape[1]
+        C = {i: self._split(data_chunks[i]) for i in range(self.k)}
+        parity = self._decode_symbols(
+            C, list(range(self.k, self.k + self.m)), L // self.alpha)
+        return np.stack([parity[i].reshape(L)
+                         for i in range(self.k, self.k + self.m)])
+
+    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
+        avail = {i: c for i, c in chunks.items() if i < self.chunk_count}
+        missing = [i for i in want if i not in avail]
+        if not missing:
+            return {i: chunks[i] for i in want}
+        L = next(iter(avail.values())).shape[-1]
+        C = {i: self._split(np.asarray(c)) for i, c in avail.items()}
+        # all erased nodes must be recovered together (coupling crosses them)
+        erased = [i for i in range(self.chunk_count) if i not in avail]
+        rec = self._decode_symbols(C, erased, L // self.alpha)
+        out: ChunkMap = {}
+        for i in want:
+            out[i] = chunks[i] if i in avail else rec[i].reshape(L)
+        return out
+
+    # -- MSR repair (d = n-1): the sub-chunk bandwidth win -----------------
+    def repair_planes(self, lost: int) -> list[int]:
+        """Planes (sub-chunk indices) each helper must send to repair
+        `lost` — alpha/q of them (z_y0 == x0)."""
+        x0, y0 = self._xy(lost)
+        return [z for z in range(self.alpha)
+                if self._digit(z, y0) == x0]
+
+    def minimum_to_decode(self, want, available):
+        """Single-failure with all other nodes available: d=n-1 helpers x
+        alpha/q sub-chunks (the CLAY minimum_to_decode sub-chunk contract,
+        ref ErasureCodeClay.h minimum_to_decode with (offset,count))."""
+        want_s, avail_s = set(want), set(available)
+        if want_s <= avail_s:
+            return sorted(want_s)
+        missing = sorted(want_s - avail_s)
+        if len(missing) == 1 and len(avail_s) >= self.d == self.chunk_count - 1:
+            return sorted(avail_s)[: self.d]
+        return super().minimum_to_decode(want, available)
+
+    def minimum_sub_chunks(self, lost: int, available) -> dict[int, list[int]]:
+        """helper -> plane indices (sub-chunks) needed for repair."""
+        planes = self.repair_planes(lost)
+        return {h: list(planes) for h in available if h != lost}
+
+    def repair_chunk(self, lost: int,
+                     helper_subchunks: dict[int, np.ndarray],
+                     L: int) -> np.ndarray:
+        """Repair one lost chunk from helpers' alpha/q sub-chunk slices
+        (each helper i supplies array (alpha/q, L/alpha) — its planes
+        repair_planes(lost), in that order)."""
+        n = self.k + self.m
+        q, alpha = self.q, self.alpha
+        x0, y0 = self._xy(lost)
+        planes = self.repair_planes(lost)
+        if set(helper_subchunks) != {i for i in range(n) if i != lost}:
+            raise ErasureCodeError("d = n-1 repair needs all other nodes")
+        Ls = L // alpha
+        zpos = {z: i for i, z in enumerate(planes)}
+        # C values of helpers on repair planes
+        def Ch(node: int, z: int) -> np.ndarray:
+            return helper_subchunks[node][zpos[z]]
+
+        # 1) U of helpers outside column y0 (pairs stay inside P)
+        U = {}
+        for node in helper_subchunks:
+            x, y = self._xy(node)
+            if y == y0:
+                continue
+            for z in planes:
+                p = self._pair(node, z)
+                if p is None:
+                    U[(node, z)] = Ch(node, z)
+                else:
+                    pn, pz = p
+                    both = Ch(node, z) ^ self._gmul(GAMMA, Ch(pn, pz))
+                    U[(node, z)] = self._gmul(self._inv_det, both)
+        # 2) per plane: solve the q unknown U of column y0 via parity checks
+        col_nodes = [self._node(x, y0) for x in range(q)]
+        Hcol = self.H[:, col_nodes]  # (m, q); m == q for d = n-1
+        Hinv = gf256.gf_mat_inv(Hcol)
+        other_nodes = [i for i in range(n) if i not in col_nodes]
+        Hoth = self.H[:, other_nodes]
+        for z in planes:
+            rhs = gf256.gf_matmul(
+                Hoth, np.stack([U[(i, z)] for i in other_nodes]))
+            sol = gf256.gf_matmul(Hinv, rhs)  # H_col @ u_col = rhs
+            for r, node in enumerate(col_nodes):
+                U[(node, z)] = sol[r]
+        # 3) assemble lost chunk: all alpha sub-chunks
+        out = np.zeros((alpha, Ls), dtype=np.uint8)
+        for z in range(alpha):
+            if self._digit(z, y0) == x0:
+                out[z] = U[(lost, z)]  # diagonal: C == U
+            else:
+                x = self._digit(z, y0)
+                helper = self._node(x, y0)
+                zp = self._set_digit(z, y0, x0)  # in P
+                # U(lost, z) from the helper's coupling equation at zp:
+                # C(helper, zp) = U(helper, zp) ^ g*U(lost, z)
+                u_lost = self._gmul(
+                    int(gf256.gf_inv(GAMMA)),
+                    Ch(helper, zp) ^ U[(helper, zp)])
+                # C(lost, z) = U(lost, z) ^ g*U(helper, zp)
+                out[z] = u_lost ^ self._gmul(GAMMA, U[(helper, zp)])
+        return out.reshape(alpha * Ls)

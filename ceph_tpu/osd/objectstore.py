@@ -148,10 +148,13 @@ class ObjectStore:
 
     @staticmethod
     def create(kind: str, **kw) -> "ObjectStore":
-        """Factory (ObjectStore::create): 'memstore' today; 'filestore'
-        (durable, WAL-backed) is the planned second backend."""
+        """Factory (ObjectStore::create src/os/ObjectStore.cc:28):
+        'memstore' (in-RAM, tests) or 'filestore' (durable, WAL-backed)."""
         if kind == "memstore":
             return MemStore(**kw)
+        if kind == "filestore":
+            from .filestore import FileStore
+            return FileStore(**kw)
         raise StoreError(f"unknown objectstore backend {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------
@@ -214,16 +217,20 @@ class MemStore(ObjectStore):
     def queue_transaction(self, tx: Transaction,
                           on_commit: Callable[[], None] | None = None) -> None:
         with self._lock:
-            # validate-then-apply gives all-or-nothing semantics; track
-            # objects/collections materialised earlier in this SAME tx so
-            # e.g. touch-then-truncate sequences validate
-            created: set[tuple] = set()
-            for op in tx.ops:
-                self._check(op, created)
+            self.validate(tx)
             for op in tx.ops:
                 self._apply(op)
         if on_commit:
             on_commit()
+
+    def validate(self, tx: Transaction) -> None:
+        """Raise if the transaction cannot apply; no effects.  Tracks
+        objects/collections materialised earlier in the SAME tx so e.g.
+        touch-then-truncate sequences validate (all-or-nothing)."""
+        with self._lock:
+            created: set[tuple] = set()
+            for op in tx.ops:
+                self._check(op, created)
 
     def _coll(self, cid) -> dict[ObjectId, _Obj]:
         c = self._colls.get(cid)

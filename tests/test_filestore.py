@@ -1,0 +1,190 @@
+"""FileStore durability tests: WAL replay, torn tails, checksum verify,
+crash-remount survival, cluster restart with durable stores."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import native
+from ceph_tpu.osd.filestore import (FileStore, decode_transaction,
+                                    encode_transaction)
+from ceph_tpu.osd.objectstore import (CollectionId, ObjectId, ObjectStore,
+                                      StoreError, Transaction)
+
+CID = CollectionId(1, 0)
+OID = ObjectId("obj", shard=2)
+RNG = np.random.default_rng(31)
+
+
+def test_transaction_codec_roundtrip():
+    tx = (Transaction().create_collection(CID).touch(CID, OID)
+          .write(CID, OID, 64, b"payload").zero(CID, OID, 0, 16)
+          .truncate(CID, OID, 100)
+          .setattrs(CID, OID, {"v": 7, "name": "x", "raw": b"\x00\x01"})
+          .omap_setkeys(CID, OID, {"k": b"v"})
+          .omap_rmkeys(CID, OID, ["k"])
+          .rmattr(CID, OID, "name")
+          .clone(CID, OID, ObjectId("copy")))
+    tx2 = decode_transaction(encode_transaction(tx))
+    assert len(tx2.ops) == len(tx.ops)
+    for a, b in zip(tx.ops, tx2.ops):
+        assert a[0] == b[0] and a[1] == b[1]
+    # WRITE payload survives
+    assert tx2.ops[2][4].to_bytes() == b"payload"
+    assert tx2.ops[5][3] == {"v": 7, "name": "x", "raw": b"\x00\x01"}
+
+
+def test_filestore_basic_and_remount(tmp_path):
+    path = str(tmp_path / "store")
+    s = ObjectStore.create("filestore", path=path)
+    s.mount()
+    data = RNG.integers(0, 256, 10_000, dtype=np.uint8).tobytes()
+    s.queue_transaction(
+        Transaction().create_collection(CID).touch(CID, OID)
+        .write(CID, OID, 0, data).setattrs(CID, OID, {"v": 3, "len": 10_000}))
+    assert s.read(CID, OID).to_bytes() == data
+    s.umount()
+    # fresh process simulation: new instance, same path
+    s2 = FileStore(path)
+    s2.mount()
+    assert s2.read(CID, OID).to_bytes() == data
+    assert s2.getattrs(CID, OID)["v"] == 3
+    assert s2.list_objects(CID) == [OID]
+
+
+def test_wal_replay_after_crash_before_apply(tmp_path):
+    """Simulate a crash after the WAL commit point but before the files
+    were written: remount must replay the record."""
+    path = str(tmp_path / "store")
+    s = FileStore(path)
+    s.mount()
+    s.queue_transaction(Transaction().create_collection(CID))
+    # craft a committed-but-unapplied record by appending to the WAL only
+    tx = Transaction().touch(CID, OID).write(CID, OID, 0, b"recovered")
+    payload = encode_transaction(tx)
+    with open(s._wal_path, "ab") as f:
+        f.write(struct.pack("<II", len(payload), native.crc32c(payload))
+                + payload)
+    s.umount()
+    s2 = FileStore(path)
+    s2.mount()
+    assert s2.read(CID, OID).to_bytes() == b"recovered"
+    # and the replay was made durable in the files too
+    s2.umount()
+    s3 = FileStore(path)
+    s3.mount()
+    assert s3.read(CID, OID).to_bytes() == b"recovered"
+
+
+def test_wal_torn_tail_discarded(tmp_path):
+    path = str(tmp_path / "store")
+    s = FileStore(path)
+    s.mount()
+    s.queue_transaction(Transaction().create_collection(CID)
+                        .touch(CID, OID).write(CID, OID, 0, b"good"))
+    # torn partial record at the tail
+    with open(s._wal_path, "ab") as f:
+        f.write(struct.pack("<II", 9999, 0) + b"partial")
+    s.umount()
+    s2 = FileStore(path)
+    s2.mount()
+    assert s2.read(CID, OID).to_bytes() == b"good"
+    # tail was truncated; further writes work
+    s2.queue_transaction(Transaction().write(CID, OID, 0, b"more"))
+    assert s2.read(CID, OID).to_bytes() == b"more"
+
+
+def test_checksum_detects_bitrot(tmp_path):
+    path = str(tmp_path / "store")
+    s = FileStore(path)
+    s.mount()
+    data = b"A" * 9000
+    s.queue_transaction(Transaction().create_collection(CID)
+                        .touch(CID, OID).write(CID, OID, 0, data))
+    s.umount()
+    # checkpoint: an intact WAL would legitimately repair the file on
+    # replay, so clear it to model corruption after journal trim
+    open(s._wal_path, "wb").close()
+    # flip a bit in the object file (silent corruption)
+    base = s._obj_base(CID, OID)
+    with open(base + ".data", "r+b") as f:
+        f.seek(5000)
+        b = f.read(1)
+        f.seek(5000)
+        f.write(bytes([b[0] ^ 0x40]))
+    s2 = FileStore(path)
+    s2.mount()
+    with pytest.raises(StoreError, match="checksum"):
+        s2.read(CID, OID)
+
+
+def test_clone_not_replayed_after_clean_remount(tmp_path):
+    """Non-idempotent ops (clone) must not re-execute on remount: the
+    applied checkpoint gates WAL replay."""
+    path = str(tmp_path / "store")
+    a, b = ObjectId("a"), ObjectId("b")
+    s = FileStore(path)
+    s.mount()
+    s.queue_transaction(Transaction().create_collection(CID)
+                        .touch(CID, a).write(CID, a, 0, b"XX"))
+    s.queue_transaction(Transaction().clone(CID, a, b))
+    s.queue_transaction(Transaction().write(CID, a, 2, b"YY"))
+    s.umount()
+    s2 = FileStore(path)
+    s2.mount()
+    assert s2.read(CID, a).to_bytes() == b"XXYY"
+    assert s2.read(CID, b).to_bytes() == b"XX"  # clone must NOT re-run
+
+
+def test_rejected_tx_never_journaled(tmp_path):
+    """A transaction that fails validation must not reach the WAL (a
+    durable invalid record would replay once state allows)."""
+    path = str(tmp_path / "store")
+    other = CollectionId(9, 9)
+    s = FileStore(path)
+    s.mount()
+    with pytest.raises(Exception):
+        s.queue_transaction(Transaction().touch(other, OID))
+    s.queue_transaction(Transaction().create_collection(other))
+    s.umount()
+    s2 = FileStore(path)
+    s2.mount()
+    assert not s2.exists(other, OID)  # the rejected touch never happened
+
+
+def test_cluster_survives_restart_with_filestore(tmp_path):
+    """OSD daemons on durable stores: kill the whole cluster, reboot new
+    daemons on the same store paths, data still readable."""
+    from ceph_tpu.tools.vstart import MiniCluster
+    from tests.test_cluster import make_cfg
+
+    stores = {i: str(tmp_path / f"osd{i}") for i in range(4)}
+    cfg = make_cfg()
+    c = MiniCluster(n_osds=0, cfg=cfg)
+    c.mon.start()
+    from ceph_tpu.osd.daemon import OSDDaemon
+    for i in range(4):
+        st = ObjectStore.create("filestore", path=stores[i])
+        osd = OSDDaemon(i, c.network, cfg=cfg, store=st, host=f"host{i}")
+        c.osds[i] = osd
+        osd.start()
+    c.wait_for_up(4)
+    client = c.client()
+    client.create_pool("rbd", size=2, pg_num=2)
+    client.write_full("rbd", "persist", b"survives restarts")
+    c.stop()
+
+    c2 = MiniCluster(n_osds=0, cfg=cfg)
+    c2.mon.start()
+    for i in range(4):
+        st = ObjectStore.create("filestore", path=stores[i])
+        osd = OSDDaemon(i, c2.network, cfg=cfg, store=st, host=f"host{i}")
+        c2.osds[i] = osd
+        osd.start()
+    c2.wait_for_up(4)
+    client2 = c2.client()
+    client2.create_pool("rbd", size=2, pg_num=2)  # mon state is fresh
+    assert client2.read("rbd", "persist") == b"survives restarts"
+    c2.stop()
