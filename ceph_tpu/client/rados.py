@@ -17,7 +17,8 @@ import time
 
 from ..mon.maps import OSDMap
 from ..msg.messages import (MMapPush, MMonCommand, MMonCommandReply,
-                            MMonSubscribe, MOSDOp, MOSDOpReply)
+                            MMonSubscribe, MOSDOp, MOSDOpReply, MScrubRequest,
+                            MScrubResult, PgId)
 from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
 from ..utils.log import dout
 
@@ -69,7 +70,7 @@ class RadosClient(Dispatcher):
                     self.osdmap = m
                 self._map_cond.notify_all()
             return True
-        if isinstance(msg, (MOSDOpReply, MMonCommandReply)):
+        if isinstance(msg, (MOSDOpReply, MMonCommandReply, MScrubResult)):
             ev = self._waiters.get(msg.tid)
             if ev is not None:
                 self._replies[msg.tid] = msg
@@ -169,6 +170,37 @@ class RadosClient(Dispatcher):
                 raise RadosError(reply.result, f"{op} {pool_name}/{oid}")
             return reply
         raise last_error or RadosError(-5, "retries exhausted")
+
+    def scrub_pg(self, pool: str, seed: int, deep: bool = False,
+                 repair: bool = False) -> MScrubResult:
+        """Scrub one PG via its primary (the `ceph pg scrub/deep-scrub/
+        repair` verbs); retries on stale-primary like any op."""
+        pool_id = self._pool_id(pool)
+        pgid = PgId(pool_id, seed)
+        for attempt in range(8):
+            up = self.osdmap.pg_to_up_osds(pool_id, seed)
+            primary = next((u for u in up if u is not None), None)
+            if primary is None:
+                raise RadosError(-5, f"pg {pgid} has no up osds")
+            tid = next(self._tids)
+            reply = self._rpc(f"osd.{primary}",
+                              MScrubRequest(tid, self.name, pgid, deep,
+                                            repair), tid)
+            if reply.result == -116:
+                time.sleep(0.05 * (attempt + 1))
+                continue
+            return reply
+        raise RadosError(-116, f"scrub {pgid}: primary stayed stale")
+
+    def scrub_pool(self, pool: str, deep: bool = False,
+                   repair: bool = False) -> list:
+        """Scrub every PG of a pool; returns all inconsistencies."""
+        pool_id = self._pool_id(pool)
+        issues = []
+        for seed in range(self.osdmap.pools[pool_id].pg_num):
+            res = self.scrub_pg(pool, seed, deep, repair)
+            issues.extend(res.inconsistencies)
+        return issues
 
     def write_full(self, pool: str, oid: str, data: bytes) -> int:
         return self._op(pool, oid, "write", bytes(data)).version

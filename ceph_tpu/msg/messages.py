@@ -208,6 +208,7 @@ class MPGPull:
 
     pgid: PgId
     names: list
+    force: bool = False  # scrub repair: replace my same-version bad copy
 
 
 @dataclass
@@ -218,6 +219,47 @@ class MPGPush:
     shard: int
     objects: dict  # name -> (version, data bytes[, total_len])
     deletes: dict = field(default_factory=dict)  # name -> delete version
+    force: bool = False  # scrub repair: overwrite same-version bad copies
+
+
+# ------------------------------------------------------------------ scrub
+@dataclass
+class MScrubRequest:
+    """Client/operator -> primary: scrub this PG (shallow or deep)."""
+
+    tid: int
+    client: str
+    pgid: PgId
+    deep: bool = False
+    repair: bool = False
+
+
+@dataclass
+class MScrubShard:
+    """Primary -> shard member: send me your scrub map for this PG."""
+
+    tid: int
+    pgid: PgId
+    deep: bool
+
+
+@dataclass
+class MScrubMap:
+    """Shard member -> primary: per-object metadata (+ digests if deep)."""
+
+    tid: int
+    pgid: PgId
+    from_osd: int
+    objects: dict  # (name, shard) -> {size, version[, digest]}
+
+
+@dataclass
+class MScrubResult:
+    tid: int
+    pgid: PgId
+    result: int
+    inconsistencies: list
+    repaired: int = 0
 
 
 # ------------------------------------------------------------ wire helpers

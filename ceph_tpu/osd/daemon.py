@@ -40,12 +40,15 @@ from ..msg.messages import (MFailureReport, MMapPush, MOSDBoot, MOSDOp,
                             MPGPull, MPGPush, MPGQuery, MSubRead,
                             MSubReadReply, MSubWrite, MSubWriteReply, PgId)
 from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
 from ..utils.log import dout
 from ..utils.perf import CounterType, global_perf
 from ..utils.tracked_op import OpTracker
+from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           Transaction)
+from .scrub import FaultInjection, ScrubMixin
 
 EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
 
@@ -78,7 +81,7 @@ class _PendingRead:
     stamp: float = field(default_factory=time.time)
 
 
-class OSDDaemon(Dispatcher):
+class OSDDaemon(ScrubMixin, Dispatcher):
     def __init__(self, osd_id: int, network: LocalNetwork,
                  mon: str = "mon.0", store: ObjectStore | None = None,
                  cfg: Config | None = None, host: str | None = None):
@@ -106,8 +109,13 @@ class OSDDaemon(Dispatcher):
         self._hb_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._tombstones: dict[PgId, dict[str, int]] = {}
+        self._pending_scrubs: dict = {}
+        self.inject = FaultInjection()
         self.op_tracker = OpTracker()
         self._handlers = {
+            MScrubRequest: self._handle_scrub_request,
+            MScrubShard: self._handle_scrub_shard,
+            MScrubMap: self._handle_scrub_map,
             MMapPush: self._handle_map,
             MOSDOp: self._handle_client_op,
             MSubWrite: self._handle_sub_write,
@@ -123,7 +131,8 @@ class OSDDaemon(Dispatcher):
         }
         self.perf = global_perf().create(self.name)
         self.perf.add_many(["op_w", "op_r", "op_rw_bytes", "subop_w",
-                            "subop_r", "recovery_push", "failure_reports"])
+                            "subop_r", "recovery_push", "failure_reports",
+                            "scrubs", "scrub_errors"])
         self.perf.add("op_lat", CounterType.TIME)
 
     # ------------------------------------------------------------ lifecycle
@@ -138,6 +147,31 @@ class OSDDaemon(Dispatcher):
     def stop(self) -> None:
         self._stop.set()
         self.messenger.shutdown()
+
+    # -------------------------------------------------- admin socket verbs
+    def admin_command(self, cmd: str, **kw):
+        """Per-daemon operator commands (the AdminSocket capability,
+        src/common/admin_socket.cc: perf dump, dump_ops_in_flight, ...)."""
+        if cmd == "perf dump":
+            return self.perf.dump()
+        if cmd == "dump_ops_in_flight":
+            return self.op_tracker.dump_ops_in_flight()
+        if cmd == "dump_historic_ops":
+            return self.op_tracker.dump_historic_ops()
+        if cmd == "dump_slow_ops":
+            return self.op_tracker.slow_ops()
+        if cmd == "config show":
+            return self.cfg.dump()
+        if cmd == "config set":
+            self.cfg.set(kw["name"], kw["value"])
+            return {"success": True}
+        if cmd == "status":
+            return {"osd": self.osd_id,
+                    "epoch": self.osdmap.epoch if self.osdmap else 0,
+                    "num_pgs": sum(1 for _ in self._pools_pgs_for_me()),
+                    "pending_writes": len(self._pending_writes),
+                    "pending_reads": len(self._pending_reads)}
+        raise ValueError(f"unknown admin command {cmd!r}")
 
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, conn, msg) -> bool:
@@ -534,6 +568,8 @@ class OSDDaemon(Dispatcher):
                      attrs: dict) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = ObjectId(oid, shard=shard)
+        # stored digest for deep scrub (per-blob csum, BlueStore role)
+        attrs = dict(attrs, d=native_crc32c(data))
         tx = Transaction()
         if cid not in self.store.list_collections():
             tx.create_collection(cid)
@@ -545,6 +581,11 @@ class OSDDaemon(Dispatcher):
 
     def _handle_sub_write(self, conn, m: MSubWrite) -> None:
         self.perf.inc("subop_w")
+        if m.shard in self.inject.drop_shard_writes:
+            # armed write-drop (ECInject write_error role): ack without
+            # applying — a lost apply that scrub must later catch
+            conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
+            return
         if m.op == "write":
             self._apply_write(m.pgid, m.oid, m.shard, m.data,
                               dict(m.attrs, v=m.version))
@@ -728,7 +769,7 @@ class OSDDaemon(Dispatcher):
             except NoSuchObject:
                 continue
         if push:
-            conn.send(MPGPush(m.pgid, -1, push))
+            conn.send(MPGPush(m.pgid, -1, push, force=m.force))
 
     def _recover_ec(self, pgid, pool, up, peer, peer_inv, my_inv,
                     dead) -> None:
@@ -771,7 +812,8 @@ class OSDDaemon(Dispatcher):
                     self._rebuild_shard(pgid, name, shard, self.osd_id,
                                         version)
 
-    def _rebuild_shard(self, pgid, name, shard, peer, version) -> None:
+    def _rebuild_shard(self, pgid, name, shard, peer, version,
+                       force: bool = False) -> None:
         """Reconstruct one shard from k survivors, then push it."""
         up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
         codec = self._pool_codec(pgid.pool)
@@ -781,9 +823,13 @@ class OSDDaemon(Dispatcher):
             if pr is None:
                 return
             chunks = pr.chunks
-            if shard in chunks:
+            if shard in chunks and not force:
                 rebuilt = chunks[shard]
             else:
+                # scrub repair must NOT trust the (possibly corrupt)
+                # existing shard copy: always re-derive it
+                chunks = {i: c for i, c in chunks.items() if i != shard} \
+                    if force else chunks
                 out = codec.decode([shard], dict(chunks))
                 rebuilt = out[shard]
             total = self._ec_total_len(pr)
@@ -791,7 +837,8 @@ class OSDDaemon(Dispatcher):
             self.messenger.send_message(
                 f"osd.{peer}",
                 MPGPush(pgid, shard,
-                        {name: (version, rebuilt.tobytes(), total)}))
+                        {name: (version, rebuilt.tobytes(), total)},
+                        force=force))
 
         pr = _PendingRead(None, 0, pgid.pool, name,
                           total_shards=sum(1 for u in up
@@ -814,6 +861,18 @@ class OSDDaemon(Dispatcher):
         for name, payload in m.objects.items():
             if dead.get(name, -1) >= payload[0]:
                 continue  # delete raced ahead of this push
+            # never clobber a NEWER local copy with a stale recovery push
+            # (a rebuild computed from a pre-overwrite inventory snapshot);
+            # scrub repairs force through (same-version corrupt copies)
+            shard_id = m.shard if m.shard >= 0 else -1
+            if not m.force:
+                try:
+                    cur = self.store.getattrs(cid, ObjectId(name,
+                                                            shard=shard_id))
+                    if int(cur.get("v", -1)) >= payload[0]:
+                        continue
+                except NoSuchObject:
+                    pass
             if m.shard >= 0:
                 version, data, total = payload
                 attrs = {"v": version}

