@@ -143,7 +143,7 @@ class RadosClient(Dispatcher):
             offset: int = 0, length: int = 0):
         pool_id = self._pool_id(pool_name)
         last_error: RadosError | None = None
-        for attempt in range(8):
+        for attempt in range(12):
             target = self._primary_for(pool_id, oid)
             tid = next(self._tids)
             m = MOSDOp(tid, self.name, pool_id, oid, op, offset, length,
@@ -157,6 +157,10 @@ class RadosClient(Dispatcher):
                                  self.name, target)
                 last_error = e
                 self._wait_epoch_past(self.osdmap.epoch, self.timeout)
+                continue
+            if reply.result == -11:  # EAGAIN: PG peering/recovering
+                time.sleep(min(0.05 * 2 ** attempt, 1.0))
+                last_error = RadosError(-11, "pg peering")
                 continue
             if reply.result == -116:  # ESTALE: not primary under its map
                 if reply.epoch > self.osdmap.epoch:
@@ -203,7 +207,14 @@ class RadosClient(Dispatcher):
         return issues
 
     def write_full(self, pool: str, oid: str, data: bytes) -> int:
-        return self._op(pool, oid, "write", bytes(data)).version
+        """Replace the whole object (rados write_full semantics)."""
+        return self._op(pool, oid, "write_full", bytes(data)).version
+
+    def write(self, pool: str, oid: str, data: bytes, offset: int = 0) -> int:
+        """Partial overwrite at an offset (rados_write semantics): EC pools
+        take the parity-delta/rmw path, replicated pools apply in place."""
+        return self._op(pool, oid, "write", bytes(data),
+                        offset=offset).version
 
     def read(self, pool: str, oid: str, offset: int = 0,
              length: int = 0) -> bytes:

@@ -192,7 +192,9 @@ class MonitorLite(Dispatcher):
                 codec = ec.factory(plugin, {k: v for k, v in profile.items()
                                             if k != "plugin"})
                 size = codec.k + codec.m
-                min_size = codec.k
+                # k+1 so an acked write survives one immediate failure
+                # (the reference's EC min_size default)
+                min_size = min(codec.k + 1, size)
             else:
                 profile = {}
                 size = int(cmd.get("size", self.cfg["osd_pool_default_size"]))

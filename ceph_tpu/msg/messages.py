@@ -36,7 +36,7 @@ class MOSDOp(Encodable):
     client: str
     pool: int
     oid: str
-    op: str  # write | read | remove | stat
+    op: str  # write_full (replace) | write (partial at offset) | read | remove | stat
     offset: int = 0
     length: int = 0
     data: bytes = b""
@@ -92,9 +92,37 @@ class MSubWrite:
     oid: str
     shard: int          # -1 replicated, >=0 EC shard id
     version: int
-    op: str             # write | remove
+    op: str             # write | write_partial | remove
     data: bytes = b""
     attrs: dict = field(default_factory=dict)
+    offset: int = 0     # write_partial only
+
+
+@dataclass
+class MSubPartialWrite:
+    """Primary -> data-shard OSD: overwrite extents inside the chunk
+    (the partial-write leg of the EC RMW pipeline, ECTransaction role)."""
+
+    tid: int
+    pgid: PgId
+    oid: str
+    shard: int
+    version: int
+    extents: list  # [(chunk_off, bytes)]
+
+
+@dataclass
+class MSubDelta:
+    """Primary -> parity-shard OSD: fold data-shard deltas into the
+    stored parity chunk (apply_delta wire leg; ECUtil encode_parity_delta
+    ECUtil.cc:519-566 role)."""
+
+    tid: int
+    pgid: PgId
+    oid: str
+    parity_shard: int   # this recipient's shard id
+    version: int
+    extents: list  # [(data_shard, chunk_off, delta bytes)]
 
 
 @dataclass

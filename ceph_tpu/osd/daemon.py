@@ -26,6 +26,7 @@ daemon per OSD:
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
@@ -37,8 +38,9 @@ from .. import ec
 from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MOSDBoot, MOSDOp,
                             MOSDOpReply, MOSDPing, MOSDPingReply, MPGInfo,
-                            MPGPull, MPGPush, MPGQuery, MSubRead,
-                            MSubReadReply, MSubWrite, MSubWriteReply, PgId)
+                            MPGPull, MPGPush, MPGQuery, MSubDelta,
+                            MSubPartialWrite, MSubRead, MSubReadReply,
+                            MSubWrite, MSubWriteReply, PgId)
 from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
@@ -60,6 +62,7 @@ class _PendingWrite:
     acks_needed: int
     version: int
     failed: int = 0
+    lock_key: tuple | None = None  # per-object write lock to release
     stamp: float = field(default_factory=time.time)
 
 
@@ -79,6 +82,17 @@ class _PendingRead:
     # recovery reads carry a completion callback instead of a client
     on_done: object = None
     stamp: float = field(default_factory=time.time)
+
+
+class _ClientConn:
+    """Send-handle towards a client entity (for re-entrant op paths)."""
+
+    def __init__(self, daemon: "OSDDaemon", client: str):
+        self._daemon = daemon
+        self._client = client
+
+    def send(self, msg) -> bool:
+        return self._daemon.messenger.send_message(self._client, msg)
 
 
 class OSDDaemon(ScrubMixin, Dispatcher):
@@ -109,6 +123,15 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._hb_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._tombstones: dict[PgId, dict[str, int]] = {}
+        # peering-lite state (PeeringState FSM role): PGs whose primary is
+        # waiting for member inventories block IO with EAGAIN, and objects
+        # the primary knows it is behind on stay blocked until pulled
+        self._peering: dict[PgId, set[int]] = {}
+        self._stale_objects: dict[PgId, set[str]] = {}
+        # per-object write serialization for multi-phase EC ops (the obc
+        # lock / ECExtentCache ordering role): queued thunks per key
+        self._obj_locks: dict[tuple, object] = {}
+        self._requery_at: dict[PgId, float] = {}
         self._pending_scrubs: dict = {}
         self.inject = FaultInjection()
         self.op_tracker = OpTracker()
@@ -119,6 +142,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             MMapPush: self._handle_map,
             MOSDOp: self._handle_client_op,
             MSubWrite: self._handle_sub_write,
+            MSubPartialWrite: self._handle_sub_partial_write,
+            MSubDelta: self._handle_sub_delta,
             MSubWriteReply: self._handle_sub_write_reply,
             MSubRead: self._handle_sub_read,
             MSubReadReply: self._handle_sub_read_reply,
@@ -206,6 +231,36 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._ensure_collections()
         if old is None or newmap.epoch > old.epoch:
             self._start_recovery()
+            self._notify_demoted(old)
+
+    def _notify_demoted(self, old: OSDMap | None) -> None:
+        """If I hold objects for PGs I am no longer an up member of, tell
+        the current primary what I have (the MNotifyRec / past-intervals
+        role): my stranded shards can then be migrated, not lost.  Only
+        PGs whose membership actually dropped me are scanned."""
+        for cid in self.store.list_collections():
+            if cid.pool not in self.osdmap.pools:
+                continue
+            pool = self.osdmap.pools[cid.pool]
+            if cid.pg_seed >= pool.pg_num:
+                continue
+            up = self.osdmap.pg_to_up_osds(cid.pool, cid.pg_seed)
+            if self.osd_id in [u for u in up if u is not None]:
+                continue
+            if old is not None and cid.pool in old.pools:
+                old_up = old.pg_to_up_osds(cid.pool, cid.pg_seed)
+                if self.osd_id not in [u for u in old_up if u is not None]:
+                    continue  # was not a member before either: no change
+            primary = self._primary_of(up)
+            if primary is None or primary == self.osd_id:
+                continue
+            pgid = PgId(cid.pool, cid.pg_seed)
+            inv = self._inventory(pgid)
+            if inv:
+                self.messenger.send_message(
+                    f"osd.{primary}",
+                    MPGInfo(pgid, self.osd_id, -2, inv,
+                            dict(self._tombstones.get(pgid, {}))))
 
     def _pools_pgs_for_me(self):
         """(pool, pg_seed, up_set, my_positions) for PGs mapping to me."""
@@ -243,26 +298,50 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             conn.send(MOSDOpReply(m.tid, ESTALE, epoch=self.osdmap.epoch))
             return
         pgid = PgId(m.pool, seed)
+        # peering gate: block IO until inventories (and the objects we are
+        # known to be behind on) have caught up — read-your-writes safety
+        if pgid in self._peering or (
+                m.oid in self._stale_objects.get(pgid, ())):
+            conn.send(MOSDOpReply(m.tid, EAGAIN, epoch=self.osdmap.epoch))
+            return
         self.perf.inc("op_rw_bytes", len(m.data))
         with self.op_tracker.create(f"{m.op} {m.oid}") as op:
             if pool.kind == "ec":
-                if m.op == "write":
+                if m.op in ("write", "write_full"):
                     self.perf.inc("op_w")
-                    self._ec_write(conn, m, pgid, up)
+                    key = (pgid, m.oid)
+                    full = m.op == "write_full"
+
+                    def wthunk(conn=conn, m=m, pgid=pgid, key=key,
+                               full=full):
+                        up2 = self.osdmap.pg_to_up_osds(
+                            pgid.pool, pgid.seed)
+                        self._ec_write(conn, m, pgid, up2, full=full,
+                                       lock_key=key)
+
+                    self._obj_lock(key, wthunk)
                 elif m.op == "read":
                     self.perf.inc("op_r")
                     self._ec_read(conn, m, pgid, up)
                 elif m.op == "remove":
-                    self._ec_remove(conn, m, pgid, up)
+                    key = (pgid, m.oid)
+
+                    def rthunk(conn=conn, m=m, pgid=pgid, key=key):
+                        up2 = self.osdmap.pg_to_up_osds(
+                            pgid.pool, pgid.seed)
+                        self._ec_remove(conn, m, pgid, up2, lock_key=key)
+
+                    self._obj_lock(key, rthunk)
                 elif m.op == "stat":
                     self._stat(conn, m, pgid, shard=0)
                 else:
                     conn.send(MOSDOpReply(m.tid, EINVAL,
                                           epoch=self.osdmap.epoch))
             else:
-                if m.op == "write":
+                if m.op in ("write", "write_full"):
                     self.perf.inc("op_w")
-                    self._rep_write(conn, m, pgid, up)
+                    self._rep_write(conn, m, pgid, up,
+                                    full=m.op == "write_full")
                 elif m.op == "read":
                     self.perf.inc("op_r")
                     self._rep_read(conn, m, pgid)
@@ -274,6 +353,46 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     conn.send(MOSDOpReply(m.tid, EINVAL,
                                           epoch=self.osdmap.epoch))
             op.mark("dispatched")
+
+    # -- per-object write serialization ------------------------------------
+    def _obj_lock(self, key: tuple, thunk) -> None:
+        """Run thunk now if the object is idle, else queue it.  Queue
+        state is guarded by _pending_lock because the sweep (heartbeat
+        thread) can release locks; thunks run outside the lock."""
+        with self._pending_lock:
+            q = self._obj_locks.get(key)
+            if q is None:
+                q = collections.deque()
+                self._obj_locks[key] = q
+            q.append(thunk)
+            run = len(q) == 1
+        if run:
+            self._run_locked_thunk(key, thunk)
+
+    def _run_locked_thunk(self, key: tuple, thunk) -> None:
+        """Run a queued write; a thrown thunk must release the lock or
+        every later write to the object wedges behind it forever."""
+        try:
+            thunk()
+        except Exception:
+            self._obj_unlock(key)
+            raise
+
+    def _obj_unlock(self, key: tuple | None) -> None:
+        if key is None:
+            return
+        nxt = None
+        with self._pending_lock:
+            q = self._obj_locks.get(key)
+            if not q:
+                return
+            q.popleft()
+            if q:
+                nxt = q[0]
+            else:
+                del self._obj_locks[key]
+        if nxt:
+            self._run_locked_thunk(key, nxt)  # start the next queued write
 
     def _next_version(self, pgid: PgId) -> int:
         v = self._pg_versions.get(pgid, 0) + 1
@@ -287,10 +406,29 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         ts[name] = max(ts.get(name, 0), version)
 
     # -- replicated pool ---------------------------------------------------
-    def _rep_write(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+    def _rep_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
+                   full: bool = True) -> None:
         version = self._next_version(pgid)
-        self._apply_write(pgid, m.oid, -1, m.data,
-                          {"v": version, "len": len(m.data)})
+        cid = CollectionId(pgid.pool, pgid.seed)
+        existed = self.store.exists(cid, ObjectId(m.oid))
+        partial = not full and (m.offset > 0 or (
+            existed and m.offset + len(m.data) < self.store.stat(
+                cid, ObjectId(m.oid))["size"]))
+        if partial:
+            self._apply_partial(pgid, m.oid, -1, [(m.offset, m.data)],
+                                version, create_ok=True)
+            if existed:
+                op, payload, off = "write_partial", m.data, m.offset
+            else:
+                # object just created here: replicas may lack it entirely,
+                # so replicate the full (zero-prefixed) content instead of
+                # a partial they could not apply
+                payload = self.store.read(cid, ObjectId(m.oid)).to_bytes()
+                op, off = "write", 0
+        else:
+            op, payload, off = "write", m.data, 0
+            self._apply_write(pgid, m.oid, -1, m.data,
+                              {"v": version, "len": len(m.data)})
         peers = [u for u in up if u is not None and u != self.osd_id]
         tid = next(self._tids)
         if not peers:
@@ -302,7 +440,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         for peer in peers:
             self.messenger.send_message(
                 f"osd.{peer}",
-                MSubWrite(tid, pgid, m.oid, -1, version, "write", m.data))
+                MSubWrite(tid, pgid, m.oid, -1, version, op, payload,
+                          offset=off))
 
     def _rep_read(self, conn, m: MOSDOp, pgid: PgId) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -383,11 +522,41 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._ec_codecs[pool_id] = codec
         return codec
 
-    def _ec_write(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+    def _ec_object_len(self, pgid: PgId, oid: str) -> int | None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        for shard in range(self.osdmap.pools[pgid.pool].size):
+            try:
+                attrs = self.store.getattrs(cid, ObjectId(oid, shard=shard))
+                if "len" in attrs:
+                    return int(attrs["len"])
+            except NoSuchObject:
+                continue
+        return None
+
+    def _ec_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
+                  full: bool = True, lock_key: tuple | None = None) -> None:
         codec = self._pool_codec(pgid.pool)
+        pool = self.osdmap.pools[pgid.pool]
         alive = [u for u in up if u is not None]
-        if len(alive) < codec.k:
-            conn.send(MOSDOpReply(m.tid, EIO, epoch=self.osdmap.epoch))
+        if len(alive) < max(pool.min_size, codec.k):
+            # below min_size: refuse the write (EAGAIN -> client retries
+            # until recovery restores redundancy) rather than accepting
+            # data with no margin to survive the next failure
+            conn.send(MOSDOpReply(m.tid, EAGAIN, epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
+            return
+        total = None if full else self._ec_object_len(pgid, m.oid)
+        if not full and (m.offset or (total is not None
+                                      and m.offset + len(m.data) < total)):
+            # sub-object overwrite (the WritePlan partial branch)
+            if (total is not None and m.offset + len(m.data) <= total
+                    and codec.supports_parity_delta()
+                    and None not in up):
+                self._ec_partial_write(conn, m, pgid, up, codec, total,
+                                       lock_key)
+            else:
+                self._ec_rmw_write(conn, m, pgid, up, codec, total,
+                                   lock_key)
             return
         version = self._next_version(pgid)
         chunks = codec.encode(m.data)
@@ -409,9 +578,230 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
             return
         self._pending_writes[tid] = _PendingWrite(
-            m.client, m.tid, remote, version)
+            m.client, m.tid, remote, version, lock_key=lock_key)
+
+    # -- EC partial writes (parity delta / rmw; ECTransaction WritePlan) ---
+    def _touched_extents(self, codec, total: int, off: int,
+                         length: int) -> dict[int, list[tuple[int, int]]]:
+        """Sub-object range -> {data_shard: [(chunk_off, len)]} under the
+        contiguous-block chunk layout of encode_prepare."""
+        cs = codec.get_chunk_size(total)
+        out: dict[int, list[tuple[int, int]]] = {}
+        end = off + length
+        while off < end:
+            shard, coff = divmod(off, cs)
+            take = min(cs - coff, end - off)
+            out.setdefault(shard, []).append((coff, take))
+            off += take
+        return out
+
+    def _ec_partial_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
+                          codec, total: int,
+                          lock_key: tuple | None = None) -> None:
+        """Parity-delta overwrite: read ONLY the old bytes being replaced,
+        write the new bytes to their data shards, and fold coef*delta into
+        every parity shard — no stripe re-encode, no k-wide read."""
+        touched = self._touched_extents(codec, total, m.offset, len(m.data))
+        version = self._next_version(pgid)
+        tid = next(self._tids)
+        # phase 1: fetch old chunks of the touched data shards
+        fan_up = [u if (s in touched) else None
+                  for s, u in enumerate(up)]
+
+        def on_old(pr) -> None:
+            if pr is None or any(s not in pr.chunks for s in touched):
+                self.messenger.send_message(
+                    m.client, MOSDOpReply(m.tid, EIO,
+                                          epoch=self.osdmap.epoch))
+                self._obj_unlock(lock_key)
+                return
+            remote = 0
+            pos = 0
+            deltas: dict[int, list[tuple[int, bytes]]] = {}
+            news: dict[int, list[tuple[int, bytes]]] = {}
+            for shard in sorted(touched):
+                for coff, take in touched[shard]:
+                    new = m.data[pos:pos + take]
+                    old = pr.chunks[shard][coff:coff + take].tobytes()
+                    delta = codec.encode_delta(
+                        np.frombuffer(old, dtype=np.uint8),
+                        np.frombuffer(new, dtype=np.uint8)).tobytes()
+                    deltas.setdefault(shard, []).append((coff, delta))
+                    news.setdefault(shard, []).append((coff, new))
+                    pos += take
+            wtid = next(self._tids)
+            local_failed = 0
+            # data shards: new bytes (touched) or version bump (untouched)
+            for shard, osd in enumerate(up):
+                if osd is None or shard >= codec.k:
+                    continue
+                ext = news.get(shard, [])
+                if osd == self.osd_id:
+                    if not self._apply_partial(pgid, m.oid, shard, ext,
+                                               version):
+                        local_failed += 1
+                else:
+                    remote += 1
+                    self.messenger.send_message(
+                        f"osd.{osd}",
+                        MSubPartialWrite(wtid, pgid, m.oid, shard, version,
+                                         ext))
+            # parity shards: one delta message covering all data deltas
+            flat = [(ds, coff, dbytes) for ds, lst in deltas.items()
+                    for coff, dbytes in lst]
+            for shard, osd in enumerate(up):
+                if osd is None or shard < codec.k:
+                    continue
+                if osd == self.osd_id:
+                    if not self._apply_delta_local(pgid, m.oid, shard,
+                                                   flat, version):
+                        local_failed += 1
+                else:
+                    remote += 1
+                    self.messenger.send_message(
+                        f"osd.{osd}",
+                        MSubDelta(wtid, pgid, m.oid, shard, version,
+                                  list(flat)))
+            if remote == 0:
+                self.messenger.send_message(
+                    m.client,
+                    MOSDOpReply(m.tid, EIO if local_failed else 0,
+                                version=version, epoch=self.osdmap.epoch))
+                self._obj_unlock(lock_key)
+            else:
+                self._pending_writes[wtid] = _PendingWrite(
+                    m.client, m.tid, remote, version, failed=local_failed,
+                    lock_key=lock_key)
+
+        pr = _PendingRead(None, 0, pgid.pool, m.oid,
+                          total_shards=len(touched), on_done=on_old)
+        self._pending_reads[tid] = pr
+        self._fan_shard_reads(tid, pgid, m.oid, fan_up)
+
+    def _ec_rmw_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
+                      codec, total: int | None,
+                      lock_key: tuple | None = None) -> None:
+        """Fallback read-modify-write: reconstruct the whole object, merge
+        the new bytes, re-encode (grows the object / creates at offset)."""
+        tid = next(self._tids)
+
+        def on_read(pr) -> None:
+            if pr is None or (pr.chunks and len(pr.chunks) < codec.k):
+                self.messenger.send_message(
+                    m.client, MOSDOpReply(m.tid, EIO,
+                                          epoch=self.osdmap.epoch))
+                self._obj_unlock(lock_key)
+                return
+            if not pr.chunks:
+                if total is not None:
+                    # the object EXISTS (local attrs say so) but no shard
+                    # answered: failing is safe, zero-filling is data loss
+                    self.messenger.send_message(
+                        m.client, MOSDOpReply(m.tid, EIO,
+                                              epoch=self.osdmap.epoch))
+                    self._obj_unlock(lock_key)
+                    return
+                base = b""  # creating a new object at an offset
+            else:
+                data_ids = list(range(codec.k))
+                if all(i in pr.chunks for i in data_ids):
+                    old = np.concatenate([pr.chunks[i] for i in data_ids])
+                else:
+                    dec = codec.decode(data_ids, dict(pr.chunks))
+                    old = np.concatenate([dec[i] for i in data_ids])
+                cur = self._ec_total_len(pr)
+                base = old.tobytes()[:cur] if cur is not None \
+                    else old.tobytes()
+            end = m.offset + len(m.data)
+            buf = bytearray(max(len(base), end))
+            buf[: len(base)] = base
+            buf[m.offset:end] = m.data
+            merged = MOSDOp(m.tid, m.client, m.pool, m.oid, "write_full",
+                            0, 0, bytes(buf), m.epoch)
+            self._ec_write(_ClientConn(self, m.client), merged, pgid, up,
+                           lock_key=lock_key)
+
+        pr = _PendingRead(None, 0, pgid.pool, m.oid,
+                          total_shards=sum(1 for u in up if u is not None),
+                          on_done=on_read)
+        self._pending_reads[tid] = pr
+        self._fan_shard_reads(tid, pgid, m.oid, up)
+
+    def _apply_partial(self, pgid: PgId, oid: str, shard: int,
+                       extents: list, version: int,
+                       create_ok: bool = False) -> bool:
+        """Apply extent overwrites to one shard chunk + refresh v/digest.
+
+        Returns False (no change) when the object is absent and create_ok
+        is not set: a lagging replica/shard must NEVER fabricate a
+        zero-filled chunk stamped with the new version — recovery's
+        version gate would then consider it current forever.  Only the
+        primary creating a genuinely new object passes create_ok."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid, shard=shard)
+        tx = Transaction()
+        if not self.store.exists(cid, obj):
+            if not create_ok:
+                return False
+            tx.touch(cid, obj)
+        for coff, data in extents:
+            tx.write(cid, obj, coff, data)
+        self.store.queue_transaction(tx)
+        data = self.store.read(cid, obj).to_bytes()
+        attrs = dict(self.store.getattrs(cid, obj))
+        attrs["v"] = version
+        attrs["d"] = native_crc32c(data)
+        if shard < 0:
+            # replicated: the object IS the data; track its size for stat
+            # (EC shards keep "len" = whole-object length, unchanged by a
+            # pure overwrite)
+            attrs["len"] = len(data)
+        self.store.queue_transaction(
+            Transaction().setattrs(cid, obj, attrs))
+        return True
+
+    def _apply_delta_local(self, pgid: PgId, oid: str, parity_shard: int,
+                           extents: list, version: int) -> bool:
+        """Fold coef*delta extents into the stored parity chunk via the
+        plugin's apply_delta (one chunk read/write for the whole batch).
+        False if the parity chunk is absent (shard not yet recovered)."""
+        codec = self._pool_codec(pgid.pool)
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid, shard=parity_shard)
+        try:
+            chunk = np.frombuffer(self.store.read(cid, obj).to_bytes(),
+                                  dtype=np.uint8).copy()
+        except NoSuchObject:
+            return False
+        for ds, coff, dbytes in extents:
+            view = chunk[coff:coff + len(dbytes)]
+            codec.apply_delta(np.frombuffer(dbytes, dtype=np.uint8), ds,
+                              {parity_shard: view})
+        return self._apply_partial(pgid, oid, parity_shard,
+                                   [(0, chunk.tobytes())], version)
+
+    def _handle_sub_partial_write(self, conn, m: MSubPartialWrite) -> None:
+        self.perf.inc("subop_w")
+        ok = self._apply_partial(m.pgid, m.oid, m.shard, m.extents,
+                                 m.version)
+        if ok:
+            self._pg_versions[m.pgid] = max(
+                self._pg_versions.get(m.pgid, 0), m.version)
+        conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id,
+                                 0 if ok else ENOENT))
+
+    def _handle_sub_delta(self, conn, m: MSubDelta) -> None:
+        self.perf.inc("subop_w")
+        ok = self._apply_delta_local(m.pgid, m.oid, m.parity_shard,
+                                     m.extents, m.version)
+        if ok:
+            self._pg_versions[m.pgid] = max(
+                self._pg_versions.get(m.pgid, 0), m.version)
+        conn.send(MSubWriteReply(m.tid, m.pgid, m.parity_shard,
+                                 self.osd_id, 0 if ok else ENOENT))
 
     def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
         tid = next(self._tids)
@@ -478,14 +868,17 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     def _finish_ec_read(self, pr: _PendingRead) -> None:
         codec = self._pool_codec(pr.pool)
         done = pr.on_done
+        if done:
+            # callback readers (recovery, partial writes) judge chunk
+            # sufficiency themselves — they may want fewer than k
+            done(pr)
+            return
         epoch = self.osdmap.epoch if self.osdmap else 0
         if len(pr.chunks) < codec.k:
             # no shard at all anywhere -> the object does not exist;
             # some-but-too-few shards -> unrecoverable (EIO)
             err = ENOENT if not pr.chunks else EIO
-            if done:
-                done(None)
-            elif pr.client:
+            if pr.client:
                 self.messenger.send_message(
                     pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch))
             return
@@ -525,19 +918,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         if self.osdmap is None:
             return None
         seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
-        cid = CollectionId(pr.pool, seed)
-        for shard in list(pr.chunks) + list(range(
-                self.osdmap.pools[pr.pool].size)):
-            try:
-                attrs = self.store.getattrs(cid, ObjectId(pr.oid,
-                                                          shard=shard))
-                if "len" in attrs:
-                    return int(attrs["len"])
-            except NoSuchObject:
-                continue
-        return None
+        return self._ec_object_len(PgId(pr.pool, seed), pr.oid)
 
-    def _ec_remove(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+    def _ec_remove(self, conn, m: MOSDOp, pgid: PgId, up: list,
+                   lock_key: tuple | None = None) -> None:
         version = self._next_version(pgid)
         self._record_tombstone(pgid, m.oid, version)
         tid = next(self._tids)
@@ -559,9 +943,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
         else:
             self._pending_writes[tid] = _PendingWrite(
-                m.client, m.tid, remote, version)
+                m.client, m.tid, remote, version, lock_key=lock_key)
 
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
@@ -589,6 +974,14 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         if m.op == "write":
             self._apply_write(m.pgid, m.oid, m.shard, m.data,
                               dict(m.attrs, v=m.version))
+        elif m.op == "write_partial":
+            if not self._apply_partial(m.pgid, m.oid, m.shard,
+                                       [(m.offset, m.data)], m.version):
+                # replica lacks the object (recovery lag): refuse rather
+                # than fabricate a zero-prefixed copy at the new version
+                conn.send(MSubWriteReply(m.tid, m.pgid, m.shard,
+                                         self.osd_id, ENOENT))
+                return
         elif m.op == "remove":
             cid = CollectionId(m.pgid.pool, m.pgid.seed)
             obj = ObjectId(m.oid, shard=m.shard)
@@ -615,6 +1008,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             pw.client,
             MOSDOpReply(pw.client_tid, result, version=pw.version,
                         epoch=self.osdmap.epoch if self.osdmap else 0))
+        self._obj_unlock(pw.lock_key)
 
     # ----------------------------------------------------------- heartbeats
     def _heartbeat_loop(self) -> None:
@@ -659,6 +1053,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self.messenger.send_message(
                 pw.client, MOSDOpReply(pw.client_tid, EIO,
                                        version=pw.version, epoch=epoch))
+            self._obj_unlock(pw.lock_key)
         for pr in expired_r:
             self._finish_ec_read(pr)  # decodes if >= k arrived, else err
 
@@ -670,15 +1065,24 @@ class OSDDaemon(ScrubMixin, Dispatcher):
 
     # ------------------------------------------------------ peering/recovery
     def _start_recovery(self) -> None:
-        """Primary-side: inventory peers for my PGs (recovery-lite)."""
+        """Primary-side: inventory peers for my PGs (recovery-lite).  PGs
+        wait in 'peering' (IO blocked with EAGAIN) until every alive up
+        member has answered, so a freshly-promoted primary cannot serve
+        stale data (the GetInfo/GetMissing phase of the peering FSM)."""
         for pool_id, seed, up in self._pools_pgs_for_me():
             if self._primary_of(up) != self.osd_id:
+                self._peering.pop(PgId(pool_id, seed), None)
                 continue
             pgid = PgId(pool_id, seed)
-            for osd in up:
-                if osd is not None and osd != self.osd_id:
-                    self.messenger.send_message(
-                        f"osd.{osd}", MPGQuery(pgid, self.osdmap.epoch))
+            peers = {osd for osd in up
+                     if osd is not None and osd != self.osd_id}
+            if peers:
+                self._peering[pgid] = set(peers)
+            else:
+                self._peering.pop(pgid, None)
+            for osd in peers:
+                self.messenger.send_message(
+                    f"osd.{osd}", MPGQuery(pgid, self.osdmap.epoch))
             # also reconcile my own shard inventory immediately
             self._handle_pg_info(None, self._my_pg_info(pgid))
 
@@ -717,6 +1121,22 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         for name, v in m.tombstones.items():
             self._record_tombstone(m.pgid, name, v)
         dead = self._tombstones.get(m.pgid, {})
+        # peering bookkeeping: learn versions, note objects I am behind on
+        # (they stay blocked until the pull lands), retire the peer
+        my_best: dict[str, int] = {}
+        for (name, _s), v in my_inv.items():
+            my_best[name] = max(my_best.get(name, -1), v)
+        stale = self._stale_objects.setdefault(m.pgid, set())
+        for (name, _s), v in peer_inv.items():
+            self._pg_versions[m.pgid] = max(
+                self._pg_versions.get(m.pgid, 0), v)
+            if v > my_best.get(name, -1) and dead.get(name, -1) < v:
+                stale.add(name)
+        waiting = self._peering.get(m.pgid)
+        if waiting is not None:
+            waiting.discard(m.from_osd)
+            if not waiting:
+                del self._peering[m.pgid]
         if pool.kind == "ec":
             self._recover_ec(m.pgid, pool, up, m.from_osd, peer_inv, my_inv,
                              dead)
@@ -728,11 +1148,14 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                             dead) -> None:
         if peer == self.osd_id:
             return
+        peer_is_member = peer in [u for u in up if u is not None]
         cid = CollectionId(pgid.pool, pgid.seed)
         push, pull, deletes = {}, [], {}
         for (name, shard), v in my_inv.items():
             if dead.get(name, -1) >= v:
                 continue  # deleted; never resurrect
+            if not peer_is_member:
+                continue  # demoted holders only feed pulls, not pushes
             pv = peer_inv.get((name, shard), -1)
             if pv < v:
                 data = self.store.read(cid, ObjectId(name, shard)).to_bytes()
@@ -797,6 +1220,18 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             if peer != self.osd_id:
                 self.messenger.send_message(
                     f"osd.{peer}", MPGPush(pgid, -3, {}, deletes))
+        if peer not in [u for u in up if u is not None]:
+            # demoted holder (notify path): migrate its stranded shards to
+            # the current position holders; the version gate on the push
+            # side dedups if the holder already caught up
+            for (name, shard), v in peer_inv.items():
+                if dead.get(name, -1) >= v or shard >= len(up):
+                    continue
+                holder = up[shard]
+                if holder is None or holder == peer:
+                    continue
+                self._fetch_and_push(pgid, name, shard, peer, holder, v)
+            return
         for shard, osd in enumerate(up):
             if osd == peer:
                 for name, version in names.items():
@@ -812,6 +1247,29 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     self._rebuild_shard(pgid, name, shard, self.osd_id,
                                         version)
 
+    def _fetch_and_push(self, pgid, name, shard, src: int, dst: int,
+                        version: int) -> None:
+        """Copy one shard from a demoted holder to its current position
+        holder (direct migration — no decode needed)."""
+        tid = next(self._tids)
+
+        def on_done(pr) -> None:
+            if pr is None or shard not in pr.chunks:
+                return
+            total = self._ec_total_len(pr)
+            self.perf.inc("recovery_push")
+            self.messenger.send_message(
+                f"osd.{dst}",
+                MPGPush(pgid, shard,
+                        {name: (version, pr.chunks[shard].tobytes(),
+                                total)}))
+
+        pr = _PendingRead(None, 0, pgid.pool, name, total_shards=1,
+                          on_done=on_done)
+        self._pending_reads[tid] = pr
+        self.messenger.send_message(f"osd.{src}",
+                                    MSubRead(tid, pgid, name, shard))
+
     def _rebuild_shard(self, pgid, name, shard, peer, version,
                        force: bool = False) -> None:
         """Reconstruct one shard from k survivors, then push it."""
@@ -820,8 +1278,9 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         tid = next(self._tids)
 
         def on_done(pr) -> None:
-            if pr is None:
-                return
+            if pr is None or (len(pr.chunks) < codec.k
+                              and shard not in pr.chunks):
+                return  # not enough survivors to rebuild
             chunks = pr.chunks
             if shard in chunks and not force:
                 rebuilt = chunks[shard]
@@ -886,3 +1345,23 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0),
             max((p[0] for p in m.objects.values()), default=0))
+        # pushed objects are no longer stale-blocked
+        stale = self._stale_objects.get(m.pgid)
+        if stale:
+            for name in list(m.objects) + list(m.deletes):
+                stale.discard(name)
+        # if I am this PG's primary, newly-landed data may need forwarding
+        # to members whose inventories were processed earlier: re-query,
+        # debounced so a recovery batch triggers one round, not O(objects)
+        if self.osdmap is not None and m.pgid.pool in self.osdmap.pools:
+            now = time.monotonic()
+            if now - self._requery_at.get(m.pgid, 0.0) < 0.2:
+                return
+            up = self.osdmap.pg_to_up_osds(m.pgid.pool, m.pgid.seed)
+            if self._primary_of(up) == self.osd_id:
+                self._requery_at[m.pgid] = now
+                for osd in up:
+                    if osd is not None and osd != self.osd_id:
+                        self.messenger.send_message(
+                            f"osd.{osd}",
+                            MPGQuery(m.pgid, self.osdmap.epoch))

@@ -129,7 +129,9 @@ def test_ec_loss_beyond_m_fails(big_cluster):
         got = client.read("ec31", "obj")
         assert got == payload
     except RadosError as e:
-        assert e.code == -5
+        # EIO (unrecoverable) or EAGAIN/timeout (stuck peering/degraded);
+        # never wrong data
+        assert e.code in (-5, -11, -110)
 
 
 def test_recovery_rebuilds_shards_on_spare(big_cluster):

@@ -1,0 +1,117 @@
+"""Thrasher: random OSD kills/revives under a live mixed workload.
+
+The teuthology thrashosds tier (SURVEY.md §4 tier 4: thrashosds.py +
+ceph_manager.py randomly kill/revive OSDs during rados model workloads;
+daemonwatchdog fails on crashes) compressed into one in-process test:
+writers keep a shadow model of every object; after the storm settles,
+every object must read back byte-exact and deep scrub must come up clean.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(1234)
+
+
+@pytest.mark.parametrize("pool_kind,profile", [
+    ("replicated", None),
+    ("ec", {"plugin": "jerasure", "k": "3", "m": "2", "backend": "native"}),
+])
+def test_thrash_osds_under_load(pool_kind, profile):
+    rng = random.Random(42)
+    cfg = make_cfg(osd_heartbeat_interval=0.05, osd_heartbeat_grace=0.4)
+    c = MiniCluster(n_osds=8, cfg=cfg).start()
+    try:
+        client = c.client()
+        if pool_kind == "ec":
+            client.create_pool("p", kind="ec", pg_num=4, ec_profile=profile)
+        else:
+            client.create_pool("p", size=3, pg_num=4)
+        # shadow model: acceptable[name] is the set of byte-strings a read
+        # may legally return.  A write that FAILS mid-2PC is INDETERMINATE
+        # (the primary may have applied it before the error — same client
+        # semantics as the reference); both old and new stay acceptable
+        # until a subsequent op settles the state.
+        acceptable: dict[str, set[bytes]] = {}
+
+        def record_write(name, data, ok):
+            if ok:
+                acceptable[name] = {data}
+            else:
+                acceptable.setdefault(name, set()).add(data)
+
+        for i in range(10):
+            data = RNG.integers(0, 256, int(RNG.integers(1000, 30_000)),
+                                dtype=np.uint8).tobytes()
+            client.write_full("p", f"obj{i}", data)
+            record_write(f"obj{i}", data, True)
+        c.settle(0.3)
+
+        dead: list[int] = []
+        ops = errors = 0
+        for round_no in range(6):
+            # thrash: kill one, maybe revive one (never below quorum)
+            alive = sorted(c.osds)
+            if len(alive) > 5:
+                victim = rng.choice(alive)
+                c.kill_osd(victim, mark_down=rng.random() < 0.5)
+                dead.append(victim)
+            if dead and rng.random() < 0.5:
+                c.revive_osd(dead.pop(0))
+            # workload during the churn
+            for _ in range(5):
+                name = f"obj{rng.randrange(14)}"
+                ops += 1
+                if rng.random() < 0.6 or name not in acceptable:
+                    data = RNG.integers(
+                        0, 256, int(RNG.integers(500, 20_000)),
+                        dtype=np.uint8).tobytes()
+                    try:
+                        client.write_full("p", name, data)
+                        record_write(name, data, True)
+                    except RadosError:
+                        errors += 1
+                        record_write(name, data, False)
+                else:
+                    try:
+                        got = client.read("p", name)
+                        assert got in acceptable[name], \
+                            f"{name}: read matches NO acceptable state"
+                        acceptable[name] = {got}  # observation settles it
+                    except RadosError:
+                        errors += 1
+            time.sleep(0.2)
+        # calm: revive everyone, let recovery finish
+        for osd in dead:
+            c.revive_osd(osd)
+        deadline = time.time() + 15
+        while time.time() < deadline and len(
+                c.mon.osdmap.up_osds()) < len(c.osds):
+            time.sleep(0.1)
+        c.settle(1.5)
+        # every object settles to ONE acceptable state (allow one extra
+        # settle round for in-flight spare rebuilds)
+        for name, states in acceptable.items():
+            try:
+                got = client.read("p", name)
+            except RadosError:
+                c.settle(2.0)
+                got = client.read("p", name)
+            assert got in states, f"{name} settled to an impossible state"
+        # and consistent on disk
+        issues = client.scrub_pool("p", deep=True)
+        # scrub may still see in-flight recovery pushes; allow one retry
+        if issues:
+            c.settle(1.5)
+            issues = client.scrub_pool("p", deep=True)
+        assert issues == [], issues
+        assert errors <= ops // 2, f"{errors}/{ops} ops failed"
+    finally:
+        c.stop()
