@@ -85,6 +85,44 @@ def _pallas_region_kernel(terms_all):
     return kernel
 
 
+def gf_matmul_mxu_graph(M: np.ndarray):
+    """MXU formulation: the GF(2^8) region matmul as a GF(2) bit-matrix
+    matmul on the systolic array (the Cauchy-bitmatrix trick).
+
+    parity_bits(8r, N) = B(8r, 8c) @ data_bits(8c, N)  mod 2
+
+    with B the bit-matrix expansion (gf256.bitmatrix) and data_bits the
+    LSB-first bit-planes.  Contraction depth 8c <= 256 (c <= 32) keeps
+    bf16 accumulation exact (partial sums stay below 256, the bf16
+    exact-integer bound).  Complements the VPU bit-term formulation
+    (gf_matmul_graph); bench picks the faster one on real hardware.
+    """
+    M = np.asarray(M, dtype=np.uint8)
+    r, c = M.shape
+    if 8 * c > 256:
+        raise ValueError("MXU path needs c <= 32 (exact bf16 accumulation)")
+    B = jnp.asarray(gf256.bitmatrix(M), dtype=jnp.bfloat16)  # (8r, 8c)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+
+    def fn(data_u8):
+        if data_u8.shape[0] != c:
+            raise ValueError(f"expected {c} rows, got {data_u8.shape[0]}")
+        n = data_u8.shape[-1]
+        # unpack: (c, n) -> (c, 8, n) -> (8c, n) bit-planes, LSB-first
+        planes = ((data_u8[:, None, :] >> shifts[None, :, None]) & 1)
+        planes = planes.reshape(8 * c, n).astype(jnp.bfloat16)
+        acc = jnp.dot(B, planes,
+                      preferred_element_type=jnp.float32)  # (8r, n)
+        bits = acc.astype(jnp.int32) & 1
+        # pack: (8r, n) -> (r, 8, n) -> bytes
+        bits = bits.reshape(r, 8, n)
+        out = (bits << shifts[None, :, None].astype(jnp.int32)).sum(
+            axis=1, dtype=jnp.int32)
+        return out.astype(jnp.uint8)
+
+    return fn
+
+
 def gf_matmul_graph(M: np.ndarray):
     """Return a pure, jit-friendly fn(data (c, L) uint8) -> (r, L) uint8
     computing M @ data over GF(2^8) as a plain jnp graph (no pallas_call),

@@ -28,13 +28,17 @@ def main() -> int:
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--reps", type=int, default=10)
     p.add_argument("--technique", default="reed_sol_van")
+    p.add_argument("--kernel", default="auto",
+                   choices=["auto", "vpu", "mxu"],
+                   help="vpu = bit-term lane kernel; mxu = GF(2) bitmatrix "
+                        "matmul; auto = time both, keep the faster")
     args = p.parse_args()
 
     import jax
 
     backend = jax.default_backend()
     from ceph_tpu.ops import gf256
-    from ceph_tpu.ops.ec_kernels import RegionMatmul
+    from ceph_tpu.ops.ec_kernels import RegionMatmul, gf_matmul_mxu_graph
 
     if args.technique == "reed_sol_van":
         M = gf256.vandermonde_matrix(args.k, args.m)
@@ -42,7 +46,31 @@ def main() -> int:
         M = gf256.cauchy_good_matrix(args.k, args.m)
     else:
         M = gf256.cauchy_matrix(args.k, args.m)
-    op = RegionMatmul(M)
+
+    candidates = {}
+    if args.kernel in ("auto", "vpu"):
+        candidates["vpu"] = RegionMatmul(M)
+    if args.kernel in ("auto", "mxu"):
+        try:
+            candidates["mxu"] = jax.jit(gf_matmul_mxu_graph(M))
+        except ValueError:
+            if args.kernel == "mxu":
+                raise  # explicitly requested but unsupported (k > 32)
+
+    def pick(host):
+        if len(candidates) == 1:
+            return next(iter(candidates.items()))
+        dev = jax.device_put(host)
+        best, best_dt = None, None
+        for name, fn in candidates.items():
+            fn(dev).block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                fn(dev).block_until_ready()
+            dt = time.perf_counter() - t0
+            if best_dt is None or dt < best_dt:
+                best, best_dt = name, dt
+        return best, candidates[best]
 
     chunk = args.stripe_bytes // args.k
     cols = args.batch * chunk  # stripes fold into the column axis
@@ -50,6 +78,7 @@ def main() -> int:
     host = rng.integers(0, 256, (args.k, cols), dtype=np.uint8)
     nbytes = host.nbytes
 
+    kernel_name, op = pick(host)
     # warm: compile + first transfer
     np.asarray(op(host))
 
@@ -69,6 +98,7 @@ def main() -> int:
 
     print(json.dumps({
         "backend": backend,
+        "kernel": kernel_name,
         "k": args.k, "m": args.m, "stripe_bytes": args.stripe_bytes,
         "batch": args.batch, "reps": args.reps,
         "bytes_per_rep": nbytes,

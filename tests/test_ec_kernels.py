@@ -72,6 +72,20 @@ def test_pallas_interpret_mode_matches():
     assert np.array_equal(got, want)
 
 
+def test_mxu_bitmatrix_kernel_matches_oracle():
+    import jax
+    from ceph_tpu.ops.ec_kernels import gf_matmul_mxu_graph
+    for maker, k, m in [(gf256.vandermonde_matrix, 8, 3),
+                        (gf256.cauchy_good_matrix, 8, 4)]:
+        M = maker(k, m)
+        fn = jax.jit(gf_matmul_mxu_graph(M))
+        data = RNG.integers(0, 256, (k, 8192), dtype=np.uint8)
+        got = np.asarray(fn(data))
+        assert np.array_equal(got, gf256.encode_region(M, data))
+    with pytest.raises(ValueError):
+        gf_matmul_mxu_graph(np.ones((2, 40), dtype=np.uint8))  # c > 32
+
+
 def test_zero_length_region():
     M = gf256.vandermonde_matrix(4, 2)
     for op in (RegionMatmul(M), RegionMatmul(M, interpret=True)):

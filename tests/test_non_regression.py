@@ -1,0 +1,18 @@
+"""Byte-parity non-regression: every plugin/backend must reproduce the
+checked-in corpus (the ceph-erasure-code-corpus gate, SURVEY.md §4)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.ops import native
+from ceph_tpu.tools.ec_non_regression import check
+
+CORPUS = os.path.join(os.path.dirname(__file__), "..", "corpus")
+
+
+@pytest.mark.parametrize("backend", ["numpy", "native", "jax"])
+def test_archive_byte_exact(backend):
+    if backend == "native" and not native.available():
+        pytest.skip("native lib unavailable")
+    assert check(CORPUS, backend) == 0
