@@ -18,7 +18,8 @@ import time
 
 from .. import ec
 from ..msg.messages import (MFailureReport, MMapPush, MMonCommand,
-                            MMonCommandReply, MMonSubscribe, MOSDBoot)
+                            MMonCommandReply, MMonSubscribe, MOSDBoot,
+                            MStatsReport)
 from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
 from ..utils.config import Config, default_config
 from ..utils.log import dout
@@ -54,11 +55,13 @@ class MonitorLite(Dispatcher):
         self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
         self._boot_times: dict[int, float] = {}
         self._lock = threading.RLock()
+        self._osd_stats: dict[int, dict] = {}
         self._handlers = {
             MOSDBoot: self._handle_boot,
             MMonSubscribe: self._handle_subscribe,
             MFailureReport: self._handle_failure,
             MMonCommand: self._handle_command,
+            MStatsReport: self._handle_stats,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -133,6 +136,7 @@ class MonitorLite(Dispatcher):
                     and m.failed_for >= self._grace_for(m.target)):
                 self.osdmap.mark_down(m.target)
                 del self._failure_reports[m.target]
+                self._osd_stats.pop(m.target, None)  # no stale usage
                 self._commit_map(
                     f"osd.{m.target} down ({distinct} reporters)")
 
@@ -152,26 +156,42 @@ class MonitorLite(Dispatcher):
             target = int(cmd["id"])
             with self._lock:
                 self.osdmap.mark_down(target)
+                self._osd_stats.pop(target, None)
                 self._commit_map(f"osd.{target} down (forced)")
             return 0, {}
         if prefix == "osd out":
             target = int(cmd["id"])
             with self._lock:
                 self.osdmap.mark_out(target)
+                self._osd_stats.pop(target, None)
                 self._commit_map(f"osd.{target} out")
             return 0, {}
         if prefix == "osd dump":
             return 0, self._dump()
         if prefix == "status":
             up = self.osdmap.up_osds()
+            agg = {"objects": 0, "bytes": 0, "op_w": 0, "op_r": 0,
+                   "recovery_push": 0, "scrub_errors": 0}
+            for s in self._osd_stats.values():
+                for k in agg:
+                    agg[k] += s.get(k, 0)
+            # raw sums count each replica/shard; objects are logical-ish
             return 0, {"epoch": self.osdmap.epoch,
                        "num_osds": len(self.osdmap.osds),
                        "num_up": len(up),
                        "pools": sorted(p.name for p in
                                        self.osdmap.pools.values()),
+                       "usage": agg,
                        "health": "HEALTH_OK" if len(up) == len(
                            self.osdmap.osds) else "HEALTH_WARN"}
+        if prefix == "osd stats":
+            return 0, {f"osd.{i}": dict(s)
+                       for i, s in sorted(self._osd_stats.items())}
         return -22, {"error": f"unknown command {prefix!r}"}
+
+    def _handle_stats(self, conn, m: MStatsReport) -> None:
+        with self._lock:
+            self._osd_stats[m.osd_id] = dict(m.stats)
 
     def _pool_create(self, cmd: dict):
         name = cmd["name"]

@@ -250,6 +250,17 @@ class MPGPush:
     force: bool = False  # scrub repair: overwrite same-version bad copies
 
 
+# ------------------------------------------------------------- mgr stats
+@dataclass
+class MStatsReport:
+    """Daemon -> monitor: periodic usage/perf summary (the MMgrReport /
+    PGStats flow feeding `ceph status` and exporters)."""
+
+    osd_id: int
+    epoch: int
+    stats: dict  # {"pgs", "objects", "bytes", "op_w", "op_r", ...}
+
+
 # ------------------------------------------------------------------ scrub
 @dataclass
 class MScrubRequest:

@@ -38,9 +38,9 @@ from .. import ec
 from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MOSDBoot, MOSDOp,
                             MOSDOpReply, MOSDPing, MOSDPingReply, MPGInfo,
-                            MPGPull, MPGPush, MPGQuery, MSubDelta,
-                            MSubPartialWrite, MSubRead, MSubReadReply,
-                            MSubWrite, MSubWriteReply, PgId)
+                            MPGPull, MPGPush, MPGQuery, MStatsReport,
+                            MSubDelta, MSubPartialWrite, MSubRead,
+                            MSubReadReply, MSubWrite, MSubWriteReply, PgId)
 from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
@@ -1014,11 +1014,13 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     def _heartbeat_loop(self) -> None:
         interval = self.cfg["osd_heartbeat_interval"]
         grace = self.cfg["osd_heartbeat_grace"]
+        ticks = 0
         while not self._stop.wait(interval):
             if self.osdmap is None:
                 continue
             now = time.time()
             self._sweep_pending(now)
+            ticks += 1
             for peer in self.osdmap.up_osds():
                 if peer == self.osd_id:
                     continue
@@ -1034,6 +1036,14 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                         self.mon,
                         MFailureReport(peer, self.osd_id,
                                        self.osdmap.epoch, now - last))
+            # stats AFTER pings (the walk must never delay liveness), every
+            # 5th tick, time-budgeted, and never allowed to kill the thread
+            if ticks % 5 == 0:
+                try:
+                    self._report_stats(budget=max(grace / 4, 0.05))
+                except Exception as e:  # noqa: BLE001
+                    dout("osd", 1)("%s: stats report failed: %r",
+                                   self.name, e)
 
     def _sweep_pending(self, now: float, max_age: float = 5.0) -> None:
         """Fail ops whose sub-ops never completed (peer died mid-op) so
@@ -1056,6 +1066,35 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._obj_unlock(pw.lock_key)
         for pr in expired_r:
             self._finish_ec_read(pr)  # decodes if >= k arrived, else err
+
+    def _report_stats(self, budget: float = 0.5) -> None:
+        """Usage/perf summary to the monitor (MMgrReport/PGStats role).
+        The store walk is time-budgeted; a partial walk reports what it
+        covered with partial=True rather than stalling heartbeats."""
+        objects = nbytes = pgs = 0
+        partial = False
+        t0 = time.monotonic()
+        for cid in self.store.list_collections():
+            pgs += 1
+            for oid in self.store.list_objects(cid):
+                try:
+                    nbytes += self.store.stat(cid, oid)["size"]
+                    objects += 1
+                except Exception:  # noqa: BLE001 - deleted under our feet
+                    continue
+            if time.monotonic() - t0 > budget:
+                partial = True
+                break
+        self.messenger.send_message(
+            self.mon,
+            MStatsReport(self.osd_id,
+                         self.osdmap.epoch if self.osdmap else 0,
+                         {"pgs": pgs, "objects": objects, "bytes": nbytes,
+                          "partial": partial,
+                          "op_w": self.perf.get("op_w"),
+                          "op_r": self.perf.get("op_r"),
+                          "recovery_push": self.perf.get("recovery_push"),
+                          "scrub_errors": self.perf.get("scrub_errors")}))
 
     def _handle_ping(self, conn, m: MOSDPing) -> None:
         conn.send(MOSDPingReply(self.osd_id, m.stamp))

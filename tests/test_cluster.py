@@ -160,6 +160,50 @@ def test_recovery_rebuilds_shards_on_spare(big_cluster):
     assert client.read("ecpool", "obj") == payload
 
 
+def test_tpu_plugin_pool_in_cluster(big_cluster):
+    """The flagship `tpu` plugin (JAX kernels) serving a live EC pool."""
+    client = big_cluster.client()
+    client.create_pool("tpupool", kind="ec", pg_num=2,
+                       ec_profile={"plugin": "tpu", "k": "4", "m": "2",
+                                   "backend": "jax"})
+    payload = RNG.integers(0, 256, 200_000, dtype=np.uint8).tobytes()
+    client.write_full("tpupool", "obj", payload)
+    assert client.read("tpupool", "obj") == payload
+    # degraded read through the JAX decode path
+    pool_id = client._pool_id("tpupool")
+    seed = big_cluster.mon.osdmap.object_to_pg(pool_id, "obj")
+    up = big_cluster.mon.osdmap.pg_to_up_osds(pool_id, seed)
+    epoch = big_cluster.mon.osdmap.epoch
+    big_cluster.kill_osd(up[0])
+    big_cluster.wait_for_epoch(epoch + 1)
+    big_cluster.settle(0.5)
+    assert client.read("tpupool", "obj") == payload
+
+
+def test_mon_stats_aggregation():
+    """OSD stats reports feed `status` usage (MMgrReport/PGStats role)."""
+    cfg = make_cfg(osd_heartbeat_interval=0.05)
+    c = MiniCluster(n_osds=3, cfg=cfg).start()
+    try:
+        client = c.client()
+        client.create_pool("rbd", size=3, pg_num=2)
+        client.write_full("rbd", "obj", b"z" * 10_000)
+        deadline = time.time() + 10
+        usage = {}
+        while time.time() < deadline:
+            usage = client.status().get("usage", {})
+            if usage.get("objects", 0) >= 3:  # 3 replicas reported
+                break
+            time.sleep(0.05)
+        assert usage.get("objects", 0) >= 3
+        assert usage.get("bytes", 0) >= 30_000
+        assert usage.get("op_w", 0) >= 1
+        per_osd = client.mon_command({"prefix": "osd stats"})
+        assert len(per_osd) == 3
+    finally:
+        c.stop()
+
+
 def test_heartbeat_failure_detection():
     """Kill an OSD without telling the mon; heartbeats must notice
     (OSD::handle_osd_ping -> MOSDFailure -> prepare_failure path)."""
