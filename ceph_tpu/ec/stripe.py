@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import numpy as np
+
 from ..utils.interval import IntervalSet
 from .interface import EC_ALIGN_SIZE, Flags
 
@@ -100,6 +102,56 @@ class StripeInfo:
         """Per-shard bytes for an object (full stripes, zero padded)."""
         stripes = -(-object_size // self.stripe_width)
         return stripes * self.chunk_size
+
+    def rows_of_range(self, off: int, length: int) -> tuple[int, int]:
+        """Stripe rows covering the ro range: (first_row, n_rows)."""
+        row0 = off // self.stripe_width
+        row_end = -(-(off + length) // self.stripe_width)
+        return row0, row_end - row0
+
+    def ro_range_segments(self, off: int,
+                          length: int) -> list[tuple[int, int, int, int]]:
+        """ro byte range -> ordered (shard, shard_off, seg_len, ro_off)
+        segments (each contiguous within one chunk cell); the walk behind
+        ro_range_to_shard_extents, keeping the ro provenance each segment
+        came from so callers can slice the client buffer."""
+        end = off + length
+        segs = []
+        while off < end:
+            shard, soff = self.ro_to_shard(off)
+            take = min(self.chunk_size - soff % self.chunk_size, end - off)
+            segs.append((shard, soff, take, off))
+            off += take
+        return segs
+
+    # -- tensor layout (the slice_iterator seam, re-shaped for devices) ----
+    def ro_scatter(self, data) -> np.ndarray:
+        """Pad an ro byte buffer to whole stripe rows and scatter it into
+        the (k, rows*chunk_size) per-shard streams of the RAID-0 layout.
+        One call covers ANY number of rows, so a whole object becomes one
+        (k, L) matrix -> one encode_chunks kernel launch."""
+        buf = np.frombuffer(data, dtype=np.uint8) if isinstance(
+            data, (bytes, bytearray, memoryview)) else np.asarray(
+                data, dtype=np.uint8).reshape(-1)
+        rows = -(-buf.size // self.stripe_width)
+        padded = np.zeros(rows * self.stripe_width, dtype=np.uint8)
+        padded[: buf.size] = buf
+        return padded.reshape(rows, self.k, self.chunk_size) \
+            .transpose(1, 0, 2).reshape(self.k, rows * self.chunk_size)
+
+    def ro_assemble(self, streams) -> np.ndarray:
+        """Inverse of ro_scatter: k equal-length shard streams -> the
+        contiguous (zero-padded) ro byte buffer they interleave."""
+        arr = np.stack([np.asarray(s, dtype=np.uint8) for s in streams])
+        if arr.shape[0] != self.k:
+            raise ValueError(f"need {self.k} data streams, got {arr.shape[0]}")
+        length = arr.shape[1]
+        if length % self.chunk_size:
+            raise ValueError(f"stream length {length} not a multiple of "
+                             f"chunk_size {self.chunk_size}")
+        rows = length // self.chunk_size
+        return arr.reshape(self.k, rows, self.chunk_size) \
+            .transpose(1, 0, 2).reshape(-1)
 
 
 # ---------------------------------------------------------------------------

@@ -211,6 +211,16 @@ class MonitorLite(Dispatcher):
                 # OSDMonitor::get_erasure_code step (:1977)
                 codec = ec.factory(plugin, {k: v for k, v in profile.items()
                                             if k != "plugin"})
+                if "stripe_unit" in profile:
+                    # the stripe geometry contract is part of profile
+                    # validation (ECUtil EC_ALIGN_SIZE): reject here, not
+                    # on the OSD dispatch thread at first IO
+                    from ..ec.stripe import StripeInfo
+                    try:
+                        StripeInfo(codec.k, codec.m,
+                                   int(profile["stripe_unit"]))
+                    except (ValueError, TypeError) as e:
+                        return -22, {"error": f"bad stripe_unit: {e}"}
                 size = codec.k + codec.m
                 # k+1 so an acked write survives one immediate failure
                 # (the reference's EC min_size default)

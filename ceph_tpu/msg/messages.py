@@ -100,29 +100,41 @@ class MSubWrite:
 
 @dataclass
 class MSubPartialWrite:
-    """Primary -> data-shard OSD: overwrite extents inside the chunk
-    (the partial-write leg of the EC RMW pipeline, ECTransaction role)."""
+    """Primary -> shard OSD: overwrite extents inside the shard stream
+    (the partial-write leg of the EC RMW pipeline, ECTransaction role).
+    Extents are shard-stream offsets under the stripe_info_t RAID-0
+    layout (ref ECUtil.h:452-800)."""
 
     tid: int
     pgid: PgId
     oid: str
     shard: int
     version: int
-    extents: list  # [(chunk_off, bytes)]
+    extents: list  # [(shard_off, bytes)]
+    total_len: int = -1  # new whole-object length; -1 = leave unchanged
+    create: bool = False  # primary-sanctioned create (fresh object rows)
+    # conditional apply: the object version the primary based this write
+    # on; a shard holding a DIFFERENT version must refuse (EAGAIN) so a
+    # stale revived shard can never absorb extents computed against newer
+    # data and be stamped current (the rollback-generation consistency
+    # role, doc/dev/osd_internals/erasure_coding/ecbackend.rst:10-27)
+    prev_version: int = -1  # -1 = unconditional
 
 
 @dataclass
 class MSubDelta:
     """Primary -> parity-shard OSD: fold data-shard deltas into the
-    stored parity chunk (apply_delta wire leg; ECUtil encode_parity_delta
-    ECUtil.cc:519-566 role)."""
+    stored parity stream (apply_delta wire leg; ECUtil
+    encode_parity_delta ECUtil.cc:519-566 role)."""
 
     tid: int
     pgid: PgId
     oid: str
     parity_shard: int   # this recipient's shard id
     version: int
-    extents: list  # [(data_shard, chunk_off, delta bytes)]
+    extents: list  # [(data_shard, shard_off, delta bytes)]
+    total_len: int = -1  # new whole-object length; -1 = leave unchanged
+    prev_version: int = -1  # conditional apply (see MSubPartialWrite)
 
 
 @dataclass
@@ -136,12 +148,16 @@ class MSubWriteReply:
 
 @dataclass
 class MSubRead:
-    """Primary -> shard OSD read (ECSubRead role)."""
+    """Primary -> shard OSD read (ECSubRead role).  extents=None reads
+    the whole shard stream; otherwise the reply carries the concatenation
+    of the requested [(shard_off, len)] slices, each zero-padded to its
+    requested length (absent tail bytes of a padded stripe are zeros)."""
 
     tid: int
     pgid: PgId
     oid: str
     shard: int
+    extents: list | None = None
 
 
 @dataclass

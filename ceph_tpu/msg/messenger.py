@@ -106,8 +106,16 @@ class LocalNetwork:
         else:
             self._partitions.discard(frozenset((a, b)))
 
+    @staticmethod
+    def _entity_of(name: str) -> str:
+        """Auxiliary endpoints (osd.3.hb) share their daemon's fate: a
+        partition severs every plane of the entity, like pulling a host's
+        cable severs both the data and heartbeat networks."""
+        return name[:-3] if name.endswith(".hb") else name
+
     def _blocked(self, src: str, dst: str) -> bool:
-        if frozenset((src, dst)) in self._partitions:
+        if frozenset((self._entity_of(src),
+                      self._entity_of(dst))) in self._partitions:
             return True
         return self.drop_rate > 0 and self._rng.random() < self.drop_rate
 

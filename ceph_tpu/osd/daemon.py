@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .. import ec
+from ..ec.stripe import StripeInfo, plan_write
 from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MOSDBoot, MOSDOp,
                             MOSDOpReply, MOSDPing, MOSDPingReply, MPGInfo,
@@ -49,7 +50,7 @@ from ..utils.perf import CounterType, global_perf
 from ..utils.tracked_op import OpTracker
 from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
-                          Transaction)
+                          StoreError, Transaction)
 from .scrub import FaultInjection, ScrubMixin
 
 EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
@@ -62,6 +63,7 @@ class _PendingWrite:
     acks_needed: int
     version: int
     failed: int = 0
+    retry: int = 0  # version-conflict sub-op refusals (client retries)
     lock_key: tuple | None = None  # per-object write lock to release
     stamp: float = field(default_factory=time.time)
 
@@ -75,9 +77,13 @@ class _PendingRead:
     total_shards: int
     chunks: dict = field(default_factory=dict)  # shard -> np.uint8 array
     attrs: dict = field(default_factory=dict)   # merged shard attrs (len/v)
+    shard_vers: dict = field(default_factory=dict)  # shard -> version attr
+    shard_attrs: dict = field(default_factory=dict)  # shard -> its attrs
     replies: int = 0
     offset: int = 0
     length: int = 0
+    row_base: int = 0      # ro byte addr of the first row covered (range
+    row_len: int = 0       # reads); row_len = shard-stream bytes per shard
     stat_only: bool = False  # reply with the object length, not data
     # recovery reads carry a completion callback instead of a client
     on_done: object = None
@@ -110,6 +116,12 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             network, self.name,
             Policy.stateless_server(self.cfg["osd_client_message_cap"]))
         self.messenger.add_dispatcher(self)
+        # dedicated heartbeat endpoint (the hb_front/hb_back messenger
+        # role, src/ceph_osd.cc:550-630): liveness probes must never queue
+        # behind bulk shard IO on the data dispatch thread
+        self.hb_messenger = Messenger(network, f"{self.name}.hb",
+                                      Policy.lossless_peer())
+        self.hb_messenger.add_dispatcher(self)
         self.osdmap: OSDMap | None = None
         self._tids = itertools.count(1)
         # pending tables are touched by the dispatch thread AND the
@@ -119,6 +131,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._pending_reads: dict[int, _PendingRead] = {}
         self._pg_versions: dict[PgId, int] = {}
         self._ec_codecs: dict[int, ec.ErasureCode] = {}
+        self._stripes: dict[int, StripeInfo] = {}
         self._hb_last: dict[int, float] = {}
         self._hb_thread: threading.Thread | None = None
         self._stop = threading.Event()
@@ -163,6 +176,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
         self.messenger.start()
+        self.hb_messenger.start()
         self.messenger.send_message(
             self.mon, MOSDBoot(self.osd_id, self.host, self.name))
         self._hb_thread = threading.Thread(
@@ -172,6 +186,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     def stop(self) -> None:
         self._stop.set()
         self.messenger.shutdown()
+        self.hb_messenger.shutdown()
 
     # -------------------------------------------------- admin socket verbs
     def admin_command(self, cmd: str, **kw):
@@ -395,9 +410,13 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._run_locked_thunk(key, nxt)  # start the next queued write
 
     def _next_version(self, pgid: PgId) -> int:
-        v = self._pg_versions.get(pgid, 0) + 1
-        self._pg_versions[pgid] = v
-        return v
+        # reachable from the dispatch thread AND the heartbeat sweep (via
+        # _obj_unlock -> queued write thunk): the RMW must be atomic or two
+        # writes in one PG can mint the same version
+        with self._pending_lock:
+            v = self._pg_versions.get(pgid, 0) + 1
+            self._pg_versions[pgid] = v
+            return v
 
     def _record_tombstone(self, pgid: PgId, name: str, version: int) -> None:
         """Deletion marker so recovery never resurrects removed objects
@@ -522,6 +541,20 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._ec_codecs[pool_id] = codec
         return codec
 
+    def _pool_stripe(self, pool_id: int) -> StripeInfo:
+        """The pool's stripe geometry (ECUtil stripe_info_t role): a FIXED
+        page-aligned chunk_size from the profile's stripe_unit, so objects
+        are many interleaved stripe rows, not one unbounded stripe."""
+        si = self._stripes.get(pool_id)
+        if si is None:
+            codec = self._pool_codec(pool_id)
+            pool = self.osdmap.pools[pool_id]
+            unit = int(pool.ec_profile.get(
+                "stripe_unit", self.cfg["osd_ec_stripe_unit"]))
+            si = StripeInfo(codec.k, codec.m, unit)
+            self._stripes[pool_id] = si
+        return si
+
     def _ec_object_len(self, pgid: PgId, oid: str) -> int | None:
         cid = CollectionId(pgid.pool, pgid.seed)
         for shard in range(self.osdmap.pools[pgid.pool].size):
@@ -546,27 +579,56 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._obj_unlock(lock_key)
             return
         total = None if full else self._ec_object_len(pgid, m.oid)
-        if not full and (m.offset or (total is not None
-                                      and m.offset + len(m.data) < total)):
-            # sub-object overwrite (the WritePlan partial branch)
-            if (total is not None and m.offset + len(m.data) <= total
-                    and codec.supports_parity_delta()
-                    and None not in up):
-                self._ec_partial_write(conn, m, pgid, up, codec, total,
-                                       lock_key)
+        si = self._pool_stripe(pgid.pool)
+        if not full:
+            object_size = total if total is not None else 0
+            end = m.offset + len(m.data)
+            if m.offset == 0 and end >= object_size:
+                pass  # covers the whole object: same as write_full below
             else:
-                self._ec_rmw_write(conn, m, pgid, up, codec, total,
-                                   lock_key)
-            return
+                # sub-object overwrite: the ECTransaction WritePlan
+                # decision (full rows / parity delta / rmw) over the
+                # stripe_info_t geometry
+                plan = plan_write(si, object_size, m.offset, len(m.data),
+                                  codec.get_flags())
+                padded_end = si.object_chunk_size(object_size) * si.k
+                if plan.mode == "full_stripe":
+                    row0, nrows = si.rows_of_range(m.offset, len(m.data))
+                    buf = bytearray(nrows * si.stripe_width)
+                    start = m.offset - row0 * si.stripe_width
+                    buf[start:start + len(m.data)] = m.data
+                    self._ec_write_rows(
+                        conn, m, pgid, up, codec, si, row0, bytes(buf),
+                        max(object_size, end), create=object_size == 0,
+                        prev_version=self._ec_object_version(pgid, m.oid)
+                        if object_size else -1,
+                        lock_key=lock_key)
+                elif (plan.mode == "parity_delta" and end <= padded_end
+                        and None not in up):
+                    # delta only valid against rows that exist on EVERY
+                    # shard; growth into new rows and degraded sets fall
+                    # back to row-rmw
+                    self._ec_partial_write(conn, m, pgid, up, codec, si,
+                                           object_size, lock_key)
+                else:
+                    self._ec_rmw_rows(conn, m, pgid, up, codec, si,
+                                      object_size, lock_key)
+                return
         version = self._next_version(pgid)
-        chunks = codec.encode(m.data)
+        # whole-object (re)write: scatter the buffer into the RAID-0
+        # shard streams and encode ALL rows in ONE kernel launch (the
+        # batching seam of ECUtil::shard_extent_map_t::encode)
+        streams = si.ro_scatter(m.data)
+        parity = codec.encode_chunks(streams)
         attrs = {"v": version, "len": len(m.data)}
         tid = next(self._tids)
         remote = 0
         for shard, osd in enumerate(up):
             if osd is None:
                 continue  # degraded write: hole shard skipped
-            data = chunks[shard].tobytes()
+            chunk = streams[shard] if shard < codec.k \
+                else parity[shard - codec.k]
+            data = chunk.tobytes()
             if osd == self.osd_id:
                 self._apply_write(pgid, m.oid, shard, data, attrs)
             else:
@@ -584,169 +646,293 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             m.client, m.tid, remote, version, lock_key=lock_key)
 
     # -- EC partial writes (parity delta / rmw; ECTransaction WritePlan) ---
-    def _touched_extents(self, codec, total: int, off: int,
-                         length: int) -> dict[int, list[tuple[int, int]]]:
-        """Sub-object range -> {data_shard: [(chunk_off, len)]} under the
-        contiguous-block chunk layout of encode_prepare."""
-        cs = codec.get_chunk_size(total)
-        out: dict[int, list[tuple[int, int]]] = {}
-        end = off + length
-        while off < end:
-            shard, coff = divmod(off, cs)
-            take = min(cs - coff, end - off)
-            out.setdefault(shard, []).append((coff, take))
-            off += take
-        return out
+    def _ec_object_version(self, pgid: PgId, oid: str) -> int:
+        """The primary's local view of the object's version (any local
+        shard's v attr; -1 if it holds none)."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        best = -1
+        for shard in range(self.osdmap.pools[pgid.pool].size):
+            try:
+                attrs = self.store.getattrs(cid, ObjectId(oid, shard=shard))
+                best = max(best, int(attrs.get("v", 0)))
+            except NoSuchObject:
+                continue
+        return best
+
+    def _ec_write_rows(self, conn, m: MOSDOp, pgid: PgId, up: list, codec,
+                       si: StripeInfo, row0: int, row_bytes: bytes,
+                       new_len: int, create: bool = False,
+                       prev_version: int = -1,
+                       lock_key: tuple | None = None) -> None:
+        """Encode and store whole stripe rows [row0, row0+n) — the
+        full-stripe branch of the WritePlan: no reads; every shard
+        (parity included) takes an extent write at the row offsets,
+        conditional on prev_version (a stale shard refuses with EAGAIN
+        and the client retries once recovery has caught it up)."""
+        version = self._next_version(pgid)
+        streams = si.ro_scatter(row_bytes)
+        parity = codec.encode_chunks(streams)
+        base = row0 * si.chunk_size
+        tid = next(self._tids)
+        remote = 0
+        local_failed = local_retry = 0
+        for shard, osd in enumerate(up):
+            if osd is None:
+                continue
+            chunk = streams[shard] if shard < codec.k \
+                else parity[shard - codec.k]
+            ext = [(base, chunk.tobytes())]
+            if osd == self.osd_id:
+                code = self._apply_partial(pgid, m.oid, shard, ext, version,
+                                           create_ok=create,
+                                           total_len=new_len,
+                                           prev_version=prev_version)
+                if code == EAGAIN:
+                    local_retry += 1
+                elif code != 0:
+                    local_failed += 1
+            else:
+                remote += 1
+                self.messenger.send_message(
+                    f"osd.{osd}",
+                    MSubPartialWrite(tid, pgid, m.oid, shard, version, ext,
+                                     total_len=new_len, create=create,
+                                     prev_version=prev_version))
+        if remote == 0:
+            result = EIO if local_failed else (EAGAIN if local_retry else 0)
+            conn.send(MOSDOpReply(m.tid, result,
+                                  version=version, epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
+        else:
+            self._pending_writes[tid] = _PendingWrite(
+                m.client, m.tid, remote, version, failed=local_failed,
+                retry=local_retry, lock_key=lock_key)
 
     def _ec_partial_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
-                          codec, total: int,
+                          codec, si: StripeInfo, object_size: int,
                           lock_key: tuple | None = None) -> None:
         """Parity-delta overwrite: read ONLY the old bytes being replaced,
-        write the new bytes to their data shards, and fold coef*delta into
-        every parity shard — no stripe re-encode, no k-wide read."""
-        touched = self._touched_extents(codec, total, m.offset, len(m.data))
-        version = self._next_version(pgid)
+        write the new bytes to their data-shard extents, and fold
+        coef*delta into every parity shard at the same shard offsets — no
+        stripe re-encode, no k-wide read (ECUtil.cc:519-566 role)."""
+        segs = si.ro_range_segments(m.offset, len(m.data))
+        per_shard: dict[int, list] = {}
+        for shard, soff, ln, ro in segs:
+            per_shard.setdefault(shard, []).append((soff, ln, ro))
+        new_len = max(object_size, m.offset + len(m.data))
         tid = next(self._tids)
-        # phase 1: fetch old chunks of the touched data shards
-        fan_up = [u if (s in touched) else None
-                  for s, u in enumerate(up)]
 
         def on_old(pr) -> None:
-            if pr is None or any(s not in pr.chunks for s in touched):
+            if pr is None or any(s not in pr.chunks for s in per_shard):
                 self.messenger.send_message(
                     m.client, MOSDOpReply(m.tid, EIO,
                                           epoch=self.osdmap.epoch))
                 self._obj_unlock(lock_key)
                 return
-            remote = 0
-            pos = 0
+            vers = {pr.shard_vers.get(s) for s in per_shard}
+            if len(vers) != 1 or None in vers:
+                # touched shards disagree on version (stale revived
+                # shard): deltas computed from those bytes would poison
+                # parity — take the row-rmw path, which decodes from a
+                # version-agreed set instead
+                self._ec_rmw_rows(_ClientConn(self, m.client), m, pgid,
+                                  up, codec, si, object_size, lock_key)
+                return
+            prev = vers.pop()
+            version = self._next_version(pgid)
+            wtid = next(self._tids)
             deltas: dict[int, list[tuple[int, bytes]]] = {}
             news: dict[int, list[tuple[int, bytes]]] = {}
-            for shard in sorted(touched):
-                for coff, take in touched[shard]:
-                    new = m.data[pos:pos + take]
-                    old = pr.chunks[shard][coff:coff + take].tobytes()
-                    delta = codec.encode_delta(
-                        np.frombuffer(old, dtype=np.uint8),
-                        np.frombuffer(new, dtype=np.uint8)).tobytes()
-                    deltas.setdefault(shard, []).append((coff, delta))
-                    news.setdefault(shard, []).append((coff, new))
-                    pos += take
-            wtid = next(self._tids)
-            local_failed = 0
+            for shard, exts in per_shard.items():
+                blob = pr.chunks[shard]
+                pos = 0
+                for soff, ln, ro in exts:
+                    old = np.asarray(blob[pos:pos + ln], dtype=np.uint8)
+                    if old.size < ln:  # reading past a short shard: zeros
+                        old = np.concatenate(
+                            [old, np.zeros(ln - old.size, np.uint8)])
+                    new = np.frombuffer(
+                        m.data[ro - m.offset: ro - m.offset + ln],
+                        dtype=np.uint8)
+                    delta = codec.encode_delta(old, new)
+                    deltas.setdefault(shard, []).append(
+                        (soff, delta.tobytes()))
+                    news.setdefault(shard, []).append((soff, new.tobytes()))
+                    pos += ln
+            remote = 0
+            local_failed = local_retry = 0
+
+            def tally(code: int) -> None:
+                nonlocal local_failed, local_retry
+                if code == EAGAIN:
+                    local_retry += 1
+                elif code != 0:
+                    local_failed += 1
+
             # data shards: new bytes (touched) or version bump (untouched)
             for shard, osd in enumerate(up):
                 if osd is None or shard >= codec.k:
                     continue
                 ext = news.get(shard, [])
                 if osd == self.osd_id:
-                    if not self._apply_partial(pgid, m.oid, shard, ext,
-                                               version):
-                        local_failed += 1
+                    tally(self._apply_partial(pgid, m.oid, shard, ext,
+                                              version, total_len=new_len,
+                                              prev_version=prev))
                 else:
                     remote += 1
                     self.messenger.send_message(
                         f"osd.{osd}",
                         MSubPartialWrite(wtid, pgid, m.oid, shard, version,
-                                         ext))
+                                         ext, total_len=new_len,
+                                         prev_version=prev))
             # parity shards: one delta message covering all data deltas
-            flat = [(ds, coff, dbytes) for ds, lst in deltas.items()
-                    for coff, dbytes in lst]
+            flat = [(ds, soff, dbytes) for ds, lst in deltas.items()
+                    for soff, dbytes in lst]
             for shard, osd in enumerate(up):
                 if osd is None or shard < codec.k:
                     continue
                 if osd == self.osd_id:
-                    if not self._apply_delta_local(pgid, m.oid, shard,
-                                                   flat, version):
-                        local_failed += 1
+                    tally(self._apply_delta_local(pgid, m.oid, shard,
+                                                  flat, version,
+                                                  total_len=new_len,
+                                                  prev_version=prev))
                 else:
                     remote += 1
                     self.messenger.send_message(
                         f"osd.{osd}",
                         MSubDelta(wtid, pgid, m.oid, shard, version,
-                                  list(flat)))
+                                  list(flat), total_len=new_len,
+                                  prev_version=prev))
             if remote == 0:
+                result = EIO if local_failed \
+                    else (EAGAIN if local_retry else 0)
                 self.messenger.send_message(
                     m.client,
-                    MOSDOpReply(m.tid, EIO if local_failed else 0,
+                    MOSDOpReply(m.tid, result,
                                 version=version, epoch=self.osdmap.epoch))
                 self._obj_unlock(lock_key)
             else:
                 self._pending_writes[wtid] = _PendingWrite(
                     m.client, m.tid, remote, version, failed=local_failed,
-                    lock_key=lock_key)
+                    retry=local_retry, lock_key=lock_key)
 
         pr = _PendingRead(None, 0, pgid.pool, m.oid,
-                          total_shards=len(touched), on_done=on_old)
+                          total_shards=len(per_shard), on_done=on_old)
         self._pending_reads[tid] = pr
-        self._fan_shard_reads(tid, pgid, m.oid, fan_up)
+        for shard, exts in per_shard.items():
+            osd = up[shard]
+            want = [(soff, ln) for soff, ln, _ro in exts]
+            if osd == self.osd_id:
+                self._deliver_local_shard_read(tid, pgid, m.oid, shard,
+                                               want)
+            else:
+                self.messenger.send_message(
+                    f"osd.{osd}", MSubRead(tid, pgid, m.oid, shard, want))
 
-    def _ec_rmw_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
-                      codec, total: int | None,
-                      lock_key: tuple | None = None) -> None:
-        """Fallback read-modify-write: reconstruct the whole object, merge
-        the new bytes, re-encode (grows the object / creates at offset)."""
+    def _ec_rmw_rows(self, conn, m: MOSDOp, pgid: PgId, up: list, codec,
+                     si: StripeInfo, object_size: int,
+                     lock_key: tuple | None = None) -> None:
+        """Read-modify-write over the touched stripe rows ONLY (never the
+        whole object): read the rows' shard extents from >= k shards
+        (decoding when degraded), merge the new bytes, re-encode the rows,
+        store them (ECCommon RMWPipeline + ECExtentCache read role)."""
+        row0, nrows = si.rows_of_range(m.offset, len(m.data))
+        old_rows = si.object_chunk_size(object_size) // si.chunk_size
+        read_rows = min(nrows, max(0, old_rows - row0))
+        end = m.offset + len(m.data)
+        new_len = max(object_size, end)
+        if read_rows <= 0:
+            # touched rows hold no live data: append-style full rows
+            buf = bytearray(nrows * si.stripe_width)
+            start = m.offset - row0 * si.stripe_width
+            buf[start:start + len(m.data)] = m.data
+            self._ec_write_rows(conn, m, pgid, up, codec, si, row0,
+                                bytes(buf), new_len,
+                                create=object_size == 0,
+                                prev_version=self._ec_object_version(
+                                    pgid, m.oid) if object_size else -1,
+                                lock_key=lock_key)
+            return
+        want_len = read_rows * si.chunk_size
+        ext = [(row0 * si.chunk_size, want_len)]
         tid = next(self._tids)
 
         def on_read(pr) -> None:
-            if pr is None or (pr.chunks and len(pr.chunks) < codec.k):
+            have = dict(pr.chunks) if pr is not None else {}
+            vmax = -1
+            if pr is not None and pr.shard_vers:
+                # merge only against a version-AGREED read set: a stale
+                # revived shard's old rows must not be re-encoded into the
+                # new stripe and stamped current
+                vmax = max(pr.shard_vers.values())
+                have = {s: c for s, c in have.items()
+                        if pr.shard_vers.get(s) == vmax}
+            for s in list(have):
+                c = have[s]
+                if c.size < want_len:
+                    have[s] = np.concatenate(
+                        [c, np.zeros(want_len - c.size, np.uint8)])
+            if len(have) < codec.k:
+                # no agreed decodable set right now: transient if a stale
+                # shard is still being recovered, so let the client retry
+                err = EAGAIN if (pr is not None
+                                 and len(pr.chunks) >= codec.k) else EIO
                 self.messenger.send_message(
-                    m.client, MOSDOpReply(m.tid, EIO,
+                    m.client, MOSDOpReply(m.tid, err,
                                           epoch=self.osdmap.epoch))
                 self._obj_unlock(lock_key)
                 return
-            if not pr.chunks:
-                if total is not None:
-                    # the object EXISTS (local attrs say so) but no shard
-                    # answered: failing is safe, zero-filling is data loss
-                    self.messenger.send_message(
-                        m.client, MOSDOpReply(m.tid, EIO,
-                                              epoch=self.osdmap.epoch))
-                    self._obj_unlock(lock_key)
-                    return
-                base = b""  # creating a new object at an offset
+            data_ids = list(range(codec.k))
+            if all(i in have for i in data_ids):
+                streams = [have[i] for i in data_ids]
             else:
-                data_ids = list(range(codec.k))
-                if all(i in pr.chunks for i in data_ids):
-                    old = np.concatenate([pr.chunks[i] for i in data_ids])
-                else:
-                    dec = codec.decode(data_ids, dict(pr.chunks))
-                    old = np.concatenate([dec[i] for i in data_ids])
-                cur = self._ec_total_len(pr)
-                base = old.tobytes()[:cur] if cur is not None \
-                    else old.tobytes()
-            end = m.offset + len(m.data)
-            buf = bytearray(max(len(base), end))
-            buf[: len(base)] = base
-            buf[m.offset:end] = m.data
-            merged = MOSDOp(m.tid, m.client, m.pool, m.oid, "write_full",
-                            0, 0, bytes(buf), m.epoch)
-            self._ec_write(_ClientConn(self, m.client), merged, pgid, up,
-                           lock_key=lock_key)
+                dec = codec.decode(data_ids, have)
+                streams = [dec[i] for i in data_ids]
+            old = si.ro_assemble(streams).tobytes()
+            buf = bytearray(nrows * si.stripe_width)
+            buf[: len(old)] = old[: len(buf)]
+            start = m.offset - row0 * si.stripe_width
+            buf[start:start + len(m.data)] = m.data
+            self._ec_write_rows(_ClientConn(self, m.client), m, pgid, up,
+                                codec, si, row0, bytes(buf), new_len,
+                                prev_version=vmax, lock_key=lock_key)
 
         pr = _PendingRead(None, 0, pgid.pool, m.oid,
                           total_shards=sum(1 for u in up if u is not None),
                           on_done=on_read)
         self._pending_reads[tid] = pr
-        self._fan_shard_reads(tid, pgid, m.oid, up)
+        self._fan_shard_reads(tid, pgid, m.oid, up, extents=ext)
 
     def _apply_partial(self, pgid: PgId, oid: str, shard: int,
                        extents: list, version: int,
-                       create_ok: bool = False) -> bool:
+                       create_ok: bool = False,
+                       total_len: int | None = None,
+                       prev_version: int = -1) -> int:
         """Apply extent overwrites to one shard chunk + refresh v/digest.
+        Returns 0, ENOENT, or EAGAIN (no change on nonzero).
 
-        Returns False (no change) when the object is absent and create_ok
-        is not set: a lagging replica/shard must NEVER fabricate a
-        zero-filled chunk stamped with the new version — recovery's
-        version gate would then consider it current forever.  Only the
-        primary creating a genuinely new object passes create_ok."""
+        ENOENT when the object is absent and create_ok is not set: a
+        lagging replica/shard must NEVER fabricate a zero-filled chunk
+        stamped with the new version — recovery's version gate would then
+        consider it current forever.  Only the primary creating a
+        genuinely new object passes create_ok.
+
+        EAGAIN when prev_version >= 0 and the stored shard is at a
+        DIFFERENT version: the primary computed these extents against
+        prev_version bytes, so applying them over stale (or newer) data
+        would desynchronize the stripe while stamping it current."""
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = ObjectId(oid, shard=shard)
         tx = Transaction()
-        if not self.store.exists(cid, obj):
+        exists = self.store.exists(cid, obj)
+        if not exists:
             if not create_ok:
-                return False
+                return ENOENT
             tx.touch(cid, obj)
+        elif prev_version >= 0:
+            cur = int(self.store.getattrs(cid, obj).get("v", 0))
+            if cur != prev_version:
+                return EAGAIN
         for coff, data in extents:
             tx.write(cid, obj, coff, data)
         self.store.queue_transaction(tx)
@@ -756,18 +942,25 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         attrs["d"] = native_crc32c(data)
         if shard < 0:
             # replicated: the object IS the data; track its size for stat
-            # (EC shards keep "len" = whole-object length, unchanged by a
-            # pure overwrite)
             attrs["len"] = len(data)
+        elif total_len is not None and total_len >= 0:
+            # EC shards carry "len" = whole-object length; growing partial
+            # writes move it forward
+            attrs["len"] = max(int(attrs.get("len", 0)), total_len)
         self.store.queue_transaction(
             Transaction().setattrs(cid, obj, attrs))
-        return True
+        return 0
 
     def _apply_delta_local(self, pgid: PgId, oid: str, parity_shard: int,
-                           extents: list, version: int) -> bool:
+                           extents: list, version: int,
+                           total_len: int | None = None,
+                           prev_version: int = -1) -> int:
         """Fold coef*delta extents into the stored parity chunk via the
         plugin's apply_delta (one chunk read/write for the whole batch).
-        False if the parity chunk is absent (shard not yet recovered)."""
+        Returns 0, ENOENT (parity chunk absent — shard not yet
+        recovered), or EAGAIN (stored version != prev_version: folding a
+        delta into stale parity would poison it while stamping it
+        current)."""
         codec = self._pool_codec(pgid.pool)
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = ObjectId(oid, shard=parity_shard)
@@ -775,74 +968,126 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             chunk = np.frombuffer(self.store.read(cid, obj).to_bytes(),
                                   dtype=np.uint8).copy()
         except NoSuchObject:
-            return False
+            return ENOENT
+        if prev_version >= 0:
+            cur = int(self.store.getattrs(cid, obj).get("v", 0))
+            if cur != prev_version:
+                return EAGAIN
+        need = max((coff + len(d) for _ds, coff, d in extents), default=0)
+        if chunk.size < need:  # delta into the padded tail of a stripe row
+            chunk = np.concatenate(
+                [chunk, np.zeros(need - chunk.size, np.uint8)])
         for ds, coff, dbytes in extents:
             view = chunk[coff:coff + len(dbytes)]
             codec.apply_delta(np.frombuffer(dbytes, dtype=np.uint8), ds,
                               {parity_shard: view})
         return self._apply_partial(pgid, oid, parity_shard,
-                                   [(0, chunk.tobytes())], version)
+                                   [(0, chunk.tobytes())], version,
+                                   total_len=total_len)
 
     def _handle_sub_partial_write(self, conn, m: MSubPartialWrite) -> None:
         self.perf.inc("subop_w")
-        ok = self._apply_partial(m.pgid, m.oid, m.shard, m.extents,
-                                 m.version)
-        if ok:
+        code = self._apply_partial(
+            m.pgid, m.oid, m.shard, m.extents, m.version,
+            create_ok=m.create,
+            total_len=m.total_len if m.total_len >= 0 else None,
+            prev_version=m.prev_version)
+        if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id,
-                                 0 if ok else ENOENT))
+                                 code))
 
     def _handle_sub_delta(self, conn, m: MSubDelta) -> None:
         self.perf.inc("subop_w")
-        ok = self._apply_delta_local(m.pgid, m.oid, m.parity_shard,
-                                     m.extents, m.version)
-        if ok:
+        code = self._apply_delta_local(
+            m.pgid, m.oid, m.parity_shard, m.extents, m.version,
+            total_len=m.total_len if m.total_len >= 0 else None,
+            prev_version=m.prev_version)
+        if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
         conn.send(MSubWriteReply(m.tid, m.pgid, m.parity_shard,
-                                 self.osd_id, 0 if ok else ENOENT))
+                                 self.osd_id, code))
 
     def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+        si = self._pool_stripe(pgid.pool)
         tid = next(self._tids)
+        extents = None
+        row_base = row_len = 0
+        if m.length:
+            # range read: fetch only the stripe rows covering the range
+            # (the shard_extent_set_t construction of a ReadPipeline op)
+            row0, nrows = si.rows_of_range(m.offset, m.length)
+            row_base = row0 * si.stripe_width
+            row_len = nrows * si.chunk_size
+            extents = [(row0 * si.chunk_size, row_len)]
         pr = _PendingRead(m.client, m.tid, pgid.pool, m.oid,
                           total_shards=sum(1 for u in up if u is not None),
-                          offset=m.offset, length=m.length)
+                          offset=m.offset, length=m.length,
+                          row_base=row_base, row_len=row_len)
         self._pending_reads[tid] = pr
-        self._fan_shard_reads(tid, pgid, m.oid, up)
+        self._fan_shard_reads(tid, pgid, m.oid, up, extents=extents)
 
     def _fan_shard_reads(self, tid: int, pgid: PgId, oid: str,
-                         up: list) -> None:
+                         up: list, extents: list | None = None) -> None:
         for shard, osd in enumerate(up):
             if osd is None:
                 continue
             if osd == self.osd_id:
-                self._deliver_local_shard_read(tid, pgid, oid, shard)
+                self._deliver_local_shard_read(tid, pgid, oid, shard,
+                                               extents)
             else:
                 self.messenger.send_message(
-                    f"osd.{osd}", MSubRead(tid, pgid, oid, shard))
+                    f"osd.{osd}", MSubRead(tid, pgid, oid, shard, extents))
 
-    def _deliver_local_shard_read(self, tid, pgid, oid, shard) -> None:
+    def _read_shard_slices(self, cid, obj, extents: list | None) -> bytes:
+        """Whole shard stream, or the concatenation of the requested
+        slices read RANGED from the store (a 4K range read of a huge
+        object must not materialize the whole shard), each zero-padded to
+        its requested length (absent tail bytes of a padded stripe row
+        are zeros)."""
+        if not extents:
+            return self.store.read(cid, obj).to_bytes()
+        parts = []
+        for off, ln in extents:
+            seg = self.store.read(cid, obj, off, ln).to_bytes()
+            if len(seg) < ln:
+                seg += b"\0" * (ln - len(seg))
+            parts.append(seg)
+        return b"".join(parts)
+
+    def _deliver_local_shard_read(self, tid, pgid, oid, shard,
+                                  extents: list | None = None) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid, shard=shard)
         try:
-            data = self.store.read(cid, ObjectId(oid, shard=shard)).to_bytes()
-            attrs = self.store.getattrs(cid, ObjectId(oid, shard=shard))
+            data = self._read_shard_slices(cid, obj, extents)
+            attrs = self.store.getattrs(cid, obj)
             result = 0
         except NoSuchObject:
             data, attrs, result = b"", {}, ENOENT
+        except StoreError:
+            # checksum-poisoned shard (FileStore csum verify): report EIO
+            # promptly so decode proceeds from the remaining shards
+            data, attrs, result = b"", {}, EIO
         self._on_shard_read(tid, shard, result, data, attrs)
 
     def _handle_sub_read(self, conn, m: MSubRead) -> None:
         self.perf.inc("subop_r")
         cid = CollectionId(m.pgid.pool, m.pgid.seed)
+        obj = ObjectId(m.oid, shard=m.shard)
         try:
-            data = self.store.read(cid, ObjectId(m.oid, shard=m.shard))
-            attrs = self.store.getattrs(cid, ObjectId(m.oid, shard=m.shard))
+            data = self._read_shard_slices(cid, obj, m.extents)
+            attrs = self.store.getattrs(cid, obj)
             conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
-                                    self.osd_id, 0, data.to_bytes(), attrs))
+                                    self.osd_id, 0, data, attrs))
         except NoSuchObject:
             conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
                                     self.osd_id, ENOENT))
+        except StoreError:
+            conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
+                                    self.osd_id, EIO))
 
     def _handle_sub_read_reply(self, conn, m: MSubReadReply) -> None:
         self._on_shard_read(m.tid, m.shard, m.result, m.data, m.attrs)
@@ -857,10 +1102,23 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 pr.chunks[shard] = np.frombuffer(data, dtype=np.uint8)
                 if attrs:
                     pr.attrs.update(attrs)
-            # finish as soon as enough chunks to decode are present — no
-            # waiting for parity stragglers (the ReadPipeline returns at k)
+                    pr.shard_attrs[shard] = dict(attrs)
+                    if "v" in attrs:
+                        pr.shard_vers[shard] = int(attrs["v"])
             k = self._pool_codec(pr.pool).k
-            if len(pr.chunks) < k and pr.replies < pr.total_shards:
+            if pr.on_done is None and pr.shard_vers:
+                # client-facing reads only decode a version-AGREED k-set
+                # (the ECCommon read-consistency role, ECCommon.h:352-420):
+                # a degraded read racing a partial write must not assemble
+                # chunks from different versions
+                vmax = max(pr.shard_vers.values())
+                agreed = sum(1 for v in pr.shard_vers.values() if v == vmax)
+                if agreed < k and pr.replies < pr.total_shards:
+                    return
+            elif len(pr.chunks) < k and pr.replies < pr.total_shards:
+                # finish as soon as enough chunks to decode are present —
+                # no waiting for parity stragglers (ReadPipeline returns
+                # at k); callback readers judge sufficiency themselves
                 return
             self._pending_reads.pop(tid, None)
         self._finish_ec_read(pr)
@@ -873,8 +1131,34 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             # sufficiency themselves — they may want fewer than k
             done(pr)
             return
+        si = self._pool_stripe(pr.pool)
         epoch = self.osdmap.epoch if self.osdmap else 0
-        if len(pr.chunks) < codec.k:
+        chunks = pr.chunks
+        total = self._ec_total_len(pr)
+        if pr.shard_vers and chunks:
+            vmax = max(pr.shard_vers.values())
+            agreed = {s: c for s, c in chunks.items()
+                      if pr.shard_vers.get(s) == vmax}
+            if len(agreed) < codec.k and len(chunks) >= codec.k:
+                # no complete version-agreed k-set: either a racing write
+                # (transient — its commit completes the set) or a stale
+                # shard awaiting recovery rebuild; both resolve, so the
+                # client retries rather than decoding a torn stripe
+                if pr.client:
+                    self.messenger.send_message(
+                        pr.client, MOSDOpReply(pr.client_tid, EAGAIN,
+                                               epoch=epoch))
+                return
+            chunks = agreed
+            # total length must come from an agreed shard, not the merged
+            # last-reply-wins attrs (a stale straggler could clobber the
+            # grown length and truncate the payload)
+            for s in chunks:
+                a = pr.shard_attrs.get(s, {})
+                if "len" in a:
+                    total = int(a["len"])
+                    break
+        if len(chunks) < codec.k:
             # no shard at all anywhere -> the object does not exist;
             # some-but-too-few shards -> unrecoverable (EIO)
             err = ENOENT if not pr.chunks else EIO
@@ -882,8 +1166,6 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 self.messenger.send_message(
                     pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch))
             return
-        # total length rides shard attrs; recompute from any shard
-        total = self._ec_total_len(pr)
         if pr.stat_only:
             if pr.client:
                 size = int(total or 0)
@@ -893,21 +1175,34 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                                 data=size.to_bytes(8, "little"),
                                 epoch=epoch))
             return
+        # equalize stream lengths (a straggling short shard pads; decode
+        # is positional so padding is safe)
+        stream_len = max(c.size for c in chunks.values())
+        chunks = {s: (c if c.size == stream_len else np.concatenate(
+            [c, np.zeros(stream_len - c.size, np.uint8)]))
+            for s, c in chunks.items()}
         data_ids = list(range(codec.k))
-        if all(i in pr.chunks for i in data_ids):
-            out = np.concatenate([pr.chunks[i] for i in data_ids])
+        if all(i in chunks for i in data_ids):
+            streams = [chunks[i] for i in data_ids]
         else:
-            decoded = codec.decode(
-                data_ids, {i: c for i, c in pr.chunks.items()})
-            out = np.concatenate([decoded[i] for i in data_ids])
-        payload = out.tobytes()[:total] if total is not None else out.tobytes()
-        if pr.length:
-            payload = payload[pr.offset:pr.offset + pr.length]
-        elif pr.offset:
-            payload = payload[pr.offset:]
-        if done:
-            done(pr)
-        elif pr.client:
+            decoded = codec.decode(data_ids, dict(chunks))
+            streams = [decoded[i] for i in data_ids]
+        ro = si.ro_assemble(streams).tobytes()
+        if pr.row_len:
+            # range read: ro covers [row_base, row_base + len(ro))
+            limit = len(ro) if total is None \
+                else max(0, min(len(ro), total - pr.row_base))
+            avail = ro[:limit]
+            start = pr.offset - pr.row_base
+            payload = avail[start:start + pr.length] if pr.length \
+                else avail[start:]
+        else:
+            payload = ro[:total] if total is not None else ro
+            if pr.length:
+                payload = payload[pr.offset:pr.offset + pr.length]
+            elif pr.offset:
+                payload = payload[pr.offset:]
+        if pr.client:
             self.messenger.send_message(
                 pr.client,
                 MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch))
@@ -975,12 +1270,13 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._apply_write(m.pgid, m.oid, m.shard, m.data,
                               dict(m.attrs, v=m.version))
         elif m.op == "write_partial":
-            if not self._apply_partial(m.pgid, m.oid, m.shard,
-                                       [(m.offset, m.data)], m.version):
+            code = self._apply_partial(m.pgid, m.oid, m.shard,
+                                       [(m.offset, m.data)], m.version)
+            if code != 0:
                 # replica lacks the object (recovery lag): refuse rather
                 # than fabricate a zero-prefixed copy at the new version
                 conn.send(MSubWriteReply(m.tid, m.pgid, m.shard,
-                                         self.osd_id, ENOENT))
+                                         self.osd_id, code))
                 return
         elif m.op == "remove":
             cid = CollectionId(m.pgid.pool, m.pgid.seed)
@@ -993,17 +1289,24 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
 
     def _handle_sub_write_reply(self, conn, m: MSubWriteReply) -> None:
+        if m.result == EAGAIN:
+            # a shard refused a conditional apply (it is stale): kick
+            # recovery NOW — without this the shard only heals on the
+            # next map epoch, and the client's retries spin meanwhile
+            self._requery_pg(m.pgid)
         with self._pending_lock:
             pw = self._pending_writes.get(m.tid)
             if pw is None:
                 return
-            if m.result != 0:
+            if m.result == EAGAIN:
+                pw.retry += 1  # version conflict: transient, retryable
+            elif m.result != 0:
                 pw.failed += 1
             pw.acks_needed -= 1
             if pw.acks_needed > 0:
                 return
             self._pending_writes.pop(m.tid, None)
-        result = EIO if pw.failed else 0
+        result = EIO if pw.failed else (EAGAIN if pw.retry else 0)
         self.messenger.send_message(
             pw.client,
             MOSDOpReply(pw.client_tid, result, version=pw.version,
@@ -1024,8 +1327,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             for peer in self.osdmap.up_osds():
                 if peer == self.osd_id:
                     continue
-                self.messenger.send_message(
-                    f"osd.{peer}",
+                self.hb_messenger.send_message(
+                    f"osd.{peer}.hb",
                     MOSDPing(self.osd_id, self.osdmap.epoch, now))
                 # seed the clock at first observation so a peer that never
                 # answers a single ping still gets reported
@@ -1045,9 +1348,11 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     dout("osd", 1)("%s: stats report failed: %r",
                                    self.name, e)
 
-    def _sweep_pending(self, now: float, max_age: float = 5.0) -> None:
+    def _sweep_pending(self, now: float, max_age: float | None = None) -> None:
         """Fail ops whose sub-ops never completed (peer died mid-op) so
         clients get an error instead of a timeout and tables don't leak."""
+        if max_age is None:
+            max_age = self.cfg["osd_op_timeout"]
         epoch = self.osdmap.epoch if self.osdmap else 0
         expired_w, expired_r = [], []
         with self._pending_lock:
@@ -1321,6 +1626,19 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                               and shard not in pr.chunks):
                 return  # not enough survivors to rebuild
             chunks = pr.chunks
+            push_version = version
+            if pr.shard_vers:
+                # rebuild only from a version-AGREED survivor set: mixing
+                # a stale shard into the decode would fabricate garbage
+                # stamped with the new version
+                vmax = max(pr.shard_vers.values())
+                cand = {s: c for s, c in chunks.items()
+                        if pr.shard_vers.get(s) == vmax}
+                if len(cand) >= codec.k or (shard in cand and not force):
+                    chunks = cand
+                    push_version = max(version, vmax)
+                else:
+                    return  # no consistent set yet; a requery will retry
             if shard in chunks and not force:
                 rebuilt = chunks[shard]
             else:
@@ -1328,6 +1646,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 # existing shard copy: always re-derive it
                 chunks = {i: c for i, c in chunks.items() if i != shard} \
                     if force else chunks
+                if len(chunks) < codec.k:
+                    return
                 out = codec.decode([shard], dict(chunks))
                 rebuilt = out[shard]
             total = self._ec_total_len(pr)
@@ -1335,7 +1655,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self.messenger.send_message(
                 f"osd.{peer}",
                 MPGPush(pgid, shard,
-                        {name: (version, rebuilt.tobytes(), total)},
+                        {name: (push_version, rebuilt.tobytes(), total)},
                         force=force))
 
         pr = _PendingRead(None, 0, pgid.pool, name,
@@ -1392,15 +1712,22 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         # if I am this PG's primary, newly-landed data may need forwarding
         # to members whose inventories were processed earlier: re-query,
         # debounced so a recovery batch triggers one round, not O(objects)
-        if self.osdmap is not None and m.pgid.pool in self.osdmap.pools:
-            now = time.monotonic()
-            if now - self._requery_at.get(m.pgid, 0.0) < 0.2:
-                return
-            up = self.osdmap.pg_to_up_osds(m.pgid.pool, m.pgid.seed)
-            if self._primary_of(up) == self.osd_id:
-                self._requery_at[m.pgid] = now
-                for osd in up:
-                    if osd is not None and osd != self.osd_id:
-                        self.messenger.send_message(
-                            f"osd.{osd}",
-                            MPGQuery(m.pgid, self.osdmap.epoch))
+        self._requery_pg(m.pgid)
+
+    def _requery_pg(self, pgid: PgId) -> None:
+        """Primary: re-run the inventory exchange for one PG (debounced)
+        so recovery reconciles stale/missing shards without waiting for
+        the next map epoch."""
+        if self.osdmap is None or pgid.pool not in self.osdmap.pools:
+            return
+        now = time.monotonic()
+        if now - self._requery_at.get(pgid, 0.0) < 0.2:
+            return
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        if self._primary_of(up) != self.osd_id:
+            return
+        self._requery_at[pgid] = now
+        for osd in up:
+            if osd is not None and osd != self.osd_id:
+                self.messenger.send_message(
+                    f"osd.{osd}", MPGQuery(pgid, self.osdmap.epoch))

@@ -185,6 +185,14 @@ OPTIONS: list[Option] = [
            startup=True),
     Option("ec_stripe_batch", int, 64, OptionLevel.ADVANCED,
            "stripes batched per device EC launch", min=1, max=4096),
+    Option("osd_ec_stripe_unit", int, 4096, OptionLevel.ADVANCED,
+           "EC chunk size (bytes per shard per stripe row); must be a "
+           "multiple of 4096 (the EC_ALIGN_SIZE page-alignment contract, "
+           "ref ECUtil.h:33)", min=4096),
+    Option("osd_op_timeout", float, 5.0, OptionLevel.ADVANCED,
+           "seconds before an in-flight op whose sub-ops never completed "
+           "is failed back to the client", min=0.1, max=3600.0,
+           see_also=("osd_heartbeat_grace",)),
 ]
 
 

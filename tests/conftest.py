@@ -34,3 +34,8 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:  # jax absent or internals moved; env vars still set
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: large-object / long-running integration tests")
