@@ -19,7 +19,7 @@ from ..mon.maps import OSDMap
 from ..msg.messages import (MMapPush, MMonCommand, MMonCommandReply,
                             MMonSubscribe, MOSDOp, MOSDOpReply, MScrubRequest,
                             MScrubResult, PgId)
-from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..utils.log import dout
 
 
@@ -35,7 +35,7 @@ class TimeoutError_(RadosError):
 
 
 class RadosClient(Dispatcher):
-    def __init__(self, network: LocalNetwork, name: str = "client.0",
+    def __init__(self, network: Network, name: str = "client.0",
                  mon: str = "mon.0", timeout: float = 10.0):
         self.name = name
         self.mon = mon

@@ -54,9 +54,10 @@ class OsdInfo(Encodable):
     in_cluster: bool = True
     weight: float = 1.0
     host: str = ""
-    addr: str = ""  # messenger address
+    addr: str = ""     # data-plane messenger address
+    hb_addr: str = ""  # heartbeat messenger address (v2 field)
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e: Encoder):
@@ -66,13 +67,17 @@ class OsdInfo(Encodable):
             e.f64(self.weight)
             e.string(self.host)
             e.string(self.addr)
+            e.string(self.hb_addr)  # v2: old decoders skip the tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "OsdInfo":
         def body(d: Decoder, v: int):
-            return cls(d.u32(), d.boolean(), d.boolean(), d.f64(),
+            info = cls(d.u32(), d.boolean(), d.boolean(), d.f64(),
                        d.string(), d.string())
+            if v >= 2:
+                info.hb_addr = d.string()
+            return info
         return dec.versioned(cls.VERSION, body)
 
 
@@ -89,15 +94,19 @@ class OSDMap(Encodable):
 
     # -- mutation (monitor-side; bumps epoch through Monitor) --------------
     def add_osd(self, osd_id: int, host: str, addr: str = "",
-                weight: float = 1.0) -> None:
+                weight: float = 1.0, hb_addr: str = "") -> None:
         self.osds[osd_id] = OsdInfo(osd_id, up=False, in_cluster=True,
-                                    weight=weight, host=host, addr=addr)
+                                    weight=weight, host=host, addr=addr,
+                                    hb_addr=hb_addr)
 
-    def mark_up(self, osd_id: int, addr: str = "") -> None:
+    def mark_up(self, osd_id: int, addr: str = "",
+                hb_addr: str = "") -> None:
         info = self.osds[osd_id]
         info.up = True
         if addr:
             info.addr = addr
+        if hb_addr:
+            info.hb_addr = hb_addr
 
     def mark_down(self, osd_id: int) -> None:
         if osd_id in self.osds:

@@ -20,7 +20,7 @@ from .. import ec
 from ..msg.messages import (MFailureReport, MMapPush, MMonCommand,
                             MMonCommandReply, MMonSubscribe, MOSDBoot,
                             MStatsReport)
-from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..utils.config import Config, default_config
 from ..utils.log import dout
 from .maps import OSDMap, PoolSpec
@@ -42,7 +42,7 @@ class MonStore:
 
 
 class MonitorLite(Dispatcher):
-    def __init__(self, network: LocalNetwork, name: str = "mon.0",
+    def __init__(self, network: Network, name: str = "mon.0",
                  cfg: Config | None = None):
         self.name = name
         self.cfg = cfg or default_config()
@@ -86,17 +86,39 @@ class MonitorLite(Dispatcher):
         self.store.commit("osdmap", raw, desc)
         dout("mon", 3)("epoch %d: %s", self.osdmap.epoch, desc)
         push = MMapPush(self.osdmap.epoch, raw)
-        for sub in list(self._subscribers):
-            self.messenger.send_message(sub, push)
+        subs = list(self._subscribers)
+
+        # push OUTSIDE the monitor lock: a wire transport's blocking
+        # connect to a dead subscriber must never stall commits.  Out-of-
+        # order delivery across commits is safe — receivers discard
+        # stale epochs.
+        def _push():
+            for sub in subs:
+                try:
+                    self.messenger.send_message(sub, push)
+                except Exception as e:  # noqa: BLE001
+                    dout("mon", 5)("map push to %s failed: %r", sub, e)
+
+        threading.Thread(target=_push, name="mon-map-push",
+                         daemon=True).start()
 
     def _handle_boot(self, conn, m: MOSDBoot) -> None:
+        # teach the transport where this daemon lives (wire transports;
+        # no-op in-proc) so map-driven sends resolve after a mon restart
+        self.messenger.network.set_addr(f"osd.{m.osd_id}", m.addr)
+        if m.hb_addr:
+            self.messenger.network.set_addr(f"osd.{m.osd_id}.hb",
+                                            m.hb_addr)
         with self._lock:
             if m.osd_id not in self.osdmap.osds:
-                self.osdmap.add_osd(m.osd_id, m.host, m.addr)
-            self.osdmap.mark_up(m.osd_id, m.addr)
+                self.osdmap.add_osd(m.osd_id, m.host, m.addr,
+                                    hb_addr=m.hb_addr)
+            self.osdmap.mark_up(m.osd_id, m.addr, hb_addr=m.hb_addr)
             self._boot_times[m.osd_id] = time.time()
             self._failure_reports.pop(m.osd_id, None)
-            self._subscribers.add(m.addr)
+            # subscribe the ENTITY, not its transport address (addr is a
+            # host:port on wire transports)
+            self._subscribers.add(f"osd.{m.osd_id}")
             self._commit_map(f"osd.{m.osd_id} boot")
 
     def _handle_subscribe(self, conn, m: MMonSubscribe) -> None:
@@ -137,6 +159,7 @@ class MonitorLite(Dispatcher):
                 self.osdmap.mark_down(m.target)
                 del self._failure_reports[m.target]
                 self._osd_stats.pop(m.target, None)  # no stale usage
+                self._subscribers.discard(f"osd.{m.target}")
                 self._commit_map(
                     f"osd.{m.target} down ({distinct} reporters)")
 
@@ -157,6 +180,10 @@ class MonitorLite(Dispatcher):
             with self._lock:
                 self.osdmap.mark_down(target)
                 self._osd_stats.pop(target, None)
+                # a down daemon stops being a push target until it
+                # re-boots (a dead host's stale addr must not stall
+                # future commits behind connect timeouts)
+                self._subscribers.discard(f"osd.{target}")
                 self._commit_map(f"osd.{target} down (forced)")
             return 0, {}
         if prefix == "osd out":

@@ -212,7 +212,8 @@ class MMonSubscribe:
 class MOSDBoot:
     osd_id: int
     host: str
-    addr: str
+    addr: str       # data-plane messenger address (transport-specific)
+    hb_addr: str = ""  # heartbeat messenger address
 
 
 @dataclass

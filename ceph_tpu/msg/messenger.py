@@ -69,8 +69,10 @@ class Connection:
         return f"Connection({self.messenger.name} -> {self.peer})"
 
 
-class LocalNetwork:
-    """In-proc transport: entity name -> messenger registry + faults."""
+class Network:
+    """Transport base: entity registry + fault injection knobs shared by
+    every transport (in-proc queues, TCP sockets).  Subclasses implement
+    delivery."""
 
     def __init__(self, seed: int = 0):
         self._entities: dict[str, "Messenger"] = {}
@@ -96,6 +98,15 @@ class LocalNetwork:
         with self._lock:
             return self._entities.get(name)
 
+    def addr_of(self, name: str) -> str:
+        """Publishable address of a local entity (the bound addr of a
+        wire transport; the entity name itself in-proc)."""
+        return name
+
+    def set_addr(self, name: str, addr: str) -> None:
+        """Teach the transport where a REMOTE entity lives (address book
+        seeded from mon addr + map pushes).  No-op in-proc."""
+
     # -- fault injection (the msgr-failures knobs) -------------------------
     def partition(self, a: str, b: str) -> None:
         self._partitions.add(frozenset((a, b)))
@@ -119,6 +130,13 @@ class LocalNetwork:
             return True
         return self.drop_rate > 0 and self._rng.random() < self.drop_rate
 
+    def deliver(self, src: str, dst: str, msg) -> bool:
+        raise NotImplementedError
+
+
+class LocalNetwork(Network):
+    """In-proc transport: entity name -> messenger registry + faults."""
+
     # -- delivery ----------------------------------------------------------
     def deliver(self, src: str, dst: str, msg) -> bool:
         target = self.lookup(dst)
@@ -139,7 +157,7 @@ class Messenger:
 
     _ids = itertools.count(1)
 
-    def __init__(self, network: LocalNetwork, name: str,
+    def __init__(self, network: Network, name: str,
                  policy: Policy | None = None):
         self.network = network
         self.name = name

@@ -1,0 +1,245 @@
+"""TCP transport: the process/host boundary for the messenger layer.
+
+The role of the reference's AsyncMessenger + PosixStack + frames_v2
+(src/msg/async/AsyncMessenger.cc, frames_v2.h): entity-addressed
+messengers exchanging length-framed, codec-encoded messages over real
+sockets, so daemons can live in different processes/hosts.  The
+contract (deliver/enqueue/partition/drop) is identical to LocalNetwork;
+`tests` run the same cluster suites over either transport.
+
+Addressing (the MonMap/OSDMap address plumbing):
+- every local Messenger binds a listening socket; `addr_of(name)` is its
+  "host:port" to publish (MOSDBoot.addr -> OsdInfo.addr -> map pushes);
+- `set_addr` seeds remote entities (a client/daemon only needs the mon
+  address a priori — everything else arrives with the maps);
+- replies ride the connection the request arrived on (learned reply
+  routes — the Connection identity of AsyncMessenger), so transient
+  entities like clients need no listener of their own to be reachable.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+from ..utils.log import dout
+from .messenger import Network
+from .wire import decode_frame, encode_frame
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray()
+    while len(buf) < n:
+        try:
+            chunk = sock.recv(min(1 << 20, n - len(buf)))
+        except OSError:  # peer reset / socket closed under us
+            return None
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+class _Conn:
+    """One live socket + send lock (shared by both directions)."""
+
+    __slots__ = ("sock", "lock", "alive")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.lock = threading.Lock()
+        self.alive = True
+
+    def send_frame(self, frame: bytes) -> bool:
+        with self.lock:
+            if not self.alive:
+                return False
+            try:
+                self.sock.sendall(frame)
+                return True
+            except OSError:
+                self.alive = False
+                return False
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class TcpNetwork(Network):
+    def __init__(self, host: str = "127.0.0.1", seed: int = 0):
+        super().__init__(seed)
+        self._host = host
+        self._listeners: dict[str, socket.socket] = {}
+        self._addrs: dict[str, str] = {}   # entity -> "host:port"
+        self._routes: dict[str, _Conn] = {}  # learned reply routes
+        self._out: dict[str, _Conn] = {}     # outgoing conns by addr
+        self._net_lock = threading.RLock()
+        self._stopping = False
+
+    # -- registry / addressing --------------------------------------------
+    def register(self, m) -> None:
+        super().register(m)
+        ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        ls.bind((self._host, 0))
+        ls.listen(64)
+        port = ls.getsockname()[1]
+        with self._net_lock:
+            self._listeners[m.name] = ls
+            self._addrs[m.name] = f"{self._host}:{port}"
+        threading.Thread(target=self._accept_loop, args=(m.name, ls),
+                         name=f"tcp-accept-{m.name}", daemon=True).start()
+
+    def unregister(self, name: str) -> None:
+        super().unregister(name)
+        with self._net_lock:
+            ls = self._listeners.pop(name, None)
+        if ls is not None:
+            try:
+                ls.close()
+            except OSError:
+                pass
+
+    def addr_of(self, name: str) -> str:
+        with self._net_lock:
+            return self._addrs.get(name, name)
+
+    def set_addr(self, name: str, addr: str) -> None:
+        if addr and ":" in addr:
+            with self._net_lock:
+                self._addrs[name] = addr
+
+    def stop(self) -> None:
+        self._stopping = True
+        with self._net_lock:
+            conns = list(self._out.values()) + list(self._routes.values())
+            listeners = list(self._listeners.values())
+            self._out.clear()
+            self._routes.clear()
+            self._listeners.clear()
+        for ls in listeners:
+            try:
+                ls.close()
+            except OSError:
+                pass
+        for c in conns:
+            c.close()
+
+    # -- receive side ------------------------------------------------------
+    def _accept_loop(self, owner: str, ls: socket.socket) -> None:
+        while not self._stopping:
+            try:
+                sock, _peer = ls.accept()
+            except OSError:
+                return
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(sock)
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             name=f"tcp-read-{owner}", daemon=True).start()
+
+    MAX_FRAME = 256 << 20  # recovery pushes batch objects; cap garbage
+
+    def _read_loop(self, conn: _Conn) -> None:
+        sock = conn.sock
+        while not self._stopping and conn.alive:
+            head = _recv_exact(sock, 4)
+            if head is None:
+                break
+            (length,) = struct.unpack("<I", head)
+            if length > self.MAX_FRAME:
+                # a non-protocol peer (port scan, probe): drop before
+                # attempting a multi-GB buffer
+                dout("msg", 1)("tcp: oversized frame header (%d)", length)
+                break
+            payload = _recv_exact(sock, length)
+            if payload is None:
+                break
+            try:
+                src, dst, msg = decode_frame(payload)
+            except Exception as e:  # noqa: BLE001 - poisoned frame
+                dout("msg", 0)("tcp: undecodable frame: %r", e)
+                break
+            with self._net_lock:
+                self._routes[src] = conn  # answer on the inbound pipe
+            target = self.lookup(dst)
+            if target is not None and not target._stopped:
+                target._enqueue(src, msg)
+            else:
+                dout("msg", 10)("tcp: no local entity %s for %s", dst,
+                                type(msg).__name__)
+        conn.close()
+        with self._net_lock:
+            for k in [k for k, v in self._routes.items() if v is conn]:
+                del self._routes[k]
+
+    # -- send side ---------------------------------------------------------
+    def _connect(self, addr: str) -> _Conn | None:
+        host, _, port = addr.rpartition(":")
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=5)
+        except OSError:
+            return None
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        conn = _Conn(sock)
+        # outgoing pipes are bidirectional: replies come back on them
+        threading.Thread(target=self._read_loop, args=(conn,),
+                         name=f"tcp-read-out-{addr}", daemon=True).start()
+        return conn
+
+    def _conn_for(self, dst: str) -> _Conn | None:
+        with self._net_lock:
+            route = self._routes.get(dst)
+            if route is not None and route.alive:
+                return route
+            addr = self._addrs.get(dst)
+            if addr is None:
+                return None
+            conn = self._out.get(addr)
+            if conn is not None and conn.alive:
+                return conn
+        conn = self._connect(addr)
+        if conn is None:
+            return None
+        with self._net_lock:
+            cur = self._out.get(addr)
+            if cur is not None and cur.alive:
+                conn.close()
+                return cur
+            self._out[addr] = conn
+        return conn
+
+    def deliver(self, src: str, dst: str, msg) -> bool:
+        if self._stopping:
+            return False
+        # same-process shortcut ONLY to detect stopped local targets the
+        # way LocalNetwork does; data still rides the socket
+        if self._blocked(src, dst):
+            self.dropped += 1
+            dout("msg", 10)("dropped %s -> %s: %s", src, dst,
+                            type(msg).__name__)
+            return True  # silently dropped, like a lossy wire
+        if self.latency:
+            time.sleep(self.latency)
+        frame = encode_frame(src, dst, msg)
+        conn = self._conn_for(dst)
+        if conn is None:
+            return False
+        if conn.send_frame(frame):
+            return True
+        # stale cached pipe: retry once on a fresh connection
+        with self._net_lock:
+            for table in (self._routes, self._out):
+                for k in [k for k, v in table.items() if v is conn]:
+                    del table[k]
+        conn2 = self._conn_for(dst)
+        return conn2 is not None and conn2.send_frame(frame)

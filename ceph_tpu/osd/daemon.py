@@ -37,12 +37,13 @@ import numpy as np
 from .. import ec
 from ..ec.stripe import StripeInfo, plan_write
 from ..mon.maps import OSDMap
-from ..msg.messages import (MFailureReport, MMapPush, MOSDBoot, MOSDOp,
-                            MOSDOpReply, MOSDPing, MOSDPingReply, MPGInfo,
-                            MPGPull, MPGPush, MPGQuery, MStatsReport,
-                            MSubDelta, MSubPartialWrite, MSubRead,
-                            MSubReadReply, MSubWrite, MSubWriteReply, PgId)
-from ..msg.messenger import Dispatcher, LocalNetwork, Messenger, Policy
+from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
+                            MOSDBoot, MOSDOp, MOSDOpReply, MOSDPing,
+                            MOSDPingReply, MPGInfo, MPGPull, MPGPush,
+                            MPGQuery, MStatsReport, MSubDelta,
+                            MSubPartialWrite, MSubRead, MSubReadReply,
+                            MSubWrite, MSubWriteReply, PgId)
+from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
 from ..utils.log import dout
@@ -102,7 +103,7 @@ class _ClientConn:
 
 
 class OSDDaemon(ScrubMixin, Dispatcher):
-    def __init__(self, osd_id: int, network: LocalNetwork,
+    def __init__(self, osd_id: int, network: Network,
                  mon: str = "mon.0", store: ObjectStore | None = None,
                  cfg: Config | None = None, host: str | None = None):
         self.osd_id = osd_id
@@ -133,6 +134,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._ec_codecs: dict[int, ec.ErasureCode] = {}
         self._stripes: dict[int, StripeInfo] = {}
         self._hb_last: dict[int, float] = {}
+        self._last_map = time.time()  # osd_beacon staleness clock
         self._hb_thread: threading.Thread | None = None
         self._stop = threading.Event()
         self._tombstones: dict[PgId, dict[str, int]] = {}
@@ -177,8 +179,11 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     def start(self) -> None:
         self.messenger.start()
         self.hb_messenger.start()
+        net = self.messenger.network
         self.messenger.send_message(
-            self.mon, MOSDBoot(self.osd_id, self.host, self.name))
+            self.mon,
+            MOSDBoot(self.osd_id, self.host, net.addr_of(self.name),
+                     hb_addr=net.addr_of(self.hb_messenger.name)))
         self._hb_thread = threading.Thread(
             target=self._heartbeat_loop, name=f"hb-{self.name}", daemon=True)
         self._hb_thread.start()
@@ -228,7 +233,16 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         if old is not None and newmap.epoch <= old.epoch:
             return
         self.osdmap = newmap
+        self._last_map = time.time()
         dout("osd", 5)("%s: map epoch %d", self.name, newmap.epoch)
+        # learn peer addresses from the map (wire transports; no-op
+        # in-proc) — the OSDMap is the address book, as in the reference
+        net = self.messenger.network
+        for peer, info in newmap.osds.items():
+            if info.addr:
+                net.set_addr(f"osd.{peer}", info.addr)
+            if info.hb_addr:
+                net.set_addr(f"osd.{peer}.hb", info.hb_addr)
         # forget heartbeat stamps for peers that (re)joined: a stale
         # pre-death stamp must not flash a revived daemon back down
         for peer, info in newmap.osds.items():
@@ -242,7 +256,9 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         me = newmap.osds.get(self.osd_id)
         if me is not None and not me.up and not self._stop.is_set():
             self.messenger.send_message(
-                self.mon, MOSDBoot(self.osd_id, self.host, self.name))
+                self.mon,
+                MOSDBoot(self.osd_id, self.host, net.addr_of(self.name),
+                         hb_addr=net.addr_of(self.hb_messenger.name)))
         self._ensure_collections()
         if old is None or newmap.epoch > old.epoch:
             self._start_recovery()
@@ -1324,6 +1340,13 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             now = time.time()
             self._sweep_pending(now)
             ticks += 1
+            # osd-beacon role: map silence means the mon may have dropped
+            # our subscription (e.g. it marked us down while we were
+            # partitioned) — re-subscribe so we learn our own state and
+            # can re-assert boot
+            if now - self._last_map > 2 * grace:
+                self._last_map = now  # debounce
+                self.messenger.send_message(self.mon, MMonSubscribe())
             for peer in self.osdmap.up_osds():
                 if peer == self.osd_id:
                     continue

@@ -1,0 +1,62 @@
+"""Standalone OSD daemon process — the ceph-osd binary role.
+
+The reference boots each OSD as its own process (src/ceph_osd.cc:124
+main: global_init, ObjectStore::create, messengers, OSD::init).  Here:
+parse flags, build a TcpNetwork seeded with the monitor address, mount
+the object store, start the daemon, run until SIGTERM/SIGINT.
+
+Used by the vstart harness's process mode (MiniCluster.spawn_osd_process)
+and directly:
+
+    python -m ceph_tpu.tools.osd_main --id 3 --mon-addr 127.0.0.1:6789
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import threading
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="ceph_tpu OSD daemon")
+    ap.add_argument("--id", type=int, required=True, dest="osd_id")
+    ap.add_argument("--mon-addr", required=True,
+                    help="host:port of the monitor's messenger")
+    ap.add_argument("--mon-name", default="mon.0")
+    ap.add_argument("--host", default=None,
+                    help="failure-domain host label")
+    ap.add_argument("--store", default="memstore",
+                    choices=("memstore", "filestore"))
+    ap.add_argument("--store-path", default=None)
+    ap.add_argument("--cfg", default="{}",
+                    help="JSON object of config overrides")
+    args = ap.parse_args(argv)
+
+    from ..msg.tcp import TcpNetwork
+    from ..osd.daemon import OSDDaemon
+    from ..osd.objectstore import ObjectStore
+    from ..utils.config import default_config
+
+    cfg = default_config()
+    cfg.apply_dict(json.loads(args.cfg))
+    net = TcpNetwork()
+    net.set_addr(args.mon_name, args.mon_addr)
+    store_kw = {"path": args.store_path} if args.store_path else {}
+    store = ObjectStore.create(args.store, **store_kw)
+    osd = OSDDaemon(args.osd_id, net, mon=args.mon_name, store=store,
+                    cfg=cfg, host=args.host)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    osd.start()
+    stop.wait()
+    osd.stop()
+    net.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

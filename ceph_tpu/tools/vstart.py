@@ -20,11 +20,18 @@ from ..utils.config import Config, default_config
 
 class MiniCluster:
     def __init__(self, n_osds: int = 3, cfg: Config | None = None,
-                 hosts_per_osd: bool = True):
+                 hosts_per_osd: bool = True, transport: str = "local"):
         self.cfg = cfg or default_config()
-        self.network = LocalNetwork()
+        if transport == "tcp":
+            from ..msg.tcp import TcpNetwork
+            self.network = TcpNetwork()
+        elif transport == "local":
+            self.network = LocalNetwork()
+        else:
+            raise ValueError(f"unknown transport {transport!r}")
         self.mon = MonitorLite(self.network, cfg=self.cfg)
         self.osds: dict[int, OSDDaemon] = {}
+        self.procs: dict[int, object] = {}  # subprocess OSDs (tcp mode)
         self.clients: list[RadosClient] = []
         self._n = n_osds
         self._hosts_per_osd = hosts_per_osd
@@ -44,6 +51,39 @@ class MiniCluster:
         osd.start()
         return osd
 
+    def spawn_osd_process(self, osd_id: int, store: str = "memstore",
+                          store_path: str | None = None,
+                          cfg_overrides: dict | None = None):
+        """Boot an OSD as a REAL child process over TCP (the multi-daemon
+        vstart.sh mode).  Requires transport='tcp'.  Returns the Popen;
+        kill it with .terminate()/.kill() like a thrasher would."""
+        import json as _json
+        import os
+        import subprocess
+        import sys
+
+        import ceph_tpu
+        mon_addr = self.network.addr_of(self.mon.name)
+        if ":" not in mon_addr:
+            raise RuntimeError("spawn_osd_process needs transport='tcp'")
+        argv = [sys.executable, "-m", "ceph_tpu.tools.osd_main",
+                "--id", str(osd_id), "--mon-addr", mon_addr,
+                "--store", store,
+                "--host", f"host{osd_id}" if self._hosts_per_osd
+                else "host0",
+                "--cfg", _json.dumps(cfg_overrides or {})]
+        if store_path:
+            argv += ["--store-path", store_path]
+        # the child must find the package regardless of caller cwd
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(ceph_tpu.__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH",
+                                                            "")
+        proc = subprocess.Popen(argv, env=env)
+        self.procs[osd_id] = proc
+        return proc
+
     def client(self, idx: int | None = None) -> RadosClient:
         idx = len(self.clients) if idx is None else idx
         c = RadosClient(self.network, f"client.{idx}").connect()
@@ -58,7 +98,16 @@ class MiniCluster:
                 pass
         for o in self.osds.values():
             o.stop()
+        for p in self.procs.values():
+            try:
+                p.terminate()
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+                p.wait()  # reap — no zombies across a test session
         self.mon.stop()
+        if hasattr(self.network, "stop"):
+            self.network.stop()
 
     # ------------------------------------------------------------- helpers
     def wait_for_up(self, n: int, timeout: float = 10.0) -> None:
@@ -83,6 +132,10 @@ class MiniCluster:
         osd = self.osds.pop(osd_id, None)
         if osd:
             osd.stop()
+        proc = self.procs.pop(osd_id, None)
+        if proc is not None:
+            proc.kill()
+            proc.wait()
         if mark_down and self.clients:
             self.clients[0].mon_command({"prefix": "osd down",
                                          "id": osd_id})

@@ -23,9 +23,12 @@ def make_cfg(**over):
     return cfg
 
 
-@pytest.fixture
-def cluster():
-    c = MiniCluster(n_osds=6, cfg=make_cfg()).start()
+@pytest.fixture(params=["local", "tcp"])
+def cluster(request):
+    """Every core cluster test runs over BOTH transports: in-proc queues
+    and real TCP sockets with the codec-framed wire format."""
+    c = MiniCluster(n_osds=6, cfg=make_cfg(),
+                    transport=request.param).start()
     yield c
     c.stop()
 
