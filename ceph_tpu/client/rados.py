@@ -36,9 +36,12 @@ class TimeoutError_(RadosError):
 
 class RadosClient(Dispatcher):
     def __init__(self, network: Network, name: str = "client.0",
-                 mon: str = "mon.0", timeout: float = 10.0):
+                 mon: str = "mon.0", timeout: float = 10.0,
+                 mons: list | None = None):
         self.name = name
-        self.mon = mon
+        self.mons = list(mons) if mons else [mon]
+        self.mon = self.mons[0]
+        self._mon_idx = 0
         self.timeout = timeout
         self.messenger = Messenger(network, name, Policy.lossless_peer())
         self.messenger.add_dispatcher(self)
@@ -51,12 +54,27 @@ class RadosClient(Dispatcher):
     # ------------------------------------------------------------ lifecycle
     def connect(self) -> "RadosClient":
         self.messenger.start()
+        deadline = time.time() + self.timeout
+        while True:
+            self.messenger.send_message(self.mon, MMonSubscribe("osdmap"))
+            with self._map_cond:
+                # wait for a POPULATED map (monitors push epoch-0 empty
+                # maps to unwedge cold daemons; clients keep waiting)
+                if self._map_cond.wait_for(
+                        lambda: self.osdmap is not None
+                        and self.osdmap.epoch > 0,
+                        timeout=min(2.0, self.timeout)):
+                    return self
+            if time.time() > deadline:
+                raise TimeoutError_("no osdmap from any monitor")
+            self._rotate_mon()
+
+    def _rotate_mon(self) -> None:
+        self._mon_idx += 1
+        self.mon = self.mons[self._mon_idx % len(self.mons)]
+        # keep the map feed alive: the previous mon may be the dead one
+        # we were subscribed to
         self.messenger.send_message(self.mon, MMonSubscribe("osdmap"))
-        with self._map_cond:
-            if not self._map_cond.wait_for(
-                    lambda: self.osdmap is not None, timeout=self.timeout):
-                raise TimeoutError_("no osdmap from monitor")
-        return self
 
     def close(self) -> None:
         self.messenger.shutdown()
@@ -99,11 +117,27 @@ class RadosClient(Dispatcher):
 
     # ----------------------------------------------------------- mon admin
     def mon_command(self, cmd: dict) -> dict:
-        tid = next(self._tids)
-        reply = self._rpc(self.mon, MMonCommand(tid, cmd), tid)
-        if reply.result != 0:
-            raise RadosError(reply.result, str(reply.data))
-        return reply.data
+        """Send a command; rotate monitors on timeout and retry on a
+        no-quorum answer (the MonClient hunt-for-mon behavior)."""
+        last: RadosError | None = None
+        for _attempt in range(max(3, 3 * len(self.mons))):
+            tid = next(self._tids)
+            try:
+                reply = self._rpc(self.mon, MMonCommand(tid, cmd), tid,
+                                  timeout=min(self.timeout, 3.0))
+            except TimeoutError_ as e:
+                last = e
+                self._rotate_mon()
+                continue
+            if reply.result == -11:  # election in progress
+                last = RadosError(-11, str(reply.data))
+                time.sleep(0.2)
+                self._rotate_mon()
+                continue
+            if reply.result != 0:
+                raise RadosError(reply.result, str(reply.data))
+            return reply.data
+        raise last or RadosError(-110, "mon command retries exhausted")
 
     def create_pool(self, name: str, kind: str = "replicated",
                     size: int = 3, pg_num: int = 8,

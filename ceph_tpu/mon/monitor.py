@@ -1,83 +1,541 @@
-"""Monitor-lite: the control plane's single source of cluster-map truth.
+"""Monitor: the control plane's source of cluster-map truth.
 
 The capability of the reference's Monitor + PaxosService stack
 (src/mon/Monitor.cc command dispatch, OSDMonitor map mutations incl.
 prepare_failure :3393 with reporter thresholds and adaptive grace
 :3261-3266, pool create -> EC profile -> plugin factory :1977,
-MonitorDBStore versioned persistence — SURVEY.md §2.4), scoped for this
-round to a single monitor: every map mutation is a versioned commit in a
-MonStore (the Paxos log's shape, so a multi-mon Paxos/Raft quorum can
-replace the single writer without changing callers), and new epochs push
-to all subscribers.
+MonitorDBStore versioned persistence MonitorDBStore.h:44, Paxos
+replication Paxos.cc, Elector.cc leader election, forwarded requests):
+
+- every map mutation is a versioned commit in a MonStore (the Paxos
+  log's shape); `DurableMonStore` persists commits through a crc-framed
+  fsync'd append-only log (the FileStore WAL framing) so a restarted
+  monitor resumes with every pool/epoch intact;
+- multiple monitors form a quorum: an Elector-lite picks the leader
+  (newest store version wins, ties to the lowest rank — the shape of
+  ElectionLogic's epoch+rank rule), the leader replicates commits to
+  followers (primary-backup: proposals apply in version order, lagging
+  peers catch up via sync — full Paxos majority-ack is the next
+  widening step), and followers proxy client/daemon requests to the
+  leader (Monitor::forward_request) and serve map subscriptions from
+  replicated state;
+- failure detection: reporter-count thresholds + report-window span +
+  uptime-adaptive grace, as before (leader-local soft state).
 """
 
 from __future__ import annotations
 
+import os
+import queue
+import struct
 import threading
 import time
 
 from .. import ec
-from ..msg.messages import (MFailureReport, MMapPush, MMonCommand,
-                            MMonCommandReply, MMonSubscribe, MOSDBoot,
-                            MStatsReport)
+from ..msg.messages import (MFailureReport, MMapPush, MMonClaim,
+                            MMonCommand, MMonCommandReply, MMonElect,
+                            MMonForward, MMonFwdReply, MMonPing,
+                            MMonPropAck, MMonPropose, MMonSubscribe,
+                            MMonSyncEntries, MMonSyncReq, MMonVote,
+                            MOSDBoot, MStatsReport)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
+from ..msg.wire import decode_frame, encode_frame
+from ..ops import native
 from ..utils.config import Config, default_config
 from ..utils.log import dout
 from .maps import OSDMap, PoolSpec
 
+_FORWARDED = (MOSDBoot, MMonCommand, MFailureReport, MStatsReport)
+
 
 class MonStore:
-    """Versioned commit log + latest-state KV (MonitorDBStore's shape)."""
+    """Versioned commit log + latest-state KV (MonitorDBStore's shape).
+    The log keeps a bounded TAIL window (paxos-trim role): lagging peers
+    within the window sync by entries, older ones by snapshot."""
+
+    LOG_KEEP = 256
 
     def __init__(self):
         self.version = 0
-        self.log: list[tuple[int, str, bytes]] = []
+        self.log: list[tuple[int, str, str, bytes]] = []
         self.kv: dict[str, bytes] = {}
 
     def commit(self, key: str, value: bytes, desc: str) -> int:
-        self.version += 1
-        self.log.append((self.version, desc, value))
+        return self.commit_at(self.version + 1, key, value, desc)
+
+    def commit_at(self, version: int, key: str, value: bytes,
+                  desc: str) -> int:
+        """Apply a replicated commit at an exact version (follower
+        path); versions must be gapless and in order."""
+        if version != self.version + 1:
+            raise ValueError(f"commit v{version} onto v{self.version}")
+        self.version = version
+        self.log.append((version, desc, key, value))
         self.kv[key] = value
-        return self.version
+        if len(self.log) > 2 * self.LOG_KEEP:
+            self._trim()
+        return version
+
+    def _trim(self) -> None:
+        self.log = self.log[-self.LOG_KEEP:]
+
+    def oldest_logged(self) -> int:
+        """Lowest version still in the tail window (0 = everything)."""
+        return self.log[0][0] if self.log else self.version + 1
+
+    def entries_after(self, version: int) -> list:
+        return [e for e in self.log if e[0] > version]
+
+    def reset_to(self, version: int, kv: dict) -> None:
+        """Adopt a leader snapshot (MonitorDBStore full-sync role)."""
+        self.version = version
+        self.kv = dict(kv)
+        self.log = []
+
+    def close(self) -> None:
+        pass
+
+
+# durable record kinds
+_REC_COMMIT, _REC_SNAPSHOT = 1, 2
+
+
+class DurableMonStore(MonStore):
+    """MonStore persisted via the crc-framed WAL contract of FileStore:
+    [u32 len][u32 crc32c][payload], fsync'd per commit; a torn tail is
+    discarded on load, so restart resumes the committed prefix.  The
+    file is compacted to a snapshot + tail when the log window trims, so
+    neither the file nor restart replay grows with cluster age."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        os.makedirs(path, exist_ok=True)
+        self._path = os.path.join(path, "monstore.bin")
+        self._file = None
+        self._load()
+        self._file = open(self._path, "ab")
+
+    # -- framing -----------------------------------------------------------
+    @staticmethod
+    def _frame(payload: bytes) -> bytes:
+        return struct.pack("<II", len(payload),
+                           native.crc32c(payload)) + payload
+
+    def _load(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, "rb") as f:
+            raw = f.read()
+        pos = 0
+        while pos + 8 <= len(raw):
+            length, crc = struct.unpack_from("<II", raw, pos)
+            payload = raw[pos + 8: pos + 8 + length]
+            if len(payload) < length or native.crc32c(payload) != crc:
+                break  # torn tail: the crash cut this record short
+            self._apply_payload(payload)
+            pos += 8 + length
+        if pos < len(raw):
+            with open(self._path, "r+b") as f:
+                f.truncate(pos)
+
+    def _apply_payload(self, payload: bytes) -> None:
+        from ..utils.codec import Decoder
+        d = Decoder(payload)
+        kind = d.u8()
+        if kind == _REC_COMMIT:
+            version, desc, key, value = d.u64(), d.string(), d.string(), \
+                d.blob()
+            MonStore.commit_at(self, version, key, value, desc)
+        elif kind == _REC_SNAPSHOT:
+            version = d.u64()
+            kv = {d.string(): d.blob() for _ in range(d.u32())}
+            MonStore.reset_to(self, version, kv)
+
+    @staticmethod
+    def _commit_payload(version, key, value, desc) -> bytes:
+        from ..utils.codec import Encoder
+        e = Encoder()
+        e.u8(_REC_COMMIT)
+        e.u64(version)
+        e.string(desc)
+        e.string(key)
+        e.blob(value)
+        return e.tobytes()
+
+    def commit_at(self, version: int, key: str, value: bytes,
+                  desc: str) -> int:
+        before = len(self.log)
+        v = super().commit_at(version, key, value, desc)
+        self._file.write(self._frame(
+            self._commit_payload(version, key, value, desc)))
+        self._file.flush()
+        os.fsync(self._file.fileno())
+        if len(self.log) < before:  # window trimmed: compact the file
+            self._compact()
+        return v
+
+    def reset_to(self, version: int, kv: dict) -> None:
+        super().reset_to(version, kv)
+        self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the file as one snapshot of the CURRENT (version, kv),
+        atomically (tmp+rename).  The in-memory tail window still serves
+        peer entry-sync; restart replay is O(kv), not O(history)."""
+        from ..utils.codec import Encoder
+        e = Encoder()
+        e.u8(_REC_SNAPSHOT)
+        e.u64(self.version)
+        e.u32(len(self.kv))
+        for k in sorted(self.kv):
+            e.string(k)
+            e.blob(self.kv[k])
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(self._frame(e.tobytes()))
+            f.flush()
+            os.fsync(f.fileno())
+        if self._file:
+            self._file.close()
+        os.replace(tmp, self._path)
+        self._file = open(self._path, "ab")
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+class _RelayConn:
+    """Reply path for a forwarded request: the leader answers through
+    the follower that proxied it (Monitor forward_request reply flow)."""
+
+    def __init__(self, mon: "MonitorLite", forwarder: str, orig: str):
+        self._mon = mon
+        self._forwarder = forwarder
+        self.peer = orig
+
+    def send(self, msg) -> bool:
+        frame = encode_frame(self._mon.name, self.peer, msg)
+        return self._mon.messenger.send_message(
+            self._forwarder, MMonFwdReply(self.peer, frame))
 
 
 class MonitorLite(Dispatcher):
     def __init__(self, network: Network, name: str = "mon.0",
-                 cfg: Config | None = None):
+                 cfg: Config | None = None,
+                 peers: tuple | list = (), path: str | None = None):
         self.name = name
         self.cfg = cfg or default_config()
+        self.peers = [p for p in peers if p != name]
+        self._rank = int(name.rsplit(".", 1)[1]) if "." in name else 0
         self.messenger = Messenger(network, name, Policy.stateless_server())
         self.messenger.add_dispatcher(self)
-        self.store = MonStore()
+        self.store: MonStore = DurableMonStore(path) if path else MonStore()
         self.osdmap = OSDMap()
+        if self.store.kv.get("osdmap"):
+            self.osdmap = OSDMap.decode_bytes(self.store.kv["osdmap"])
         self._subscribers: set[str] = set()
         # failure accounting: target -> reporter -> (first, last) stamps
         self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
         self._boot_times: dict[int, float] = {}
         self._lock = threading.RLock()
         self._osd_stats: dict[int, dict] = {}
+        # quorum state (single mon = permanent leader, zero overhead)
+        self._term = 0
+        self._role = "leader" if not self.peers else "electing"
+        self._leader: str | None = name if not self.peers else None
+        self._votes: set[str] = set()
+        self._voted: tuple[int, str] | None = None  # (term, candidate)
+        self._election_at = 0.0
+        self._leader_seen = time.monotonic()
+        self._stop = threading.Event()
+        # per-destination sender lanes: a blocking connect to one dead
+        # peer must not head-of-line-block pings/proposals to the others
+        self._outqs: dict[str, queue.Queue] = {}
+        self._outq_lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
         self._handlers = {
             MOSDBoot: self._handle_boot,
             MMonSubscribe: self._handle_subscribe,
             MFailureReport: self._handle_failure,
             MMonCommand: self._handle_command,
             MStatsReport: self._handle_stats,
+            MMonPing: self._handle_mon_ping,
+            MMonElect: self._handle_elect,
+            MMonVote: self._handle_vote,
+            MMonClaim: self._handle_claim,
+            MMonPropose: self._handle_propose,
+            MMonPropAck: lambda conn, m: None,
+            MMonSyncReq: self._handle_sync_req,
+            MMonSyncEntries: self._handle_sync_entries,
+            MMonForward: self._handle_forward,
+            MMonFwdReply: self._handle_fwd_reply,
         }
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
         self.messenger.start()
+        if self.peers:
+            t = threading.Thread(target=self._quorum_loop,
+                                 name=f"{self.name}-quorum", daemon=True)
+            t.start()
+            self._threads.append(t)
+            self._start_election()
 
     def stop(self) -> None:
+        self._stop.set()
+        with self._outq_lock:
+            for q in self._outqs.values():
+                q.put(None)
         self.messenger.shutdown()
+        self.store.close()
+
+    @property
+    def is_leader(self) -> bool:
+        return self._role == "leader"
+
+    # ------------------------------------------------- ordered async sends
+    def _sender_loop(self, dst: str, q: queue.Queue) -> None:
+        """Per-destination ordered sender: a wire transport's blocking
+        connect to a dead peer must never stall commits NOR delay pings
+        and proposals to healthy peers (the lanes keep per-peer FIFO so
+        proposal versions arrive in order)."""
+        while True:
+            msg = q.get()
+            if msg is None or self._stop.is_set():
+                return
+            try:
+                self.messenger.send_message(dst, msg)
+            except Exception as e:  # noqa: BLE001
+                dout("mon", 5)("send to %s failed: %r", dst, e)
+
+    def _post(self, dst: str, msg) -> None:
+        with self._outq_lock:
+            q = self._outqs.get(dst)
+            if q is None:
+                q = queue.Queue()
+                self._outqs[dst] = q
+                t = threading.Thread(target=self._sender_loop,
+                                     args=(dst, q),
+                                     name=f"{self.name}-tx-{dst}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+        q.put(msg)
 
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, conn, msg) -> bool:
         handler = self._handlers.get(type(msg))
         if handler is None:
             return False
+        if isinstance(msg, _FORWARDED) and not self.is_leader:
+            self._forward_to_leader(conn, msg)
+            return True
         handler(conn, msg)
         return True
+
+    def _forward_to_leader(self, conn, msg) -> None:
+        """Follower: proxy a client/daemon request to the quorum leader
+        (Monitor::forward_request role)."""
+        if isinstance(msg, MOSDBoot):
+            # the follower may push maps to this daemon later: learn its
+            # address regardless of who leads
+            self.messenger.network.set_addr(f"osd.{msg.osd_id}", msg.addr)
+        leader = self._leader
+        if leader is None:
+            if isinstance(msg, MMonCommand):
+                conn.send(MMonCommandReply(msg.tid, -11,
+                                           {"error": "no quorum"}))
+            return  # boots/reports retry via beacons
+        frame = encode_frame(conn.peer, leader, msg)
+        self._post(leader, MMonForward(conn.peer, frame))
+
+    def _handle_forward(self, conn, m: MMonForward) -> None:
+        if not self.is_leader:
+            return  # stale leadership view; sender will retry
+        src, _dst, inner = decode_frame(m.frame[4:])
+        handler = self._handlers.get(type(inner))
+        if handler is not None:
+            handler(_RelayConn(self, conn.peer, m.orig), inner)
+
+    def _handle_fwd_reply(self, conn, m: MMonFwdReply) -> None:
+        _src, _dst, inner = decode_frame(m.frame[4:])
+        self.messenger.send_message(m.orig, inner)
+
+    # ------------------------------------------------------- quorum engine
+    def _score(self) -> tuple:
+        """Newest data wins; ties to the lowest rank (ElectionLogic)."""
+        return (self.store.version, -self._rank)
+
+    def _majority(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    def _quorum_loop(self) -> None:
+        interval = self.cfg["osd_heartbeat_interval"]
+        lease = 2 * self.cfg["osd_heartbeat_grace"]
+        while not self._stop.wait(interval):
+            now = time.monotonic()
+            with self._lock:
+                role = self._role
+            if role == "leader":
+                ping = MMonPing(self.name, self._term, "leader",
+                                self.store.version, time.time())
+                for p in self.peers:
+                    self._post(p, ping)
+            elif role == "follower":
+                if now - self._leader_seen > lease:
+                    dout("mon", 1)("%s: leader lease expired", self.name)
+                    self._start_election()
+            elif role == "electing":
+                # rank-staggered retry so colliding candidacies settle
+                if now - self._election_at > 0.4 + 0.1 * self._rank:
+                    self._start_election()
+
+    def _start_election(self) -> None:
+        with self._lock:
+            if not self.peers:
+                return
+            self._term += 1
+            self._role = "electing"
+            self._leader = None
+            self._votes = {self.name}
+            self._voted = (self._term, self.name)  # my vote is spent
+            self._election_at = time.monotonic()
+            term, version = self._term, self.store.version
+        dout("mon", 3)("%s: election term %d (v%d)", self.name, term,
+                       version)
+        for p in self.peers:
+            self._post(p, MMonElect(term, version, self._rank, self.name))
+
+    def _handle_elect(self, conn, m: MMonElect) -> None:
+        with self._lock:
+            if m.term < self._term:
+                return
+            if m.term > self._term:
+                self._term = m.term
+                self._votes = set()
+                if self._role == "leader":
+                    self._role = "electing"
+            if (m.version, -m.rank) >= self._score():
+                # at most ONE vote per term (the Raft votedFor rule —
+                # without it two candidates can both reach majority in
+                # the same term and split-brain)
+                if self._voted and self._voted[0] == m.term \
+                        and self._voted[1] != m.name:
+                    return
+                # defer to a better (or equally-good, lower-rank)
+                # candidate
+                if self._role == "leader":
+                    self._role = "follower"
+                self._voted = (m.term, m.name)
+                self._leader_seen = time.monotonic()
+                self._post(m.name, MMonVote(m.term, self._rank, self.name,
+                                            self.store.version))
+                return
+        # I am strictly better: counter-candidacy at a higher term
+        self._start_election()
+
+    def _handle_vote(self, conn, m: MMonVote) -> None:
+        claim = False
+        with self._lock:
+            if m.term != self._term or self._role != "electing":
+                return
+            self._votes.add(m.name)
+            if len(self._votes) >= self._majority():
+                self._role = "leader"
+                self._leader = self.name
+                claim = True
+                dout("mon", 1)("%s: leader for term %d (votes %s)",
+                               self.name, self._term, sorted(self._votes))
+        if claim:
+            for p in self.peers:
+                self._post(p, MMonClaim(self._term, self.store.version,
+                                        self.name))
+
+    def _handle_claim(self, conn, m: MMonClaim) -> None:
+        with self._lock:
+            if m.term < self._term:
+                return
+            self._term = m.term
+            self._role = "follower"
+            self._leader = m.name
+            self._leader_seen = time.monotonic()
+            behind = m.version > self.store.version
+        if behind:
+            self._post(m.name, MMonSyncReq(self.store.version, self.name))
+
+    def _handle_mon_ping(self, conn, m: MMonPing) -> None:
+        if m.role != "leader":
+            return
+        with self._lock:
+            if m.term < self._term:
+                return
+            self._term = m.term
+            if m.name != self.name:
+                self._role = "follower"
+                self._leader = m.name
+                self._leader_seen = time.monotonic()
+            behind = m.version > self.store.version
+        if behind:
+            self._post(m.name, MMonSyncReq(self.store.version, self.name))
+
+    # ---------------------------------------------------------- replication
+    def _handle_propose(self, conn, m: MMonPropose) -> None:
+        with self._lock:
+            if m.term < self._term:
+                return
+            self._term = m.term
+            self._leader_seen = time.monotonic()
+            if m.version <= self.store.version:
+                return  # already have it
+            if m.version > self.store.version + 1:
+                self._post(self._leader or conn.peer,
+                           MMonSyncReq(self.store.version, self.name))
+                return
+            self._apply_replicated(m.version, m.key, m.value, m.desc)
+        self._post(conn.peer, MMonPropAck(m.term, m.version, self.name))
+
+    def _handle_sync_req(self, conn, m: MMonSyncReq) -> None:
+        if not self.is_leader:
+            return
+        if m.from_version + 1 < self.store.oldest_logged():
+            # peer is older than the trimmed log window: full sync
+            self._post(m.name, MMonSyncEntries(
+                self._term, [], snap_version=self.store.version,
+                snap_kv=dict(self.store.kv)))
+            return
+        entries = self.store.entries_after(m.from_version)
+        if entries:
+            self._post(m.name, MMonSyncEntries(self._term, list(entries)))
+
+    def _handle_sync_entries(self, conn, m: MMonSyncEntries) -> None:
+        with self._lock:
+            if m.snap_kv is not None and \
+                    m.snap_version > self.store.version:
+                self.store.reset_to(m.snap_version, m.snap_kv)
+                if self.store.kv.get("osdmap"):
+                    self.osdmap = OSDMap.decode_bytes(
+                        self.store.kv["osdmap"])
+                    push = MMapPush(self.osdmap.epoch,
+                                    self.store.kv["osdmap"])
+                    for sub in list(self._subscribers):
+                        self._post(sub, push)
+            for version, desc, key, value in m.entries:
+                if version != self.store.version + 1:
+                    continue
+                self._apply_replicated(version, key, value, desc)
+
+    def _apply_replicated(self, version: int, key: str, value: bytes,
+                          desc: str) -> None:
+        """Follower: append a replicated commit and make it visible
+        (map decode + push to local subscribers).  Caller holds _lock."""
+        self.store.commit_at(version, key, value, desc)
+        if key == "osdmap":
+            self.osdmap = OSDMap.decode_bytes(value)
+            push = MMapPush(self.osdmap.epoch, value)
+            for sub in list(self._subscribers):
+                self._post(sub, push)
 
     # ------------------------------------------------------------ map flow
     def _commit_map(self, desc: str) -> None:
@@ -86,21 +544,12 @@ class MonitorLite(Dispatcher):
         self.store.commit("osdmap", raw, desc)
         dout("mon", 3)("epoch %d: %s", self.osdmap.epoch, desc)
         push = MMapPush(self.osdmap.epoch, raw)
-        subs = list(self._subscribers)
-
-        # push OUTSIDE the monitor lock: a wire transport's blocking
-        # connect to a dead subscriber must never stall commits.  Out-of-
-        # order delivery across commits is safe — receivers discard
-        # stale epochs.
-        def _push():
-            for sub in subs:
-                try:
-                    self.messenger.send_message(sub, push)
-                except Exception as e:  # noqa: BLE001
-                    dout("mon", 5)("map push to %s failed: %r", sub, e)
-
-        threading.Thread(target=_push, name="mon-map-push",
-                         daemon=True).start()
+        for sub in list(self._subscribers):
+            self._post(sub, push)
+        prop = MMonPropose(self._term, self.store.version, "osdmap", raw,
+                           desc)
+        for p in self.peers:
+            self._post(p, prop)
 
     def _handle_boot(self, conn, m: MOSDBoot) -> None:
         # teach the transport where this daemon lives (wire transports;
@@ -124,9 +573,12 @@ class MonitorLite(Dispatcher):
     def _handle_subscribe(self, conn, m: MMonSubscribe) -> None:
         with self._lock:
             self._subscribers.add(conn.peer)
-            if self.osdmap.epoch > 0:
-                conn.send(MMapPush(self.osdmap.epoch,
-                                   self.osdmap.encode_bytes()))
+            # push even an empty epoch-0 map: a daemon whose boot was
+            # dropped during an election sees itself absent and
+            # re-asserts (without this, a cold 3-mon cluster can wedge
+            # with every boot lost and no commit to trigger a push)
+            conn.send(MMapPush(self.osdmap.epoch,
+                               self.osdmap.encode_bytes()))
 
     # -- failure detection (prepare_failure / check_failure role) ----------
     def _grace_for(self, target: int) -> float:
@@ -165,6 +617,10 @@ class MonitorLite(Dispatcher):
 
     # ------------------------------------------------------------- commands
     def _handle_command(self, conn, m: MMonCommand) -> None:
+        if not self.is_leader:
+            # reachable on a mid-election mon addressed directly
+            conn.send(MMonCommandReply(m.tid, -11, {"error": "not leader"}))
+            return
         try:
             result, data = self._run_command(m.cmd)
         except Exception as e:  # noqa: BLE001 - commands must not kill mon
@@ -209,6 +665,9 @@ class MonitorLite(Dispatcher):
                        "pools": sorted(p.name for p in
                                        self.osdmap.pools.values()),
                        "usage": agg,
+                       "quorum": {"leader": self._leader,
+                                  "term": self._term,
+                                  "role": self._role},
                        "health": "HEALTH_OK" if len(up) == len(
                            self.osdmap.osds) else "HEALTH_WARN"}
         if prefix == "osd stats":

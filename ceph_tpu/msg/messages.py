@@ -267,6 +267,105 @@ class MPGPush:
     force: bool = False  # scrub repair: overwrite same-version bad copies
 
 
+# ----------------------------------------------------- mon quorum (Raft-lite)
+@dataclass
+class MMonPing:
+    """Mon <-> mon liveness + role advertisement (the Elector's
+    connectivity stream role)."""
+
+    name: str
+    term: int
+    role: str   # leader | follower | electing
+    version: int
+    stamp: float
+
+
+@dataclass
+class MMonElect:
+    """Candidate -> peers: I propose myself for `term` (Elector propose)."""
+
+    term: int
+    version: int  # candidate's store version (newest data wins)
+    rank: int
+    name: str
+
+
+@dataclass
+class MMonVote:
+    """Peer -> candidate: deferral/ack for `term` (Elector ack)."""
+
+    term: int
+    rank: int
+    name: str
+    version: int
+
+
+@dataclass
+class MMonClaim:
+    """Winner -> peers: I am the leader for `term` (Elector victory)."""
+
+    term: int
+    version: int
+    name: str
+
+
+@dataclass
+class MMonPropose:
+    """Leader -> follower: replicate one store commit (Paxos
+    begin/commit collapsed to primary-backup for this round)."""
+
+    term: int
+    version: int
+    key: str
+    value: bytes
+    desc: str
+
+
+@dataclass
+class MMonPropAck:
+    term: int
+    version: int
+    name: str
+
+
+@dataclass
+class MMonSyncReq:
+    """Lagging mon -> leader: send me commits after `from_version`
+    (MonitorDBStore sync role)."""
+
+    from_version: int
+    name: str
+
+
+@dataclass
+class MMonSyncEntries:
+    term: int
+    entries: list  # [(version, desc, key, value bytes)]
+    # full-sync path for peers older than the leader's log window
+    # (MonitorDBStore full sync role): adopt the snapshot, then entries
+    snap_version: int = 0
+    snap_kv: dict | None = None
+
+
+@dataclass
+class MMonForward:
+    """Follower -> leader: a client/daemon message proxied to the
+    quorum leader (Monitor forward_request role).  `frame` is a full
+    wire frame (encode_frame) of the original message."""
+
+    orig: str   # original sender entity (reply target)
+    frame: bytes
+
+
+@dataclass
+class MMonFwdReply:
+    """Leader -> forwarding follower: relay this reply frame to the
+    original sender over your connection to them."""
+
+    orig: str
+    frame: bytes
+
+
 # ------------------------------------------------------------- mgr stats
 @dataclass
 class MStatsReport:

@@ -113,6 +113,9 @@ MESSAGE_TYPES: list[type] = [
     M.MPGQuery, M.MPGInfo, M.MPGPull, M.MPGPush,    # 17-20
     M.MStatsReport,                                 # 21
     M.MScrubRequest, M.MScrubShard, M.MScrubMap, M.MScrubResult,  # 22-25
+    M.MMonPing, M.MMonElect, M.MMonVote, M.MMonClaim,             # 26-29
+    M.MMonPropose, M.MMonPropAck, M.MMonSyncReq,                  # 30-32
+    M.MMonSyncEntries, M.MMonForward, M.MMonFwdReply,             # 33-35
 ]
 _TYPE_IDS = {t: i + 1 for i, t in enumerate(MESSAGE_TYPES)}
 _ID_TYPES = {i: t for t, i in _TYPE_IDS.items()}

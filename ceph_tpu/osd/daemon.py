@@ -105,11 +105,14 @@ class _ClientConn:
 class OSDDaemon(ScrubMixin, Dispatcher):
     def __init__(self, osd_id: int, network: Network,
                  mon: str = "mon.0", store: ObjectStore | None = None,
-                 cfg: Config | None = None, host: str | None = None):
+                 cfg: Config | None = None, host: str | None = None,
+                 mons: list | None = None):
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.host = host or f"host{osd_id}"
-        self.mon = mon
+        self._mons = list(mons) if mons else [mon]
+        self._mon_idx = 0
+        self.mon = self._mons[0]
         self.cfg = cfg or default_config()
         self.store = store or ObjectStore.create("memstore")
         self.store.mount()
@@ -229,6 +232,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     # ------------------------------------------------------------- mapping
     def _handle_map(self, conn, msg: MMapPush) -> None:
         newmap = OSDMap.decode_bytes(msg.map_bytes)
+        # ANY push — even a stale/equal epoch answering a beacon
+        # re-subscribe — proves the mon link is alive; without this a
+        # quiescent cluster's beacons rotate monitors forever
+        self._last_map = time.time()
         old = self.osdmap
         if old is not None and newmap.epoch <= old.epoch:
             return
@@ -253,8 +260,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             if not info.up:
                 self._hb_last.pop(peer, None)
         # if the map says I am down but I am alive, re-assert (osd re-boot)
+        # if the map says I am down — or does not know me at all (my boot
+        # was dropped during a mon election) — re-assert
         me = newmap.osds.get(self.osd_id)
-        if me is not None and not me.up and not self._stop.is_set():
+        if (me is None or not me.up) and not self._stop.is_set():
             self.messenger.send_message(
                 self.mon,
                 MOSDBoot(self.osd_id, self.host, net.addr_of(self.name),
@@ -320,7 +329,12 @@ class OSDDaemon(ScrubMixin, Dispatcher):
     # ----------------------------------------------------------- client ops
     def _handle_client_op(self, conn, m: MOSDOp) -> None:
         if self.osdmap is None or m.pool not in self.osdmap.pools:
-            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=0))
+            # the client's map may be AHEAD of ours (pool just created,
+            # our push still in flight): EAGAIN retries; only a pool
+            # unknown at the client's own epoch is truly ENOENT
+            my_epoch = self.osdmap.epoch if self.osdmap else 0
+            err = EAGAIN if m.epoch > my_epoch else ENOENT
+            conn.send(MOSDOpReply(m.tid, err, epoch=my_epoch))
             return
         pool = self.osdmap.pools[m.pool]
         seed = self.osdmap.object_to_pg(m.pool, m.oid)
@@ -1335,18 +1349,31 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         grace = self.cfg["osd_heartbeat_grace"]
         ticks = 0
         while not self._stop.wait(interval):
-            if self.osdmap is None:
-                continue
             now = time.time()
-            self._sweep_pending(now)
-            ticks += 1
-            # osd-beacon role: map silence means the mon may have dropped
-            # our subscription (e.g. it marked us down while we were
-            # partitioned) — re-subscribe so we learn our own state and
-            # can re-assert boot
+            # osd-beacon role (runs even before the FIRST map arrives):
+            # map silence means the mon dropped our subscription (marked
+            # us down / lost our boot during an election) or died —
+            # rotate monitors, re-subscribe, and re-assert boot if the
+            # map we hold doesn't show us up
             if now - self._last_map > 2 * grace:
                 self._last_map = now  # debounce
+                self._mon_idx += 1
+                self.mon = self._mons[self._mon_idx % len(self._mons)]
                 self.messenger.send_message(self.mon, MMonSubscribe())
+                me = self.osdmap.osds.get(self.osd_id) \
+                    if self.osdmap else None
+                if me is None or not me.up:
+                    net = self.messenger.network
+                    self.messenger.send_message(
+                        self.mon,
+                        MOSDBoot(self.osd_id, self.host,
+                                 net.addr_of(self.name),
+                                 hb_addr=net.addr_of(
+                                     self.hb_messenger.name)))
+            if self.osdmap is None:
+                continue
+            self._sweep_pending(now)
+            ticks += 1
             for peer in self.osdmap.up_osds():
                 if peer == self.osd_id:
                     continue

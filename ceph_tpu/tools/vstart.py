@@ -20,7 +20,8 @@ from ..utils.config import Config, default_config
 
 class MiniCluster:
     def __init__(self, n_osds: int = 3, cfg: Config | None = None,
-                 hosts_per_osd: bool = True, transport: str = "local"):
+                 hosts_per_osd: bool = True, transport: str = "local",
+                 n_mons: int = 1, mon_path: str | None = None):
         self.cfg = cfg or default_config()
         if transport == "tcp":
             from ..msg.tcp import TcpNetwork
@@ -29,24 +30,68 @@ class MiniCluster:
             self.network = LocalNetwork()
         else:
             raise ValueError(f"unknown transport {transport!r}")
-        self.mon = MonitorLite(self.network, cfg=self.cfg)
+        self.mon_names = [f"mon.{i}" for i in range(n_mons)]
+        self.mons: dict[int, MonitorLite] = {}
+        self._mon_path = mon_path
+        for i in range(n_mons):
+            self.mons[i] = self._make_mon(i)
+        self.mon = self.mons[0]  # compat alias (single-mon tests)
         self.osds: dict[int, OSDDaemon] = {}
         self.procs: dict[int, object] = {}  # subprocess OSDs (tcp mode)
         self.clients: list[RadosClient] = []
         self._n = n_osds
         self._hosts_per_osd = hosts_per_osd
 
+    def _make_mon(self, rank: int) -> MonitorLite:
+        import os
+        path = None
+        if self._mon_path:
+            path = os.path.join(self._mon_path, f"mon{rank}")
+        return MonitorLite(self.network, f"mon.{rank}", cfg=self.cfg,
+                           peers=self.mon_names if len(self.mon_names) > 1
+                           else (), path=path)
+
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "MiniCluster":
-        self.mon.start()
+        for m in self.mons.values():
+            m.start()
+        if len(self.mons) > 1:
+            self.wait_for_leader()
         for i in range(self._n):
             self.add_osd(i)
         self.wait_for_up(self._n)
         return self
 
+    def leader_mon(self) -> MonitorLite | None:
+        for m in self.mons.values():
+            if m.is_leader:
+                return m
+        return None
+
+    def wait_for_leader(self, timeout: float = 15.0) -> MonitorLite:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            m = self.leader_mon()
+            if m is not None:
+                return m
+            time.sleep(0.02)
+        raise TimeoutError("no mon leader elected")
+
+    def kill_mon(self, rank: int) -> None:
+        m = self.mons.pop(rank, None)
+        if m:
+            m.stop()
+
+    def revive_mon(self, rank: int) -> MonitorLite:
+        m = self._make_mon(rank)
+        self.mons[rank] = m
+        m.start()
+        return m
+
     def add_osd(self, osd_id: int) -> OSDDaemon:
         host = f"host{osd_id}" if self._hosts_per_osd else "host0"
-        osd = OSDDaemon(osd_id, self.network, cfg=self.cfg, host=host)
+        osd = OSDDaemon(osd_id, self.network, cfg=self.cfg, host=host,
+                        mons=self.mon_names)
         self.osds[osd_id] = osd
         osd.start()
         return osd
@@ -86,7 +131,8 @@ class MiniCluster:
 
     def client(self, idx: int | None = None) -> RadosClient:
         idx = len(self.clients) if idx is None else idx
-        c = RadosClient(self.network, f"client.{idx}").connect()
+        c = RadosClient(self.network, f"client.{idx}",
+                        mons=self.mon_names).connect()
         self.clients.append(c)
         return c
 
@@ -105,26 +151,37 @@ class MiniCluster:
             except Exception:  # noqa: BLE001
                 p.kill()
                 p.wait()  # reap — no zombies across a test session
-        self.mon.stop()
+        for m in self.mons.values():
+            m.stop()
         if hasattr(self.network, "stop"):
             self.network.stop()
 
     # ------------------------------------------------------------- helpers
+    def _best_epoch_map(self):
+        """The newest map any live monitor holds."""
+        best = None
+        for m in self.mons.values():
+            if best is None or m.osdmap.epoch > best.epoch:
+                best = m.osdmap
+        return best
+
     def wait_for_up(self, n: int, timeout: float = 10.0) -> None:
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if len(self.mon.osdmap.up_osds()) >= n:
+            if len(self._best_epoch_map().up_osds()) >= n:
                 return
             time.sleep(0.01)
-        raise TimeoutError(f"only {len(self.mon.osdmap.up_osds())}/{n} up")
+        raise TimeoutError(
+            f"only {len(self._best_epoch_map().up_osds())}/{n} up")
 
     def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
         deadline = time.time() + timeout
         while time.time() < deadline:
-            if self.mon.osdmap.epoch >= epoch:
+            if self._best_epoch_map().epoch >= epoch:
                 return
             time.sleep(0.01)
-        raise TimeoutError(f"epoch {self.mon.osdmap.epoch} < {epoch}")
+        raise TimeoutError(
+            f"epoch {self._best_epoch_map().epoch} < {epoch}")
 
     def kill_osd(self, osd_id: int, mark_down: bool = True) -> None:
         """Hard-kill a daemon (kill_daemon in ceph-helpers).  With

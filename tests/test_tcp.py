@@ -65,6 +65,18 @@ def _sample(cls):
                                              "digest": 77}}),
         M.MScrubResult: M.MScrubResult(1, pg, 0,
                                        [{"osd": 1, "kind": "x"}], 2),
+        M.MMonPing: M.MMonPing("mon.1", 3, "leader", 9, 55.5),
+        M.MMonElect: M.MMonElect(3, 9, 1, "mon.1"),
+        M.MMonVote: M.MMonVote(3, 2, "mon.2", 8),
+        M.MMonClaim: M.MMonClaim(3, 9, "mon.1"),
+        M.MMonPropose: M.MMonPropose(3, 10, "osdmap", b"raw", "boot"),
+        M.MMonPropAck: M.MMonPropAck(3, 10, "mon.2"),
+        M.MMonSyncReq: M.MMonSyncReq(7, "mon.2"),
+        M.MMonSyncEntries: M.MMonSyncEntries(
+            3, [(8, "boot", "osdmap", b"v8"), (9, "down", "osdmap",
+                                               b"v9")]),
+        M.MMonForward: M.MMonForward("client.0", b"\x01\x02frame"),
+        M.MMonFwdReply: M.MMonFwdReply("client.0", b"\x03frame"),
     }
     return samples[cls]
 
