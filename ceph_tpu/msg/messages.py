@@ -232,10 +232,15 @@ class MMonCommandReply:
 # --------------------------------------------------------- peering/recovery
 @dataclass
 class MPGQuery:
-    """Primary -> peer: send me your object inventory for this PG."""
+    """Primary -> peer: peering info request.  Carries the primary's
+    log head/floor so an in-sync peer can answer LEAN (no O(objects)
+    inventory walk — the log-based GetInfo/GetLog fast path)."""
 
     pgid: PgId
     epoch: int
+    primary_last: int = -1   # primary's pglog last_version
+    primary_floor: int = -1  # oldest version still in the primary's log
+    force_full: bool = False  # demand a full inventory regardless
 
 
 @dataclass
@@ -243,8 +248,10 @@ class MPGInfo:
     pgid: PgId
     from_osd: int
     shard: int
-    objects: dict  # (name, shard) -> version
+    objects: dict  # (name, shard) -> version  (empty when lean)
     tombstones: dict = field(default_factory=dict)  # name -> delete version
+    last_complete: int = -1  # contiguity point of this peer's pglog
+    lean: bool = False  # no inventory attached: delta-resync from my log
 
 
 @dataclass
@@ -258,13 +265,30 @@ class MPGPull:
 
 @dataclass
 class MPGPush:
-    """Recovery payload: full objects (log-based delta is future work)."""
+    """Recovery payload: objects to apply, plus an optional log
+    CHECKPOINT — set only when the primary has verified the peer needs
+    nothing, letting it fast-path future peering rounds."""
 
     pgid: PgId
     shard: int
     objects: dict  # name -> (version, data bytes[, total_len])
     deletes: dict = field(default_factory=dict)  # name -> delete version
     force: bool = False  # scrub repair: overwrite same-version bad copies
+    checkpoint: int = -1  # peer may advance last_complete to this
+
+
+@dataclass
+class MPGRollback:
+    """Primary -> shard holder: your shard applied writes on `oid` past
+    the version the stripe can decode at (< k shards committed them) —
+    roll back to `to_version` using your pglog pre-images, or drop the
+    shard object for rebuild (the EC rollback-generation role,
+    doc/dev/osd_internals/erasure_coding/ecbackend.rst:10-27)."""
+
+    pgid: PgId
+    oid: str
+    shard: int
+    to_version: int
 
 
 # ----------------------------------------------------- mon quorum (Raft-lite)

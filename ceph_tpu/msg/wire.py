@@ -116,6 +116,7 @@ MESSAGE_TYPES: list[type] = [
     M.MMonPing, M.MMonElect, M.MMonVote, M.MMonClaim,             # 26-29
     M.MMonPropose, M.MMonPropAck, M.MMonSyncReq,                  # 30-32
     M.MMonSyncEntries, M.MMonForward, M.MMonFwdReply,             # 33-35
+    M.MPGRollback,                                                # 36
 ]
 _TYPE_IDS = {t: i + 1 for i, t in enumerate(MESSAGE_TYPES)}
 _ID_TYPES = {i: t for t, i in _TYPE_IDS.items()}

@@ -40,7 +40,7 @@ from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
                             MOSDBoot, MOSDOp, MOSDOpReply, MOSDPing,
                             MOSDPingReply, MPGInfo, MPGPull, MPGPush,
-                            MPGQuery, MStatsReport, MSubDelta,
+                            MPGQuery, MPGRollback, MStatsReport, MSubDelta,
                             MSubPartialWrite, MSubRead, MSubReadReply,
                             MSubWrite, MSubWriteReply, PgId)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
@@ -52,6 +52,7 @@ from ..utils.tracked_op import OpTracker
 from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
+from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scrub import FaultInjection, ScrubMixin
 
 EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
@@ -136,6 +137,13 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._pg_versions: dict[PgId, int] = {}
         self._ec_codecs: dict[int, ec.ErasureCode] = {}
         self._stripes: dict[int, StripeInfo] = {}
+        self._pglogs: dict[PgId, PGLog] = {}
+        self._pg_lc: dict[PgId, int] = {}  # last-complete contiguity pt
+        # peering reconciliation: collected peer inventories + log
+        # positions this round
+        self._peer_invs: dict[PgId, dict[int, dict]] = {}
+        self._peer_lcs: dict[PgId, dict[int, int]] = {}
+        self._reconcile_at: dict[PgId, float] = {}
         self._hb_last: dict[int, float] = {}
         self._last_map = time.time()  # osd_beacon staleness clock
         self._hb_thread: threading.Thread | None = None
@@ -145,11 +153,11 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         # waiting for member inventories block IO with EAGAIN, and objects
         # the primary knows it is behind on stay blocked until pulled
         self._peering: dict[PgId, set[int]] = {}
-        self._stale_objects: dict[PgId, set[str]] = {}
+        self._stale_objects: dict[PgId, dict[str, int]] = {}
         # per-object write serialization for multi-phase EC ops (the obc
         # lock / ECExtentCache ordering role): queued thunks per key
         self._obj_locks: dict[tuple, object] = {}
-        self._requery_at: dict[PgId, float] = {}
+        self._requery_at: dict[tuple, float] = {}
         self._pending_scrubs: dict = {}
         self.inject = FaultInjection()
         self.op_tracker = OpTracker()
@@ -171,10 +179,12 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             MPGInfo: self._handle_pg_info,
             MPGPull: self._handle_pg_pull,
             MPGPush: self._handle_pg_push,
+            MPGRollback: self._handle_pg_rollback,
         }
         self.perf = global_perf().create(self.name)
         self.perf.add_many(["op_w", "op_r", "op_rw_bytes", "subop_w",
-                            "subop_r", "recovery_push", "failure_reports",
+                            "subop_r", "recovery_push", "recovery_delta",
+                            "rollbacks", "failure_reports",
                             "scrubs", "scrub_errors"])
         self.perf.add("op_lat", CounterType.TIME)
 
@@ -512,9 +522,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         if not self.store.exists(cid, ObjectId(m.oid)):
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
             return
-        self.store.queue_transaction(
-            Transaction().remove(cid, ObjectId(m.oid)))
-        self._record_tombstone(pgid, m.oid, version)
+        self._apply_remove(pgid, m.oid, -1, version)
         peers = [u for u in up if u is not None and u != self.osd_id]
         tid = next(self._tids)
         if not peers:
@@ -570,6 +578,55 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             codec = ec.factory(plugin, profile)
             self._ec_codecs[pool_id] = codec
         return codec
+
+    # ----------------------------------------------------------- pg log
+    def _pglog(self, pgid: PgId) -> PGLog:
+        pl = self._pglogs.get(pgid)
+        if pl is None:
+            pl = PGLog(self.store, CollectionId(pgid.pool, pgid.seed))
+            self._pglogs[pgid] = pl
+        return pl
+
+    def _lc(self, pgid: PgId) -> int:
+        """last-complete: highest version through which this OSD has seen
+        EVERY pg mutation gaplessly (the log's authority point)."""
+        lc = self._pg_lc.get(pgid)
+        if lc is None:
+            cid = CollectionId(pgid.pool, pgid.seed)
+            try:
+                raw = self.store.omap_get(cid, PGLOG_OID).get("_lc")
+                lc = int.from_bytes(raw, "little") if raw else 0
+            except Exception:  # noqa: BLE001 - no log object yet
+                lc = 0
+            self._pg_lc[pgid] = lc
+        return lc
+
+    def _set_lc(self, pgid: PgId, lc: int,
+                tx: Transaction | None = None) -> None:
+        self._pg_lc[pgid] = lc
+        cid = CollectionId(pgid.pool, pgid.seed)
+        own = tx is None
+        if own:
+            tx = Transaction()
+        if not self.store.exists(cid, PGLOG_OID):
+            tx.touch(cid, PGLOG_OID)
+        tx.omap_setkeys(cid, PGLOG_OID,
+                        {"_lc": lc.to_bytes(8, "little")})
+        if own:
+            self.store.queue_transaction(tx)
+
+    def _log_apply(self, tx: Transaction, pgid: PgId,
+                   entry: LogEntry) -> None:
+        """Append a log entry in the SAME transaction as its data write
+        and advance the contiguity point when versions arrive in order
+        (a gap means we missed a mutation: last-complete stays put and
+        peering falls back to the inventory exchange)."""
+        pl = self._pglog(pgid)
+        pl.append_to(tx, entry)
+        pl.trim_to(tx)
+        lc = self._lc(pgid)
+        if entry.version == lc + 1:
+            self._set_lc(pgid, entry.version, tx=tx)
 
     def _pool_stripe(self, pool_id: int) -> StripeInfo:
         """The pool's stripe geometry (ECUtil stripe_info_t role): a FIXED
@@ -955,16 +1012,35 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         obj = ObjectId(oid, shard=shard)
         tx = Transaction()
         exists = self.store.exists(cid, obj)
+        old_attrs: dict = {}
         if not exists:
             if not create_ok:
                 return ENOENT
             tx.touch(cid, obj)
-        elif prev_version >= 0:
-            cur = int(self.store.getattrs(cid, obj).get("v", 0))
-            if cur != prev_version:
+        else:
+            old_attrs = dict(self.store.getattrs(cid, obj))
+            if prev_version >= 0 and \
+                    int(old_attrs.get("v", 0)) != prev_version:
                 return EAGAIN
+        # stash the pre-images being overwritten (the PGLog rollback
+        # generation role): a torn partial write rolls back via these
+        rollback = []
+        old_shard_len = -1
+        if exists:
+            old_shard_len = self.store.stat(cid, obj)["size"]
+            for coff, data in extents:
+                old = self.store.read(cid, obj, coff,
+                                      len(data)).to_bytes()
+                old += b"\0" * (len(data) - len(old))
+                rollback.append((coff, old))
         for coff, data in extents:
             tx.write(cid, obj, coff, data)
+        self._log_apply(tx, pgid, LogEntry(
+            version, "rows", oid, shard,
+            prev_version=int(old_attrs.get("v", -1)),
+            rollback=rollback,
+            old_len=int(old_attrs.get("len", -1)),
+            old_shard_len=old_shard_len))
         self.store.queue_transaction(tx)
         data = self.store.read(cid, obj).to_bytes()
         attrs = dict(self.store.getattrs(cid, obj))
@@ -994,25 +1070,28 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         codec = self._pool_codec(pgid.pool)
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = ObjectId(oid, shard=parity_shard)
-        try:
-            chunk = np.frombuffer(self.store.read(cid, obj).to_bytes(),
-                                  dtype=np.uint8).copy()
-        except NoSuchObject:
+        if not self.store.exists(cid, obj):
             return ENOENT
         if prev_version >= 0:
             cur = int(self.store.getattrs(cid, obj).get("v", 0))
             if cur != prev_version:
                 return EAGAIN
-        need = max((coff + len(d) for _ds, coff, d in extents), default=0)
-        if chunk.size < need:  # delta into the padded tail of a stripe row
-            chunk = np.concatenate(
-                [chunk, np.zeros(need - chunk.size, np.uint8)])
+        # fold deltas over ONE union-range buffer: extents from different
+        # data shards overlap in parity space (same stripe row), and the
+        # folds must accumulate — read the covering range once, fold all,
+        # write it back (and only this range is stashed for rollback,
+        # not the whole parity stream)
+        lo = min(coff for _ds, coff, _d in extents)
+        hi = max(coff + len(d) for _ds, coff, d in extents)
+        old = self.store.read(cid, obj, lo, hi - lo).to_bytes()
+        old += b"\0" * ((hi - lo) - len(old))
+        buf = np.frombuffer(old, dtype=np.uint8).copy()
         for ds, coff, dbytes in extents:
-            view = chunk[coff:coff + len(dbytes)]
+            view = buf[coff - lo: coff - lo + len(dbytes)]
             codec.apply_delta(np.frombuffer(dbytes, dtype=np.uint8), ds,
                               {parity_shard: view})
         return self._apply_partial(pgid, oid, parity_shard,
-                                   [(0, chunk.tobytes())], version,
+                                   [(lo, buf.tobytes())], version,
                                    total_len=total_len)
 
     def _handle_sub_partial_write(self, conn, m: MSubPartialWrite) -> None:
@@ -1171,9 +1250,13 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                       if pr.shard_vers.get(s) == vmax}
             if len(agreed) < codec.k and len(chunks) >= codec.k:
                 # no complete version-agreed k-set: either a racing write
-                # (transient — its commit completes the set) or a stale
-                # shard awaiting recovery rebuild; both resolve, so the
-                # client retries rather than decoding a torn stripe
+                # (transient — its commit completes the set) or a torn
+                # stripe awaiting rollback/rebuild; kick a FULL
+                # reconciliation (lean peering hides per-object versions)
+                # and have the client retry rather than decode torn data
+                if self.osdmap is not None:
+                    seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
+                    self._requery_pg(PgId(pr.pool, seed), force_full=True)
                 if pr.client:
                     self.messenger.send_message(
                         pr.client, MOSDOpReply(pr.client_tid, EAGAIN,
@@ -1255,11 +1338,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             if osd is None:
                 continue
             if osd == self.osd_id:
-                cid = CollectionId(pgid.pool, pgid.seed)
-                oid = ObjectId(m.oid, shard=shard)
-                if self.store.exists(cid, oid):
-                    self.store.queue_transaction(
-                        Transaction().remove(cid, oid))
+                self._apply_remove(pgid, m.oid, shard, version)
             else:
                 remote += 1
                 self.messenger.send_message(
@@ -1287,6 +1366,17 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         tx.truncate(cid, obj, 0)
         tx.write(cid, obj, 0, data)
         tx.setattrs(cid, obj, {k: v for k, v in attrs.items()})
+        if "v" in attrs:
+            try:
+                old = self.store.getattrs(cid, obj)
+            except NoSuchObject:
+                old = {}
+            # whole-object replace: no pre-image stash (rollback of a
+            # full write = drop the shard object and rebuild from peers)
+            self._log_apply(tx, pgid, LogEntry(
+                int(attrs["v"]), "write", oid, shard,
+                prev_version=int(old.get("v", -1)),
+                old_len=int(old.get("len", -1))))
         self.store.queue_transaction(tx)
 
     def _handle_sub_write(self, conn, m: MSubWrite) -> None:
@@ -1309,14 +1399,22 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                                          self.osd_id, code))
                 return
         elif m.op == "remove":
-            cid = CollectionId(m.pgid.pool, m.pgid.seed)
-            obj = ObjectId(m.oid, shard=m.shard)
-            if self.store.exists(cid, obj):
-                self.store.queue_transaction(Transaction().remove(cid, obj))
-            self._record_tombstone(m.pgid, m.oid, m.version)
+            self._apply_remove(m.pgid, m.oid, m.shard, m.version)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0), m.version)
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
+
+    def _apply_remove(self, pgid: PgId, oid: str, shard: int,
+                      version: int) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid, shard=shard)
+        tx = Transaction()
+        if self.store.exists(cid, obj):
+            tx.remove(cid, obj)
+        self._log_apply(tx, pgid, LogEntry(version, "remove", oid, shard,
+                                           prev_version=-1))
+        self.store.queue_transaction(tx)
+        self._record_tombstone(pgid, oid, version)
 
     def _handle_sub_write_reply(self, conn, m: MSubWriteReply) -> None:
         if m.result == EAGAIN:
@@ -1465,30 +1563,45 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         stale data (the GetInfo/GetMissing phase of the peering FSM)."""
         for pool_id, seed, up in self._pools_pgs_for_me():
             if self._primary_of(up) != self.osd_id:
-                self._peering.pop(PgId(pool_id, seed), None)
+                pg = PgId(pool_id, seed)
+                self._peering.pop(pg, None)
+                self._peer_invs.pop(pg, None)
+                self._peer_lcs.pop(pg, None)
                 continue
             pgid = PgId(pool_id, seed)
+            # fresh round: stale cached inventories/log-positions must
+            # not feed rollback decisions (they could roll back writes
+            # committed since they were collected)
+            self._peer_invs.pop(pgid, None)
+            self._peer_lcs.pop(pgid, None)
             peers = {osd for osd in up
                      if osd is not None and osd != self.osd_id}
             if peers:
                 self._peering[pgid] = set(peers)
             else:
                 self._peering.pop(pgid, None)
+            pl = self._pglog(pgid)
             for osd in peers:
                 self.messenger.send_message(
-                    f"osd.{osd}", MPGQuery(pgid, self.osdmap.epoch))
+                    f"osd.{osd}",
+                    MPGQuery(pgid, self.osdmap.epoch,
+                             primary_last=pl.last_version(),
+                             primary_floor=pl.floor()))
             # also reconcile my own shard inventory immediately
             self._handle_pg_info(None, self._my_pg_info(pgid))
 
     def _my_pg_info(self, pgid: PgId) -> MPGInfo:
         return MPGInfo(pgid, self.osd_id, -2, self._inventory(pgid),
-                       dict(self._tombstones.get(pgid, {})))
+                       dict(self._tombstones.get(pgid, {})),
+                       last_complete=self._lc(pgid))
 
     def _inventory(self, pgid: PgId) -> dict:
         cid = CollectionId(pgid.pool, pgid.seed)
         out = {}
         try:
             for oid in self.store.list_objects(cid):
+                if oid.shard <= -2:
+                    continue  # PG metadata (pglog), not user data
                 attrs = self.store.getattrs(cid, oid)
                 v = attrs.get("v", 0)
                 out[(oid.name, oid.shard)] = v
@@ -1497,12 +1610,27 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         return out
 
     def _handle_pg_query(self, conn, m: MPGQuery) -> None:
+        pl = self._pglog(m.pgid)
+        lc = self._lc(m.pgid)
+        # LEAN fast path (log-based GetLog): my log is gapless through lc
+        # and the primary can delta-replay from there — skip the
+        # O(objects) inventory walk entirely
+        if (not m.force_full and m.primary_last >= 0
+                and lc == pl.last_version()
+                and lc <= m.primary_last
+                and (lc + 1 >= m.primary_floor or lc == m.primary_last)):
+            conn.send(MPGInfo(m.pgid, self.osd_id, -2, {},
+                              dict(self._tombstones.get(m.pgid, {})),
+                              last_complete=lc, lean=True))
+            return
         conn.send(MPGInfo(m.pgid, self.osd_id, -2, self._inventory(m.pgid),
-                          dict(self._tombstones.get(m.pgid, {}))))
+                          dict(self._tombstones.get(m.pgid, {})),
+                          last_complete=lc))
 
     def _handle_pg_info(self, conn, m: MPGInfo) -> None:
-        """Primary: compare a peer's inventory against authority and
-        schedule pushes for missing/stale objects."""
+        """Primary: compare a peer's state against authority and schedule
+        recovery — by log replay (delta) when the peer's last-complete is
+        inside our log window, by inventory compare otherwise."""
         if self.osdmap is None or m.pgid.pool not in self.osdmap.pools:
             return
         pool = self.osdmap.pools[m.pgid.pool]
@@ -1520,28 +1648,108 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         my_best: dict[str, int] = {}
         for (name, _s), v in my_inv.items():
             my_best[name] = max(my_best.get(name, -1), v)
-        stale = self._stale_objects.setdefault(m.pgid, set())
+        stale = self._stale_objects.setdefault(m.pgid, {})
         for (name, _s), v in peer_inv.items():
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), v)
             if v > my_best.get(name, -1) and dead.get(name, -1) < v:
-                stale.add(name)
+                stale[name] = max(stale.get(name, 0), v)
         waiting = self._peering.get(m.pgid)
+        done_peering = False
         if waiting is not None:
             waiting.discard(m.from_osd)
             if not waiting:
                 del self._peering[m.pgid]
-        if pool.kind == "ec":
-            self._recover_ec(m.pgid, pool, up, m.from_osd, peer_inv, my_inv,
-                             dead)
+                done_peering = True
+        if m.last_complete >= 0:
+            self._peer_lcs.setdefault(m.pgid, {})[m.from_osd] = \
+                m.last_complete
+        if m.lean:
+            self._delta_recover(m.pgid, pool, up, m.from_osd,
+                                m.last_complete, dead)
         else:
-            self._recover_replicated(m.pgid, up, m.from_osd, peer_inv,
-                                     my_inv, dead)
+            self._peer_invs.setdefault(m.pgid, {})[m.from_osd] = peer_inv
+            if pool.kind == "ec":
+                scheduled = self._recover_ec(m.pgid, pool, up, m.from_osd,
+                                             peer_inv, my_inv, dead)
+            else:
+                scheduled = self._recover_replicated(
+                    m.pgid, up, m.from_osd, peer_inv, my_inv, dead)
+            if scheduled == 0 and m.from_osd != self.osd_id and \
+                    m.from_osd in [u for u in up if u is not None]:
+                # verified in sync: checkpoint so future peering rounds
+                # take the lean path
+                self.messenger.send_message(
+                    f"osd.{m.from_osd}",
+                    MPGPush(m.pgid, -2, {}, {},
+                            checkpoint=self._pglog(m.pgid).last_version()))
+        if pool.kind == "ec" and (done_peering
+                                  or m.pgid not in self._peering):
+            # reconcile on completion AND on post-peering updates: a
+            # pre-rollback inventory arriving late must not re-wedge the
+            # stale gate on a version that was rolled back.  Debounced —
+            # a recovery batch triggers one pass, not one per info.
+            now = time.monotonic()
+            if done_peering or \
+                    now - self._reconcile_at.get(m.pgid, 0.0) > 0.25:
+                self._reconcile_at[m.pgid] = now
+                # the lc-based PG-level rollback only trusts a COMPLETE
+                # fresh round (done_peering): partial or cached lc views
+                # must never roll back writes committed since collection
+                self._reconcile_ec(m.pgid, pool, up,
+                                   lc_authority=done_peering)
+
+    def _delta_recover(self, pgid: PgId, pool, up, peer: int,
+                       peer_lc: int, dead: dict) -> None:
+        """Log-based delta recovery: replay MY entries after the peer's
+        last-complete and push exactly those objects (PGLog delta resync
+        instead of whole-inventory backfill)."""
+        pl = self._pglog(pgid)
+        entries = pl.entries_after(peer_lc)
+        if not entries:
+            return
+        self.perf.inc("recovery_delta")
+        names: dict[str, int] = {}
+        removes: dict[str, int] = {}
+        for e in entries:
+            if e.op == "remove":
+                removes[e.oid] = max(removes.get(e.oid, 0), e.version)
+                names.pop(e.oid, None)
+            else:
+                names[e.oid] = max(names.get(e.oid, -1), e.version)
+        for name, v in list(names.items()):
+            if dead.get(name, -1) >= v:
+                removes[name] = dead[name]
+                del names[name]
+        if removes and peer != self.osd_id:
+            self.messenger.send_message(
+                f"osd.{peer}", MPGPush(pgid, -3, {}, removes))
+        if pool.kind == "ec":
+            for shard, osd in enumerate(up):
+                if osd != peer:
+                    continue
+                for name, v in names.items():
+                    self._rebuild_shard(pgid, name, shard, peer, v)
+        else:
+            cid = CollectionId(pgid.pool, pgid.seed)
+            push = {}
+            for name, v in names.items():
+                try:
+                    data = self.store.read(cid,
+                                           ObjectId(name)).to_bytes()
+                    attrs = self.store.getattrs(cid, ObjectId(name))
+                    push[name] = (int(attrs.get("v", v)), data)
+                except NoSuchObject:
+                    continue
+            if push and peer != self.osd_id:
+                self.perf.inc("recovery_push", len(push))
+                self.messenger.send_message(
+                    f"osd.{peer}", MPGPush(pgid, -1, push))
 
     def _recover_replicated(self, pgid, up, peer, peer_inv, my_inv,
-                            dead) -> None:
+                            dead) -> int:
         if peer == self.osd_id:
-            return
+            return 0
         peer_is_member = peer in [u for u in up if u is not None]
         cid = CollectionId(pgid.pool, pgid.seed)
         push, pull, deletes = {}, [], {}
@@ -1574,6 +1782,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             # the primary itself is behind (e.g. revived empty): pull
             self.messenger.send_message(
                 f"osd.{peer}", MPGPull(pgid, pull))
+        return len(push) + len(deletes) + len(pull)
 
     def _handle_pg_pull(self, conn, m: MPGPull) -> None:
         cid = CollectionId(m.pgid.pool, m.pgid.seed)
@@ -1589,8 +1798,11 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             conn.send(MPGPush(m.pgid, -1, push, force=m.force))
 
     def _recover_ec(self, pgid, pool, up, peer, peer_inv, my_inv,
-                    dead) -> None:
-        """Rebuild missing shards on `peer` from k survivors."""
+                    dead) -> int:
+        """Rebuild missing shards on `peer` from k survivors.  Returns
+        how much recovery work was scheduled (0 = peer verified in
+        sync)."""
+        scheduled = 0
         # authority object set: union of all shard inventories we know of
         # (primary's own + this peer's); keyed by name -> version
         names: dict[str, int] = {}
@@ -1614,6 +1826,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             if peer != self.osd_id:
                 self.messenger.send_message(
                     f"osd.{peer}", MPGPush(pgid, -3, {}, deletes))
+        scheduled += len(deletes)
         if peer not in [u for u in up if u is not None]:
             # demoted holder (notify path): migrate its stranded shards to
             # the current position holders; the version gate on the push
@@ -1625,13 +1838,15 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 if holder is None or holder == peer:
                     continue
                 self._fetch_and_push(pgid, name, shard, peer, holder, v)
-            return
+                scheduled += 1
+            return scheduled
         for shard, osd in enumerate(up):
             if osd == peer:
                 for name, version in names.items():
                     if peer_inv.get((name, shard), -1) >= version:
                         continue  # peer current for its shard
                     self._rebuild_shard(pgid, name, shard, peer, version)
+                    scheduled += 1
             elif osd == self.osd_id:
                 # the peer's inventory may reveal objects where MY OWN
                 # shard is missing/stale (e.g. primary revived empty)
@@ -1640,6 +1855,166 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                         continue
                     self._rebuild_shard(pgid, name, shard, self.osd_id,
                                         version)
+                    scheduled += 1
+        return scheduled
+
+    def _reconcile_ec(self, pgid: PgId, pool, up,
+                      lc_authority: bool = False) -> None:
+        """After a peering round collected full inventories: find torn
+        objects — ones whose newest version has FEWER than k shards (a
+        partial write the stripe can never decode) — and roll the ahead
+        shards back to the newest version k shards can serve (the EC
+        rollback/rollforward decision of PGLog + rollback generations)."""
+        codec = self._pool_codec(pgid.pool)
+        invs = dict(self._peer_invs.get(pgid, {}))
+        invs[self.osd_id] = self._inventory(pgid)
+        holders = {shard: osd for shard, osd in enumerate(up)
+                   if osd is not None}
+        # PG-LEVEL torn detection from log positions (covers lean peers
+        # whose inventories never traveled): the stripe can only decode
+        # through the k-th highest last-complete; any member logged past
+        # that point applied writes the stripe can never serve
+        lcs = dict(self._peer_lcs.get(pgid, {}))
+        lcs[self.osd_id] = self._lc(pgid)
+        # only members with log EVIDENCE count: a freshly promoted spare
+        # (lc 0, empty log) never saw the writes — its emptiness must not
+        # drag the decode point down and roll back COMMITTED data on the
+        # survivors (that would destroy the very shards recovery needs)
+        member_lcs = {osd: lc for osd, lc in lcs.items()
+                      if osd in holders.values() and lc > 0}
+        if lc_authority and len(member_lcs) >= codec.k:
+            decode_point = sorted(member_lcs.values(),
+                                  reverse=True)[codec.k - 1]
+            # versions past the decode point are being rolled back: stop
+            # gating reads on pushes that will never come
+            stale = self._stale_objects.get(pgid)
+            if stale:
+                for name, ver in list(stale.items()):
+                    if ver > decode_point:
+                        stale.pop(name)
+            for osd, lc in member_lcs.items():
+                if lc <= decode_point:
+                    continue
+                dout("osd", 1)("%s: %s member osd.%d logged to v%d past "
+                               "decode point v%d: rolling back",
+                               self.name, pgid, osd, lc, decode_point)
+                msg = MPGRollback(pgid, "", -3, decode_point)
+                if osd == self.osd_id:
+                    self._handle_pg_rollback(None, msg)
+                else:
+                    self.messenger.send_message(f"osd.{osd}", msg)
+        # per object: shard -> newest version any inventory reports
+        per_obj: dict[str, dict[int, int]] = {}
+        for _osd, inv in invs.items():
+            for (name, shard), v in inv.items():
+                if shard < 0:
+                    continue
+                cur = per_obj.setdefault(name, {})
+                cur[shard] = max(cur.get(shard, -1), v)
+        dead = self._tombstones.get(pgid, {})
+        for name, vs in per_obj.items():
+            if not vs or dead.get(name, -1) >= max(vs.values()):
+                continue
+            vmax = max(vs.values())
+            if sum(1 for v in vs.values() if v == vmax) >= codec.k:
+                continue  # newest version decodable: roll-forward path
+            # newest version k shards hold EXACTLY (shards at a newer
+            # version carry different bytes and only help if they roll
+            # back, which is what we're about to ask of them)
+            target = max((v for v in set(vs.values())
+                          if sum(1 for x in vs.values() if x == v)
+                          >= codec.k), default=None)
+            if target is None or target == vmax:
+                continue  # nothing decodable — scrub/EIO territory
+            dout("osd", 1)("%s: torn EC object %s/%s: rolling %s back "
+                           "to v%d", self.name, pgid, name,
+                           [s for s, v in vs.items() if v > target],
+                           target)
+            for shard, v in vs.items():
+                if v <= target or shard not in holders:
+                    continue
+                holder = holders[shard]
+                msg = MPGRollback(pgid, name, shard, target)
+                if holder == self.osd_id:
+                    self._handle_pg_rollback(None, msg)
+                else:
+                    self.messenger.send_message(f"osd.{holder}", msg)
+
+    def _handle_pg_rollback(self, conn, m: MPGRollback) -> None:
+        """Shard holder: undo applies on `oid` past to_version using the
+        pglog pre-images; without pre-images, drop the shard copy so
+        recovery rebuilds it from the version k shards agree on."""
+        self.perf.inc("rollbacks")
+        cid = CollectionId(m.pgid.pool, m.pgid.seed)
+        pl = self._pglog(m.pgid)
+        if m.oid == "":
+            # PG-level: undo EVERYTHING this shard logged past the
+            # decode point (first entry past the point per object gives
+            # the version to return to)
+            span = sorted((e for e in pl.entries()
+                           if e.version > m.to_version),
+                          key=lambda e: e.version)
+            firsts: dict[tuple, LogEntry] = {}
+            for e in span:
+                firsts.setdefault((e.oid, e.shard), e)
+            for (oid, shard), first in firsts.items():
+                # PG-level undo is PRE-IMAGE ONLY: dropping a full-write
+                # shard here could destroy the only copy of its position
+                # without verifying the target version is decodable —
+                # that call belongs to the per-object reconcile, which
+                # checks k-support first
+                self._rollback_one(m.pgid, pl, cid, oid, shard,
+                                   first.prev_version, allow_drop=False)
+        else:
+            self._rollback_one(m.pgid, pl, cid, m.oid, m.shard,
+                               m.to_version)
+        if self._lc(m.pgid) > m.to_version:
+            self._set_lc(m.pgid, m.to_version)
+        # surface the new state to the primary so it can verify/rebuild
+        if self.osdmap is None or m.pgid.pool not in self.osdmap.pools:
+            return
+        up = self.osdmap.pg_to_up_osds(m.pgid.pool, m.pgid.seed)
+        primary = self._primary_of(up)
+        if primary is None:
+            return
+        info = self._my_pg_info(m.pgid)
+        if primary == self.osd_id:
+            self._handle_pg_info(None, info)
+        else:
+            self.messenger.send_message(f"osd.{primary}", info)
+
+    def _rollback_one(self, pgid: PgId, pl: PGLog, cid, oid: str,
+                      shard: int, to_version: int,
+                      allow_drop: bool = True) -> None:
+        """Undo one object's applies past to_version: pre-images when
+        stashed, else (allow_drop) drop the shard copy for rebuild;
+        to_version < 0 means the object was CREATED past the point —
+        drop it."""
+        obj = ObjectId(oid, shard=shard)
+        ok = False
+        if to_version >= 0:
+            try:
+                ok = pl.rollback_object(oid, shard, to_version)
+            except Exception as e:  # noqa: BLE001
+                dout("osd", 1)("%s: rollback %s/%s failed: %r", self.name,
+                               pgid, oid, e)
+        if not ok and not allow_drop:
+            return
+        if not ok:
+            span = [e for e in pl.entries_for(oid)
+                    if e.shard == shard and e.version > max(to_version, -1)]
+            tx = Transaction()
+            if self.store.exists(cid, obj):
+                tx.remove(cid, obj)
+            if span:
+                from .pglog import _key
+                tx.omap_rmkeys(cid, PGLOG_OID,
+                               [_key(e.version) for e in span])
+            if tx.ops:
+                self.store.queue_transaction(tx)
+        dout("osd", 2)("%s: rolled %s/%s shard %d back to v%d (%s)",
+                       self.name, pgid, oid, shard, to_version,
+                       "pre-images" if ok else "dropped for rebuild")
 
     def _fetch_and_push(self, pgid, name, shard, src: int, dst: int,
                         version: int) -> None:
@@ -1758,26 +2133,40 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         stale = self._stale_objects.get(m.pgid)
         if stale:
             for name in list(m.objects) + list(m.deletes):
-                stale.discard(name)
+                stale.pop(name, None)
+        if m.checkpoint >= 0 and m.checkpoint > self._lc(m.pgid):
+            # the primary verified we need nothing through this version:
+            # future peering rounds can take the lean (log) path
+            self._set_lc(m.pgid, m.checkpoint)
         # if I am this PG's primary, newly-landed data may need forwarding
         # to members whose inventories were processed earlier: re-query,
         # debounced so a recovery batch triggers one round, not O(objects)
         self._requery_pg(m.pgid)
 
-    def _requery_pg(self, pgid: PgId) -> None:
+    def _requery_pg(self, pgid: PgId, force_full: bool = False) -> None:
         """Primary: re-run the inventory exchange for one PG (debounced)
         so recovery reconciles stale/missing shards without waiting for
-        the next map epoch."""
+        the next map epoch.  force_full demands inventories even from
+        in-sync (lean) peers — needed when a version-split read shows
+        the PG is torn and reconciliation requires the full picture."""
         if self.osdmap is None or pgid.pool not in self.osdmap.pools:
             return
         now = time.monotonic()
-        if now - self._requery_at.get(pgid, 0.0) < 0.2:
+        # force_full has its own debounce lane so a routine requery just
+        # before it cannot swallow the full-inventory demand
+        key = (pgid, force_full)
+        if now - self._requery_at.get(key, 0.0) < 0.2:
             return
         up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
         if self._primary_of(up) != self.osd_id:
             return
-        self._requery_at[pgid] = now
+        self._requery_at[key] = now
+        pl = self._pglog(pgid)
         for osd in up:
             if osd is not None and osd != self.osd_id:
                 self.messenger.send_message(
-                    f"osd.{osd}", MPGQuery(pgid, self.osdmap.epoch))
+                    f"osd.{osd}",
+                    MPGQuery(pgid, self.osdmap.epoch,
+                             primary_last=pl.last_version(),
+                             primary_floor=pl.floor(),
+                             force_full=force_full))

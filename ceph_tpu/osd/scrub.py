@@ -53,6 +53,8 @@ class ScrubMixin:
         except Exception:  # noqa: BLE001 - no collection yet
             return out
         for oid in oids:
+            if oid.shard <= -2:
+                continue  # PG metadata (pglog), not user data
             try:
                 attrs = self.store.getattrs(cid, oid)
                 entry = {"size": self.store.stat(cid, oid)["size"],

@@ -77,6 +77,7 @@ def _sample(cls):
                                                b"v9")]),
         M.MMonForward: M.MMonForward("client.0", b"\x01\x02frame"),
         M.MMonFwdReply: M.MMonFwdReply("client.0", b"\x03frame"),
+        M.MPGRollback: M.MPGRollback(pg, "obj", 3, 7),
     }
     return samples[cls]
 
