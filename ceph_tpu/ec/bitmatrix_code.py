@@ -1,0 +1,189 @@
+"""GF(2) bit-matrix erasure codes — the liberation-family technique path.
+
+The capability of jerasure's packed-word bit-matrix techniques
+(/root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.h:135-336:
+liberation, blaum_roth, liber8tion — RAID-6 codes whose schedules are
+pure XOR over w sub-stripes per chunk).  The reference's actual
+matrices live in the absent jerasure submodule; here each technique is
+an OWN construction with the same parameter envelope and the same
+execution shape: a (w·m, w·k) GF(2) matrix applied as XORs of packet
+rows — which is also exactly the formulation the MXU bitmatrix kernel
+executes (ops/ec_kernels.py:88).
+
+Packetization is GRANULE-LOCAL: the byte stream is processed in
+independent granules of w·SIMD_ALIGN bytes, each split into w packets.
+Any granule-aligned sub-range therefore encodes identically to the same
+bytes inside a larger call — the property the OSD's row-ranged encode
+relies on (a whole-object encode and a later row rmw must agree).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .interface import (ChunkMap, ErasureCode, ErasureCodeError, Flags,
+                        SIMD_ALIGN)
+
+# primitive polynomials over GF(2) for the word sizes the techniques use
+_POLYS = {4: 0x13, 5: 0x25, 6: 0x43, 7: 0x89, 8: 0x11D}
+
+
+def gfw_mul(a: int, b: int, w: int) -> int:
+    """Carry-less multiply mod the primitive polynomial of GF(2^w)."""
+    poly = _POLYS[w]
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a >> w:
+            a ^= poly
+    return r
+
+
+def element_bitmatrix(e: int, w: int) -> np.ndarray:
+    """The w x w GF(2) matrix of multiply-by-e in GF(2^w): column j is
+    the bit vector of e * x^j (the companion-matrix representation that
+    turns field math into XOR schedules)."""
+    M = np.zeros((w, w), dtype=np.uint8)
+    for j in range(w):
+        v = gfw_mul(e, 1 << j, w)
+        for i in range(w):
+            M[i, j] = (v >> i) & 1
+    return M
+
+
+def raid6_bitmatrix(k: int, w: int) -> np.ndarray:
+    """(2w, kw) bit-matrix of the RAID-6 pair over GF(2^w):
+    P = XOR of all data, Q = sum alpha^i * d_i  (alpha = x, primitive).
+    MDS for k <= 2^w - 1: every 2x2 minor of [[1..1],[a^i]] inverts."""
+    if k > (1 << w) - 1:
+        raise ErasureCodeError(f"k={k} > {(1 << w) - 1} for w={w}")
+    B = np.zeros((2 * w, k * w), dtype=np.uint8)
+    ident = np.eye(w, dtype=np.uint8)
+    alpha_i = 1
+    for i in range(k):
+        B[:w, i * w:(i + 1) * w] = ident
+        B[w:, i * w:(i + 1) * w] = element_bitmatrix(alpha_i, w)
+        alpha_i = gfw_mul(alpha_i, 2, w)
+    return B
+
+
+def _gf2_invert(M: np.ndarray) -> np.ndarray:
+    """Invert a square GF(2) matrix (Gauss-Jordan over bits)."""
+    n = M.shape[0]
+    A = np.concatenate([M.astype(np.uint8) % 2,
+                        np.eye(n, dtype=np.uint8)], axis=1)
+    for col in range(n):
+        piv = next((r for r in range(col, n) if A[r, col]), None)
+        if piv is None:
+            raise ErasureCodeError("bitmatrix not invertible")
+        if piv != col:
+            A[[col, piv]] = A[[piv, col]]
+        rows = [r for r in range(n) if r != col and A[r, col]]
+        A[rows] ^= A[col]
+    return A[:, n:]
+
+
+class BitMatrixErasureCode(ErasureCode):
+    """Systematic GF(2) bit-matrix code executed as XORs of packet rows.
+
+    Subclasses set self.w and self.bitmatrix ((w*m, w*k)) in
+    _init_from_profile.  Chunks are processed in granules of
+    w*SIMD_ALIGN bytes; every chunk length must be granule-aligned
+    (get_chunk_size/minimum granularity enforce it)."""
+
+    w: int
+    bitmatrix: np.ndarray
+
+    def _init_bitmatrix(self) -> None:
+        # validate the backend name like the matrix codes do; execution
+        # is the vectorized numpy XOR path for every backend this round
+        # (the MXU bit-matrix formulation of ops/ec_kernels.py is the
+        # acceleration seam)
+        from .matrix_code import _pick_backend
+        self._backend = _pick_backend(self.profile.get("backend", "auto"))
+        self._granule = self.w * SIMD_ALIGN
+        self._decode_cache: dict[tuple, np.ndarray] = {}
+
+    def get_flags(self) -> Flags:
+        # no PARITY_DELTA: a parity byte depends on data bytes at OTHER
+        # offsets (cross-packet mixing), so the view-positional delta
+        # contract of the matrix codes does not hold — overwrites take
+        # the rmw path
+        return Flags.ZERO_PADDING
+
+    def get_minimum_granularity(self) -> int:
+        return self._granule
+
+    def get_chunk_size(self, stripe_width: int) -> int:
+        per = -(-stripe_width // self.k)
+        return -(-per // self._granule) * self._granule
+
+    # -- packet algebra ----------------------------------------------------
+    def _rows(self, chunks: np.ndarray) -> np.ndarray:
+        """(n, L) chunks -> (G, n*w, S) packet rows per granule."""
+        n, L = chunks.shape
+        if L % self._granule:
+            raise ErasureCodeError(
+                f"chunk length {L} not a multiple of the {self._granule}"
+                f"-byte granule (w={self.w})")
+        g = L // self._granule
+        return chunks.reshape(n, g, self.w, SIMD_ALIGN) \
+            .transpose(1, 0, 2, 3).reshape(g, n * self.w, SIMD_ALIGN)
+
+    def _unrows(self, rows: np.ndarray, n: int) -> np.ndarray:
+        g = rows.shape[0]
+        return rows.reshape(g, n, self.w, SIMD_ALIGN) \
+            .transpose(1, 0, 2, 3).reshape(n, g * self._granule)
+
+    def _apply_bits(self, B: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        """out[:, r] = XOR of rows[:, c] where B[r, c] — per granule."""
+        g, _nr, s = rows.shape
+        out = np.zeros((g, B.shape[0], s), dtype=np.uint8)
+        for r in range(B.shape[0]):
+            idx = np.nonzero(B[r])[0]
+            if idx.size:
+                out[:, r] = np.bitwise_xor.reduce(rows[:, idx], axis=1)
+        return out
+
+    # -- encode/decode -----------------------------------------------------
+    def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
+        rows = self._rows(np.ascontiguousarray(data_chunks,
+                                               dtype=np.uint8))
+        parity = self._apply_bits(self.bitmatrix, rows)
+        return self._unrows(parity, self.m)
+
+    def _decode_combo(self, want: tuple, avail: tuple) -> np.ndarray:
+        """Combination matrix mapping avail shards' packet rows to the
+        wanted shards' packet rows (cached per erasure signature)."""
+        key = (want, avail)
+        C = self._decode_cache.get(key)
+        if C is not None:
+            return C
+        w, k = self.w, self.k
+        full = np.concatenate([np.eye(k * w, dtype=np.uint8),
+                               self.bitmatrix], axis=0)
+        S = np.concatenate([full[s * w:(s + 1) * w] for s in avail])
+        R = _gf2_invert(S)
+        Wm = np.concatenate([full[s * w:(s + 1) * w] for s in want])
+        C = (Wm.astype(np.uint8) @ R.astype(np.uint8)) % 2
+        if len(self._decode_cache) > 64:
+            self._decode_cache.pop(next(iter(self._decode_cache)))
+        self._decode_cache[key] = C
+        return C
+
+    def decode_chunks(self, want, chunks: ChunkMap) -> ChunkMap:
+        avail = tuple(sorted(chunks))[: self.k]
+        if len(avail) < self.k:
+            raise ErasureCodeError(
+                f"need {self.k} shards, have {sorted(chunks)}")
+        wanted = tuple(sorted(want))
+        C = self._decode_combo(wanted, avail)
+        data = np.stack([np.asarray(chunks[s], dtype=np.uint8)
+                         for s in avail])
+        rows = self._rows(data)
+        out_rows = self._apply_bits(C, rows)
+        out = self._unrows(out_rows, len(wanted))
+        return {s: out[i] for i, s in enumerate(wanted)}

@@ -14,9 +14,13 @@ codeword of a scalar (n, k) MDS code.  Single-node repair with d = n-1
 helpers reads only alpha/q sub-chunks from each helper (the MSR bandwidth
 point) instead of whole chunks.
 
-Round-1 scope: q*t == n configurations (covers the BASELINE config
-k=8 m=4 d=11 -> q=4, t=3, alpha=64) and d = n-1 repair; other (k, m, d)
-raise with a clear message.
+Shortening (ref ErasureCodeClay.cc nu handling): when q = d-k+1 does
+not divide n, the grid is built over n + nu nodes with nu VIRTUAL
+all-zero data nodes (internal ids [k, k+nu)); the scalar plane code is
+(k+nu+m, k+nu) MDS.  External chunk ids stay [0, n): data i maps to
+internal i, parity j to internal k+nu+j.  The MSR sub-chunk repair
+path applies when d = k+m-1 (m == q); other valid d fall back to full
+MDS decode (correct, not bandwidth-optimal).
 """
 
 from __future__ import annotations
@@ -48,16 +52,19 @@ class ClayCode(MatrixErasureCode):
         if not self.k < self.d <= n - 1:
             raise ErasureCodeError(f"need k < d <= k+m-1, got d={self.d}")
         self.q = self.d - self.k + 1
-        if n % self.q:
-            raise ErasureCodeError(
-                f"clay (TPU build) needs q=d-k+1 ({self.q}) to divide "
-                f"k+m ({n}); shortened configs are future work")
-        self.t = n // self.q
+        if self.q < 2:
+            raise ErasureCodeError(f"d={self.d} gives q={self.q} < 2")
+        # shortening: pad the grid with nu virtual zero data nodes so q
+        # divides the internal node count
+        self.nu = (self.q - n % self.q) % self.q
+        self.k_int = self.k + self.nu
+        self.n_int = n + self.nu
+        self.t = self.n_int // self.q
         self.alpha = self.q ** self.t
-        # scalar MDS code across each z-plane
-        self.matrix = gf256.vandermonde_matrix(self.k, self.m)
+        # scalar MDS code across each z-plane (over internal data)
+        self.matrix = gf256.vandermonde_matrix(self.k_int, self.m)
         self.full = np.concatenate(
-            [np.eye(self.k, dtype=np.uint8), self.matrix])
+            [np.eye(self.k_int, dtype=np.uint8), self.matrix])
         # parity-check H = [P | I]: H @ u = 0 for plane codewords
         self.H = np.concatenate(
             [self.matrix, np.eye(self.m, dtype=np.uint8)], axis=1)
@@ -82,6 +89,13 @@ class ClayCode(MatrixErasureCode):
         return -(-base // quantum) * quantum
 
     # -- coordinate helpers ------------------------------------------------
+    def _ext2int(self, i: int) -> int:
+        """External chunk id -> internal grid node (skip virtual pads)."""
+        return i if i < self.k else i + self.nu
+
+    def _virtual(self, node: int) -> bool:
+        return self.k <= node < self.k_int
+
     def _xy(self, node: int) -> tuple[int, int]:
         return node % self.q, node // self.q
 
@@ -110,10 +124,11 @@ class ClayCode(MatrixErasureCode):
     # -- core: recover erased C given alive C (also the encode) ------------
     def _decode_symbols(self, C: dict[int, np.ndarray],
                         erased: list[int], L: int) -> dict[int, np.ndarray]:
-        """C: alive node -> (alpha, L) sub-chunk array.  Returns C for
-        erased nodes.  IS-ordered plane-by-plane recovery of the uncoupled
-        codeword U, then re-coupling."""
-        n = self.k + self.m
+        """C: alive INTERNAL node -> (alpha, L) sub-chunk array (virtual
+        pads included as zeros).  Returns C for erased nodes.  IS-ordered
+        plane-by-plane recovery of the uncoupled codeword U, then
+        re-coupling."""
+        n = self.n_int
         q, t, alpha = self.q, self.t, self.alpha
         E = set(erased)
         if len(E) > self.m:
@@ -126,9 +141,9 @@ class ClayCode(MatrixErasureCode):
 
         planes = sorted(range(alpha), key=IS)
         alive = [i for i in range(n) if i not in E]
-        # decode matrix: recover erased U symbols of a plane from k alive
-        use = alive[: self.k]
-        D = gf256.decode_matrix(self.matrix, self.k, use)
+        # decode matrix: recover erased U of a plane from k_int alive
+        use = alive[: self.k_int]
+        D = gf256.decode_matrix(self.matrix, self.k_int, use)
         F_er = self.full[sorted(E)] if E else None
         for z in planes:
             # 1) U of alive nodes in this plane
@@ -176,6 +191,9 @@ class ClayCode(MatrixErasureCode):
         return np.ascontiguousarray(chunk, dtype=np.uint8).reshape(
             self.alpha, L // self.alpha)
 
+    def _zero_split(self, L: int) -> np.ndarray:
+        return np.zeros((self.alpha, L // self.alpha), dtype=np.uint8)
+
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
         if data_chunks.shape[0] != self.k:
@@ -183,10 +201,12 @@ class ClayCode(MatrixErasureCode):
                 f"expected {self.k} data chunks, got {data_chunks.shape[0]}")
         L = data_chunks.shape[1]
         C = {i: self._split(data_chunks[i]) for i in range(self.k)}
+        for v in range(self.k, self.k_int):  # shortened: virtual zeros
+            C[v] = self._zero_split(L)
         parity = self._decode_symbols(
-            C, list(range(self.k, self.k + self.m)), L // self.alpha)
-        return np.stack([parity[i].reshape(L)
-                         for i in range(self.k, self.k + self.m)])
+            C, list(range(self.k_int, self.n_int)), L // self.alpha)
+        return np.stack([parity[self.k_int + j].reshape(L)
+                         for j in range(self.m)])
 
     def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
         avail = {i: c for i, c in chunks.items() if i < self.chunk_count}
@@ -194,20 +214,25 @@ class ClayCode(MatrixErasureCode):
         if not missing:
             return {i: chunks[i] for i in want}
         L = next(iter(avail.values())).shape[-1]
-        C = {i: self._split(np.asarray(c)) for i, c in avail.items()}
+        C = {self._ext2int(i): self._split(np.asarray(c))
+             for i, c in avail.items()}
+        for v in range(self.k, self.k_int):
+            C[v] = self._zero_split(L)
         # all erased nodes must be recovered together (coupling crosses them)
-        erased = [i for i in range(self.chunk_count) if i not in avail]
+        erased = [self._ext2int(i) for i in range(self.chunk_count)
+                  if i not in avail]
         rec = self._decode_symbols(C, erased, L // self.alpha)
         out: ChunkMap = {}
         for i in want:
-            out[i] = chunks[i] if i in avail else rec[i].reshape(L)
+            out[i] = chunks[i] if i in avail \
+                else rec[self._ext2int(i)].reshape(L)
         return out
 
     # -- MSR repair (d = n-1): the sub-chunk bandwidth win -----------------
     def repair_planes(self, lost: int) -> list[int]:
         """Planes (sub-chunk indices) each helper must send to repair
-        `lost` — alpha/q of them (z_y0 == x0)."""
-        x0, y0 = self._xy(lost)
+        EXTERNAL chunk `lost` — alpha/q of them (z_y0 == x0)."""
+        x0, y0 = self._xy(self._ext2int(lost))
         return [z for z in range(self.alpha)
                 if self._digit(z, y0) == x0]
 
@@ -231,24 +256,36 @@ class ClayCode(MatrixErasureCode):
     def repair_chunk(self, lost: int,
                      helper_subchunks: dict[int, np.ndarray],
                      L: int) -> np.ndarray:
-        """Repair one lost chunk from helpers' alpha/q sub-chunk slices
-        (each helper i supplies array (alpha/q, L/alpha) — its planes
-        repair_planes(lost), in that order)."""
-        n = self.k + self.m
+        """Repair one lost EXTERNAL chunk from helpers' alpha/q sub-chunk
+        slices (each helper i supplies array (alpha/q, L/alpha) — its
+        planes repair_planes(lost), in that order)."""
+        if self.m != self.q:
+            raise ErasureCodeError(
+                "sub-chunk repair applies when d = k+m-1 (m == q); use "
+                "decode_chunks otherwise")
+        n_ext = self.chunk_count
         q, alpha = self.q, self.alpha
-        x0, y0 = self._xy(lost)
+        lost_i = self._ext2int(lost)
+        x0, y0 = self._xy(lost_i)
         planes = self.repair_planes(lost)
-        if set(helper_subchunks) != {i for i in range(n) if i != lost}:
-            raise ErasureCodeError("d = n-1 repair needs all other nodes")
+        if set(helper_subchunks) != {i for i in range(n_ext) if i != lost}:
+            raise ErasureCodeError("repair needs all other real nodes")
         Ls = L // alpha
         zpos = {z: i for i, z in enumerate(planes)}
-        # C values of helpers on repair planes
-        def Ch(node: int, z: int) -> np.ndarray:
-            return helper_subchunks[node][zpos[z]]
+        zero = np.zeros(Ls, dtype=np.uint8)
+        by_int = {self._ext2int(i): s for i, s in helper_subchunks.items()}
 
-        # 1) U of helpers outside column y0 (pairs stay inside P)
+        # C values of helper nodes on repair planes (virtuals are zero)
+        def Ch(node: int, z: int) -> np.ndarray:
+            if self._virtual(node):
+                return zero
+            return by_int[node][zpos[z]]
+
+        # 1) U of nodes outside column y0 (pairs stay inside P)
         U = {}
-        for node in helper_subchunks:
+        for node in range(self.n_int):
+            if node == lost_i:
+                continue
             x, y = self._xy(node)
             if y == y0:
                 continue
@@ -262,9 +299,10 @@ class ClayCode(MatrixErasureCode):
                     U[(node, z)] = self._gmul(self._inv_det, both)
         # 2) per plane: solve the q unknown U of column y0 via parity checks
         col_nodes = [self._node(x, y0) for x in range(q)]
-        Hcol = self.H[:, col_nodes]  # (m, q); m == q for d = n-1
+        Hcol = self.H[:, col_nodes]  # (m, q); square since m == q
         Hinv = gf256.gf_mat_inv(Hcol)
-        other_nodes = [i for i in range(n) if i not in col_nodes]
+        other_nodes = [i for i in range(self.n_int)
+                       if i not in col_nodes]
         Hoth = self.H[:, other_nodes]
         for z in planes:
             rhs = gf256.gf_matmul(
@@ -276,7 +314,7 @@ class ClayCode(MatrixErasureCode):
         out = np.zeros((alpha, Ls), dtype=np.uint8)
         for z in range(alpha):
             if self._digit(z, y0) == x0:
-                out[z] = U[(lost, z)]  # diagonal: C == U
+                out[z] = U[(lost_i, z)]  # diagonal: C == U
             else:
                 x = self._digit(z, y0)
                 helper = self._node(x, y0)

@@ -12,9 +12,12 @@ Techniques:
 - reed_sol_r6_op — RAID-6 specialisation (m=2): P = XOR, Q = sum 2^j d_j
 - cauchy_orig    — Cauchy matrix, jerasure point convention
 - cauchy_good    — Cauchy matrix, bit-matrix density optimised
-- liberation / blaum_roth / liber8tion — packed-word bit-matrix codes of the
-  reference; NOT implemented (w in {7, 31, 8-with-bitpacking} schedules are
-  CPU-word-oriented and off the TPU design path) — selecting them raises.
+- liberation / blaum_roth / liber8tion — RAID-6 (m=2) GF(2) bit-matrix
+  schedules over w sub-stripe packets (w=7 / w=6 / w=8 respectively, the
+  per-technique word-size envelopes of the reference).  The reference's
+  exact matrices live in the absent jerasure submodule; these are own
+  constructions (companion-matrix P/Q pairs, provably MDS) with the same
+  XOR-schedule execution shape — see ec/bitmatrix_code.py.
 """
 
 from __future__ import annotations
@@ -22,6 +25,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import gf256
+from .bitmatrix_code import BitMatrixErasureCode, raid6_bitmatrix
 from .interface import ErasureCodeError, profile_int
 from .matrix_code import MatrixErasureCode
 from .registry import register
@@ -33,9 +37,9 @@ DEFAULT_M = 3
 
 TECHNIQUES = ("reed_sol_van", "reed_sol_r6_op", "cauchy_orig", "cauchy_good",
               "liberation", "blaum_roth", "liber8tion")
+BIT_TECHNIQUES = {"liberation": 7, "blaum_roth": 6, "liber8tion": 8}
 
 
-@register("jerasure")
 class JerasureCode(MatrixErasureCode):
     def _init_from_profile(self) -> None:
         self.k = profile_int(self.profile, "k", DEFAULT_K)
@@ -46,8 +50,6 @@ class JerasureCode(MatrixErasureCode):
                 f"w={w} unsupported: the TPU build implements GF(2^8) only "
                 "(byte-oriented; other word sizes are CPU-schedule oriented)")
         self.technique = self.profile.get("technique", "reed_sol_van")
-        if self.technique not in TECHNIQUES:
-            raise ErasureCodeError(f"unknown technique {self.technique!r}")
         if self.technique == "reed_sol_van":
             self.matrix = gf256.vandermonde_matrix(self.k, self.m)
         elif self.technique == "reed_sol_r6_op":
@@ -59,10 +61,40 @@ class JerasureCode(MatrixErasureCode):
             self.matrix = M
         elif self.technique == "cauchy_orig":
             self.matrix = gf256.cauchy_matrix(self.k, self.m)
-        elif self.technique == "cauchy_good":
+        else:  # cauchy_good
             self.matrix = gf256.cauchy_good_matrix(self.k, self.m)
-        else:
-            raise ErasureCodeError(
-                f"technique {self.technique!r} is not implemented in the "
-                "TPU build (bit-packed word schedule)")
         self._init_matrix_backend()
+
+
+class JerasureBitCode(BitMatrixErasureCode):
+    """The liberation-family techniques: RAID-6 XOR schedules over w
+    packets per chunk (ref ErasureCodeJerasure.h:238-336 envelope)."""
+
+    def _init_from_profile(self) -> None:
+        self.k = profile_int(self.profile, "k", DEFAULT_K)
+        self.m = profile_int(self.profile, "m", 2)
+        self.technique = self.profile["technique"]
+        default_w = BIT_TECHNIQUES[self.technique]
+        self.w = profile_int(self.profile, "w", default_w)
+        if self.m != 2:
+            raise ErasureCodeError(
+                f"{self.technique} is a RAID-6 technique: m must be 2")
+        if self.technique == "liberation" and self.w not in (5, 7):
+            raise ErasureCodeError("liberation needs prime w (5 or 7)")
+        if self.technique == "blaum_roth" and self.w not in (4, 6):
+            raise ErasureCodeError("blaum_roth needs w with w+1 prime "
+                                   "(4 or 6)")
+        if self.technique == "liber8tion" and self.w != 8:
+            raise ErasureCodeError("liber8tion is defined for w=8")
+        self.bitmatrix = raid6_bitmatrix(self.k, self.w)
+        self._init_bitmatrix()
+
+
+@register("jerasure")
+def _jerasure_factory(profile):
+    technique = dict(profile).get("technique", "reed_sol_van")
+    if technique not in TECHNIQUES:
+        raise ErasureCodeError(f"unknown technique {technique!r}")
+    if technique in BIT_TECHNIQUES:
+        return JerasureBitCode(profile)
+    return JerasureCode(profile)

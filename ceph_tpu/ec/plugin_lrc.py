@@ -2,19 +2,28 @@
 
 The capability of the reference's lrc plugin
 (/root/reference/src/erasure-code/lrc/ErasureCodeLrc.{h,cc}: layered
-chunk-pattern profiles, minimum_to_decode preferring the cheapest layer).
-This build implements the common simple form `k=K m=M l=L`: K data chunks,
-M global Reed-Solomon parities, and one local XOR parity per group of L
-consecutive chunks taken over the (data + global) sequence — so a single
-lost chunk repairs from its L-1 group neighbours instead of K chunks
-(the locality win), and multi-failures fall back to the global layer.
+chunk-pattern profiles ErasureCodeLrc.h:48-163, minimum_to_decode
+preferring the cheapest layer).  Two profile forms:
 
-Chunk layout: [0..k) data, [k..k+m) global parity,
-[k+m..k+m+(k+m)/l) local parity (group g covers chunks [g*l, (g+1)*l)).
-Requires l to divide k+m.
+1. the simple form `k=K m=M l=L`: K data chunks, M global Reed-Solomon
+   parities, and one local XOR parity per group of L consecutive chunks
+   over the (data + global) sequence;
+2. the LAYERS grammar: `mapping=` gives the chunk roles ('D' data, '_'
+   coding/local), `layers=` is a JSON list of [chunk-pattern, config]
+   pairs applied in order — each pattern marks its layer's inputs 'D'
+   and outputs 'c' ('_' not in layer), and the config picks the inner
+   plugin/technique for that layer.  Layer outputs may feed later
+   layers (the reference's pyramid/composition semantics); every
+   coding position must be produced by exactly one layer.
+
+Single failures repair from the smallest equation covering the chunk
+(the cheapest-layer rule); multi-failures fall back to rank-greedy
+selection over the full generator stack.
 """
 
 from __future__ import annotations
+
+import json
 
 import numpy as np
 
@@ -29,6 +38,9 @@ PLUGIN_API_VERSION = 1
 @register("lrc")
 class LrcCode(GeneralMatrixCode):
     def _init_from_profile(self) -> None:
+        if "layers" in self.profile:
+            self._init_layers()
+            return
         self.k = profile_int(self.profile, "k", 4)
         self.global_m = profile_int(self.profile, "m", 2)
         self.l = profile_int(self.profile, "l", 3)
@@ -47,16 +59,118 @@ class LrcCode(GeneralMatrixCode):
             for member in range(g * self.l, (g + 1) * self.l):
                 local[g] ^= dg[member]
         self.full = np.concatenate([dg, local])
+        self._layer_eqs: list[dict[int, int]] = []
         self._init_general()
+
+    # ------------------------------------------------- layers grammar form
+    def _init_layers(self) -> None:
+        try:
+            layers = json.loads(str(self.profile["layers"]))
+        except (ValueError, TypeError) as e:
+            raise ErasureCodeError(f"layers is not JSON: {e}") from e
+        mapping = str(self.profile.get("mapping", ""))
+        if not mapping:
+            raise ErasureCodeError("layers profiles require mapping=")
+        n = len(mapping)
+        data_pos = [i for i, ch in enumerate(mapping) if ch == "D"]
+        self.k = len(data_pos)
+        self.m = n - self.k
+        if self.k == 0 or self.m <= 0:
+            raise ErasureCodeError(f"bad mapping {mapping!r}")
+        self.groups = 0
+        self.l = 0
+        self.global_m = self.m
+        # symbolic row per position: its GF(2^8) combination of the data
+        exprs: dict[int, np.ndarray] = {}
+        for idx, pos in enumerate(data_pos):
+            e = np.zeros(self.k, dtype=np.uint8)
+            e[idx] = 1
+            exprs[pos] = e
+        self._layer_eqs = []
+        for entry in layers:
+            if not (isinstance(entry, (list, tuple)) and len(entry) >= 1):
+                raise ErasureCodeError(f"bad layer entry {entry!r}")
+            pattern = str(entry[0])
+            cfg = str(entry[1]) if len(entry) > 1 else ""
+            if len(pattern) != n:
+                raise ErasureCodeError(
+                    f"layer pattern {pattern!r} length != mapping ({n})")
+            ins = [i for i, ch in enumerate(pattern) if ch in "Dd"]
+            outs = [i for i, ch in enumerate(pattern) if ch == "c"]
+            if not ins or not outs:
+                raise ErasureCodeError(
+                    f"layer {pattern!r} needs inputs and outputs")
+            for i in ins:
+                if i not in exprs:
+                    raise ErasureCodeError(
+                        f"layer {pattern!r} reads position {i} before "
+                        "any layer produced it (order layers bottom-up)")
+            for o in outs:
+                if o in exprs:
+                    raise ErasureCodeError(
+                        f"position {o} produced by two layers")
+            M = self._layer_matrix(cfg, len(ins), len(outs))
+            for j, out in enumerate(outs):
+                acc = np.zeros(self.k, dtype=np.uint8)
+                eq: dict[int, int] = {out: 1}
+                for i, pos in enumerate(ins):
+                    coef = int(M[j, i])
+                    if coef:
+                        acc ^= gf256.gf_mul(np.uint8(coef), exprs[pos])
+                        eq[pos] = coef
+                exprs[out] = acc
+                self._layer_eqs.append(eq)
+        undefined = [i for i in range(n) if i not in exprs]
+        if undefined:
+            raise ErasureCodeError(
+                f"positions {undefined} not produced by any layer")
+        # reorder so data chunks occupy ids [0, k) (the daemon's shard
+        # convention); parity/local chunks follow in mapping order
+        order = data_pos + [i for i in range(n) if i not in data_pos]
+        self._pos_to_id = {pos: idx for idx, pos in enumerate(order)}
+        self.full = np.stack([exprs[p] for p in order])
+        self._layer_eqs = [
+            {self._pos_to_id[p]: c for p, c in eq.items()}
+            for eq in self._layer_eqs]
+        self._init_general()
+
+    @staticmethod
+    def _layer_matrix(cfg: str, k: int, m: int) -> np.ndarray:
+        """Coefficient matrix of one layer's inner code.  cfg is the
+        reference's space-separated `key=value` string; the inner plugin
+        must be a GF(2^8) matrix code (jerasure matrix techniques / isa)
+        or the XOR plugin."""
+        opts = {}
+        for tok in cfg.split():
+            if "=" in tok:
+                key, val = tok.split("=", 1)
+                opts[key] = val
+        plugin = opts.pop("plugin", "jerasure")
+        opts["k"] = str(k)
+        opts["m"] = str(m)
+        if plugin == "xor" or (plugin == "jerasure"
+                               and opts.get("technique") == "xor"):
+            if m != 1:
+                raise ErasureCodeError(
+                    f"xor layer can produce one output, pattern wants {m}")
+            return np.ones((1, k), dtype=np.uint8)
+        from .registry import factory
+        inner = factory(plugin, opts)
+        if not hasattr(inner, "matrix"):
+            raise ErasureCodeError(
+                f"layer plugin {plugin!r} is not a GF(2^8) matrix code")
+        return np.asarray(inner.matrix, dtype=np.uint8)
 
     def get_flags(self):
         from .interface import Flags
         return super().get_flags() & ~Flags.PARITY_DELTA_OPTIMIZATION
 
     def repair_equations(self):
-        """Group XOR relations (local = XOR of its l members, members may
-        be data OR global-parity chunks) + the global parity relations."""
+        """Locality relations: per-layer equations (layers grammar) or
+        group XORs (simple form) + the global parity relations."""
         eqs = super().repair_equations()
+        if self._layer_eqs:
+            return eqs + [dict(eq) for eq in self._layer_eqs]
         for g in range(self.groups):
             eq = {self.k + self.global_m + g: 1}
             for member in range(g * self.l, (g + 1) * self.l):
@@ -74,6 +188,10 @@ class LrcCode(GeneralMatrixCode):
         """Prefer the failed chunk's group members (local repair), then
         data, then global, then other locals — the cheapest-layer-first
         rule of the reference's LRC minimum_to_decode."""
+        if not self.l:
+            # layers grammar: single failures already take the smallest
+            # layer equation; multi-failures use the default order
+            return super()._decode_candidates(want, available)
         avail = set(available)
         missing = [i for i in want if i not in avail]
         order: list[int] = []

@@ -697,16 +697,25 @@ class MonitorLite(Dispatcher):
                 # OSDMonitor::get_erasure_code step (:1977)
                 codec = ec.factory(plugin, {k: v for k, v in profile.items()
                                             if k != "plugin"})
-                if "stripe_unit" in profile:
-                    # the stripe geometry contract is part of profile
-                    # validation (ECUtil EC_ALIGN_SIZE): reject here, not
-                    # on the OSD dispatch thread at first IO
-                    from ..ec.stripe import StripeInfo
-                    try:
-                        StripeInfo(codec.k, codec.m,
-                                   int(profile["stripe_unit"]))
-                    except (ValueError, TypeError) as e:
-                        return -22, {"error": f"bad stripe_unit: {e}"}
+                # the stripe geometry contract is part of profile
+                # validation (ECUtil EC_ALIGN_SIZE + plugin minimum
+                # granularity): reject here, not on the OSD dispatch
+                # thread at first IO
+                from ..ec.stripe import StripeInfo
+                try:
+                    unit = int(profile.get(
+                        "stripe_unit", self.cfg["osd_ec_stripe_unit"]))
+                    StripeInfo(codec.k, codec.m, unit)
+                except (ValueError, TypeError) as e:
+                    return -22, {"error": f"bad stripe_unit: {e}"}
+                gran = codec.get_minimum_granularity()
+                if gran > 1 and unit % gran:
+                    import math
+                    ok_unit = gran * 4096 // math.gcd(gran, 4096)
+                    return -22, {"error":
+                                 f"stripe_unit {unit} must be a multiple "
+                                 f"of the plugin granularity {gran} "
+                                 f"(smallest page-aligned: {ok_unit})"}
                 size = codec.k + codec.m
                 # k+1 so an acked write survives one immediate failure
                 # (the reference's EC min_size default)

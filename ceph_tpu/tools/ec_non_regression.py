@@ -32,9 +32,13 @@ DEFAULT_GRID = [
     ("jerasure", {"technique": "cauchy_good", "k": "8", "m": "4"}),
     ("isa", {"technique": "reed_sol_van", "k": "8", "m": "4"}),
     ("isa", {"technique": "cauchy", "k": "8", "m": "4"}),
+    ("jerasure", {"technique": "liberation", "k": "5", "m": "2"}),
+    ("jerasure", {"technique": "blaum_roth", "k": "4", "m": "2"}),
+    ("jerasure", {"technique": "liber8tion", "k": "6", "m": "2"}),
     ("lrc", {"k": "4", "m": "2", "l": "3"}),
     ("shec", {"k": "8", "m": "4", "c": "3"}),
     ("clay", {"k": "8", "m": "4", "d": "11"}),
+    ("clay", {"k": "5", "m": "3", "d": "7"}),  # shortened (nu=1)
     ("tpu", {"technique": "reed_sol_van", "k": "8", "m": "3"}),
 ]
 

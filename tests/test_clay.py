@@ -22,8 +22,9 @@ def test_profile_validation():
         make(4, 2, 7)
     with pytest.raises(ErasureCodeError, match="k < d"):
         ec.factory("clay", {"k": "5", "m": "2", "d": "5"})  # d == k
-    with pytest.raises(ErasureCodeError, match="divide"):
-        ec.factory("clay", {"k": "3", "m": "2", "d": "4"})  # q=2, n=5
+    # q no longer has to divide n: shortening pads virtual zero nodes
+    short = ec.factory("clay", {"k": "3", "m": "2", "d": "4"})  # q=2, n=5
+    assert short.nu == 1 and short.n_int == 6
     codec = make(4, 2, 5)
     assert codec.q == 2 and codec.t == 3 and codec.alpha == 8
     assert codec.get_sub_chunk_count() == 8

@@ -48,8 +48,9 @@ def test_profile_parsing_errors():
         ec.factory("jerasure", {"k": "banana"})
     with pytest.raises(ErasureCodeError, match="unknown technique"):
         ec.factory("jerasure", {"technique": "quantum"})
-    with pytest.raises(ErasureCodeError, match="not implemented"):
-        ec.factory("jerasure", {"technique": "liberation"})
+    # liberation family now implemented as GF(2) bit-matrix schedules
+    lib = ec.factory("jerasure", {"k": "5", "technique": "liberation"})
+    assert lib.w == 7 and lib.m == 2
     with pytest.raises(ErasureCodeError, match="w=16"):
         ec.factory("jerasure", {"w": "16"})
     with pytest.raises(ErasureCodeError, match="m=2"):
