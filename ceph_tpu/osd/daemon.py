@@ -53,6 +53,7 @@ from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
 from .pglog import PGLOG_OID, LogEntry, PGLog
+from .scheduler import ClassParams, MClockScheduler
 from .scrub import FaultInjection, ScrubMixin
 
 EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
@@ -187,9 +188,44 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                             "rollbacks", "failure_reports",
                             "scrubs", "scrub_errors"])
         self.perf.add("op_lat", CounterType.TIME)
+        # op scheduler (OpScheduler/mClockScheduler role): the messenger
+        # thread classifies+enqueues; ONE dequeue worker executes
+        # handlers, preserving single-threaded handler semantics while
+        # recovery/scrub traffic is QoS-shaped against client ops
+        # peering traffic (MPGQuery/MPGInfo/MPGRollback) is deliberately
+        # NOT background: client IO blocks on peering completing, so
+        # throttling it would be a priority inversion (the reference
+        # serves peering at immediate priority).  Recovery QoS shapes
+        # the BULK payload movement: pushes and pulls.
+        self._op_classes = {
+            MOSDOp: "client",
+            MPGPush: "recovery", MPGPull: "recovery",
+            MScrubRequest: "scrub", MScrubShard: "scrub",
+            MScrubMap: "scrub",
+        }
+        self._use_mclock = self.cfg["osd_op_queue"] == "mclock"
+        self.scheduler = MClockScheduler(
+            self._run_scheduled,
+            {
+                "client": ClassParams(self.cfg["osd_mclock_client_res"],
+                                      self.cfg["osd_mclock_client_wgt"],
+                                      self.cfg["osd_mclock_client_lim"]),
+                "recovery": ClassParams(
+                    self.cfg["osd_mclock_recovery_res"],
+                    self.cfg["osd_mclock_recovery_wgt"],
+                    self.cfg["osd_mclock_recovery_lim"]),
+                "scrub": ClassParams(self.cfg["osd_mclock_scrub_res"],
+                                     self.cfg["osd_mclock_scrub_wgt"],
+                                     self.cfg["osd_mclock_scrub_lim"]),
+                # system (maps, sub-ops, replies): effectively unthrottled
+                "system": ClassParams(1e9, 1e6, 0.0),
+            },
+            name=f"mclock-{self.name}")
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
+        if self._use_mclock:
+            self.scheduler.start()
         self.messenger.start()
         self.hb_messenger.start()
         net = self.messenger.network
@@ -205,6 +241,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._stop.set()
         self.messenger.shutdown()
         self.hb_messenger.shutdown()
+        if self._use_mclock:
+            self.scheduler.shutdown()
 
     # -------------------------------------------------- admin socket verbs
     def admin_command(self, cmd: str, **kw):
@@ -220,6 +258,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             return self.op_tracker.slow_ops()
         if cmd == "config show":
             return self.cfg.dump()
+        if cmd == "dump_op_queue":
+            return {"mode": "mclock" if self._use_mclock else "fifo",
+                    "depth": self.scheduler.queue_depth(),
+                    "served": dict(self.scheduler.served)}
         if cmd == "config set":
             self.cfg.set(kw["name"], kw["value"])
             return {"success": True}
@@ -236,8 +278,19 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         handler = self._handlers.get(type(msg))
         if handler is None:
             return False
-        handler(conn, msg)
+        # heartbeats stay inline on their own messenger thread: liveness
+        # must never queue behind the op scheduler
+        if not self._use_mclock or isinstance(msg, (MOSDPing,
+                                                    MOSDPingReply)):
+            handler(conn, msg)
+            return True
+        klass = self._op_classes.get(type(msg), "system")
+        self.scheduler.enqueue(klass, (handler, conn, msg))
         return True
+
+    def _run_scheduled(self, klass: str, item) -> None:
+        handler, conn, msg = item
+        handler(conn, msg)
 
     # ------------------------------------------------------------- mapping
     def _handle_map(self, conn, msg: MMapPush) -> None:

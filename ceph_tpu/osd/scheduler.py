@@ -1,0 +1,175 @@
+"""mClock-style op scheduler: QoS between client, recovery, and scrub.
+
+The capability of the reference's OpScheduler + mClockScheduler
+(src/osd/scheduler/OpScheduler.h:37, mClockScheduler.cc, vendored
+dmclock): ops are tagged per class with reservation / weight / limit
+(R, W, L) tags and served reservation-first, then by weighted
+proportional share among classes under their limit — so background
+recovery and scrub cannot starve client IO, yet keep a guaranteed
+floor when the client is idle.
+
+One dequeue worker preserves the daemon's single-threaded handler
+execution (the sharded scheduler's shard count is a scale knob, as in
+the reference); the messenger dispatch thread only classifies and
+enqueues.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class ClassParams:
+    reservation: float  # guaranteed ops/sec (0 = none)
+    weight: float       # proportional share when past reservation
+    limit: float        # max ops/sec (0 = unlimited)
+
+
+class MClockScheduler:
+    """Single-server dmclock over named classes.
+
+    Tag rules (dmclock paper / mClockScheduler.cc):
+      r_tag = max(now, prev_r + 1/R)    (reservation clock)
+      p_tag = max(now, prev_p + 1/W)    (proportional virtual clock)
+      l_tag = max(now, prev_l + 1/L)    (limit clock)
+    Serve: earliest r_tag <= now first; otherwise smallest p_tag among
+    classes whose l_tag <= now; otherwise wait for the nearest tag.
+    """
+
+    #: per-class queue bound: a rate-limited class must not buffer an
+    #: unbounded backlog of full message payloads (drops are the lossy
+    #: messenger semantic; recovery retries via requery rounds)
+    QUEUE_CAP = 512
+
+    def __init__(self, handler, classes: dict[str, ClassParams],
+                 name: str = "mclock", clock=time.monotonic):
+        self._handler = handler
+        self._classes = {}
+        for c, p in classes.items():
+            if p.limit > 0 and p.reservation > p.limit:
+                # limit is the hard upper bound: a reservation above it
+                # would silently exceed the configured cap
+                p = ClassParams(p.limit, p.weight, p.limit)
+            self._classes[c] = p
+        self._clock = clock
+        self.dropped: dict[str, int] = {c: 0 for c in classes}
+        self._queues: dict[str, collections.deque] = {
+            c: collections.deque() for c in classes}
+        self._tags = {c: {"r": 0.0, "p": 0.0, "l": 0.0} for c in classes}
+        self._cv = threading.Condition()
+        self._stop = False
+        self.served: dict[str, int] = {c: 0 for c in classes}
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+    def enqueue(self, klass: str, item) -> None:
+        with self._cv:
+            q = self._queues[klass]
+            if len(q) >= self.QUEUE_CAP:
+                self.dropped[klass] += 1
+                return  # lossy backpressure; senders retry/requery
+            if not q:
+                # idle->busy: catch the proportional clock up to the
+                # busy minimum so an idle class cannot burst unfairly
+                busy = [self._tags[c]["p"]
+                        for c, qq in self._queues.items() if qq]
+                if busy:
+                    t = self._tags[klass]
+                    t["p"] = max(t["p"], min(busy))
+            q.append(item)
+            self._cv.notify()
+
+    def queue_depth(self, klass: str | None = None) -> int:
+        with self._cv:
+            if klass is not None:
+                return len(self._queues[klass])
+            return sum(len(q) for q in self._queues.values())
+
+    # ------------------------------------------------------------ worker
+    def _pick(self, now: float):
+        """(klass, phase) to serve now, or (None, wake_at).
+
+        Tags hold NEXT-ELIGIBLE instants: "r" the next reservation
+        service, "l" the next limit-allowed service; "p" is a virtual
+        round number compared only among busy classes."""
+        best_r = None
+        wake = None
+        for c, q in self._queues.items():
+            if not q:
+                continue
+            p = self._classes[c]
+            if p.reservation > 0:
+                r_next = self._tags[c]["r"]
+                if r_next <= now and (best_r is None
+                                      or r_next < best_r[1]):
+                    best_r = (c, r_next)
+                elif r_next > now:
+                    wake = r_next if wake is None else min(wake, r_next)
+        if best_r is not None:
+            return best_r[0], "reservation"
+        best_p = None
+        for c, q in self._queues.items():
+            if not q:
+                continue
+            p = self._classes[c]
+            if p.limit > 0 and self._tags[c]["l"] > now:
+                l_next = self._tags[c]["l"]
+                wake = l_next if wake is None else min(wake, l_next)
+                continue
+            p_tag = self._tags[c]["p"]
+            if best_p is None or p_tag < best_p[1]:
+                best_p = (c, p_tag)
+        if best_p is not None:
+            return best_p[0], "weight"
+        return None, wake
+
+    def _account(self, c: str, phase: str, now: float) -> None:
+        p = self._classes[c]
+        t = self._tags[c]
+        if p.reservation > 0 and phase == "reservation":
+            # bounded burst of one: an idle class's clock resets near now
+            t["r"] = max(t["r"], now - 1.0 / p.reservation) \
+                + 1.0 / p.reservation
+        if p.limit > 0:
+            t["l"] = max(t["l"], now - 1.0 / p.limit) + 1.0 / p.limit
+        if phase == "weight":
+            # reservation-phase service must NOT also consume the
+            # class's proportional share (the dmclock P-tag compensation)
+            t["p"] = t["p"] + 1.0 / max(p.weight, 1e-9)
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._stop:
+                        return
+                    now = self._clock()
+                    klass, res = self._pick(now)
+                    if klass is not None:
+                        item = self._queues[klass].popleft()
+                        self._account(klass, res, now)
+                        self.served[klass] += 1
+                        break
+                    timeout = None if res is None \
+                        else max(0.001, res - now)
+                    self._cv.wait(timeout=timeout)
+            try:
+                self._handler(klass, item)
+            except Exception:  # noqa: BLE001 - worker must survive
+                from ..utils.log import dout
+                import traceback
+                dout("osd", 0)("scheduler handler error: %s",
+                               traceback.format_exc())

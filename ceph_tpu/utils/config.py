@@ -193,6 +193,30 @@ OPTIONS: list[Option] = [
            "seconds before an in-flight op whose sub-ops never completed "
            "is failed back to the client", min=0.1, max=3600.0,
            see_also=("osd_heartbeat_grace",)),
+    Option("osd_op_queue", str, "mclock", OptionLevel.ADVANCED,
+           "op scheduler: mclock (QoS classes) or fifo (inline dispatch)",
+           enum_values=("mclock", "fifo"), startup=True),
+    # mClock class parameters (reservation ops/s, weight, limit ops/s;
+    # 0 = none/unlimited) — the mClockScheduler client vs background
+    # recovery vs scrub QoS knobs
+    Option("osd_mclock_client_res", float, 100.0, OptionLevel.ADVANCED,
+           "client op reservation (ops/s)", min=0.0),
+    Option("osd_mclock_client_wgt", float, 10.0, OptionLevel.ADVANCED,
+           "client op weight", min=0.001),
+    Option("osd_mclock_client_lim", float, 0.0, OptionLevel.ADVANCED,
+           "client op limit (ops/s; 0 unlimited)", min=0.0),
+    Option("osd_mclock_recovery_res", float, 20.0, OptionLevel.ADVANCED,
+           "background recovery reservation (ops/s)", min=0.0),
+    Option("osd_mclock_recovery_wgt", float, 2.0, OptionLevel.ADVANCED,
+           "background recovery weight", min=0.001),
+    Option("osd_mclock_recovery_lim", float, 0.0, OptionLevel.ADVANCED,
+           "background recovery limit (ops/s; 0 unlimited)", min=0.0),
+    Option("osd_mclock_scrub_res", float, 5.0, OptionLevel.ADVANCED,
+           "scrub reservation (ops/s)", min=0.0),
+    Option("osd_mclock_scrub_wgt", float, 1.0, OptionLevel.ADVANCED,
+           "scrub weight", min=0.001),
+    Option("osd_mclock_scrub_lim", float, 0.0, OptionLevel.ADVANCED,
+           "scrub limit (ops/s; 0 unlimited)", min=0.0),
 ]
 
 
