@@ -1,0 +1,117 @@
+"""Prometheus exporter: cluster + per-daemon metrics over HTTP.
+
+The capability of the reference's metrics path (mgr prometheus module +
+standalone src/exporter/ DaemonMetricCollector.cc scraping admin
+sockets): an HTTP endpoint serving /metrics in the prometheus text
+exposition format, fed by the in-process PerfCounters collection and
+the monitor's cluster state (map epoch, osd up/in, aggregated usage).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.perf import global_perf
+
+_PREFIX = "ceph_tpu"
+
+
+def _sanitize(name: str) -> str:
+    return "".join(ch if ch.isalnum() or ch == "_" else "_"
+                   for ch in name)
+
+
+def render_metrics(mon=None) -> str:
+    """The prometheus text format body (flat counters + labeled
+    per-daemon series, sum/count pairs for timers)."""
+    lines: list[str] = []
+
+    def emit(metric: str, value, labels: dict | None = None,
+             help_: str | None = None, typ: str = "gauge"):
+        m = f"{_PREFIX}_{_sanitize(metric)}"
+        if help_:
+            lines.append(f"# HELP {m} {help_}")
+            lines.append(f"# TYPE {m} {typ}")
+        lab = ""
+        if labels:
+            pairs = ",".join(f'{k}="{v}"' for k, v in sorted(
+                labels.items()))
+            lab = "{" + pairs + "}"
+        lines.append(f"{m}{lab} {float(value):g}")
+
+    if mon is not None:
+        osds = list(mon.osdmap.osds.values())
+        emit("osdmap_epoch", mon.osdmap.epoch,
+             help_="current OSDMap epoch", typ="counter")
+        emit("osd_total", len(osds), help_="known OSDs")
+        emit("osd_up", sum(1 for o in osds if o.up), help_="up OSDs")
+        emit("osd_in", sum(1 for o in osds if o.in_cluster),
+             help_="in OSDs")
+        emit("pools", len(mon.osdmap.pools), help_="pools")
+        emit("mon_is_leader", 1 if mon.is_leader else 0,
+             help_="1 when this monitor leads the quorum")
+        agg: dict[str, float] = {}
+        for stats in mon._osd_stats.values():
+            for k, v in stats.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    agg[k] = agg.get(k, 0) + v
+        for k, v in sorted(agg.items()):
+            emit(f"cluster_{k}", v,
+                 help_=f"sum of per-osd reported {k}")
+    # per-daemon perf counters (the MMgrReport/DaemonMetricCollector feed)
+    first_metric: set[str] = set()
+    for daemon, counters in global_perf().dump().items():
+        for cname, val in counters.items():
+            base = f"daemon_{_sanitize(cname)}"
+            if isinstance(val, dict):
+                for sub in ("sum", "count", "sum_seconds"):
+                    if sub in val:
+                        metric = f"{base}_{sub}"
+                        emit(metric, val[sub], {"daemon": daemon},
+                             help_=None if metric in first_metric
+                             else f"perf counter {cname} {sub}",
+                             typ="counter")
+                        first_metric.add(metric)
+            elif isinstance(val, (int, float)):
+                emit(base, val, {"daemon": daemon},
+                     help_=None if base in first_metric
+                     else f"perf counter {cname}", typ="counter")
+                first_metric.add(base)
+    return "\n".join(lines) + "\n"
+
+
+class MetricsExporter:
+    """HTTP /metrics endpoint (port 0 = ephemeral; .port tells)."""
+
+    def __init__(self, mon=None, host: str = "127.0.0.1", port: int = 0):
+        exporter = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                body = render_metrics(exporter.mon).encode("utf-8")
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self.mon = mon
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="metrics-exporter",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -80,10 +80,37 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("command", nargs="+",
                    help="status | health | osd dump | osd perf | df | "
-                        "config show | pg scrub <pool> <seed>")
+                        "config show | pg scrub <pool> <seed> | "
+                        "daemon <socket-path> <command...>")
     p.add_argument("--osds", type=int, default=4,
                    help="demo cluster size (in-proc vstart)")
     args = p.parse_args(argv)
+
+    # `ceph daemon <asok> <verb...> [key=value ...]`: talk to a LIVE
+    # daemon's admin socket — no demo cluster involved.  Bare words form
+    # the verb; key=value tokens become arguments (e.g.
+    # `daemon x.asok config set name=osd_op_timeout value=9.5`).
+    if args.command[0] == "daemon":
+        if len(args.command) < 3:
+            print("usage: daemon <socket-path> <verb...> [key=value ...]",
+                  file=sys.stderr)
+            return 2
+        from ..utils.admin_socket import admin_request
+        words, kwargs = [], {}
+        for tok in args.command[2:]:
+            if "=" in tok:
+                key, val = tok.split("=", 1)
+                kwargs[key] = val
+            else:
+                words.append(tok)
+        try:
+            out = admin_request(args.command[1], " ".join(words),
+                                **kwargs)
+        except (OSError, RuntimeError) as e:
+            print(f"admin command failed: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(out, indent=2, default=str))
+        return 0
 
     # validate BEFORE paying the demo-cluster boot
     cmd = " ".join(args.command)

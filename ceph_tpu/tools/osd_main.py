@@ -32,6 +32,8 @@ def main(argv=None) -> int:
     ap.add_argument("--store-path", default=None)
     ap.add_argument("--cfg", default="{}",
                     help="JSON object of config overrides")
+    ap.add_argument("--admin-socket", default=None,
+                    help="unix socket path for `ceph daemon` commands")
     args = ap.parse_args(argv)
 
     from ..msg.tcp import TcpNetwork
@@ -48,11 +50,20 @@ def main(argv=None) -> int:
     osd = OSDDaemon(args.osd_id, net, mon=args.mon_name, store=store,
                     cfg=cfg, host=args.host)
 
+    admin = None
+    if args.admin_socket:
+        from ..utils.admin_socket import AdminSocketServer
+        admin = AdminSocketServer(
+            args.admin_socket,
+            lambda prefix, **kw: osd.admin_command(prefix, **kw))
+
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
     osd.start()
     stop.wait()
+    if admin is not None:
+        admin.stop()
     osd.stop()
     net.stop()
     return 0

@@ -21,7 +21,9 @@ from ..utils.config import Config, default_config
 class MiniCluster:
     def __init__(self, n_osds: int = 3, cfg: Config | None = None,
                  hosts_per_osd: bool = True, transport: str = "local",
-                 n_mons: int = 1, mon_path: str | None = None):
+                 n_mons: int = 1, mon_path: str | None = None,
+                 admin_dir: str | None = None,
+                 metrics_port: int | None = None):
         self.cfg = cfg or default_config()
         if transport == "tcp":
             from ..msg.tcp import TcpNetwork
@@ -41,6 +43,34 @@ class MiniCluster:
         self.clients: list[RadosClient] = []
         self._n = n_osds
         self._hosts_per_osd = hosts_per_osd
+        # observability (AdminSocket + mgr-prometheus roles)
+        self._admin_dir = admin_dir
+        self.admin_sockets: dict[str, object] = {}
+        self.exporter = None
+        if metrics_port is not None:
+            from ..mon.exporter import MetricsExporter
+            self.exporter = MetricsExporter(self.mon, port=metrics_port)
+        if admin_dir:
+            # resolve through self.mons at CALL time: a revived monitor
+            # must serve, not the stopped object the closure was born with
+            self._add_admin_socket(
+                self.mon.name,
+                lambda prefix, **kw: self.mons[0]._run_command(
+                    dict(kw, prefix=prefix)))
+
+    def _add_admin_socket(self, name: str, handler) -> None:
+        import os
+        from ..utils.admin_socket import AdminSocketServer
+        old = self.admin_sockets.pop(name, None)
+        if old is not None:
+            old.stop()  # revive: never leak the previous server
+        path = os.path.join(self._admin_dir, f"{name}.asok")
+        self.admin_sockets[name] = AdminSocketServer(path, handler)
+
+    def _drop_admin_socket(self, name: str) -> None:
+        old = self.admin_sockets.pop(name, None)
+        if old is not None:
+            old.stop()
 
     def _make_mon(self, rank: int) -> MonitorLite:
         import os
@@ -85,6 +115,10 @@ class MiniCluster:
     def revive_mon(self, rank: int) -> MonitorLite:
         m = self._make_mon(rank)
         self.mons[rank] = m
+        if rank == 0:
+            self.mon = m  # keep the compat alias + exporter current
+            if self.exporter is not None:
+                self.exporter.mon = m
         m.start()
         return m
 
@@ -94,6 +128,11 @@ class MiniCluster:
                         mons=self.mon_names)
         self.osds[osd_id] = osd
         osd.start()
+        if self._admin_dir:
+            self._add_admin_socket(
+                osd.name,
+                lambda prefix, _o=osd, **kw: _o.admin_command(prefix,
+                                                              **kw))
         return osd
 
     def spawn_osd_process(self, osd_id: int, store: str = "memstore",
@@ -119,6 +158,10 @@ class MiniCluster:
                 "--cfg", _json.dumps(cfg_overrides or {})]
         if store_path:
             argv += ["--store-path", store_path]
+        if self._admin_dir:
+            argv += ["--admin-socket",
+                     os.path.join(self._admin_dir,
+                                  f"osd.{osd_id}.asok")]
         # the child must find the package regardless of caller cwd
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(ceph_tpu.__file__)))
@@ -153,6 +196,10 @@ class MiniCluster:
                 p.wait()  # reap — no zombies across a test session
         for m in self.mons.values():
             m.stop()
+        for a in self.admin_sockets.values():
+            a.stop()
+        if self.exporter is not None:
+            self.exporter.stop()
         if hasattr(self.network, "stop"):
             self.network.stop()
 
@@ -189,6 +236,7 @@ class MiniCluster:
         osd = self.osds.pop(osd_id, None)
         if osd:
             osd.stop()
+            self._drop_admin_socket(osd.name)
         proc = self.procs.pop(osd_id, None)
         if proc is not None:
             proc.kill()
