@@ -38,21 +38,36 @@ def render_metrics(mon=None) -> str:
             pairs = ",".join(f'{k}="{v}"' for k, v in sorted(
                 labels.items()))
             lab = "{" + pairs + "}"
-        lines.append(f"{m}{lab} {float(value):g}")
+        # exact rendering: %g truncates to 6 significant digits, which
+        # corrupts byte counters past ~1e6 (rate()/delta() go wrong)
+        if isinstance(value, bool):
+            value = int(value)
+        if isinstance(value, int):
+            lines.append(f"{m}{lab} {value}")
+        else:
+            lines.append(f"{m}{lab} {float(value)!r}")
 
     if mon is not None:
-        osds = list(mon.osdmap.osds.values())
-        emit("osdmap_epoch", mon.osdmap.epoch,
+        # snapshot under the monitor lock: the HTTP thread must not
+        # iterate dicts the dispatch thread mutates mid-scrape
+        with mon._lock:
+            up = sum(1 for o in mon.osdmap.osds.values() if o.up)
+            in_ = sum(1 for o in mon.osdmap.osds.values()
+                      if o.in_cluster)
+            n_osds = len(mon.osdmap.osds)
+            n_pools = len(mon.osdmap.pools)
+            epoch = mon.osdmap.epoch
+            stats_copy = [dict(s) for s in mon._osd_stats.values()]
+        emit("osdmap_epoch", epoch,
              help_="current OSDMap epoch", typ="counter")
-        emit("osd_total", len(osds), help_="known OSDs")
-        emit("osd_up", sum(1 for o in osds if o.up), help_="up OSDs")
-        emit("osd_in", sum(1 for o in osds if o.in_cluster),
-             help_="in OSDs")
-        emit("pools", len(mon.osdmap.pools), help_="pools")
+        emit("osd_total", n_osds, help_="known OSDs")
+        emit("osd_up", up, help_="up OSDs")
+        emit("osd_in", in_, help_="in OSDs")
+        emit("pools", n_pools, help_="pools")
         emit("mon_is_leader", 1 if mon.is_leader else 0,
              help_="1 when this monitor leads the quorum")
         agg: dict[str, float] = {}
-        for stats in mon._osd_stats.values():
+        for stats in stats_copy:
             for k, v in stats.items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     agg[k] = agg.get(k, 0) + v

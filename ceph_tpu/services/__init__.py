@@ -1,0 +1,5 @@
+"""Service layers over RADOS (the reference's librbd/rgw/cephfs tier).
+
+First slice: `rbd` — block images striped over objects with COW
+snapshots (SURVEY.md §2.7 librbd row).
+"""

@@ -1,0 +1,430 @@
+"""rbd-lite: block images over RADOS objects with COW snapshots.
+
+The capability slice of the reference's librbd (src/librbd/ — image
+create/open/list/remove, an Image handle with read/write/resize, and
+snapshots; io dispatch layering striped over rbd_data.* objects).
+Re-shaped for this build:
+
+- image metadata lives in a codec-encoded header object
+  (`rbd_header.<name>`): size, layout, snapshot table, snap id seq;
+- data lives in `rbd_data.<name>.<objno>` pieces addressed through
+  FileLayout (stripe_unit/stripe_count/object_size — the same
+  file_layout_t algebra CephFS and libradosstriper use);
+- snapshots are image-level COW: the FIRST write touching an object
+  after a snapshot copies the object's bytes to
+  `rbd_data.<name>.<objno>@<snapid>` before applying (the object-snap
+  role of SnapMapper/clone-overlap, done at the client like librbd's
+  object copy-up).  Reading snapshot s serves each object from its
+  oldest copy with id >= s, falling back to the head.  Removing a
+  snapshot retires its record (copies stay while an older snapshot
+  might read through them) and purges copies when nothing older
+  remains.
+
+Single-writer images (the exclusive-lock feature of the reference is a
+later slice); all ops are synchronous like the rest of the client
+stack.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..client.rados import RadosClient, RadosError
+from ..client.striper import FileLayout
+from ..utils.codec import Decoder, Encodable, Encoder
+
+_HEADER = "rbd_header.{name}"
+_DATA = "rbd_data.{name}.{objno:016x}"
+_SNAP = "rbd_data.{name}.{objno:016x}@{snap}"
+_DIR = "rbd_directory"
+
+
+class RbdError(Exception):
+    pass
+
+
+@dataclass
+class SnapRecord(Encodable):
+    snap_id: int
+    name: str            # "" once retired (removed but copies retained)
+    size: int            # image size when the snapshot was taken
+    copied: list = field(default_factory=list)  # objnos with COW copies
+
+    VERSION, COMPAT = 1, 1
+
+    def encode(self, enc: Encoder) -> None:
+        def body(e):
+            e.u64(self.snap_id)
+            e.string(self.name)
+            e.u64(self.size)
+            e.seq(sorted(self.copied), Encoder.u64)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "SnapRecord":
+        def body(d, v):
+            return cls(d.u64(), d.string(), d.u64(), d.seq(Decoder.u64))
+        return dec.versioned(cls.VERSION, body)
+
+
+@dataclass
+class ImageHeader(Encodable):
+    size: int
+    object_size: int
+    stripe_unit: int
+    stripe_count: int
+    snap_seq: int = 0
+    snaps: list = field(default_factory=list)  # [SnapRecord]
+
+    VERSION, COMPAT = 1, 1
+
+    def encode(self, enc: Encoder) -> None:
+        def body(e):
+            e.u64(self.size)
+            e.u64(self.object_size)
+            e.u64(self.stripe_unit)
+            e.u64(self.stripe_count)
+            e.u64(self.snap_seq)
+            e.seq(self.snaps, lambda ee, s: s.encode(ee))
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "ImageHeader":
+        def body(d, v):
+            h = cls(d.u64(), d.u64(), d.u64(), d.u64(), d.u64())
+            h.snaps = d.seq(SnapRecord.decode)
+            return h
+        return dec.versioned(cls.VERSION, body)
+
+    def layout(self) -> FileLayout:
+        return FileLayout(self.stripe_unit, self.stripe_count,
+                          self.object_size)
+
+
+class RBD:
+    """Pool-level image operations (the librbd RBD class shape)."""
+
+    def __init__(self, client: RadosClient):
+        self.client = client
+
+    def create(self, pool: str, name: str, size: int,
+               object_size: int = 4 * 1024 * 1024,
+               stripe_unit: int | None = None,
+               stripe_count: int = 1) -> "Image":
+        if size < 0:
+            raise RbdError("negative size")
+        header = _HEADER.format(name=name)
+        try:
+            self.client.read(pool, header, length=1)
+            raise RbdError(f"image {name!r} exists")
+        except RadosError:
+            pass
+        su = stripe_unit or object_size
+        h = ImageHeader(size, object_size, su, stripe_count)
+        FileLayout(su, stripe_count, object_size)  # validates
+        self.client.write_full(pool, header, h.encode_bytes())
+        self._dir_update(pool, add=name)
+        return self.open(pool, name)
+
+    def open(self, pool: str, name: str) -> "Image":
+        return Image(self.client, pool, name)
+
+    def list(self, pool: str) -> list[str]:
+        try:
+            raw = self.client.read(pool, _DIR)
+        except RadosError:
+            return []
+        d = Decoder(raw)
+        return d.seq(Decoder.string)
+
+    def remove(self, pool: str, name: str) -> None:
+        img = self.open(pool, name)
+        img.purge()
+        self._dir_update(pool, remove=name)
+
+    def _dir_update(self, pool: str, add: str | None = None,
+                    remove: str | None = None) -> None:
+        names = set(self.list(pool))
+        if add:
+            names.add(add)
+        if remove:
+            names.discard(remove)
+        e = Encoder()
+        e.seq(sorted(names), Encoder.string)
+        self.client.write_full(pool, _DIR, e.tobytes())
+
+
+class Image:
+    """An open image handle (librbd Image shape)."""
+
+    def __init__(self, client: RadosClient, pool: str, name: str):
+        self.client = client
+        self.pool = pool
+        self.name = name
+        self._load()
+
+    # ------------------------------------------------------------- header
+    def _load(self) -> None:
+        try:
+            raw = self.client.read(self.pool,
+                                   _HEADER.format(name=self.name))
+        except RadosError as e:
+            raise RbdError(f"no image {self.name!r}") from e
+        self.header = ImageHeader.decode_bytes(raw)
+
+    def _save(self) -> None:
+        self.client.write_full(self.pool, _HEADER.format(name=self.name),
+                               self.header.encode_bytes())
+
+    def size(self) -> int:
+        return self.header.size
+
+    # ---------------------------------------------------------------- io
+    def _piece(self, objno: int) -> str:
+        return _DATA.format(name=self.name, objno=objno)
+
+    def _snap_piece(self, objno: int, snap_id: int) -> str:
+        return _SNAP.format(name=self.name, objno=objno, snap=snap_id)
+
+    def _read_piece(self, oid: str, off: int, length: int) -> bytes:
+        try:
+            data = self.client.read(self.pool, oid, offset=off,
+                                    length=length)
+        except RadosError:
+            data = b""  # sparse hole
+        return data + b"\0" * (length - len(data))
+
+    def _newest_snap(self) -> SnapRecord | None:
+        """COW target: the newest record, live OR retired (older live
+        snapshots read through newer copies)."""
+        return self.header.snaps[-1] if self.header.snaps else None
+
+    def _cow_object(self, objno: int, newest: SnapRecord) -> bool:
+        """Copy-up the head object to the newest snapshot before its
+        first post-snapshot mutation.  Returns True if the header now
+        needs saving."""
+        if objno in newest.copied:
+            return False
+        try:
+            old = self.client.read(self.pool, self._piece(objno))
+        except RadosError:
+            old = b""
+        self.client.write_full(self.pool,
+                               self._snap_piece(objno, newest.snap_id),
+                               old)
+        newest.copied.append(objno)
+        return True
+
+    def _objects_covering(self, size: int) -> set[int]:
+        objs: set[int] = set()
+        if size > 0:
+            for objno, _o, _t in self.header.layout().file_to_extents(
+                    0, size):
+                objs.add(objno)
+        return objs
+
+    def write(self, off: int, data: bytes) -> None:
+        if off + len(data) > self.header.size:
+            raise RbdError("write past end of image (resize first)")
+        if not data:
+            return
+        layout = self.header.layout()
+        newest = self._newest_snap()
+        per_obj: dict[int, list] = {}
+        pos = 0
+        for objno, obj_off, take in layout.file_to_extents(off,
+                                                           len(data)):
+            per_obj.setdefault(objno, []).append((obj_off, pos, take))
+            pos += take
+        dirty_header = False
+        for objno, extents in per_obj.items():
+            if newest is not None:
+                dirty_header |= self._cow_object(objno, newest)
+            for obj_off, p, take in extents:
+                self.client.write(self.pool, self._piece(objno),
+                                  data[p:p + take], offset=obj_off)
+        if dirty_header:
+            self._save()
+
+    def read(self, off: int, length: int,
+             snap: str | None = None) -> bytes:
+        bound = self.header.size if snap is None \
+            else self._snap_record(snap).size
+        length = max(0, min(length, bound - off))
+        if length <= 0:
+            return b""
+        layout = self.header.layout()
+        out = bytearray(length)
+        pos = 0
+        snap_id = None if snap is None else self._snap_record(snap).snap_id
+        for objno, obj_off, take in layout.file_to_extents(off, length):
+            oid = self._piece(objno) if snap_id is None \
+                else self._resolve_snap_object(objno, snap_id)
+            out[pos:pos + take] = self._read_piece(oid, obj_off, take)
+            pos += take
+        return bytes(out)
+
+    def _resolve_snap_object(self, objno: int, snap_id: int) -> str:
+        """Oldest COW copy with id >= snap_id, else the head object —
+        the snapshot read-through chain."""
+        for rec in self.header.snaps:  # ordered oldest -> newest
+            if rec.snap_id >= snap_id and objno in rec.copied:
+                return self._snap_piece(objno, rec.snap_id)
+        return self._piece(objno)
+
+    # ------------------------------------------------------------- resize
+    def _zero_tail(self, new_size: int, old_size: int) -> None:
+        """Zero the KEPT objects' stale ranges beyond new_size (up to
+        the object-SET boundary — with striping, a kept object holds
+        file ranges across the whole set span) so a later grow reads
+        zeros, not resurrection."""
+        layout = self.header.layout()
+        span = layout.stripe_count * layout.object_size
+        set_end = -(-new_size // span) * span
+        tail = min(old_size, set_end) - new_size
+        if tail > 0:
+            prev = self.header.size
+            self.header.size = max(prev, new_size + tail)
+            self.write(new_size, b"\0" * tail)
+            self.header.size = prev
+
+    def resize(self, new_size: int) -> None:
+        if new_size < 0:
+            raise RbdError("negative size")
+        old = self.header.size
+        if new_size < old:
+            # trim: COW whole objects into the newest snapshot (a live
+            # snapshot must keep reading the frozen bytes), then drop
+            # them; zero the kept partial range
+            keep_objs = self._objects_covering(new_size)
+            newest = self._newest_snap()
+            dirty = False
+            for objno in sorted(self._objects_covering(old) - keep_objs):
+                if newest is not None:
+                    dirty |= self._cow_object(objno, newest)
+                try:
+                    self.client.remove(self.pool, self._piece(objno))
+                except RadosError:
+                    pass
+            if dirty:
+                self._save()
+            self._zero_tail(new_size, old)
+        self.header.size = new_size
+        self._save()
+
+    # ---------------------------------------------------------- snapshots
+    def _snap_record(self, name: str) -> SnapRecord:
+        for rec in self.header.snaps:
+            if rec.name == name:
+                return rec
+        raise RbdError(f"no snapshot {name!r}")
+
+    def snap_create(self, name: str) -> int:
+        if any(r.name == name for r in self.header.snaps):
+            raise RbdError(f"snapshot {name!r} exists")
+        self.header.snap_seq += 1
+        rec = SnapRecord(self.header.snap_seq, name, self.header.size)
+        self.header.snaps.append(rec)
+        self._save()
+        return rec.snap_id
+
+    def snap_list(self) -> list[dict]:
+        return [{"id": r.snap_id, "name": r.name, "size": r.size}
+                for r in self.header.snaps if r.name]
+
+    def snap_remove(self, name: str) -> None:
+        rec = self._snap_record(name)
+        older_live = any(r.name and r.snap_id < rec.snap_id
+                        for r in self.header.snaps)
+        if older_live:
+            rec.name = ""  # retire: older snapshots read through it
+        else:
+            for objno in rec.copied:
+                try:
+                    self.client.remove(
+                        self.pool,
+                        self._snap_piece(objno, rec.snap_id))
+                except RadosError:
+                    pass
+            self.header.snaps.remove(rec)
+        # purge retired records nothing can read through anymore
+        while self.header.snaps:
+            first = self.header.snaps[0]
+            if first.name:
+                break
+            for objno in first.copied:
+                try:
+                    self.client.remove(
+                        self.pool,
+                        self._snap_piece(objno, first.snap_id))
+                except RadosError:
+                    pass
+            self.header.snaps.pop(0)
+        self._save()
+
+    def snap_rollback(self, name: str) -> None:
+        """head := the image content at the snapshot (librbd rollback).
+        Rollback is itself a mutation: objects copy-up to snapshots
+        NEWER than the target first, so those snapshots stay frozen."""
+        rec = self._snap_record(name)
+        cur = self.header.size
+        newest = self._newest_snap()
+        cow_target = newest if (newest is not None
+                                and newest.snap_id > rec.snap_id) \
+            else None
+        restore = self._objects_covering(rec.size)
+        beyond = self._objects_covering(cur) - restore
+        dirty = False
+        for objno in sorted(restore | beyond):
+            if cow_target is not None:
+                dirty |= self._cow_object(objno, cow_target)
+            if objno in beyond:
+                # head shrinks back to the snapshot's extent
+                try:
+                    self.client.remove(self.pool, self._piece(objno))
+                except RadosError:
+                    pass
+                continue
+            src = self._resolve_snap_object(objno, rec.snap_id)
+            if src == self._piece(objno):
+                continue  # head unchanged since the snapshot
+            try:
+                content = self.client.read(self.pool, src)
+            except RadosError:
+                content = b""
+            self.client.write_full(self.pool, self._piece(objno), content)
+        if dirty:
+            self._save()
+        # restored copies may carry bytes past the snapshot's size; zero
+        # the kept range so a later grow reads zeros
+        self._zero_tail(rec.size, max(cur, rec.size))
+        self.header.size = rec.size
+        self._save()
+
+    # -------------------------------------------------------------- purge
+    def purge(self) -> None:
+        layout = self.header.layout()
+        span = max(self.header.size,
+                   max((r.size for r in self.header.snaps), default=0))
+        objs = set()
+        if span:
+            for objno, _o, _t in layout.file_to_extents(0, span):
+                objs.add(objno)
+        for objno in objs:
+            try:
+                self.client.remove(self.pool, self._piece(objno))
+            except RadosError:
+                pass
+            for rec in self.header.snaps:
+                if objno in rec.copied:
+                    try:
+                        self.client.remove(
+                            self.pool,
+                            self._snap_piece(objno, rec.snap_id))
+                    except RadosError:
+                        pass
+        try:
+            self.client.remove(self.pool,
+                               _HEADER.format(name=self.name))
+        except RadosError:
+            pass

@@ -1,0 +1,215 @@
+"""rbd-lite: block images over RADOS with COW snapshots.
+
+The judge gate (librbd slice): create/resize/read/write/snapshot on
+images striped over objects, byte-exact under OSD thrash.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.services.rbd import RBD, RbdError
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(88)
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=8, cfg=make_cfg()).start()
+    yield c
+    c.stop()
+
+
+def _mkpool(client, kind="replicated"):
+    if kind == "ec":
+        client.create_pool("rbd", kind="ec", pg_num=2,
+                           ec_profile={"plugin": "jerasure", "k": "4",
+                                       "m": "2", "backend": "native"})
+    else:
+        client.create_pool("rbd", size=3, pg_num=2)
+
+
+def test_image_lifecycle_and_io(cluster):
+    client = cluster.client()
+    _mkpool(client)
+    rbd = RBD(client)
+    img = rbd.create("rbd", "disk0", 8 * 1024 * 1024,
+                     object_size=1024 * 1024)
+    assert rbd.list("rbd") == ["disk0"]
+    assert img.size() == 8 * 1024 * 1024
+    # cross-object writes land byte-exact
+    data = RNG.integers(0, 256, 3_000_000, dtype=np.uint8).tobytes()
+    img.write(500_000, data)  # spans objects 0..3
+    assert img.read(500_000, len(data)) == data
+    assert img.read(0, 100) == b"\0" * 100  # sparse reads as zeros
+    # bounds are enforced
+    with pytest.raises(RbdError):
+        img.write(img.size() - 10, b"x" * 20)
+    with pytest.raises(RbdError):
+        rbd.create("rbd", "disk0", 1)
+    rbd.remove("rbd", "disk0")
+    assert rbd.list("rbd") == []
+    with pytest.raises(RbdError):
+        rbd.open("rbd", "disk0")
+
+
+def test_image_striped_layout(cluster):
+    client = cluster.client()
+    _mkpool(client)
+    rbd = RBD(client)
+    img = rbd.create("rbd", "fast", 4 * 1024 * 1024,
+                     object_size=1024 * 1024, stripe_unit=65536,
+                     stripe_count=4)
+    data = RNG.integers(0, 256, 1_000_000, dtype=np.uint8).tobytes()
+    img.write(123_456, data)
+    assert img.read(123_456, len(data)) == data
+
+
+def test_resize_trims_and_zeroes(cluster):
+    client = cluster.client()
+    _mkpool(client)
+    img = RBD(client).create("rbd", "d", 4 * 1024 * 1024,
+                             object_size=1024 * 1024)
+    img.write(0, b"\xAB" * (3 * 1024 * 1024))
+    img.resize(1_500_000)
+    assert img.size() == 1_500_000
+    img.resize(4 * 1024 * 1024)
+    # regrown space reads zeros, not stale bytes
+    assert img.read(1_500_000, 1_000_000) == b"\0" * 1_000_000
+    assert img.read(0, 1_500_000) == b"\xAB" * 1_500_000
+
+
+def test_snapshots_cow_and_rollback(cluster):
+    client = cluster.client()
+    _mkpool(client)
+    img = RBD(client).create("rbd", "snappy", 2 * 1024 * 1024,
+                             object_size=512 * 1024)
+    v1 = RNG.integers(0, 256, 1_200_000, dtype=np.uint8).tobytes()
+    img.write(0, v1)
+    img.snap_create("s1")
+    patch = b"\xEE" * 400_000
+    img.write(300_000, patch)  # COW copies the touched objects
+    head = bytearray(v1)
+    head[300_000:700_000] = patch
+    assert img.read(0, len(v1)) == bytes(head)
+    assert img.read(0, len(v1), snap="s1") == v1  # snapshot is frozen
+    img.snap_create("s2")
+    img.write(0, b"\x11" * 200_000)
+    assert img.read(0, len(v1), snap="s1") == v1
+    assert img.read(0, len(v1), snap="s2") == bytes(head)
+    assert [s["name"] for s in img.snap_list()] == ["s1", "s2"]
+    # rollback to s1 restores head content
+    img.snap_rollback("s1")
+    assert img.read(0, len(v1)) == v1
+    # removing the newest snap keeps the older one readable
+    img.snap_remove("s2")
+    assert img.read(0, len(v1), snap="s1") == v1
+    img.snap_remove("s1")
+    assert img.snap_list() == []
+
+
+def test_shrink_preserves_snapshot_data(cluster):
+    """Trimmed objects must COW into the newest snapshot first."""
+    client = cluster.client()
+    _mkpool(client)
+    img = RBD(client).create("rbd", "d", 4 * 1024 * 1024,
+                             object_size=1024 * 1024)
+    data = RNG.integers(0, 256, 4 * 1024 * 1024,
+                        dtype=np.uint8).tobytes()
+    img.write(0, data)
+    img.snap_create("s1")
+    img.resize(1024 * 1024)
+    assert img.read(0, 4 * 1024 * 1024, snap="s1") == data
+    img.resize(4 * 1024 * 1024)
+    assert img.read(1024 * 1024, 3 * 1024 * 1024) == \
+        b"\0" * (3 * 1024 * 1024)
+    assert img.read(0, 4 * 1024 * 1024, snap="s1") == data
+
+
+def test_rollback_preserves_newer_snapshots(cluster):
+    """Rollback is a mutation: snapshots newer than the target must
+    copy-up before the head is overwritten."""
+    client = cluster.client()
+    _mkpool(client)
+    img = RBD(client).create("rbd", "d", 1024 * 1024,
+                             object_size=256 * 1024)
+    v1 = RNG.integers(0, 256, 1024 * 1024, dtype=np.uint8).tobytes()
+    img.write(0, v1)
+    img.snap_create("s1")
+    v2 = RNG.integers(0, 256, 1024 * 1024, dtype=np.uint8).tobytes()
+    img.write(0, v2)
+    img.snap_create("s2")  # no writes after s2: no copies yet
+    img.snap_rollback("s1")
+    assert img.read(0, len(v1)) == v1
+    assert img.read(0, len(v2), snap="s2") == v2  # s2 stayed frozen
+
+
+def test_striped_shrink_zeroes_whole_object_set(cluster):
+    """With striping, kept objects hold ranges across the whole object
+    set; shrink must zero them all (no resurrection on regrow)."""
+    client = cluster.client()
+    _mkpool(client)
+    img = RBD(client).create("rbd", "d", 4 * 1024 * 1024,
+                             object_size=1024 * 1024,
+                             stripe_unit=65536, stripe_count=4)
+    data = RNG.integers(0, 256, 4 * 1024 * 1024,
+                        dtype=np.uint8).tobytes()
+    img.write(0, data)
+    img.resize(100 * 1024)
+    img.resize(4 * 1024 * 1024)
+    assert img.read(0, 100 * 1024) == data[:100 * 1024]
+    rest = img.read(100 * 1024, 4 * 1024 * 1024 - 100 * 1024)
+    assert rest == b"\0" * len(rest)
+
+
+def test_rollback_to_smaller_then_grow_reads_zeros(cluster):
+    client = cluster.client()
+    _mkpool(client)
+    img = RBD(client).create("rbd", "d", 2 * 1024 * 1024,
+                             object_size=512 * 1024)
+    img.write(0, b"\xAA" * (2 * 1024 * 1024))
+    img.resize(512 * 1024)
+    img.snap_create("small")
+    img.resize(2 * 1024 * 1024)
+    img.write(512 * 1024, b"\xBB" * (512 * 1024))
+    img.snap_rollback("small")
+    assert img.size() == 512 * 1024
+    img.resize(2 * 1024 * 1024)
+    tail = img.read(512 * 1024, 3 * 512 * 1024)
+    assert tail == b"\0" * len(tail)
+
+
+def test_image_on_ec_pool_survives_thrash(cluster):
+    """The judge gate: an image on an EC pool keeps byte-exact reads
+    through OSD kills and revives."""
+    client = cluster.client()
+    _mkpool(client, kind="ec")
+    img = RBD(client).create("rbd", "vm0", 4 * 1024 * 1024,
+                             object_size=512 * 1024)
+    data = bytearray(RNG.integers(0, 256, 2_500_000,
+                                  dtype=np.uint8).tobytes())
+    img.write(0, bytes(data))
+    img.snap_create("base")
+    cluster.settle(0.5)
+    victims = sorted(cluster.osds)[:2]
+    epoch = cluster.mon.osdmap.epoch
+    for v in victims:
+        cluster.kill_osd(v)
+    cluster.wait_for_epoch(epoch + 2)
+    cluster.settle(1.0)
+    # degraded: head and snapshot both byte-exact
+    assert img.read(0, len(data)) == bytes(data)
+    patch = RNG.integers(0, 256, 300_000, dtype=np.uint8).tobytes()
+    img.write(1_000_000, patch)
+    data[1_000_000:1_300_000] = patch
+    assert img.read(0, len(data)) == bytes(data)
+    # revive and settle: still byte-exact, snapshot intact
+    for v in victims:
+        cluster.revive_osd(v)
+    cluster.settle(1.5)
+    assert img.read(0, len(data)) == bytes(data)
+    snap_view = img.read(0, 2_500_000, snap="base")
+    assert snap_view[:1_000_000] == bytes(data[:1_000_000])
+    assert snap_view[1_300_000:] == bytes(data[1_300_000:])
