@@ -94,6 +94,10 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(u8p), ctypes.c_size_t]
         L.ct_crc32c.restype = ctypes.c_uint32
         L.ct_crc32c.argtypes = [ctypes.c_uint32, u8p, ctypes.c_size_t]
+        L.ct_xxhash32.restype = ctypes.c_uint32
+        L.ct_xxhash32.argtypes = [ctypes.c_uint32, u8p, ctypes.c_size_t]
+        L.ct_xxhash64.restype = ctypes.c_uint64
+        L.ct_xxhash64.argtypes = [ctypes.c_uint64, u8p, ctypes.c_size_t]
         L.ct_init()
         return L
 
@@ -208,3 +212,34 @@ def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:
         data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
             data, dtype=np.uint8)
     return int(lib().ct_crc32c(ctypes.c_uint32(crc).value, _u8p(a), a.size))
+
+
+def xxhash32(data: bytes | np.ndarray, seed: int = 0) -> int:
+    """XXH32 (public xxHash spec) — the non-crc member of the reference
+    Checksummer dispatch (src/common/Checksummer.h:13)."""
+    a = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
+            data, dtype=np.uint8)
+    return int(lib().ct_xxhash32(ctypes.c_uint32(seed).value, _u8p(a),
+                                 a.size))
+
+
+def xxhash64(data: bytes | np.ndarray, seed: int = 0) -> int:
+    """XXH64 (public xxHash spec)."""
+    a = np.frombuffer(data, dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(
+            data, dtype=np.uint8)
+    return int(lib().ct_xxhash64(ctypes.c_uint64(seed).value, _u8p(a),
+                                 a.size))
+
+
+CSUM_FUNCS = {"crc32c": crc32c, "xxhash32": xxhash32, "xxhash64": xxhash64}
+
+
+def checksummer(kind: str):
+    """Checksummer dispatch (src/common/Checksummer.h:13 template
+    switch): pick a checksum family by name."""
+    try:
+        return CSUM_FUNCS[kind]
+    except KeyError:
+        raise ValueError(f"unknown checksum {kind!r}") from None

@@ -52,6 +52,7 @@ from ..utils.tracked_op import OpTracker
 from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
+from .extent_cache import ECExtentCache
 from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scheduler import ClassParams, MClockScheduler
 from .scrub import FaultInjection, ScrubMixin
@@ -145,6 +146,9 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._peer_invs: dict[PgId, dict[int, dict]] = {}
         self._peer_lcs: dict[PgId, dict[int, int]] = {}
         self._reconcile_at: dict[PgId, float] = {}
+        # hot shard extents for the partial-write pipeline
+        # (ECExtentCache role): serves the delta path's old-byte reads
+        self._ec_cache = ECExtentCache()
         self._hb_last: dict[int, float] = {}
         self._last_map = time.time()  # osd_beacon staleness clock
         self._hb_thread: threading.Thread | None = None
@@ -186,7 +190,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self.perf.add_many(["op_w", "op_r", "op_rw_bytes", "subop_w",
                             "subop_r", "recovery_push", "recovery_delta",
                             "rollbacks", "failure_reports",
-                            "scrubs", "scrub_errors"])
+                            "scrubs", "scrub_errors", "ec_cache_hit",
+                            "ec_cache_miss"])
         self.perf.add("op_lat", CounterType.TIME)
         # op scheduler (OpScheduler/mClockScheduler role): the messenger
         # thread classifies+enqueues; ONE dequeue worker executes
@@ -304,6 +309,19 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             return
         self.osdmap = newmap
         self._last_map = time.time()
+        # drop cached extents only for PGs whose membership actually
+        # changed (an unrelated epoch bump must not cold the cache)
+        if old is None:
+            self._ec_cache.clear()
+        else:
+            for pool_id, pool in newmap.pools.items():
+                for seed in range(pool.pg_num):
+                    new_up = newmap.pg_to_up_osds(pool_id, seed)
+                    old_up = old.pg_to_up_osds(pool_id, seed) \
+                        if pool_id in old.pools \
+                        and seed < old.pools[pool_id].pg_num else None
+                    if new_up != old_up:
+                        self._ec_cache.invalidate(PgId(pool_id, seed))
         dout("osd", 5)("%s: map epoch %d", self.name, newmap.epoch)
         # learn peer addresses from the map (wire transports; no-op
         # in-proc) — the OSDMap is the address book, as in the reference
@@ -758,6 +776,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         # whole-object (re)write: scatter the buffer into the RAID-0
         # shard streams and encode ALL rows in ONE kernel launch (the
         # batching seam of ECUtil::shard_extent_map_t::encode)
+        self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(m.data)
         parity = codec.encode_chunks(streams)
         attrs = {"v": version, "len": len(m.data)}
@@ -810,6 +829,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         conditional on prev_version (a stale shard refuses with EAGAIN
         and the client retries once recovery has caught it up)."""
         version = self._next_version(pgid)
+        self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(row_bytes)
         parity = codec.encode_chunks(streams)
         base = row0 * si.chunk_size
@@ -943,9 +963,18 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                         MSubDelta(wtid, pgid, m.oid, shard, version,
                                   list(flat), total_len=new_len,
                                   prev_version=prev))
+            # refill the extent cache with the bytes just written (the
+            # next overlapping overwrite skips the read fan); failure
+            # paths invalidate
+            for shard, lst in news.items():
+                for soff, nb in lst:
+                    self._ec_cache.write(pgid, m.oid, shard, soff, nb,
+                                         version=version)
             if remote == 0:
                 result = EIO if local_failed \
                     else (EAGAIN if local_retry else 0)
+                if result != 0:
+                    self._ec_cache.invalidate(pgid, m.oid)
                 self.messenger.send_message(
                     m.client,
                     MOSDOpReply(m.tid, result,
@@ -956,6 +985,32 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     m.client, m.tid, remote, version, failed=local_failed,
                     retry=local_retry, lock_key=lock_key)
 
+        # extent-cache fast path (ECExtentCache role): if EVERY touched
+        # segment is cached at a known version, skip the read fan-out
+        cver = self._ec_cache.version(pgid, m.oid)
+        if cver is not None:
+            cached: dict[int, np.ndarray] = {}
+            for shard, exts in per_shard.items():
+                parts = []
+                for soff, ln, _ro in exts:
+                    b = self._ec_cache.read(pgid, m.oid, shard, soff, ln)
+                    if b is None:
+                        break
+                    parts.append(b)
+                else:
+                    cached[shard] = np.frombuffer(b"".join(parts),
+                                                  dtype=np.uint8)
+                    continue
+                break
+            if len(cached) == len(per_shard):
+                self.perf.inc("ec_cache_hit")
+                pr = _PendingRead(None, 0, pgid.pool, m.oid,
+                                  total_shards=len(per_shard))
+                pr.chunks = cached
+                pr.shard_vers = {s: cver for s in per_shard}
+                on_old(pr)
+                return
+        self.perf.inc("ec_cache_miss")
         pr = _PendingRead(None, 0, pgid.pool, m.oid,
                           total_shards=len(per_shard), on_done=on_old)
         self._pending_reads[tid] = pr
@@ -1467,6 +1522,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._log_apply(tx, pgid, LogEntry(version, "remove", oid, shard,
                                            prev_version=-1))
         self.store.queue_transaction(tx)
+        self._ec_cache.invalidate(pgid, oid)
         self._record_tombstone(pgid, oid, version)
 
     def _handle_sub_write_reply(self, conn, m: MSubWriteReply) -> None:
@@ -1488,6 +1544,9 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 return
             self._pending_writes.pop(m.tid, None)
         result = EIO if pw.failed else (EAGAIN if pw.retry else 0)
+        if result != 0 and pw.lock_key is not None:
+            # a failed/torn write leaves cached extents untrustworthy
+            self._ec_cache.invalidate(*pw.lock_key)
         self.messenger.send_message(
             pw.client,
             MOSDOpReply(pw.client_tid, result, version=pw.version,
@@ -1566,6 +1625,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     self._pending_reads.pop(tid, None)
                     expired_r.append(pr)
         for pw in expired_w:
+            if pw.lock_key is not None:
+                self._ec_cache.invalidate(*pw.lock_key)
             self.messenger.send_message(
                 pw.client, MOSDOpReply(pw.client_tid, EIO,
                                        version=pw.version, epoch=epoch))
@@ -2065,6 +2126,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                                [_key(e.version) for e in span])
             if tx.ops:
                 self.store.queue_transaction(tx)
+        self._ec_cache.invalidate(pgid, oid)
         dout("osd", 2)("%s: rolled %s/%s shard %d back to v%d (%s)",
                        self.name, pgid, oid, shard, to_version,
                        "pre-images" if ok else "dropped for rebuild")
@@ -2102,7 +2164,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         def on_done(pr) -> None:
             if pr is None or (len(pr.chunks) < codec.k
                               and shard not in pr.chunks):
-                return  # not enough survivors to rebuild
+                # not enough survivors NOW; a later peering/requery round
+                # retries (never leave a hole with no retry scheduled)
+                self._requery_pg(pgid)
+                return
             chunks = pr.chunks
             push_version = version
             if pr.shard_vers:
@@ -2116,7 +2181,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     chunks = cand
                     push_version = max(version, vmax)
                 else:
-                    return  # no consistent set yet; a requery will retry
+                    self._requery_pg(pgid, force_full=True)
+                    return  # no consistent set yet; the requery retries
             if shard in chunks and not force:
                 rebuilt = chunks[shard]
             else:
@@ -2125,6 +2191,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 chunks = {i: c for i, c in chunks.items() if i != shard} \
                     if force else chunks
                 if len(chunks) < codec.k:
+                    self._requery_pg(pgid)
                     return
                 out = codec.decode([shard], dict(chunks))
                 rebuilt = out[shard]
@@ -2154,6 +2221,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     self.store.queue_transaction(
                         Transaction().remove(cid, oid))
         dead = self._tombstones.get(m.pgid, {})
+        for name in m.objects:
+            self._ec_cache.invalidate(m.pgid, name)
         for name, payload in m.objects.items():
             if dead.get(name, -1) >= payload[0]:
                 continue  # delete raced ahead of this push

@@ -1,0 +1,131 @@
+"""ECExtentCache: hot shard extents for the partial-write pipeline.
+
+The capability of the reference's ECExtentCache
+(src/osd/ECExtentCache.{h,cc}: an LRU of shard extents backing RMW
+reads so overlapping partial writes don't re-read what the pipeline
+just touched).  The primary consults it before fanning old-byte reads
+for a parity-delta overwrite and refills it with the bytes it reads
+and writes; anything that mutates shard state outside the primary's
+write pipeline (recovery pushes, rollbacks, removes, map changes)
+invalidates.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+
+
+class _Extents:
+    """Non-overlapping sorted (off -> bytearray) runs for one shard."""
+
+    __slots__ = ("runs",)
+
+    def __init__(self):
+        self.runs: list[tuple[int, bytearray]] = []
+
+    def nbytes(self) -> int:
+        return sum(len(b) for _o, b in self.runs)
+
+    def write(self, off: int, data: bytes) -> None:
+        """Insert/overwrite [off, off+len) and merge adjacent runs."""
+        end = off + len(data)
+        merged_off = off
+        buf = bytearray(data)
+        keep: list[tuple[int, bytearray]] = []
+        for roff, rbuf in self.runs:
+            rend = roff + len(rbuf)
+            if rend < off or roff > end:
+                keep.append((roff, rbuf))
+                continue
+            # overlap/adjacency: fold the old run around the new bytes
+            if roff < merged_off:
+                buf = rbuf[: merged_off - roff] + buf
+                merged_off = roff
+            if rend > end:
+                buf = buf + rbuf[len(rbuf) - (rend - end):]
+                end = rend
+        keep.append((merged_off, buf))
+        keep.sort(key=lambda t: t[0])
+        self.runs = keep
+
+    def read(self, off: int, length: int) -> bytes | None:
+        """The exact bytes if FULLY covered, else None."""
+        end = off + length
+        for roff, rbuf in self.runs:
+            if roff <= off and off + length <= roff + len(rbuf):
+                return bytes(rbuf[off - roff: end - roff])
+        return None
+
+
+class ECExtentCache:
+    def __init__(self, max_bytes: int = 8 << 20):
+        self._max = max_bytes
+        self._bytes = 0
+        self._lock = threading.Lock()
+        # key: (pgid, oid) -> shard -> _Extents; LRU by key
+        self._lru: collections.OrderedDict = collections.OrderedDict()
+        # object version the cached bytes correspond to (the pipeline
+        # updates it with every write it caches; external mutation
+        # paths invalidate instead)
+        self._ver: dict = {}
+
+    def version(self, pgid, oid: str) -> int | None:
+        with self._lock:
+            return self._ver.get((pgid, oid))
+
+    def read(self, pgid, oid: str, shard: int, off: int,
+             length: int) -> bytes | None:
+        with self._lock:
+            shards = self._lru.get((pgid, oid))
+            if shards is None:
+                return None
+            ext = shards.get(shard)
+            data = ext.read(off, length) if ext is not None else None
+            if data is None:
+                return None
+            self._lru.move_to_end((pgid, oid))
+            return data
+
+    def write(self, pgid, oid: str, shard: int, off: int,
+              data: bytes, version: int | None = None) -> None:
+        if not data:
+            return
+        with self._lock:
+            key = (pgid, oid)
+            shards = self._lru.get(key)
+            if shards is None:
+                shards = {}
+                self._lru[key] = shards
+            ext = shards.setdefault(shard, _Extents())
+            self._bytes -= ext.nbytes()
+            ext.write(off, data)
+            self._bytes += ext.nbytes()
+            if version is not None:
+                self._ver[key] = version
+            self._lru.move_to_end(key)
+            while self._bytes > self._max and self._lru:
+                k, dropped = self._lru.popitem(last=False)
+                self._ver.pop(k, None)
+                self._bytes -= sum(e.nbytes() for e in dropped.values())
+
+    def invalidate(self, pgid, oid: str | None = None) -> None:
+        with self._lock:
+            if oid is not None:
+                key = (pgid, oid)
+                dropped = self._lru.pop(key, None)
+                self._ver.pop(key, None)
+                if dropped:
+                    self._bytes -= sum(e.nbytes()
+                                       for e in dropped.values())
+                return
+            for key in [k for k in self._lru if k[0] == pgid]:
+                dropped = self._lru.pop(key)
+                self._ver.pop(key, None)
+                self._bytes -= sum(e.nbytes() for e in dropped.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._lru.clear()
+            self._ver.clear()
+            self._bytes = 0

@@ -1,0 +1,153 @@
+// xxhash32/64: the non-crc checksum family of the reference's
+// Checksummer (src/common/Checksummer.h:13 dispatches crc32c* and
+// xxhash32/xxhash64; the reference vendors xxhash.c).  Implemented
+// from the public XXH32/XXH64 specification (canonical constants and
+// round structure), C++-fresh for this build.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t P32_1 = 2654435761U;
+constexpr uint32_t P32_2 = 2246822519U;
+constexpr uint32_t P32_3 = 3266489917U;
+constexpr uint32_t P32_4 = 668265263U;
+constexpr uint32_t P32_5 = 374761393U;
+
+constexpr uint64_t P64_1 = 11400714785074694791ULL;
+constexpr uint64_t P64_2 = 14029467366897019727ULL;
+constexpr uint64_t P64_3 = 1609587929392839161ULL;
+constexpr uint64_t P64_4 = 9650029242287828579ULL;
+constexpr uint64_t P64_5 = 2870177450012600261ULL;
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+inline uint64_t rotl64(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;  // little-endian hosts only (x86/arm LE), as the build targets
+}
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline uint32_t round32(uint32_t acc, uint32_t input) {
+  acc += input * P32_2;
+  acc = rotl32(acc, 13);
+  acc *= P32_1;
+  return acc;
+}
+
+inline uint64_t round64(uint64_t acc, uint64_t input) {
+  acc += input * P64_2;
+  acc = rotl64(acc, 31);
+  acc *= P64_1;
+  return acc;
+}
+
+inline uint64_t merge64(uint64_t acc, uint64_t val) {
+  acc ^= round64(0, val);
+  acc = acc * P64_1 + P64_4;
+  return acc;
+}
+
+}  // namespace
+
+extern "C" {
+
+uint32_t ct_xxhash32(uint32_t seed, const uint8_t* data, size_t len) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint32_t h;
+  if (len >= 16) {
+    uint32_t v1 = seed + P32_1 + P32_2;
+    uint32_t v2 = seed + P32_2;
+    uint32_t v3 = seed + 0;
+    uint32_t v4 = seed - P32_1;
+    const uint8_t* limit = end - 16;
+    do {
+      v1 = round32(v1, read32(p)); p += 4;
+      v2 = round32(v2, read32(p)); p += 4;
+      v3 = round32(v3, read32(p)); p += 4;
+      v4 = round32(v4, read32(p)); p += 4;
+    } while (p <= limit);
+    h = rotl32(v1, 1) + rotl32(v2, 7) + rotl32(v3, 12) + rotl32(v4, 18);
+  } else {
+    h = seed + P32_5;
+  }
+  h += static_cast<uint32_t>(len);
+  while (p + 4 <= end) {
+    h += read32(p) * P32_3;
+    h = rotl32(h, 17) * P32_4;
+    p += 4;
+  }
+  while (p < end) {
+    h += (*p) * P32_5;
+    h = rotl32(h, 11) * P32_1;
+    ++p;
+  }
+  h ^= h >> 15;
+  h *= P32_2;
+  h ^= h >> 13;
+  h *= P32_3;
+  h ^= h >> 16;
+  return h;
+}
+
+uint64_t ct_xxhash64(uint64_t seed, const uint8_t* data, size_t len) {
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  if (len >= 32) {
+    uint64_t v1 = seed + P64_1 + P64_2;
+    uint64_t v2 = seed + P64_2;
+    uint64_t v3 = seed + 0;
+    uint64_t v4 = seed - P64_1;
+    const uint8_t* limit = end - 32;
+    do {
+      v1 = round64(v1, read64(p)); p += 8;
+      v2 = round64(v2, read64(p)); p += 8;
+      v3 = round64(v3, read64(p)); p += 8;
+      v4 = round64(v4, read64(p)); p += 8;
+    } while (p <= limit);
+    h = rotl64(v1, 1) + rotl64(v2, 7) + rotl64(v3, 12) + rotl64(v4, 18);
+    h = merge64(h, v1);
+    h = merge64(h, v2);
+    h = merge64(h, v3);
+    h = merge64(h, v4);
+  } else {
+    h = seed + P64_5;
+  }
+  h += static_cast<uint64_t>(len);
+  while (p + 8 <= end) {
+    h ^= round64(0, read64(p));
+    h = rotl64(h, 27) * P64_1 + P64_4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= static_cast<uint64_t>(read32(p)) * P64_1;
+    h = rotl64(h, 23) * P64_2 + P64_3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= (*p) * P64_5;
+    h = rotl64(h, 11) * P64_1;
+    ++p;
+  }
+  h ^= h >> 33;
+  h *= P64_2;
+  h ^= h >> 29;
+  h *= P64_3;
+  h ^= h >> 32;
+  return h;
+}
+
+}  // extern "C"

@@ -100,7 +100,7 @@ def test_thrash_osds_under_load(pool_kind, profile):
         # settle round for in-flight spare rebuilds)
         for name, states in acceptable.items():
             got = None
-            for attempt in range(4):
+            for attempt in range(6):
                 try:
                     got = client.read("p", name)
                     break
@@ -113,7 +113,7 @@ def test_thrash_osds_under_load(pool_kind, profile):
             assert got in states, f"{name} settled to an impossible state"
         # and consistent on disk (recovery/rollback reconciliation may
         # still be pushing shards right after the storm)
-        deadline = time.time() + 12
+        deadline = time.time() + 20
         issues = client.scrub_pool("p", deep=True)
         while issues and time.time() < deadline:
             c.settle(1.5)
