@@ -1,0 +1,123 @@
+"""Compressor plugin registry (src/compressor/Compressor.h shape)."""
+
+from __future__ import annotations
+
+import bz2
+import lzma
+import threading
+import zlib
+from abc import ABC, abstractmethod
+
+
+class Compressor(ABC):
+    """One algorithm: compress/decompress byte blobs.  `level` follows
+    the per-plugin convention (ref compressor plugins read their own
+    options)."""
+
+    name: str = ""
+
+    @abstractmethod
+    def compress(self, data: bytes) -> bytes: ...
+
+    @abstractmethod
+    def decompress(self, data: bytes,
+                   max_out: int | None = None) -> bytes:
+        """Decompress; when max_out is given, implementations MUST bound
+        the output allocation (decompression-bomb defence for wire
+        consumers) and raise ValueError if the stream exceeds it."""
+
+
+_FACTORIES: dict[str, type] = {}
+_LOCK = threading.Lock()
+
+
+def register(name: str):
+    def deco(cls):
+        cls.name = name
+        with _LOCK:
+            _FACTORIES[name] = cls
+        return cls
+    return deco
+
+
+def factory(name: str, **kw) -> Compressor:
+    with _LOCK:
+        cls = _FACTORIES.get(name)
+    if cls is None:
+        raise ValueError(f"no compressor plugin {name!r} "
+                         f"(have {sorted(_FACTORIES)})")
+    return cls(**kw)
+
+
+def registered() -> list[str]:
+    with _LOCK:
+        return sorted(_FACTORIES)
+
+
+@register("none")
+class NoneCompressor(Compressor):
+    def compress(self, data: bytes) -> bytes:
+        return bytes(data)
+
+    def decompress(self, data: bytes,
+                   max_out: int | None = None) -> bytes:
+        if max_out is not None and len(data) > max_out:
+            raise ValueError("output exceeds bound")
+        return bytes(data)
+
+
+@register("zlib")
+class ZlibCompressor(Compressor):
+    def __init__(self, level: int = 1):
+        self.level = int(level)
+
+    def compress(self, data: bytes) -> bytes:
+        return zlib.compress(data, self.level)
+
+    def decompress(self, data: bytes,
+                   max_out: int | None = None) -> bytes:
+        if max_out is None:
+            return zlib.decompress(data)
+        d = zlib.decompressobj()
+        out = d.decompress(data, max_out)
+        if d.unconsumed_tail or not d.eof:
+            raise ValueError("output exceeds bound")
+        return out
+
+
+@register("lzma")
+class LzmaCompressor(Compressor):
+    def __init__(self, preset: int = 0):
+        self.preset = int(preset)
+
+    def compress(self, data: bytes) -> bytes:
+        return lzma.compress(data, preset=self.preset)
+
+    def decompress(self, data: bytes,
+                   max_out: int | None = None) -> bytes:
+        if max_out is None:
+            return lzma.decompress(data)
+        d = lzma.LZMADecompressor()
+        out = d.decompress(data, max_length=max_out)
+        if not d.eof:
+            raise ValueError("output exceeds bound")
+        return out
+
+
+@register("bz2")
+class Bz2Compressor(Compressor):
+    def __init__(self, level: int = 1):
+        self.level = int(level)
+
+    def compress(self, data: bytes) -> bytes:
+        return bz2.compress(data, self.level)
+
+    def decompress(self, data: bytes,
+                   max_out: int | None = None) -> bytes:
+        if max_out is None:
+            return bz2.decompress(data)
+        d = bz2.BZ2Decompressor()
+        out = d.decompress(data, max_length=max_out)
+        if not d.eof:
+            raise ValueError("output exceeds bound")
+        return out

@@ -19,6 +19,9 @@ Addressing (the MonMap/OSDMap address plumbing):
 
 from __future__ import annotations
 
+import hmac
+import hashlib
+import secrets as _secrets
 import socket
 import struct
 import threading
@@ -27,6 +30,13 @@ import time
 from ..utils.log import dout
 from .messenger import Network
 from .wire import decode_frame, encode_frame
+
+_AUTH_MAGIC = b"CTPX1\0"
+_TAG_LEN = 16
+
+
+def _mac(key: bytes, *parts: bytes) -> bytes:
+    return hmac.new(key, b"".join(parts), hashlib.sha256).digest()
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
@@ -45,12 +55,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
 class _Conn:
     """One live socket + send lock (shared by both directions)."""
 
-    __slots__ = ("sock", "lock", "alive")
+    __slots__ = ("sock", "lock", "alive", "session_key")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.lock = threading.Lock()
         self.alive = True
+        self.session_key: bytes | None = None  # cephx-lite session
 
     def send_frame(self, frame: bytes) -> bool:
         with self.lock:
@@ -75,10 +86,29 @@ class _Conn:
             pass
 
 
+_COMPRESSED = 0x8000_0000  # frame-length flag bit (msgr v2
+# compression_onwire role: payload compressed, u32 raw length follows)
+
+
 class TcpNetwork(Network):
-    def __init__(self, host: str = "127.0.0.1", seed: int = 0):
+    def __init__(self, host: str = "127.0.0.1", seed: int = 0,
+                 compress: str = "none", compress_min: int = 4096,
+                 auth_secret: bytes | None = None):
         super().__init__(seed)
         self._host = host
+        # cephx-lite (src/auth/cephx role): shared-secret mutual
+        # challenge/response on connect derives a per-connection session
+        # key; every frame carries a truncated HMAC tag under it.  A
+        # peer without the secret can neither connect nor forge frames.
+        self._auth_secret = auth_secret
+        # on-wire compression (ProtocolV2 compression_onwire role):
+        # config-driven algorithm, applied to frames past the threshold;
+        # both endpoints of a deployment share the setting
+        self._compressor = None
+        self._compress_min = compress_min
+        if compress and compress != "none":
+            from ..compress import factory as _cfactory
+            self._compressor = _cfactory(compress)
         self._listeners: dict[str, socket.socket] = {}
         self._addrs: dict[str, str] = {}   # entity -> "host:port"
         self._routes: dict[str, _Conn] = {}  # learned reply routes
@@ -135,6 +165,49 @@ class TcpNetwork(Network):
         for c in conns:
             c.close()
 
+    # -- cephx-lite handshake ---------------------------------------------
+    def _auth_server(self, sock: socket.socket) -> bytes | None:
+        """Server leg of the challenge/response; returns the session key
+        or None on failure."""
+        sock.settimeout(5)
+        try:
+            hello = _recv_exact(sock, len(_AUTH_MAGIC) + 16)
+            if hello is None or not hello.startswith(_AUTH_MAGIC):
+                return None
+            nonce_c = hello[len(_AUTH_MAGIC):]
+            nonce_s = _secrets.token_bytes(16)
+            sock.sendall(nonce_s + _mac(self._auth_secret, b"srv",
+                                        nonce_c, nonce_s))
+            proof = _recv_exact(sock, 32)
+            want = _mac(self._auth_secret, b"cli", nonce_s, nonce_c)
+            if proof is None or not hmac.compare_digest(proof, want):
+                return None
+            return _mac(self._auth_secret, b"ses", nonce_c, nonce_s)
+        except OSError:
+            return None
+        finally:
+            sock.settimeout(None)
+
+    def _auth_client(self, sock: socket.socket) -> bytes | None:
+        sock.settimeout(5)
+        try:
+            nonce_c = _secrets.token_bytes(16)
+            sock.sendall(_AUTH_MAGIC + nonce_c)
+            reply = _recv_exact(sock, 16 + 32)
+            if reply is None:
+                return None
+            nonce_s, proof = reply[:16], reply[16:]
+            want = _mac(self._auth_secret, b"srv", nonce_c, nonce_s)
+            if not hmac.compare_digest(proof, want):
+                return None
+            sock.sendall(_mac(self._auth_secret, b"cli", nonce_s,
+                              nonce_c))
+            return _mac(self._auth_secret, b"ses", nonce_c, nonce_s)
+        except OSError:
+            return None
+        finally:
+            sock.settimeout(None)
+
     # -- receive side ------------------------------------------------------
     def _accept_loop(self, owner: str, ls: socket.socket) -> None:
         while not self._stopping:
@@ -143,9 +216,19 @@ class TcpNetwork(Network):
             except OSError:
                 return
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = _Conn(sock)
-            threading.Thread(target=self._read_loop, args=(conn,),
+            threading.Thread(target=self._serve_conn, args=(sock, owner),
                              name=f"tcp-read-{owner}", daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket, owner: str) -> None:
+        conn = _Conn(sock)
+        if self._auth_secret is not None:
+            key = self._auth_server(sock)
+            if key is None:
+                dout("msg", 1)("tcp %s: auth handshake failed", owner)
+                conn.close()
+                return
+            conn.session_key = key
+        self._read_loop(conn)
 
     MAX_FRAME = 256 << 20  # recovery pushes batch objects; cap garbage
 
@@ -156,6 +239,8 @@ class TcpNetwork(Network):
             if head is None:
                 break
             (length,) = struct.unpack("<I", head)
+            compressed = bool(length & _COMPRESSED)
+            length &= ~_COMPRESSED
             if length > self.MAX_FRAME:
                 # a non-protocol peer (port scan, probe): drop before
                 # attempting a multi-GB buffer
@@ -164,6 +249,36 @@ class TcpNetwork(Network):
             payload = _recv_exact(sock, length)
             if payload is None:
                 break
+            if conn.session_key is not None:
+                # verify-and-strip the per-frame signature (cephx
+                # message signing role)
+                if len(payload) < _TAG_LEN:
+                    break
+                payload, tag = payload[:-_TAG_LEN], payload[-_TAG_LEN:]
+                want = _mac(conn.session_key, payload)[:_TAG_LEN]
+                if not hmac.compare_digest(tag, want):
+                    dout("msg", 0)("tcp: BAD frame signature; dropping "
+                                   "connection")
+                    break
+            if compressed:
+                if self._compressor is None or len(payload) < 4:
+                    dout("msg", 1)("tcp: compressed frame but no "
+                                   "compressor configured")
+                    break
+                (rawlen,) = struct.unpack("<I", payload[:4])
+                if rawlen > self.MAX_FRAME:
+                    dout("msg", 1)("tcp: oversized decompressed frame "
+                                   "(%d)", rawlen)
+                    break
+                try:
+                    payload = self._compressor.decompress(
+                        payload[4:], max_out=rawlen)
+                except Exception as e:  # noqa: BLE001 - bad peer data
+                    dout("msg", 1)("tcp: undecompressable frame: %r", e)
+                    break
+                if len(payload) != rawlen:
+                    dout("msg", 1)("tcp: decompressed size mismatch")
+                    break
             try:
                 src, dst, msg = decode_frame(payload)
             except Exception as e:  # noqa: BLE001 - poisoned frame
@@ -191,6 +306,13 @@ class TcpNetwork(Network):
             return None
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         conn = _Conn(sock)
+        if self._auth_secret is not None:
+            key = self._auth_client(sock)
+            if key is None:
+                dout("msg", 1)("tcp: auth to %s failed", addr)
+                conn.close()
+                return None
+            conn.session_key = key
         # outgoing pipes are bidirectional: replies come back on them
         threading.Thread(target=self._read_loop, args=(conn,),
                          name=f"tcp-read-out-{addr}", daemon=True).start()
@@ -230,11 +352,18 @@ class TcpNetwork(Network):
             return True  # silently dropped, like a lossy wire
         if self.latency:
             time.sleep(self.latency)
-        frame = encode_frame(src, dst, msg)
+        payload = encode_frame(src, dst, msg)[4:]
+        flags = 0
+        if self._compressor is not None and \
+                len(payload) >= self._compress_min:
+            packed = self._compressor.compress(payload)
+            if len(packed) + 4 < len(payload):  # only when it wins
+                payload = struct.pack("<I", len(payload)) + packed
+                flags = _COMPRESSED
         conn = self._conn_for(dst)
         if conn is None:
             return False
-        if conn.send_frame(frame):
+        if conn.send_frame(self._finalize(conn, flags, payload)):
             return True
         # stale cached pipe: retry once on a fresh connection
         with self._net_lock:
@@ -242,4 +371,14 @@ class TcpNetwork(Network):
                 for k in [k for k, v in table.items() if v is conn]:
                     del table[k]
         conn2 = self._conn_for(dst)
-        return conn2 is not None and conn2.send_frame(frame)
+        return conn2 is not None and \
+            conn2.send_frame(self._finalize(conn2, flags, payload))
+
+    @staticmethod
+    def _finalize(conn: _Conn, flags: int, payload: bytes) -> bytes:
+        """Per-connection frame finalization: sign under the session key
+        (cephx message signing) and length-prefix."""
+        if conn.session_key is not None:
+            payload = payload + _mac(conn.session_key,
+                                     payload)[:_TAG_LEN]
+        return struct.pack("<I", len(payload) | flags) + payload

@@ -309,19 +309,21 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             return
         self.osdmap = newmap
         self._last_map = time.time()
-        # drop cached extents only for PGs whose membership actually
-        # changed (an unrelated epoch bump must not cold the cache)
+        # drop cached extents only for CACHED PGs whose membership
+        # actually changed (an unrelated epoch bump must not cold the
+        # cache, and the check is O(cached PGs), not O(cluster PGs))
         if old is None:
             self._ec_cache.clear()
         else:
-            for pool_id, pool in newmap.pools.items():
-                for seed in range(pool.pg_num):
-                    new_up = newmap.pg_to_up_osds(pool_id, seed)
-                    old_up = old.pg_to_up_osds(pool_id, seed) \
-                        if pool_id in old.pools \
-                        and seed < old.pools[pool_id].pg_num else None
-                    if new_up != old_up:
-                        self._ec_cache.invalidate(PgId(pool_id, seed))
+            for pgid in self._ec_cache.pgids():
+                if pgid.pool not in newmap.pools or \
+                        pgid.pool not in old.pools or \
+                        pgid.seed >= old.pools[pgid.pool].pg_num:
+                    self._ec_cache.invalidate(pgid)
+                    continue
+                if newmap.pg_to_up_osds(pgid.pool, pgid.seed) != \
+                        old.pg_to_up_osds(pgid.pool, pgid.seed):
+                    self._ec_cache.invalidate(pgid)
         dout("osd", 5)("%s: map epoch %d", self.name, newmap.epoch)
         # learn peer addresses from the map (wire transports; no-op
         # in-proc) — the OSDMap is the address book, as in the reference

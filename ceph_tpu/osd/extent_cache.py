@@ -70,6 +70,12 @@ class ECExtentCache:
         # paths invalidate instead)
         self._ver: dict = {}
 
+    def pgids(self) -> set:
+        """PGs with cached entries (map-change invalidation scans only
+        these, not the whole cluster's placement)."""
+        with self._lock:
+            return {k[0] for k in self._lru}
+
     def version(self, pgid, oid: str) -> int | None:
         with self._lock:
             return self._ver.get((pgid, oid))

@@ -34,6 +34,10 @@ def main(argv=None) -> int:
                     help="JSON object of config overrides")
     ap.add_argument("--admin-socket", default=None,
                     help="unix socket path for `ceph daemon` commands")
+    ap.add_argument("--auth-secret-hex", default=None,
+                    help="cephx-lite shared secret (hex)")
+    ap.add_argument("--compress", default="none",
+                    help="on-wire compression algorithm")
     args = ap.parse_args(argv)
 
     from ..msg.tcp import TcpNetwork
@@ -43,7 +47,9 @@ def main(argv=None) -> int:
 
     cfg = default_config()
     cfg.apply_dict(json.loads(args.cfg))
-    net = TcpNetwork()
+    secret = bytes.fromhex(args.auth_secret_hex) \
+        if args.auth_secret_hex is not None else None
+    net = TcpNetwork(auth_secret=secret, compress=args.compress)
     net.set_addr(args.mon_name, args.mon_addr)
     store_kw = {"path": args.store_path} if args.store_path else {}
     store = ObjectStore.create(args.store, **store_kw)

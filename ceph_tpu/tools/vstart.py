@@ -23,15 +23,20 @@ class MiniCluster:
                  hosts_per_osd: bool = True, transport: str = "local",
                  n_mons: int = 1, mon_path: str | None = None,
                  admin_dir: str | None = None,
-                 metrics_port: int | None = None):
+                 metrics_port: int | None = None,
+                 tcp_auth_secret: bytes | None = None,
+                 tcp_compress: str = "none"):
         self.cfg = cfg or default_config()
         if transport == "tcp":
             from ..msg.tcp import TcpNetwork
-            self.network = TcpNetwork()
+            self.network = TcpNetwork(auth_secret=tcp_auth_secret,
+                                      compress=tcp_compress)
         elif transport == "local":
             self.network = LocalNetwork()
         else:
             raise ValueError(f"unknown transport {transport!r}")
+        self._tcp_auth_secret = tcp_auth_secret
+        self._tcp_compress = tcp_compress
         self.mon_names = [f"mon.{i}" for i in range(n_mons)]
         self.mons: dict[int, MonitorLite] = {}
         self._mon_path = mon_path
@@ -162,6 +167,10 @@ class MiniCluster:
             argv += ["--admin-socket",
                      os.path.join(self._admin_dir,
                                   f"osd.{osd_id}.asok")]
+        if self._tcp_auth_secret is not None:
+            argv += ["--auth-secret-hex", self._tcp_auth_secret.hex()]
+        if self._tcp_compress != "none":
+            argv += ["--compress", self._tcp_compress]
         # the child must find the package regardless of caller cwd
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(ceph_tpu.__file__)))
