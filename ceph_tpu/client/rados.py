@@ -18,8 +18,9 @@ import time
 from ..mon.maps import OSDMap
 from ..msg.messages import (MMapPush, MMonCommand, MMonCommandReply,
                             MMonSubscribe, MOSDOp, MOSDOpReply, MScrubRequest,
-                            MScrubResult, PgId)
+                            MScrubResult, PgId, MNotifyAck, MWatchNotify)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
+from ..msg.wire import pack_value, unpack_value
 from ..utils.log import dout
 
 
@@ -50,6 +51,11 @@ class RadosClient(Dispatcher):
         self._waiters: dict[int, threading.Event] = {}
         self._replies: dict[int, object] = {}
         self._map_cond = threading.Condition()
+        # (pool_id, oid) -> (callback, cookie) — re-asserted on map change
+        self._watches: dict[tuple, tuple] = {}
+        self._cookies = itertools.count(1)
+        self._watch_renewer = None
+        self._closed = False
 
     # ------------------------------------------------------------ lifecycle
     def connect(self) -> "RadosClient":
@@ -77,16 +83,31 @@ class RadosClient(Dispatcher):
         self.messenger.send_message(self.mon, MMonSubscribe("osdmap"))
 
     def close(self) -> None:
+        self._closed = True
         self.messenger.shutdown()
 
     # ------------------------------------------------------------- dispatch
     def ms_dispatch(self, conn, msg) -> bool:
         if isinstance(msg, MMapPush):
+            changed = False
             with self._map_cond:
                 m = OSDMap.decode_bytes(msg.map_bytes)
                 if self.osdmap is None or m.epoch > self.osdmap.epoch:
                     self.osdmap = m
+                    changed = True
                 self._map_cond.notify_all()
+            if changed and self._watches:
+                # linger-op role: watches are primary-local soft state,
+                # re-assert them after any map change
+                self._reregister_watches()
+            return True
+        if isinstance(msg, MWatchNotify):
+            cb = self._watches.get((msg.pool, msg.oid), (None, 0))[0]
+            try:
+                if cb is not None:
+                    cb(msg.oid, msg.notifier, msg.payload)
+            finally:
+                conn.send(MNotifyAck(msg.notify_id, self.name))
             return True
         if isinstance(msg, (MOSDOpReply, MMonCommandReply, MScrubResult)):
             ev = self._waiters.get(msg.tid)
@@ -261,3 +282,84 @@ class RadosClient(Dispatcher):
     def stat(self, pool: str, oid: str) -> int:
         reply = self._op(pool, oid, "stat")
         return int.from_bytes(reply.data, "little")
+
+
+    # ------------------------------------------ extended ops (do_osd_ops)
+    _pack = staticmethod(pack_value)
+    _unpack = staticmethod(unpack_value)
+
+    def omap_set(self, pool: str, oid: str, kv: dict) -> None:
+        self._op(pool, oid, "omap_set",
+                 self._pack({str(k): bytes(v) for k, v in kv.items()}))
+
+    def omap_get(self, pool: str, oid: str) -> dict:
+        return self._unpack(self._op(pool, oid, "omap_get").data)
+
+    def omap_rm(self, pool: str, oid: str, keys) -> None:
+        self._op(pool, oid, "omap_rm", self._pack([str(k) for k in keys]))
+
+    WATCH_RENEW = 10.0  # server expiry is 30s; renew well inside it
+
+    def watch(self, pool: str, oid: str, callback) -> int:
+        """Register interest in notifies on the object (librados watch):
+        callback(oid, notifier, payload) runs on the dispatch thread.
+        A renewal thread keeps the server-side watch alive (Watch.cc
+        timeout semantics)."""
+        cookie = next(self._cookies)
+        self._watches[(self._pool_id(pool), oid)] = (callback, cookie)
+        self._op(pool, oid, "watch", offset=cookie)
+        if self._watch_renewer is None:
+            self._watch_renewer = threading.Thread(
+                target=self._renew_watches, name=f"{self.name}-rewatch",
+                daemon=True)
+            self._watch_renewer.start()
+        return cookie
+
+    def _renew_watches(self) -> None:
+        while not self._closed and self._watches:
+            time.sleep(self.WATCH_RENEW)
+            if self._closed:
+                return
+            self._reregister_watches()
+
+    def unwatch(self, pool: str, oid: str) -> None:
+        self._watches.pop((self._pool_id(pool), oid), None)
+        self._op(pool, oid, "unwatch")
+
+    def notify(self, pool: str, oid: str, payload: bytes = b"") -> list:
+        """Fan a notify to every watcher; returns who acked (librados
+        notify2 shape)."""
+        return self._unpack(
+            self._op(pool, oid, "notify", bytes(payload)).data)
+
+    def cls_call(self, pool: str, oid: str, cls: str, method: str,
+                 input_=None):
+        """Execute an object-class method server-side (rados exec)."""
+        reply = self._op(pool, oid, "call",
+                         self._pack({"cls": cls, "method": method,
+                                     "input": input_}))
+        return self._unpack(reply.data)
+
+    def _reregister_watches(self) -> None:
+        """Re-assert watches after a map change.  Runs the registration
+        through _op (with its EAGAIN/peering retries) on a SIDE thread:
+        the dispatch thread must not block on replies it itself
+        delivers."""
+        watches = list(self._watches.items())
+
+        def rereg():
+            for (pool_id, oid), (_cb, cookie) in watches:
+                if (pool_id, oid) not in self._watches:
+                    continue  # unwatched meanwhile
+                pool_name = next(
+                    (p.name for p in self.osdmap.pools.values()
+                     if p.pool_id == pool_id), None)
+                if pool_name is None:
+                    continue
+                try:
+                    self._op(pool_name, oid, "watch", offset=cookie)
+                except RadosError:
+                    pass  # retried on the next map change
+
+        threading.Thread(target=rereg, name=f"{self.name}-rewatch",
+                         daemon=True).start()

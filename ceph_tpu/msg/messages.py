@@ -390,6 +390,27 @@ class MMonFwdReply:
     frame: bytes
 
 
+# ------------------------------------------------------------ watch/notify
+@dataclass
+class MWatchNotify:
+    """Primary -> watching client: a notify fired on an object you
+    watch (src/osd/Watch.cc role)."""
+
+    notify_id: int
+    pool: int
+    oid: str
+    notifier: str
+    payload: bytes = b""
+
+
+@dataclass
+class MNotifyAck:
+    """Watching client -> primary: notify processed."""
+
+    notify_id: int
+    watcher: str
+
+
 # ------------------------------------------------------------- mgr stats
 @dataclass
 class MStatsReport:

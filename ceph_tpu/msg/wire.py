@@ -117,6 +117,7 @@ MESSAGE_TYPES: list[type] = [
     M.MMonPropose, M.MMonPropAck, M.MMonSyncReq,                  # 30-32
     M.MMonSyncEntries, M.MMonForward, M.MMonFwdReply,             # 33-35
     M.MPGRollback,                                                # 36
+    M.MWatchNotify, M.MNotifyAck,                                 # 37-38
 ]
 _TYPE_IDS = {t: i + 1 for i, t in enumerate(MESSAGE_TYPES)}
 _ID_TYPES = {i: t for t, i in _TYPE_IDS.items()}
@@ -152,6 +153,18 @@ def _decode_body(dec: Decoder, cls):
         return cls(*values[: len(fields)])
 
     return dec.versioned(_GENERIC_VERSION, body)
+
+
+def pack_value(value) -> bytes:
+    """One tagged value as bytes (the shared serialization helper for
+    op payloads, class IO, and client APIs)."""
+    e = Encoder()
+    encode_value(e, value)
+    return e.tobytes()
+
+
+def unpack_value(raw: bytes):
+    return decode_value(Decoder(raw)) if raw else None
 
 
 def encode_frame(src: str, dst: str, msg) -> bytes:

@@ -38,11 +38,12 @@ from .. import ec
 from ..ec.stripe import StripeInfo, plan_write
 from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
-                            MOSDBoot, MOSDOp, MOSDOpReply, MOSDPing,
-                            MOSDPingReply, MPGInfo, MPGPull, MPGPush,
-                            MPGQuery, MPGRollback, MStatsReport, MSubDelta,
-                            MSubPartialWrite, MSubRead, MSubReadReply,
-                            MSubWrite, MSubWriteReply, PgId)
+                            MNotifyAck, MOSDBoot, MOSDOp, MOSDOpReply,
+                            MOSDPing, MOSDPingReply, MPGInfo, MPGPull,
+                            MPGPush, MPGQuery, MPGRollback, MStatsReport,
+                            MSubDelta, MSubPartialWrite, MSubRead,
+                            MSubReadReply, MSubWrite, MSubWriteReply,
+                            PgId)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
@@ -53,6 +54,7 @@ from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
 from .extent_cache import ECExtentCache
+from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scheduler import ClassParams, MClockScheduler
 from .scrub import FaultInjection, ScrubMixin
@@ -105,7 +107,7 @@ class _ClientConn:
         return self._daemon.messenger.send_message(self._client, msg)
 
 
-class OSDDaemon(ScrubMixin, Dispatcher):
+class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
     def __init__(self, osd_id: int, network: Network,
                  mon: str = "mon.0", store: ObjectStore | None = None,
                  cfg: Config | None = None, host: str | None = None,
@@ -166,6 +168,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         self._pending_scrubs: dict = {}
         self.inject = FaultInjection()
         self.op_tracker = OpTracker()
+        self._init_objops()
         self._handlers = {
             MScrubRequest: self._handle_scrub_request,
             MScrubShard: self._handle_scrub_shard,
@@ -185,6 +188,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             MPGPull: self._handle_pg_pull,
             MPGPush: self._handle_pg_push,
             MPGRollback: self._handle_pg_rollback,
+            MNotifyAck: self._handle_notify_ack,
         }
         self.perf = global_perf().create(self.name)
         self.perf.add_many(["op_w", "op_r", "op_rw_bytes", "subop_w",
@@ -462,6 +466,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     self._obj_lock(key, rthunk)
                 elif m.op == "stat":
                     self._stat(conn, m, pgid, shard=0)
+                elif m.op in self.EXTENDED_OPS:
+                    self._handle_extended_op(conn, m, pgid, up)
                 else:
                     conn.send(MOSDOpReply(m.tid, EINVAL,
                                           epoch=self.osdmap.epoch))
@@ -477,6 +483,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     self._rep_remove(conn, m, pgid, up)
                 elif m.op == "stat":
                     self._stat(conn, m, pgid, shard=-1)
+                elif m.op in self.EXTENDED_OPS:
+                    self._handle_extended_op(conn, m, pgid, up)
                 else:
                     conn.send(MOSDOpReply(m.tid, EINVAL,
                                           epoch=self.osdmap.epoch))
@@ -1464,7 +1472,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
 
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
-                     attrs: dict) -> None:
+                     attrs: dict, omap: dict | None = None) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = ObjectId(oid, shard=shard)
         # stored digest for deep scrub (per-blob csum, BlueStore role)
@@ -1476,6 +1484,17 @@ class OSDDaemon(ScrubMixin, Dispatcher):
         tx.truncate(cid, obj, 0)
         tx.write(cid, obj, 0, data)
         tx.setattrs(cid, obj, {k: v for k, v in attrs.items()})
+        if omap is not None:
+            # recovery pushes carry the object's omap: REPLACE ours
+            try:
+                old_keys = list(self.store.omap_get(cid, obj))
+            except NoSuchObject:
+                old_keys = []
+            if old_keys:
+                tx.omap_rmkeys(cid, obj, old_keys)
+            if omap:
+                tx.omap_setkeys(cid, obj, {str(k): bytes(v)
+                                           for k, v in omap.items()})
         if "v" in attrs:
             try:
                 old = self.store.getattrs(cid, obj)
@@ -1510,6 +1529,14 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                 return
         elif m.op == "remove":
             self._apply_remove(m.pgid, m.oid, m.shard, m.version)
+        elif m.op in ("omap_set", "omap_rm"):
+            from ..msg.wire import unpack_value
+            self._apply_omap(m.pgid, m.oid, m.op, unpack_value(m.data),
+                             m.version, create_ok=True)
+        elif m.op == "cls_effects":
+            from ..msg.wire import unpack_value
+            self._apply_cls_effects(m.pgid, m.oid, unpack_value(m.data),
+                                    m.version)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0), m.version)
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
@@ -1551,7 +1578,10 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._ec_cache.invalidate(*pw.lock_key)
         self.messenger.send_message(
             pw.client,
-            MOSDOpReply(pw.client_tid, result, version=pw.version,
+            MOSDOpReply(pw.client_tid, result,
+                        data=getattr(pw, "reply_data", b"")
+                        if result == 0 else b"",
+                        version=pw.version,
                         epoch=self.osdmap.epoch if self.osdmap else 0))
         self._obj_unlock(pw.lock_key)
 
@@ -1635,6 +1665,7 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             self._obj_unlock(pw.lock_key)
         for pr in expired_r:
             self._finish_ec_read(pr)  # decodes if >= k arrived, else err
+        self._sweep_notifies(now, max_age)
 
     def _report_stats(self, budget: float = 0.5) -> None:
         """Usage/perf summary to the monitor (MMgrReport/PGStats role).
@@ -1854,7 +1885,9 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     data = self.store.read(cid,
                                            ObjectId(name)).to_bytes()
                     attrs = self.store.getattrs(cid, ObjectId(name))
-                    push[name] = (int(attrs.get("v", v)), data)
+                    push[name] = (int(attrs.get("v", v)), data, None,
+                                  self.store.omap_get(cid,
+                                                      ObjectId(name)))
                 except NoSuchObject:
                     continue
             if push and peer != self.osd_id:
@@ -1877,7 +1910,9 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             pv = peer_inv.get((name, shard), -1)
             if pv < v:
                 data = self.store.read(cid, ObjectId(name, shard)).to_bytes()
-                push[name] = (v, data)
+                push[name] = (v, data, None,
+                              self.store.omap_get(cid,
+                                                  ObjectId(name, shard)))
         for (name, shard), pv in peer_inv.items():
             if dead.get(name, -1) >= pv:
                 deletes[name] = dead[name]  # peer missed the remove
@@ -1907,7 +1942,8 @@ class OSDDaemon(ScrubMixin, Dispatcher):
             try:
                 data = self.store.read(cid, ObjectId(name)).to_bytes()
                 attrs = self.store.getattrs(cid, ObjectId(name))
-                push[name] = (int(attrs.get("v", 0)), data)
+                push[name] = (int(attrs.get("v", 0)), data, None,
+                              self.store.omap_get(cid, ObjectId(name)))
             except NoSuchObject:
                 continue
         if push:
@@ -2247,9 +2283,11 @@ class OSDDaemon(ScrubMixin, Dispatcher):
                     attrs["len"] = total
                 self._apply_write(m.pgid, name, m.shard, data, attrs)
             else:
-                version, data = payload
+                version, data = payload[0], payload[1]
+                omap = payload[3] if len(payload) > 3 else None
                 self._apply_write(m.pgid, name, -1, data,
-                                  {"v": version, "len": len(data)})
+                                  {"v": version, "len": len(data)},
+                                  omap=omap)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0),
             max((p[0] for p in m.objects.values()), default=0))

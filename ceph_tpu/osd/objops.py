@@ -1,0 +1,276 @@
+"""Extended object ops: omap, watch/notify, object classes.
+
+The PrimaryLogPG op breadth beyond read/write/remove/stat (ref
+PrimaryLogPG::do_osd_ops op-switch :6163 — omap get/set/rm ops,
+watch/notify via src/osd/Watch.cc, `call` into object classes), as a
+mixin on OSDDaemon.  Replicated pools only this round: EC omap needs
+the ECOmapJournal tier (planned); watch/notify state is primary-local
+soft state and clients re-register on map change, the reference's
+linger-op semantic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..msg.messages import (MNotifyAck, MOSDOpReply, MSubWrite,
+                            MWatchNotify, PgId)
+from ..msg.wire import pack_value as _pack, unpack_value as _unpack
+from ..ops.native import crc32c as _crc32c
+from . import classes as cls_mod
+from .objectstore import CollectionId, NoSuchObject, ObjectId, Transaction
+
+EIO, ENOENT, EINVAL = -5, -2, -22
+
+
+@dataclass
+class _PendingNotify:
+    client: str
+    client_tid: int
+    waiting: set
+    acked: list = field(default_factory=list)
+    stamp: float = field(default_factory=time.time)
+
+
+class ObjOpsMixin:
+    """Mixed into OSDDaemon; dispatches the extended replicated ops."""
+
+    WATCH_TIMEOUT = 30.0  # Watch.cc timeout role; clients renew
+
+    def _init_objops(self) -> None:
+        # (pgid, oid) -> {client: (cookie, expires)}  (Watch.cc state)
+        self._watchers: dict[tuple, dict[str, tuple]] = {}
+        self._pending_notifies: dict[int, _PendingNotify] = {}
+
+    # ---------------------------------------------------------- dispatch
+    EXTENDED_OPS = ("omap_get", "omap_set", "omap_rm", "watch",
+                    "unwatch", "notify", "call")
+
+    def _handle_extended_op(self, conn, m, pgid: PgId, up: list) -> None:
+        pool = self.osdmap.pools[m.pool]
+        if pool.kind == "ec":
+            # EC omap/watch/cls need the ECOmapJournal tier (planned)
+            conn.send(MOSDOpReply(m.tid, EINVAL,
+                                  epoch=self.osdmap.epoch))
+            return
+        handler = {
+            "omap_get": self._op_omap_get,
+            "omap_set": self._op_omap_mut,
+            "omap_rm": self._op_omap_mut,
+            "watch": self._op_watch,
+            "unwatch": self._op_watch,
+            "notify": self._op_notify,
+            "call": self._op_call,
+        }[m.op]
+        handler(conn, m, pgid, up)
+
+    # -------------------------------------------------------------- omap
+    def _op_omap_get(self, conn, m, pgid: PgId, up: list) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        try:
+            omap = self.store.omap_get(cid, ObjectId(m.oid))
+        except NoSuchObject:
+            conn.send(MOSDOpReply(m.tid, ENOENT,
+                                  epoch=self.osdmap.epoch))
+            return
+        conn.send(MOSDOpReply(m.tid, 0, data=_pack(omap),
+                              epoch=self.osdmap.epoch))
+
+    def _op_omap_mut(self, conn, m, pgid: PgId, up: list) -> None:
+        """omap_set (data = packed {key: bytes}) / omap_rm (data =
+        packed [keys]); replicated like any write."""
+        payload = _unpack(m.data)
+        version = self._next_version(pgid)
+        if not self._apply_omap(pgid, m.oid, m.op, payload, version,
+                                create_ok=(m.op == "omap_set")):
+            conn.send(MOSDOpReply(m.tid, ENOENT,
+                                  epoch=self.osdmap.epoch))
+            return
+        peers = [u for u in up if u is not None and u != self.osd_id]
+        if not peers:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            return
+        tid = next(self._tids)
+        from .daemon import _PendingWrite
+        self._pending_writes[tid] = _PendingWrite(
+            m.client, m.tid, len(peers), version)
+        for peer in peers:
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MSubWrite(tid, pgid, m.oid, -1, version, m.op, m.data))
+
+    def _apply_omap(self, pgid: PgId, oid: str, op: str, payload,
+                    version: int, create_ok: bool = False) -> bool:
+        from .pglog import LogEntry
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid)
+        tx = Transaction()
+        exists = self.store.exists(cid, obj)
+        if not exists:
+            if not create_ok:
+                return False
+            tx.touch(cid, obj)
+        if op == "omap_set":
+            tx.omap_setkeys(cid, obj, {str(k): bytes(v)
+                                       for k, v in payload.items()})
+        else:
+            keys = [str(k) for k in payload]
+            have = set(self.store.omap_get(cid, obj))
+            tx.omap_rmkeys(cid, obj, [k for k in keys if k in have])
+        data = self.store.read(cid, obj).to_bytes() if exists else b""
+        tx.setattrs(cid, obj, {"v": version, "d": _crc32c(data),
+                               "len": len(data)})
+        # every versioned mutation logs (last-complete must stay
+        # contiguous; delta recovery replays the object WITH its omap)
+        self._log_apply(tx, pgid, LogEntry(version, "omap", oid, -1,
+                                           prev_version=-1))
+        self.store.queue_transaction(tx)
+        return True
+
+    # ------------------------------------------------------ watch/notify
+    def _op_watch(self, conn, m, pgid: PgId, up: list) -> None:
+        key = (pgid, m.oid)
+        watchers = self._watchers.setdefault(key, {})
+        if m.op == "watch":
+            # offset carries the cookie; registration doubles as renewal
+            watchers[m.client] = (m.offset,
+                                  time.time() + self.WATCH_TIMEOUT)
+        else:
+            watchers.pop(m.client, None)
+            if not watchers:
+                self._watchers.pop(key, None)
+        conn.send(MOSDOpReply(m.tid, 0, epoch=self.osdmap.epoch))
+
+    def _op_notify(self, conn, m, pgid: PgId, up: list) -> None:
+        watchers = dict(self._watchers.get((pgid, m.oid), {}))
+        watchers.pop(m.client, None)  # don't notify the notifier
+        if not watchers:
+            conn.send(MOSDOpReply(m.tid, 0, data=_pack([]),
+                                  epoch=self.osdmap.epoch))
+            return
+        nid = next(self._tids)
+        self._pending_notifies[nid] = _PendingNotify(
+            m.client, m.tid, waiting=set(watchers))
+        for watcher in watchers:
+            self.messenger.send_message(
+                watcher, MWatchNotify(nid, pgid.pool, m.oid, m.client,
+                                      m.data))
+
+    def _handle_notify_ack(self, conn, m: MNotifyAck) -> None:
+        pn = self._pending_notifies.get(m.notify_id)
+        if pn is None:
+            return
+        pn.waiting.discard(m.watcher)
+        pn.acked.append(m.watcher)
+        if pn.waiting:
+            return
+        del self._pending_notifies[m.notify_id]
+        self.messenger.send_message(
+            pn.client,
+            MOSDOpReply(pn.client_tid, 0, data=_pack(sorted(pn.acked)),
+                        epoch=self.osdmap.epoch))
+
+    def _sweep_notifies(self, now: float, max_age: float) -> None:
+        # expire watchers that stopped renewing (crashed clients must
+        # not make every notify wait out the timeout forever)
+        for key, watchers in list(self._watchers.items()):
+            for client, (_c, expires) in list(watchers.items()):
+                if now > expires:
+                    watchers.pop(client, None)
+            if not watchers:
+                self._watchers.pop(key, None)
+        for nid, pn in list(self._pending_notifies.items()):
+            if now - pn.stamp > max_age:
+                del self._pending_notifies[nid]
+                # partial completion: report who DID ack (the reference
+                # returns a timeout list alongside)
+                self.messenger.send_message(
+                    pn.client,
+                    MOSDOpReply(pn.client_tid, 0,
+                                data=_pack(sorted(pn.acked)),
+                                epoch=self.osdmap.epoch
+                                if self.osdmap else 0))
+
+    # ---------------------------------------------------- object classes
+    def _op_call(self, conn, m, pgid: PgId, up: list) -> None:
+        """`call cls.method(input)`: run the class method against the
+        object, then apply its queued effects through the replicated
+        write path (ClassHandler + do_osd_ops `call`)."""
+        req = _unpack(m.data)
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(m.oid)
+        exists = self.store.exists(cid, obj)
+        data = self.store.read(cid, obj).to_bytes() if exists else b""
+        omap = self.store.omap_get(cid, obj) if exists else {}
+        ctx = cls_mod.ClsContext(data, omap, exists)
+        try:
+            out = cls_mod.call(req["cls"], req["method"], ctx,
+                               req.get("input"))
+        except cls_mod.ClsError as e:
+            conn.send(MOSDOpReply(m.tid, e.code,
+                                  data=_pack(str(e)),
+                                  epoch=self.osdmap.epoch))
+            return
+        except Exception as e:  # noqa: BLE001 - class bug must still reply
+            conn.send(MOSDOpReply(m.tid, EIO, data=_pack(repr(e)),
+                                  epoch=self.osdmap.epoch))
+            return
+        mutated = (ctx.new_data is not None or ctx.omap_set
+                   or ctx.omap_rm)
+        if not mutated:
+            conn.send(MOSDOpReply(m.tid, 0, data=_pack(out),
+                                  epoch=self.osdmap.epoch))
+            return
+        version = self._next_version(pgid)
+        effects = {"data": ctx.new_data, "set": dict(ctx.omap_set),
+                   "rm": sorted(ctx.omap_rm)}
+        self._apply_cls_effects(pgid, m.oid, effects, version)
+        peers = [u for u in up if u is not None and u != self.osd_id]
+        if not peers:
+            conn.send(MOSDOpReply(m.tid, 0, data=_pack(out),
+                                  version=version,
+                                  epoch=self.osdmap.epoch))
+            return
+        tid = next(self._tids)
+        from .daemon import _PendingWrite
+        pw = _PendingWrite(m.client, m.tid, len(peers), version)
+        pw.reply_data = _pack(out)
+        self._pending_writes[tid] = pw
+        for peer in peers:
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MSubWrite(tid, pgid, m.oid, -1, version, "cls_effects",
+                          _pack(effects)))
+
+    def _apply_cls_effects(self, pgid: PgId, oid: str, effects: dict,
+                           version: int) -> None:
+        from .pglog import LogEntry
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid)
+        tx = Transaction()
+        exists = self.store.exists(cid, obj)
+        if not exists:
+            tx.touch(cid, obj)
+        if effects.get("data") is not None:
+            tx.truncate(cid, obj, 0)
+            tx.write(cid, obj, 0, effects["data"])
+            data = bytes(effects["data"])
+        else:
+            data = self.store.read(cid, obj).to_bytes() if exists \
+                else b""
+        if effects.get("set"):
+            tx.omap_setkeys(cid, obj, {str(k): bytes(v) for k, v
+                                       in effects["set"].items()})
+        if effects.get("rm"):
+            have = set(self.store.omap_get(cid, obj)) if exists else set()
+            tx.omap_rmkeys(cid, obj,
+                           [k for k in effects["rm"] if k in have])
+        # digest/len must track the NEW content or deep scrub flags a
+        # phantom mismatch and stat() reports the stale length
+        tx.setattrs(cid, obj, {"v": version, "d": _crc32c(data),
+                               "len": len(data)})
+        self._log_apply(tx, pgid, LogEntry(version, "cls", oid, -1,
+                                           prev_version=-1))
+        self.store.queue_transaction(tx)

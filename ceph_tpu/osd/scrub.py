@@ -242,9 +242,11 @@ class ScrubMixin:
             data = self.store.read(cid, ObjectId(name)).to_bytes()
             attrs = self.store.getattrs(cid, ObjectId(name))
             v = int(attrs.get("v", 0))
+            omap = self.store.omap_get(cid, ObjectId(name))
             self.messenger.send_message(
                 f"osd.{target}",
-                MPGPush(ps.pgid, -1, {name: (v, data)}, force=True))
+                MPGPush(ps.pgid, -1, {name: (v, data, None, omap)},
+                        force=True))
             repaired += 1
         return repaired
 

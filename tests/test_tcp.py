@@ -78,6 +78,9 @@ def _sample(cls):
         M.MMonForward: M.MMonForward("client.0", b"\x01\x02frame"),
         M.MMonFwdReply: M.MMonFwdReply("client.0", b"\x03frame"),
         M.MPGRollback: M.MPGRollback(pg, "obj", 3, 7),
+        M.MWatchNotify: M.MWatchNotify(9, 2, "obj", "client.1",
+                                       b"payload"),
+        M.MNotifyAck: M.MNotifyAck(9, "client.2"),
     }
     return samples[cls]
 
