@@ -1,0 +1,198 @@
+"""fs-lite: a POSIX-ish file layer over RADOS.
+
+The capability slice of CephFS's data path (src/mds metadata +
+src/client Client.cc -> Striper -> RADOS): directories are omap-backed
+metadata objects (the dentry table of a dir frag), file data stripes
+over RADOS objects via the same file_layout_t algebra the reference's
+Striper uses, and path resolution walks the directory chain.
+
+What the reference's MDS adds beyond this slice — distributed cache
+with capabilities (leases), journaling via MDLog, multi-active subtree
+partitioning and rebalancing, snapshots — is the planned widening;
+this layer gives the POSIX surface (mkdir/readdir/create/read/write/
+truncate/unlink/rename/stat) with single-writer semantics.
+"""
+
+from __future__ import annotations
+
+import posixpath
+import time
+import uuid
+
+from ..client.rados import RadosClient, RadosError
+from ..client.striper import FileLayout, StripedObject
+from ..msg.wire import pack_value, unpack_value
+
+_DIR_OID = "fs_dir.{path}"
+_DATA_PREFIX = "fs_data.{ino}"
+
+
+class FsError(Exception):
+    def __init__(self, code: int, what: str):
+        super().__init__(what)
+        self.code = code
+
+
+def _norm(path: str) -> str:
+    # POSIX quirk: normpath("//x") keeps the double slash; strip leading
+    # slashes before re-rooting
+    return posixpath.normpath("/" + path.strip().lstrip("/"))
+
+
+class FsClient:
+    """One mounted filesystem view (libcephfs Client shape)."""
+
+    def __init__(self, client: RadosClient, pool: str,
+                 layout: FileLayout | None = None):
+        self.client = client
+        self.pool = pool
+        self.layout = layout or FileLayout(stripe_unit=65536,
+                                           stripe_count=4,
+                                           object_size=1 << 22)
+        # ensure the root exists
+        try:
+            self.client.omap_get(self.pool, _DIR_OID.format(path="/"))
+        except RadosError:
+            self.client.omap_set(self.pool, _DIR_OID.format(path="/"),
+                                 {})
+
+    # ------------------------------------------------------------ helpers
+    def _dir_oid(self, path: str) -> str:
+        return _DIR_OID.format(path=_norm(path))
+
+    def _entries(self, dirpath: str) -> dict:
+        try:
+            raw = self.client.omap_get(self.pool, self._dir_oid(dirpath))
+        except RadosError:
+            raise FsError(-2, f"no such directory {dirpath!r}") from None
+        return {k: unpack_value(v) for k, v in raw.items()}
+
+    def _lookup(self, path: str) -> dict:
+        path = _norm(path)
+        if path == "/":
+            return {"type": "dir"}
+        parent, name = posixpath.split(path)
+        ent = self._entries(parent).get(name)
+        if ent is None:
+            raise FsError(-2, f"no such entry {path!r}")
+        return ent
+
+    def _set_entry(self, path: str, ent: dict) -> None:
+        parent, name = posixpath.split(_norm(path))
+        self.client.omap_set(self.pool, self._dir_oid(parent),
+                             {name: pack_value(ent)})
+
+    def _rm_entry(self, path: str) -> None:
+        parent, name = posixpath.split(_norm(path))
+        self.client.omap_rm(self.pool, self._dir_oid(parent), [name])
+
+    def _data(self, ino: str) -> StripedObject:
+        return StripedObject(self.client, self.pool,
+                             _DATA_PREFIX.format(ino=ino), self.layout)
+
+    # ---------------------------------------------------------- directory
+    def mkdir(self, path: str) -> None:
+        path = _norm(path)
+        parent, name = posixpath.split(path)
+        ents = self._entries(parent)  # raises if parent missing
+        if name in ents:
+            raise FsError(-17, f"{path!r} exists")
+        self.client.omap_set(self.pool, self._dir_oid(path), {})
+        self._set_entry(path, {"type": "dir", "mtime": time.time()})
+
+    def listdir(self, path: str) -> list[str]:
+        self._assert_dir(path)
+        return sorted(self._entries(path))
+
+    def rmdir(self, path: str) -> None:
+        path = _norm(path)
+        if path == "/":
+            raise FsError(-22, "cannot remove the root")
+        self._assert_dir(path)
+        if self._entries(path):
+            raise FsError(-39, f"{path!r} not empty")
+        self.client.remove(self.pool, self._dir_oid(path))
+        self._rm_entry(path)
+
+    def _assert_dir(self, path: str) -> None:
+        ent = self._lookup(path)
+        if ent["type"] != "dir":
+            raise FsError(-20, f"{path!r} is not a directory")
+
+    # --------------------------------------------------------------- files
+    def create(self, path: str) -> None:
+        path = _norm(path)
+        parent, name = posixpath.split(path)
+        ents = self._entries(parent)
+        if name in ents:
+            raise FsError(-17, f"{path!r} exists")
+        self._set_entry(path, {"type": "file", "size": 0,
+                               "ino": uuid.uuid4().hex,
+                               "mtime": time.time()})
+
+    def write_file(self, path: str, data: bytes, offset: int = 0) -> None:
+        ent = self._lookup(path)
+        if ent["type"] != "file":
+            raise FsError(-21, f"{path!r} is a directory")
+        self._data(ent["ino"]).write(offset, data)
+        ent["size"] = max(ent["size"], offset + len(data))
+        ent["mtime"] = time.time()
+        self._set_entry(path, ent)
+
+    def read_file(self, path: str, offset: int = 0,
+                  length: int | None = None) -> bytes:
+        ent = self._lookup(path)
+        if ent["type"] != "file":
+            raise FsError(-21, f"{path!r} is a directory")
+        if length is None:
+            length = max(0, ent["size"] - offset)
+        length = max(0, min(length, ent["size"] - offset))
+        return self._data(ent["ino"]).read(offset, length)
+
+    def truncate(self, path: str, size: int) -> None:
+        ent = self._lookup(path)
+        if ent["type"] != "file":
+            raise FsError(-21, f"{path!r} is a directory")
+        if size > ent["size"]:
+            self._data(ent["ino"]).write(
+                ent["size"], b"\0" * (size - ent["size"]))
+        ent["size"] = size
+        ent["mtime"] = time.time()
+        self._set_entry(path, ent)
+
+    def unlink(self, path: str) -> None:
+        ent = self._lookup(path)
+        if ent["type"] != "file":
+            raise FsError(-21, f"{path!r} is a directory (use rmdir)")
+        self._data(ent["ino"]).remove()
+        self._rm_entry(path)
+
+    def stat(self, path: str) -> dict:
+        ent = dict(self._lookup(path))
+        ent.setdefault("size", 0)
+        return ent
+
+    def rename(self, src: str, dst: str) -> None:
+        """Same-type rename; directories move their SUBTREE by renaming
+        the dir object path keys (the subtree-migration slice of the
+        MDS, minus the distributed locking)."""
+        src, dst = _norm(src), _norm(dst)
+        ent = self._lookup(src)
+        parent, name = posixpath.split(dst)
+        dents = self._entries(parent)
+        if name in dents:
+            raise FsError(-17, f"{dst!r} exists")
+        if ent["type"] == "dir":
+            self._rename_dir_tree(src, dst)
+        self._set_entry(dst, ent)
+        self._rm_entry(src)
+
+    def _rename_dir_tree(self, src: str, dst: str) -> None:
+        ents = self._entries(src)
+        self.client.omap_set(self.pool, self._dir_oid(dst),
+                             {k: pack_value(v) for k, v in ents.items()})
+        for name, ent in ents.items():
+            if ent["type"] == "dir":
+                self._rename_dir_tree(posixpath.join(src, name),
+                                      posixpath.join(dst, name))
+        self.client.remove(self.pool, self._dir_oid(src))

@@ -1,0 +1,259 @@
+"""rgw-lite: an S3-dialect HTTP object gateway over RADOS.
+
+The capability slice of the reference's RGW (src/rgw/ — beast frontend
+accepting S3 REST, rgw_op.cc op classes, bucket indexes maintained via
+cls_rgw omap on index objects, object data striped over RADOS):
+
+- buckets: PUT /b creates, GET /b lists (ListBucketResult XML with
+  prefix= filtering), DELETE /b removes when empty, GET / lists all
+  buckets; the bucket registry and each bucket's index live in omap
+  (the cls_rgw index role, via the extended omap ops);
+- objects: PUT /b/k stores the body striped over RADOS objects
+  (Striper), GET retrieves (with Range: bytes=a-b support), HEAD
+  returns metadata, DELETE removes; ETag is the body's MD5 as S3
+  defines it.
+
+Anonymous access this round (AWS SigV4 is the auth slice's next step);
+multipart upload and versioning are planned.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.sax.saxutils import escape
+
+from ..client.rados import RadosClient, RadosError
+from ..client.striper import FileLayout, StripedObject
+from ..msg.wire import pack_value, unpack_value
+
+_BUCKETS_OID = "rgw_buckets"
+_INDEX_OID = "rgw_index.{bucket}"
+_DATA_PREFIX = "rgw_data.{bucket}.{key}"
+
+
+class RgwGateway:
+    """The HTTP frontend + SAL-ish store glue (rgw_process role)."""
+
+    def __init__(self, client: RadosClient, pool: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.client = client
+        self.pool = pool
+        gw = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _send(self, code: int, body: bytes = b"",
+                      ctype: str = "application/xml",
+                      headers: dict | None = None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _error(self, code: int, s3code: str):
+                body = (f'<?xml version="1.0"?><Error><Code>{s3code}'
+                        f'</Code></Error>').encode()
+                self._send(code, body)
+
+            def _route(self):
+                path = self.path.split("?", 1)[0].strip("/")
+                query = self.path.split("?", 1)[1] \
+                    if "?" in self.path else ""
+                parts = path.split("/", 1)
+                bucket = parts[0] if parts[0] else None
+                key = parts[1] if len(parts) > 1 else None
+                return bucket, key, query
+
+            # ----------------------------------------------------- verbs
+            def do_GET(self):  # noqa: N802
+                bucket, key, query = self._route()
+                try:
+                    if bucket is None:
+                        self._send(200, gw.list_buckets_xml())
+                    elif key is None:
+                        prefix = ""
+                        for part in query.split("&"):
+                            if part.startswith("prefix="):
+                                prefix = part[len("prefix="):]
+                        self._send(200, gw.list_objects_xml(bucket,
+                                                            prefix))
+                    else:
+                        rng = self.headers.get("Range")
+                        data, meta, status = gw.get_object(bucket, key,
+                                                           rng)
+                        self._send(status, data,
+                                   ctype="application/octet-stream",
+                                   headers={"ETag": f'"{meta["etag"]}"'})
+                except KeyError:
+                    self._error(404, "NoSuchKey")
+
+            def do_HEAD(self):  # noqa: N802
+                bucket, key, _ = self._route()
+                try:
+                    if key is None:
+                        gw.check_bucket(bucket)
+                        self._send(200)
+                    else:
+                        meta = gw.head_object(bucket, key)
+                        self._send(200, headers={
+                            "ETag": f'"{meta["etag"]}"',
+                            "X-Object-Size": str(meta["size"])})
+                except KeyError:
+                    self._error(404, "NoSuchKey")
+
+            def do_PUT(self):  # noqa: N802
+                bucket, key, _ = self._route()
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                try:
+                    if key is None:
+                        gw.create_bucket(bucket)
+                        self._send(200)
+                    else:
+                        etag = gw.put_object(bucket, key, body)
+                        self._send(200, headers={"ETag": f'"{etag}"'})
+                except KeyError:
+                    self._error(404, "NoSuchBucket")
+
+            def do_DELETE(self):  # noqa: N802
+                bucket, key, _ = self._route()
+                try:
+                    if key is None:
+                        gw.delete_bucket(bucket)
+                    else:
+                        gw.delete_object(bucket, key)
+                    self._send(204)
+                except KeyError:
+                    self._error(404, "NoSuchKey")
+                except ValueError:
+                    self._error(409, "BucketNotEmpty")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="rgw-frontend",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ------------------------------------------------------------ buckets
+    def _buckets(self) -> dict:
+        try:
+            return self.client.omap_get(self.pool, _BUCKETS_OID)
+        except RadosError:
+            return {}
+
+    def create_bucket(self, bucket: str) -> None:
+        self.client.omap_set(self.pool, _BUCKETS_OID,
+                             {bucket: pack_value(time.time())})
+
+    def check_bucket(self, bucket: str) -> None:
+        if bucket not in self._buckets():
+            raise KeyError(bucket)
+
+    def delete_bucket(self, bucket: str) -> None:
+        self.check_bucket(bucket)
+        if self._index(bucket):
+            raise ValueError("not empty")
+        self.client.omap_rm(self.pool, _BUCKETS_OID, [bucket])
+
+    def list_buckets_xml(self) -> bytes:
+        names = sorted(self._buckets())
+        items = "".join(f"<Bucket><Name>{escape(n)}</Name></Bucket>"
+                        for n in names)
+        return (f'<?xml version="1.0"?><ListAllMyBucketsResult>'
+                f"<Buckets>{items}</Buckets>"
+                f"</ListAllMyBucketsResult>").encode()
+
+    # ------------------------------------------------------- bucket index
+    def _index(self, bucket: str) -> dict:
+        try:
+            raw = self.client.omap_get(self.pool,
+                                       _INDEX_OID.format(bucket=bucket))
+        except RadosError:
+            return {}
+        return {k: unpack_value(v) for k, v in raw.items()}
+
+    def _index_set(self, bucket: str, key: str, meta: dict) -> None:
+        self.client.omap_set(self.pool, _INDEX_OID.format(bucket=bucket),
+                             {key: pack_value(meta)})
+
+    def _index_rm(self, bucket: str, key: str) -> None:
+        self.client.omap_rm(self.pool, _INDEX_OID.format(bucket=bucket),
+                            [key])
+
+    def list_objects_xml(self, bucket: str, prefix: str = "") -> bytes:
+        self.check_bucket(bucket)
+        idx = self._index(bucket)
+        items = []
+        for key in sorted(idx):
+            if prefix and not key.startswith(prefix):
+                continue
+            meta = idx[key]
+            items.append(
+                f"<Contents><Key>{escape(key)}</Key>"
+                f"<Size>{meta['size']}</Size>"
+                f"<ETag>&quot;{meta['etag']}&quot;</ETag></Contents>")
+        return (f'<?xml version="1.0"?><ListBucketResult>'
+                f"<Name>{escape(bucket)}</Name>"
+                f"<Prefix>{escape(prefix)}</Prefix>"
+                f"{''.join(items)}</ListBucketResult>").encode()
+
+    # ------------------------------------------------------------ objects
+    def _striped(self, bucket: str, key: str) -> StripedObject:
+        safe = hashlib.sha256(key.encode()).hexdigest()[:24]
+        return StripedObject(
+            self.client, self.pool,
+            _DATA_PREFIX.format(bucket=bucket, key=safe),
+            FileLayout(stripe_unit=65536, stripe_count=4,
+                       object_size=1 << 22))
+
+    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+        self.check_bucket(bucket)
+        so = self._striped(bucket, key)
+        so.remove()  # replace semantics
+        if body:
+            so.write(0, body)
+        etag = hashlib.md5(body).hexdigest()
+        self._index_set(bucket, key, {"size": len(body), "etag": etag,
+                                      "mtime": time.time()})
+        return etag
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        self.check_bucket(bucket)
+        meta = self._index(bucket).get(key)
+        if meta is None:
+            raise KeyError(key)
+        return meta
+
+    def get_object(self, bucket: str, key: str,
+                   range_header: str | None = None):
+        meta = self.head_object(bucket, key)
+        so = self._striped(bucket, key)
+        if range_header and range_header.startswith("bytes="):
+            spec = range_header[len("bytes="):]
+            start_s, _, end_s = spec.partition("-")
+            start = int(start_s) if start_s else 0
+            end = int(end_s) if end_s else meta["size"] - 1
+            data = so.read(start, max(0, end - start + 1))
+            return data, meta, 206
+        return so.read(0, meta["size"]), meta, 200
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self.head_object(bucket, key)
+        self._striped(bucket, key).remove()
+        self._index_rm(bucket, key)

@@ -1,0 +1,99 @@
+"""fs-lite: POSIX-ish file layer over RADOS (the CephFS data-path
+slice: omap dentry tables + striped file data)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.services.fs import FsClient, FsError
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(44)
+
+
+@pytest.fixture
+def fs():
+    c = MiniCluster(n_osds=6, cfg=make_cfg()).start()
+    client = c.client()
+    client.create_pool("fs", size=3, pg_num=2)
+    yield c, FsClient(client, "fs")
+    c.stop()
+
+
+def test_tree_and_file_io(fs):
+    _c, f = fs
+    f.mkdir("/home")
+    f.mkdir("/home/user")
+    assert f.listdir("/") == ["home"]
+    assert f.listdir("/home") == ["user"]
+    f.create("/home/user/data.bin")
+    data = RNG.integers(0, 256, 2_000_000, dtype=np.uint8).tobytes()
+    f.write_file("/home/user/data.bin", data)
+    assert f.read_file("/home/user/data.bin") == data
+    assert f.read_file("/home/user/data.bin", 500_000, 1000) == \
+        data[500_000:501_000]
+    # partial overwrite + grow
+    f.write_file("/home/user/data.bin", b"PATCH", offset=100)
+    assert f.read_file("/home/user/data.bin", 95, 15) == \
+        data[95:100] + b"PATCH" + data[105:110]
+    st = f.stat("/home/user/data.bin")
+    assert st["type"] == "file" and st["size"] == len(data)
+    f.truncate("/home/user/data.bin", 100)
+    assert f.stat("/home/user/data.bin")["size"] == 100
+    f.truncate("/home/user/data.bin", 200)
+    assert f.read_file("/home/user/data.bin", 100, 100) == b"\0" * 100
+
+
+def test_errors(fs):
+    _c, f = fs
+    with pytest.raises(FsError):
+        f.listdir("/missing")
+    with pytest.raises(FsError):
+        f.mkdir("/a/b")  # parent missing
+    f.mkdir("/a")
+    with pytest.raises(FsError):
+        f.mkdir("/a")  # exists
+    f.create("/a/f")
+    with pytest.raises(FsError):
+        f.create("/a/f")
+    with pytest.raises(FsError):
+        f.rmdir("/a")  # not empty
+    with pytest.raises(FsError):
+        f.unlink("/a")  # is a dir
+    f.unlink("/a/f")
+    f.rmdir("/a")
+    assert f.listdir("/") == []
+
+
+def test_rename_moves_subtrees(fs):
+    _c, f = fs
+    f.mkdir("/proj")
+    f.mkdir("/proj/src")
+    f.create("/proj/src/main.py")
+    f.write_file("/proj/src/main.py", b"print('hi')")
+    f.create("/proj/readme")
+    f.write_file("/proj/readme", b"docs")
+    f.rename("/proj", "/project")
+    assert f.listdir("/") == ["project"]
+    assert f.listdir("/project") == ["readme", "src"]
+    assert f.read_file("/project/src/main.py") == b"print('hi')"
+    with pytest.raises(FsError):
+        f.listdir("/proj")
+    # file rename
+    f.rename("/project/readme", "/project/README.md")
+    assert f.read_file("/project/README.md") == b"docs"
+
+
+def test_files_survive_osd_failure(fs):
+    c, f = fs
+    f.mkdir("/d")
+    f.create("/d/x")
+    data = RNG.integers(0, 256, 800_000, dtype=np.uint8).tobytes()
+    f.write_file("/d/x", data)
+    victim = sorted(c.osds)[0]
+    epoch = c.mon.osdmap.epoch
+    c.kill_osd(victim)
+    c.wait_for_epoch(epoch + 1)
+    c.settle(0.8)
+    assert f.read_file("/d/x") == data
+    assert f.listdir("/d") == ["x"]
