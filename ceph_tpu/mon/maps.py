@@ -56,8 +56,9 @@ class OsdInfo(Encodable):
     host: str = ""
     addr: str = ""     # data-plane messenger address
     hb_addr: str = ""  # heartbeat messenger address (v2 field)
+    primary_affinity: float = 1.0  # v3: likelihood of leading (0..1)
 
-    VERSION, COMPAT = 2, 1
+    VERSION, COMPAT = 3, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e: Encoder):
@@ -68,6 +69,7 @@ class OsdInfo(Encodable):
             e.string(self.host)
             e.string(self.addr)
             e.string(self.hb_addr)  # v2: old decoders skip the tail
+            e.f64(self.primary_affinity)  # v3 tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -77,6 +79,8 @@ class OsdInfo(Encodable):
                        d.string(), d.string())
             if v >= 2:
                 info.hb_addr = d.string()
+            if v >= 3:
+                info.primary_affinity = d.f64()
             return info
         return dec.versioned(cls.VERSION, body)
 
@@ -84,13 +88,16 @@ class OsdInfo(Encodable):
 class OSDMap(Encodable):
     """Epoch-versioned cluster map; placement is a pure function of it."""
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def __init__(self):
         self.epoch = 0
         self.osds: dict[int, OsdInfo] = {}
         self.pools: dict[int, PoolSpec] = {}
         self.next_pool_id = 1
+        # explicit placement overrides (the pg_upmap/read-balancer
+        # machinery, ref OSDMap.cc upmap handling): (pool, seed) -> osds
+        self.pg_upmap: dict[tuple[int, int], list[int]] = {}
 
     # -- mutation (monitor-side; bumps epoch through Monitor) --------------
     def add_osd(self, osd_id: int, host: str, addr: str = "",
@@ -136,8 +143,9 @@ class OSDMap(Encodable):
         return self.placement().select(key, pool.size)
 
     def pg_to_up_osds(self, pool_id: int, pg_seed: int) -> list[int]:
-        """Up set: raw placement with down devices re-drawn (the up/acting
-        derivation; pg_temp overrides come in with async recovery).  For EC
+        """Up set: raw placement with down devices re-drawn, honoring
+        pg_upmap overrides and primary affinity (the up/acting
+        derivation of OSDMap::_pg_to_up_acting_osds :3143).  For EC
         pools, positions are shard ids, so a down device leaves a hole
         (None) rather than shifting shards."""
         pool = self.pools[pool_id]
@@ -148,6 +156,25 @@ class OSDMap(Encodable):
             o = self.osds.get(dev_id)
             return o is None or not o.up
 
+        override = self.pg_upmap.get((pool_id, pg_seed))
+        if override is not None:
+            # dead mapped members re-draw from healthy placement (the
+            # reference prunes invalid upmaps on map change; pinning a
+            # PG degraded behind a stale override would be worse)
+            healthy = pm.select(key, pool.size, reject=down)
+            spares = [d for d in healthy if d not in override]
+            if pool.kind == "ec":
+                out: list[int | None] = []
+                for d in override:
+                    if not down(d):
+                        out.append(d)
+                    else:
+                        out.append(spares.pop(0) if spares else None)
+                return out
+            filled = [d for d in override if not down(d)]
+            while len(filled) < pool.size and spares:
+                filled.append(spares.pop(0))
+            return self._apply_affinity(filled)
         raw = pm.select(key, pool.size)
         if pool.kind == "ec":
             # keep shard positions stable; holes where devices are down
@@ -160,7 +187,22 @@ class OSDMap(Encodable):
                 else:
                     out.append(spares.pop(0) if spares else None)
             return out
-        return pm.select(key, pool.size, reject=down)
+        return self._apply_affinity(pm.select(key, pool.size,
+                                              reject=down))
+
+    def _apply_affinity(self, up: list[int]) -> list[int]:
+        """Primary affinity (OSDMap primary-affinity role): rotate the
+        member with the HIGHEST affinity to the front; equal affinities
+        keep the placement order (so the default 1.0 changes nothing)."""
+        if not up:
+            return up
+        best = max(up, key=lambda d: self.osds[d].primary_affinity
+                   if d in self.osds else 0.0)
+        if self.osds.get(best) is not None and \
+                self.osds[best].primary_affinity > \
+                self.osds[up[0]].primary_affinity:
+            up = [best] + [d for d in up if d != best]
+        return up
 
     def object_to_pg(self, pool_id: int, name: str) -> int:
         return pg_of_object(name, self.pools[pool_id].pg_num)
@@ -180,6 +222,10 @@ class OSDMap(Encodable):
             e.seq(sorted(self.pools.values(), key=lambda p: p.pool_id),
                   lambda ee, p: p.encode(ee))
             e.u64(self.next_pool_id)
+            # v2 tail: upmap overrides
+            e.seq(sorted(self.pg_upmap.items()),
+                  lambda ee, kv: (ee.u64(kv[0][0]), ee.u64(kv[0][1]),
+                                  ee.seq(kv[1], Encoder.i64)))
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -192,5 +238,11 @@ class OSDMap(Encodable):
             for p in d.seq(PoolSpec.decode):
                 m.pools[p.pool_id] = p
             m.next_pool_id = d.u64()
+            if v >= 2:
+                def upmap_item(dd: Decoder):
+                    pool, seed = dd.u64(), dd.u64()
+                    return (pool, seed), dd.seq(Decoder.i64)
+                for k, vlist in d.seq(upmap_item):
+                    m.pg_upmap[k] = vlist
             return m
         return dec.versioned(cls.VERSION, body)

@@ -649,6 +649,43 @@ class MonitorLite(Dispatcher):
                 self._osd_stats.pop(target, None)
                 self._commit_map(f"osd.{target} out")
             return 0, {}
+        if prefix == "osd pg-upmap":
+            pool_id, seed = int(cmd["pool"]), int(cmd["seed"])
+            osds = [int(x) for x in cmd["osds"]]
+            with self._lock:
+                pool = self.osdmap.pools.get(pool_id)
+                if pool is None:
+                    return -2, {"error": f"no pool {pool_id}"}
+                if len(osds) != pool.size or len(set(osds)) != len(osds):
+                    return -22, {"error":
+                                 f"need {pool.size} distinct osds"}
+                unknown = [o for o in osds if o not in self.osdmap.osds]
+                if unknown:
+                    return -22, {"error": f"unknown osds {unknown}"}
+                self.osdmap.pg_upmap[(pool_id, seed)] = osds
+                self._commit_map(f"pg-upmap {pool_id}.{seed} -> {osds}")
+            return 0, {}
+        if prefix == "osd rm-pg-upmap":
+            pool_id, seed = int(cmd["pool"]), int(cmd["seed"])
+            with self._lock:
+                if self.osdmap.pg_upmap.pop((pool_id, seed), None) \
+                        is None:
+                    return -2, {"error": "no such upmap"}
+                self._commit_map(f"rm-pg-upmap {pool_id}.{seed}")
+            return 0, {}
+        if prefix == "osd primary-affinity":
+            target, aff = int(cmd["id"]), float(cmd["weight"])
+            if not 0.0 <= aff <= 1.0:
+                return -22, {"error": "affinity must be in [0, 1]"}
+            with self._lock:
+                info = self.osdmap.osds.get(target)
+                if info is None:
+                    return -2, {"error": f"no osd.{target}"}
+                info.primary_affinity = aff
+                self._commit_map(f"osd.{target} primary-affinity {aff}")
+            return 0, {}
+        if prefix == "balancer optimize":
+            return self._balancer_optimize(int(cmd.get("max_moves", 10)))
         if prefix == "osd dump":
             return 0, self._dump()
         if prefix == "status":
@@ -674,6 +711,58 @@ class MonitorLite(Dispatcher):
             return 0, {f"osd.{i}": dict(s)
                        for i, s in sorted(self._osd_stats.items())}
         return -22, {"error": f"unknown command {prefix!r}"}
+
+    def _balancer_optimize(self, max_moves: int = 10):
+        """Even out replicated-PG membership counts with pg_upmap moves
+        (the mgr balancer module's upmap mode, scoped to membership
+        counts; respects host failure domains)."""
+        with self._lock:
+            osds = {o.osd_id: o for o in self.osdmap.osds.values()
+                    if o.in_cluster and o.up}
+            if len(osds) < 2:
+                return 0, {"moves": []}
+            counts = {o: 0 for o in osds}
+            mapping = {}
+            for pool_id, pool in self.osdmap.pools.items():
+                for seed in range(pool.pg_num):
+                    up = [d for d in self.osdmap.pg_to_up_osds(pool_id,
+                                                               seed)
+                          if d is not None]
+                    mapping[(pool_id, seed)] = up
+                    for d in up:
+                        if d in counts:
+                            counts[d] += 1
+            moves = []
+            for _ in range(max_moves):
+                hi = max(counts, key=lambda o: counts[o])
+                lo = min(counts, key=lambda o: counts[o])
+                if counts[hi] - counts[lo] <= 1:
+                    break
+                moved = False
+                for (pid, seed), up in mapping.items():
+                    if self.osdmap.pools[pid].kind != "replicated":
+                        continue
+                    if hi not in up or lo in up:
+                        continue
+                    # never co-locate replicas on one host
+                    hosts = {osds[d].host for d in up
+                             if d != hi and d in osds}
+                    if osds[lo].host in hosts:
+                        continue
+                    new = [lo if d == hi else d for d in up]
+                    self.osdmap.pg_upmap[(pid, seed)] = new
+                    mapping[(pid, seed)] = new
+                    counts[hi] -= 1
+                    counts[lo] += 1
+                    moves.append({"pg": f"{pid}.{seed}", "from": hi,
+                                  "to": lo})
+                    moved = True
+                    break
+                if not moved:
+                    break
+            if moves:
+                self._commit_map(f"balancer: {len(moves)} upmap moves")
+            return 0, {"moves": moves}
 
     def _handle_stats(self, conn, m: MStatsReport) -> None:
         with self._lock:

@@ -1,0 +1,219 @@
+"""KeyValueDB: the KV abstraction + a durable WAL/snapshot store.
+
+The capability of the reference's src/kv/ (KeyValueDB.h interface over
+RocksDBStore, consumed by BlueStore metadata and MonitorDBStore):
+
+- `KeyValueDB`: get/put/rm per (prefix, key), atomic transactions,
+  ordered iteration within a prefix — the interface BlueStore-shaped
+  stores program against;
+- `WalKV`: a durable implementation with the store family's WAL
+  contract — every transaction appends a crc-framed fsync'd record
+  ([u32 len][u32 crc32c][payload]); a torn tail is discarded on open;
+  the log compacts to a snapshot when it outgrows the live data (so
+  neither the file nor open-replay grows with history).
+
+A leveled SSTable stack (RocksDB-grade) is the next widening; the
+interface is the stable seam.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from abc import ABC, abstractmethod
+
+from ..ops.native import crc32c
+from ..utils.codec import Decoder, Encoder
+
+
+class KVTransaction:
+    """Atomic batch (KeyValueDB::Transaction role)."""
+
+    def __init__(self):
+        self.ops: list[tuple] = []  # ("put", prefix, key, val)|("rm",..)
+
+    def put(self, prefix: str, key: str, value: bytes) -> "KVTransaction":
+        self.ops.append(("put", prefix, key, bytes(value)))
+        return self
+
+    def rm(self, prefix: str, key: str) -> "KVTransaction":
+        self.ops.append(("rm", prefix, key, b""))
+        return self
+
+    def rm_prefix(self, prefix: str) -> "KVTransaction":
+        self.ops.append(("rmp", prefix, "", b""))
+        return self
+
+
+class KeyValueDB(ABC):
+    @abstractmethod
+    def submit(self, tx: KVTransaction) -> None: ...
+
+    @abstractmethod
+    def get(self, prefix: str, key: str) -> bytes | None: ...
+
+    @abstractmethod
+    def iterate(self, prefix: str, start: str = ""): ...
+
+    def put(self, prefix: str, key: str, value: bytes) -> None:
+        self.submit(KVTransaction().put(prefix, key, value))
+
+    def rm(self, prefix: str, key: str) -> None:
+        self.submit(KVTransaction().rm(prefix, key))
+
+    def close(self) -> None: ...
+
+
+_REC_TX, _REC_SNAP = 1, 2
+
+
+class MemKV(KeyValueDB):
+    """In-memory KeyValueDB (tests / volatile stores)."""
+
+    def __init__(self):
+        self._data: dict[str, dict[str, bytes]] = {}
+        self._lock = threading.RLock()
+
+    def submit(self, tx: KVTransaction) -> None:
+        with self._lock:
+            for op, prefix, key, val in tx.ops:
+                if op == "put":
+                    self._data.setdefault(prefix, {})[key] = val
+                elif op == "rm":
+                    self._data.get(prefix, {}).pop(key, None)
+                else:
+                    self._data.pop(prefix, None)
+
+    def get(self, prefix: str, key: str) -> bytes | None:
+        with self._lock:
+            return self._data.get(prefix, {}).get(key)
+
+    def iterate(self, prefix: str, start: str = ""):
+        with self._lock:
+            items = sorted(self._data.get(prefix, {}).items())
+        for k, v in items:
+            if k >= start:
+                yield k, v
+
+
+class WalKV(MemKV):
+    """Durable KV: MemKV state + crc-framed WAL + snapshot compaction
+    (the FileStore/DurableMonStore WAL contract over KV semantics)."""
+
+    COMPACT_RATIO = 4  # compact when log bytes > ratio * live bytes
+
+    def __init__(self, path: str):
+        super().__init__()
+        os.makedirs(path, exist_ok=True)
+        self._path = os.path.join(path, "kv.wal")
+        self._file = None
+        self._log_bytes = 0
+        self._live_bytes = 0
+        self._load()
+        self._file = open(self._path, "ab")
+
+    # -- framing -----------------------------------------------------------
+    @staticmethod
+    def _frame(payload: bytes) -> bytes:
+        return struct.pack("<II", len(payload), crc32c(payload)) + payload
+
+    def _load(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, "rb") as f:
+            raw = f.read()
+        pos = 0
+        while pos + 8 <= len(raw):
+            length, crc = struct.unpack_from("<II", raw, pos)
+            payload = raw[pos + 8: pos + 8 + length]
+            if len(payload) < length or crc32c(payload) != crc:
+                break  # torn tail
+            self._apply_payload(payload)
+            pos += 8 + length
+        if pos < len(raw):
+            with open(self._path, "r+b") as f:
+                f.truncate(pos)
+        self._log_bytes = pos
+        self._live_bytes = self._live_size()
+
+    def _apply_payload(self, payload: bytes) -> None:
+        d = Decoder(payload)
+        kind = d.u8()
+        if kind == _REC_TX:
+            tx = KVTransaction()
+            for _ in range(d.u32()):
+                op, prefix, key, val = d.string(), d.string(), \
+                    d.string(), d.blob()
+                tx.ops.append((op, prefix, key, val))
+            MemKV.submit(self, tx)
+        elif kind == _REC_SNAP:
+            self._data = {}
+            for _ in range(d.u32()):
+                prefix = d.string()
+                self._data[prefix] = d.mapping(Decoder.string,
+                                               Decoder.blob)
+
+    def _live_size(self) -> int:
+        return sum(len(k) + len(v)
+                   for kv in self._data.values()
+                   for k, v in kv.items()) or 1
+
+    # -- api ---------------------------------------------------------------
+    def submit(self, tx: KVTransaction) -> None:
+        e = Encoder()
+        e.u8(_REC_TX)
+        e.u32(len(tx.ops))
+        for op, prefix, key, val in tx.ops:
+            e.string(op)
+            e.string(prefix)
+            e.string(key)
+            e.blob(val)
+        payload = e.tobytes()
+        with self._lock:
+            super().submit(tx)
+            self._file.write(self._frame(payload))
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            self._log_bytes += len(payload) + 8
+            if self._log_bytes > self.COMPACT_RATIO * \
+                    max(self._live_bytes, 4096):
+                self._compact()
+
+    def _compact(self) -> None:
+        """Rewrite the file as one snapshot record (tmp+rename)."""
+        e = Encoder()
+        e.u8(_REC_SNAP)
+        e.u32(len(self._data))
+        for prefix in sorted(self._data):
+            e.string(prefix)
+            e.mapping(self._data[prefix], Encoder.string, Encoder.blob)
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            frame = self._frame(e.tobytes())
+            f.write(frame)
+            f.flush()
+            os.fsync(f.fileno())
+        if self._file:
+            self._file.close()
+        os.replace(tmp, self._path)
+        self._file = open(self._path, "ab")
+        self._log_bytes = len(frame)
+        self._live_bytes = self._live_size()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+
+
+def create_kv(kind: str, path: str | None = None) -> KeyValueDB:
+    """Factory (KeyValueDB::create role): 'mem' or 'wal'."""
+    if kind == "mem":
+        return MemKV()
+    if kind == "wal":
+        if not path:
+            raise ValueError("wal kv needs a path")
+        return WalKV(path)
+    raise ValueError(f"unknown kv backend {kind!r}")

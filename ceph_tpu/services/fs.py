@@ -156,6 +156,11 @@ class FsClient:
         if size > ent["size"]:
             self._data(ent["ino"]).write(
                 ent["size"], b"\0" * (size - ent["size"]))
+        elif size < ent["size"]:
+            # zero the cut region so a later grow reads POSIX holes,
+            # not resurrected pre-truncate bytes
+            self._data(ent["ino"]).write(
+                size, b"\0" * (ent["size"] - size))
         ent["size"] = size
         ent["mtime"] = time.time()
         self._set_entry(path, ent)
@@ -177,6 +182,9 @@ class FsClient:
         the dir object path keys (the subtree-migration slice of the
         MDS, minus the distributed locking)."""
         src, dst = _norm(src), _norm(dst)
+        if dst == src or dst.startswith(src + "/"):
+            raise FsError(-22,
+                          f"cannot move {src!r} into itself ({dst!r})")
         ent = self._lookup(src)
         parent, name = posixpath.split(dst)
         dents = self._entries(parent)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from xml.sax.saxutils import escape
 
@@ -71,8 +72,11 @@ class RgwGateway:
                 query = self.path.split("?", 1)[1] \
                     if "?" in self.path else ""
                 parts = path.split("/", 1)
-                bucket = parts[0] if parts[0] else None
-                key = parts[1] if len(parts) > 1 else None
+                # S3 clients percent-encode keys; store the DECODED form
+                bucket = urllib.parse.unquote(parts[0]) \
+                    if parts[0] else None
+                key = urllib.parse.unquote(parts[1]) \
+                    if len(parts) > 1 else None
                 return bucket, key, query
 
             # ----------------------------------------------------- verbs
@@ -85,7 +89,8 @@ class RgwGateway:
                         prefix = ""
                         for part in query.split("&"):
                             if part.startswith("prefix="):
-                                prefix = part[len("prefix="):]
+                                prefix = urllib.parse.unquote(
+                                    part[len("prefix="):])
                         self._send(200, gw.list_objects_xml(bucket,
                                                             prefix))
                     else:
@@ -247,8 +252,14 @@ class RgwGateway:
         if range_header and range_header.startswith("bytes="):
             spec = range_header[len("bytes="):]
             start_s, _, end_s = spec.partition("-")
-            start = int(start_s) if start_s else 0
-            end = int(end_s) if end_s else meta["size"] - 1
+            if not start_s:
+                # suffix range (RFC 7233): the LAST N bytes
+                n = int(end_s)
+                start = max(0, meta["size"] - n)
+                end = meta["size"] - 1
+            else:
+                start = int(start_s)
+                end = int(end_s) if end_s else meta["size"] - 1
             data = so.read(start, max(0, end - start + 1))
             return data, meta, 206
         return so.read(0, meta["size"]), meta, 200

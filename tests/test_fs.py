@@ -97,3 +97,17 @@ def test_files_survive_osd_failure(fs):
     c.settle(0.8)
     assert f.read_file("/d/x") == data
     assert f.listdir("/d") == ["x"]
+
+
+def test_truncate_hole_and_rename_into_self(fs):
+    _c, f = fs
+    f.create("/f")
+    f.write_file("/f", b"\xAA" * 200)
+    f.truncate("/f", 100)
+    f.write_file("/f", b"x", offset=180)
+    assert f.read_file("/f", 100, 80) == b"\0" * 80  # POSIX hole
+    f.mkdir("/a")
+    f.mkdir("/a/b")
+    with pytest.raises(FsError):
+        f.rename("/a", "/a/b/c")
+    assert f.listdir("/a") == ["b"]  # tree intact

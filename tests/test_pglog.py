@@ -212,7 +212,7 @@ def test_torn_ec_partial_write_rolls_back():
     epoch = c.mon.osdmap.epoch
     c.mon._commit_map("re-peer")
     c.wait_for_epoch(epoch + 1)
-    deadline = time.time() + 10
+    deadline = time.time() + 25
     while time.time() < deadline:
         vs2 = {}
         for shard, osd in enumerate(up):
@@ -229,11 +229,11 @@ def test_torn_ec_partial_write_rolls_back():
     assert rollbacks >= 1, "no rollback was performed"
 
     def read_with_retry():
-        for _ in range(6):
+        for _ in range(8):
             try:
                 return client.read("ec", "obj")
             except RadosError:
-                c.settle(1.0)  # reconciliation still converging
+                c.settle(1.5)  # reconciliation still converging
         return client.read("ec", "obj")
 
     # the stripe decodes to the OLD bytes everywhere, degraded included
@@ -244,7 +244,7 @@ def test_torn_ec_partial_write_rolls_back():
     c.settle(0.8)
     assert read_with_retry() == base
     # consistent on disk once the promoted spare finishes rebuilding
-    deadline = time.time() + 12
+    deadline = time.time() + 20
     issues = client.scrub_pg("ec", seed, deep=True).inconsistencies
     while issues and time.time() < deadline:
         c.settle(1.0)

@@ -107,3 +107,19 @@ def test_objects_survive_osd_failure(gateway):
     c.settle(0.8)
     st, body, _ = _req(gw, "GET", "/b/durable")
     assert st == 200 and body == data
+
+
+def test_suffix_range_and_encoded_keys(gateway):
+    _c, gw = gateway
+    _req(gw, "PUT", "/b")
+    data = RNG.integers(0, 256, 10_000, dtype=np.uint8).tobytes()
+    # percent-encoded key round-trips DECODED
+    _req(gw, "PUT", "/b/my%20file.txt", body=data)
+    st, body, _ = _req(gw, "GET", "/b/my%20file.txt")
+    assert st == 200 and body == data
+    st, body, _ = _req(gw, "GET", "/b")
+    assert b"<Key>my file.txt</Key>" in body
+    # suffix range = LAST N bytes (RFC 7233)
+    st, body, _ = _req(gw, "GET", "/b/my%20file.txt",
+                       headers={"Range": "bytes=-500"})
+    assert st == 206 and body == data[-500:]
