@@ -164,66 +164,113 @@ class RegionMatmul:
         on_tpu = jax.default_backend() == "tpu"
         self._interpret = interpret and not on_tpu
         self._use_pallas = on_tpu or self._interpret
-        self._shape_cache: dict[int, object] = {}
+        self._shape_cache: dict[tuple, object] = {}
 
-    def _compiled(self, n4: int):
-        fn = self._shape_cache.get(n4)
+    def _compiled(self, key: tuple):
+        fn = self._shape_cache.get(key)
         if fn is None:
-            fn = self._build(n4)
+            kind, n4 = key
+            fn = (self._build_u32(n4) if kind == "u32"
+                  else self._build_u8(n4))
             if len(self._shape_cache) >= 16:
                 self._shape_cache.pop(next(iter(self._shape_cache)))
-            self._shape_cache[n4] = fn
+            self._shape_cache[key] = fn
         return fn
 
-    def _build(self, n4: int):
+    def _lanes_op(self, n4: int):
+        """The core (c, n4) -> (r, n4) uint32 lane computation: a Pallas
+        grid over VMEM blocks on TPU (or interpret mode), the identical
+        jnp graph elsewhere.  Keeping the callable u32-in/u32-out means no
+        device-side byte<->lane bitcasts: feeding XLA the pre-packed lanes
+        avoids the layout the compiler otherwise invents for the bitcast
+        (minor-most rows axis, T(8,128)-padded 16x — enough to OOM HBM on
+        multi-GiB batches)."""
         terms_all = self._terms
-        r = self.r
+        if not self._use_pallas:
+            return lambda x32: _rows_op(x32, terms_all)
 
-        if self._use_pallas:
-            from jax.experimental import pallas as pl
+        from jax.experimental import pallas as pl
 
-            block = min(self.BLOCK, n4)
-            grid = (n4 // block,)
-            kernel = _pallas_region_kernel(terms_all)
+        block = min(self.BLOCK, n4)
+        grid = (n4 // block,)
+        kernel = _pallas_region_kernel(terms_all)
+        r, c, interpret = self.r, self.c, self._interpret
 
-            interpret = self._interpret
+        def run(x32):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((r, n4), jnp.uint32),
+                grid=grid,
+                in_specs=[pl.BlockSpec((c, block), lambda g: (0, g))],
+                out_specs=pl.BlockSpec((r, block), lambda g: (0, g)),
+                interpret=interpret,
+            )(x32)
 
-            def run(x32):
-                return pl.pallas_call(
-                    kernel,
-                    out_shape=jax.ShapeDtypeStruct((r, n4), jnp.uint32),
-                    grid=grid,
-                    in_specs=[pl.BlockSpec((self.c, block), lambda g: (0, g))],
-                    out_specs=pl.BlockSpec((r, block), lambda g: (0, g)),
-                    interpret=interpret,
-                )(x32)
-        else:
+        return run
+
+    def _build_u32(self, n4: int):
+        return jax.jit(self._lanes_op(n4))
+
+    def _build_u8(self, n4: int):
+        if not self._use_pallas:
             # identical math as a plain jnp graph — shared with
             # gf_matmul_graph so the lane-packing logic lives once
             return jax.jit(gf_matmul_graph(self.M))
+        run, r, c = self._lanes_op(n4), self.r, self.c
 
         @jax.jit
         def fn(data_u8):
             x32 = jax.lax.bitcast_convert_type(
-                data_u8.reshape(self.c, n4, 4), jnp.uint32)
+                data_u8.reshape(c, n4, 4), jnp.uint32)
             y32 = run(x32)
             return jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(
                 r, n4 * 4)
 
         return fn
 
+    def _quantum(self, L: int) -> int:
+        # uint32 tiling wants multiples of 128 lanes (512 bytes); beyond one
+        # block, round up to a whole block so the grid divides evenly.
+        return 512 if L <= 4 * self.BLOCK else 4 * self.BLOCK
+
+    def encode_lanes(self, x32) -> jax.Array:
+        """Raw lane-domain entry: x32 (c, n4) uint32 -> (r, n4) uint32.
+        n4 must already be a multiple of 128 (whole tiles); the byte view
+        of a chunk IS its lane view (little-endian u32 of 4 consecutive
+        bytes), so callers holding host buffers use numpy ``.view`` —
+        zero-copy — rather than paying a device-side bitcast."""
+        n4 = x32.shape[-1]
+        if n4 % 128 or (n4 > self.BLOCK and n4 % self.BLOCK):
+            # the Pallas grid is (n4 // block,) whole blocks — a ragged
+            # tail would silently stay unwritten in the output
+            raise ValueError(
+                f"encode_lanes wants n4 % 128 == 0 and, beyond one block, "
+                f"n4 % {self.BLOCK} == 0; got {n4}")
+        return self._compiled(("u32", n4))(x32)
+
     def __call__(self, data) -> jax.Array:
+        if (isinstance(data, np.ndarray) and data.dtype == np.uint8
+                and data.ndim == 2 and data.shape[0] == self.c
+                and data.shape[1] > 0):
+            # host fast path: pad host-side, view bytes as u32 lanes
+            # (zero-copy), run the lane kernel, un-view on device
+            L = data.shape[1]
+            pad = (-L) % self._quantum(L)
+            if pad:
+                data = np.pad(data, ((0, 0), (0, pad)))
+            x32 = np.ascontiguousarray(data).view(np.uint32)
+            y32 = self.encode_lanes(x32)
+            out = jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(
+                self.r, L + pad)
+            return out[:, :L] if pad else out
         data = jnp.asarray(data, dtype=jnp.uint8)
         if data.ndim != 2 or data.shape[0] != self.c:
             raise ValueError(f"expected ({self.c}, L) data, got {data.shape}")
         L = data.shape[1]
         if L == 0:
             return jnp.zeros((self.r, 0), dtype=jnp.uint8)
-        # uint32 tiling wants multiples of 128 lanes (512 bytes); beyond one
-        # block, round up to a whole block so the grid divides evenly.
-        quantum = 512 if L <= 4 * self.BLOCK else 4 * self.BLOCK
-        pad = (-L) % quantum
+        pad = (-L) % self._quantum(L)
         if pad:
             data = jnp.pad(data, ((0, 0), (0, pad)))
-        out = self._compiled((L + pad) // 4)(data)
+        out = self._compiled(("u8", (L + pad) // 4))(data)
         return out[:, :L] if pad else out

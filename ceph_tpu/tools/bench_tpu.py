@@ -2,18 +2,37 @@
 default JAX backend and print one JSON line.
 
 Run as a subprocess by bench.py so a wedged TPU tunnel (axon) can be
-timed out without hanging the driver.  Measures both:
-- end_to_end_gbps: host numpy in -> device -> encode -> host chunks out
-  (the BASELINE.md rule: staging included), and
-- kernel_gbps: device-resident encode only (block_until_ready).
+timed out without hanging the driver.
+
+Methodology (hardened for the axon remote backend, where execution is
+LAZY: ``block_until_ready`` returns before the computation has actually
+run, so naive timing loops measure dispatch, not compute — round-1's
+numbers did exactly that).  Every timed repetition here fetches a 4-byte
+digest computed from the full parity output, which forces the execution
+to complete while moving almost nothing over the tunnel; the digest is
+checked against the CPU oracle, so a kernel that did not really run (or
+ran wrong) cannot produce a timing at all.  Reported numbers:
+
+- kernel_gbps: device-resident lanes in HBM -> parity in HBM, measured
+  as median(per-rep digest-forced time) - median RTT, over DISTINCT
+  input buffers (the tunnel memoizes repeated identical executions).
+- staging_gbps: host -> device transfer rate (device_put, landing forced
+  by a one-element fetch).
+- e2e_gbps: host bytes in -> full parity bytes back on host, one shot
+  (BASELINE.md's staging-included rule; over the axon tunnel this is
+  transport-bound and reported for honesty, not capability).
+- rtt_s: median trivial-fetch round trip, subtracted from kernel reps.
+
 GB/s counts source data bytes (iterations x size / elapsed / 2^30),
-matching the reference tool's convention (ceph_erasure_code_benchmark.cc:193).
+matching the reference tool's convention
+(ceph_erasure_code_benchmark.cc:193).
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import statistics
 import sys
 import time
 
@@ -26,18 +45,23 @@ def main() -> int:
     p.add_argument("--m", type=int, default=3)
     p.add_argument("--stripe-bytes", type=int, default=1024 * 1024)
     p.add_argument("--batch", type=int, default=64)
-    p.add_argument("--reps", type=int, default=10)
+    p.add_argument("--reps", type=int, default=4)
     p.add_argument("--technique", default="reed_sol_van")
     p.add_argument("--kernel", default="auto",
-                   choices=["auto", "vpu", "mxu"],
-                   help="vpu = bit-term lane kernel; mxu = GF(2) bitmatrix "
-                        "matmul; auto = time both, keep the faster")
+                   choices=["auto", "pallas", "xla", "mxu"],
+                   help="pallas = VPU bit-term Pallas kernel; xla = same "
+                        "math as a fused XLA graph; mxu = GF(2) bitmatrix "
+                        "matmul; auto = time all, keep the fastest")
+    p.add_argument("--skip-e2e", action="store_true",
+                   help="skip the full-parity-fetch end-to-end rep "
+                        "(slow over the tunnel)")
     args = p.parse_args()
 
     import jax
+    import jax.numpy as jnp
 
     backend = jax.default_backend()
-    from ceph_tpu.ops import gf256
+    from ceph_tpu.ops import gf256, native
     from ceph_tpu.ops.ec_kernels import RegionMatmul, gf_matmul_mxu_graph
 
     if args.technique == "reed_sol_van":
@@ -47,63 +71,171 @@ def main() -> int:
     else:
         M = gf256.cauchy_matrix(args.k, args.m)
 
-    candidates = {}
-    if args.kernel in ("auto", "vpu"):
-        candidates["vpu"] = RegionMatmul(M)
+    k, r = args.k, args.m
+    chunk = args.stripe_bytes // k
+    cols = args.batch * chunk           # stripes fold into the column axis
+    rm = RegionMatmul(M)
+    # round up to whole kernel tiles/blocks (encode_lanes contract, same
+    # quantum rule RegionMatmul applies); the buffers are generated at
+    # this size, so no padding bytes exist
+    cols += (-cols) % rm._quantum(cols)
+    n4 = cols // 4
+    rng = np.random.default_rng(0)
+
+    # ---- candidates: all take (k, n4) uint32 lanes, return (parity_lanes,
+    # uint32-sum digest); the digest fetch is the forcing function --------
+    def with_digest(core):
+        def fn(x32):
+            y32 = core(x32)
+            return y32, jnp.sum(y32, dtype=jnp.uint32)
+        return jax.jit(fn)
+
+    candidates: dict[str, object] = {}
+    if args.kernel in ("auto", "pallas") and (
+            rm._use_pallas or args.kernel == "pallas"):
+        # off-TPU, _lanes_op degenerates to the same jnp graph as "xla" —
+        # skip it in auto mode; an explicit request gets the real Pallas
+        # kernel in interpret mode (honest label, interpreter speed)
+        if not rm._use_pallas:
+            rm = RegionMatmul(M, interpret=True)
+        candidates["pallas"] = with_digest(rm._lanes_op(n4))
+    if args.kernel in ("auto", "xla"):
+        from ceph_tpu.ops.ec_kernels import _rows_op, _terms
+        terms = _terms(M)
+        candidates["xla"] = with_digest(lambda x32: _rows_op(x32, terms))
     if args.kernel in ("auto", "mxu"):
         try:
-            candidates["mxu"] = jax.jit(gf_matmul_mxu_graph(M))
+            mxu = gf_matmul_mxu_graph(M)
+
+            def mxu_core(x32):
+                u8 = jax.lax.bitcast_convert_type(x32, jnp.uint8)
+                y8 = mxu(u8.reshape(k, 4 * x32.shape[-1]))
+                return jax.lax.bitcast_convert_type(
+                    y8.reshape(r, x32.shape[-1], 4), jnp.uint32)
+
+            candidates["mxu"] = with_digest(mxu_core)
         except ValueError:
             if args.kernel == "mxu":
                 raise  # explicitly requested but unsupported (k > 32)
 
-    def pick(host):
-        if len(candidates) == 1:
-            return next(iter(candidates.items()))
-        dev = jax.device_put(host)
-        best, best_dt = None, None
-        for name, fn in candidates.items():
-            fn(dev).block_until_ready()  # compile
+    # ---- RTT: trivial computation + 4-byte fetch, distinct inputs ------
+    bump = jax.jit(lambda s: s + jnp.uint32(1))
+    int(bump(jnp.uint32(0)))  # compile
+    rtts = []
+    for i in range(5):
+        t0 = time.perf_counter()
+        int(bump(jnp.uint32(i + 1)))
+        rtts.append(time.perf_counter() - t0)
+    rtt = statistics.median(rtts)
+
+    # ---- staging: distinct host buffers -> device ----------------------
+    # reps timed + 1 warm/verify; one EXTRA host buffer is reserved for
+    # the e2e shot and never staged here, so neither its transfer nor its
+    # execution can be served from the tunnel's memo
+    hosts = [rng.integers(0, 2**32, (k, n4), dtype=np.uint32)
+             for _ in range(args.reps + 2)]
+    nbytes = hosts[0].nbytes
+    # warm transfer + the per-shape gather executable on the first buffer
+    # (untimed), then time the rest
+    bufs = [jax.device_put(hosts[0])]
+    int(bufs[0][0, 0])
+    t0 = time.perf_counter()
+    for h in hosts[1:-1]:
+        d = jax.device_put(h)
+        int(d[0, 0])            # force the buffer to actually land
+        bufs.append(d)
+    n_timed = len(bufs) - 1
+    staging_dt = time.perf_counter() - t0 - n_timed * rtt
+    staging_gbps = (None if staging_dt <= 0
+                    else round(n_timed * nbytes / staging_dt / 2**30, 4))
+
+    # ---- per-buffer oracle digests (prove every timed execution) -------
+    def oracle_digest(h) -> int:
+        par = (native.encode_region(M, h.view(np.uint8))
+               if native.available()
+               else gf256.encode_region(M, h.view(np.uint8)))
+        return int(np.sum(par.view(np.uint32), dtype=np.uint32))
+
+    wants = [oracle_digest(h) for h in hosts[:-1]]
+
+    # ---- per-candidate: verify then time -------------------------------
+    results = {}
+    for name, fn in candidates.items():
+        try:
             t0 = time.perf_counter()
-            for _ in range(3):
-                fn(dev).block_until_ready()
-            dt = time.perf_counter() - t0
-            if best_dt is None or dt < best_dt:
-                best, best_dt = name, dt
-        return best, candidates[best]
+            _, dig = fn(bufs[-1])
+            got = int(dig)
+            compile_s = time.perf_counter() - t0
+        except Exception as e:  # compile/runtime failure: skip candidate
+            print(f"bench_tpu: {name} failed: {e}", file=sys.stderr)
+            continue
+        if got != wants[-1]:
+            print(f"bench_tpu: {name} WRONG digest {got} != {wants[-1]}",
+                  file=sys.stderr)
+            continue
+        times = []
+        bad = False
+        for i in range(args.reps):
+            t0 = time.perf_counter()
+            _, dig = fn(bufs[i])
+            got = int(dig)
+            times.append(time.perf_counter() - t0)
+            if got != wants[i]:
+                print(f"bench_tpu: {name} rep {i} WRONG digest", file=sys.stderr)
+                bad = True
+                break
+        if bad:
+            continue
+        dt = statistics.median(times) - rtt
+        if dt <= rtt:  # RTT-dominated: the batch is too small to resolve
+            print(f"bench_tpu: {name} unmeasurable at this size "
+                  f"(median rep {statistics.median(times):.6f}s vs rtt "
+                  f"{rtt:.6f}s) — raise --batch", file=sys.stderr)
+            results[name] = {
+                "kernel_gbps": None,
+                "rep_times_s": [round(t, 6) for t in times],
+                "compile_s": round(compile_s, 3),
+            }
+            continue
+        results[name] = {
+            "kernel_gbps": nbytes / dt / 2**30,
+            "rep_times_s": [round(t, 6) for t in times],
+            "compile_s": round(compile_s, 3),
+        }
+    measurable = {n: v for n, v in results.items()
+                  if v["kernel_gbps"] is not None}
+    if not measurable:
+        print("bench_tpu: no candidate produced a verified, measurable "
+              "timing", file=sys.stderr)
+        return 1
 
-    chunk = args.stripe_bytes // args.k
-    cols = args.batch * chunk  # stripes fold into the column axis
-    rng = np.random.default_rng(0)
-    host = rng.integers(0, 256, (args.k, cols), dtype=np.uint8)
-    nbytes = host.nbytes
+    best = max(measurable, key=lambda n: measurable[n]["kernel_gbps"])
 
-    kernel_name, op = pick(host)
-    # warm: compile + first transfer
-    np.asarray(op(host))
-
-    # end-to-end: host in -> parity back on host
-    t0 = time.perf_counter()
-    for _ in range(args.reps):
-        np.asarray(op(host))
-    e2e = time.perf_counter() - t0
-
-    # kernel-only: device-resident input, parity left on device
-    dev = jax.device_put(host)
-    op(dev).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(args.reps):
-        op(dev).block_until_ready()
-    kern = time.perf_counter() - t0
+    # ---- end-to-end (one shot): host in -> full parity bytes out -------
+    # uses the reserved never-seen buffer: a fresh transfer and a fresh
+    # execution, immune to the tunnel's memoization
+    e2e_gbps = None
+    if not args.skip_e2e:
+        fn = candidates[best]
+        t0 = time.perf_counter()
+        d = jax.device_put(hosts[-1])
+        y32, _ = fn(d)
+        parity = np.asarray(y32)          # full fetch over the tunnel
+        e2e_gbps = nbytes / (time.perf_counter() - t0) / 2**30
+        del parity
 
     print(json.dumps({
         "backend": backend,
-        "kernel": kernel_name,
-        "k": args.k, "m": args.m, "stripe_bytes": args.stripe_bytes,
+        "kernel": best,
+        "k": k, "m": r, "stripe_bytes": args.stripe_bytes,
         "batch": args.batch, "reps": args.reps,
         "bytes_per_rep": nbytes,
-        "end_to_end_gbps": args.reps * nbytes / e2e / 2**30,
-        "kernel_gbps": args.reps * nbytes / kern / 2**30,
+        "digest_verified": True,
+        "rtt_s": round(rtt, 6),
+        "staging_gbps": staging_gbps,
+        "kernel_gbps": round(measurable[best]["kernel_gbps"], 4),
+        "e2e_gbps": None if e2e_gbps is None else round(e2e_gbps, 6),
+        "candidates": results,
     }))
     return 0
 
