@@ -155,6 +155,9 @@ class ObjectStore:
         if kind == "filestore":
             from .filestore import FileStore
             return FileStore(**kw)
+        if kind == "bluestore":
+            from .bluestore import BlueStore
+            return BlueStore(**kw)
         raise StoreError(f"unknown objectstore backend {kind!r}")
 
     # -- lifecycle ---------------------------------------------------------

@@ -28,7 +28,8 @@ from ..msg.messages import (MPGPull, MPGPush, MScrubMap, MScrubRequest,
                             MScrubResult, MScrubShard, PgId)
 from ..ops import native
 from ..utils.log import dout
-from .objectstore import CollectionId, NoSuchObject, ObjectId
+from .objectstore import (CollectionId, NoSuchCollection, NoSuchObject,
+                          ObjectId)
 
 
 @dataclass
@@ -267,6 +268,22 @@ class FaultInjection:
         tests) — bypasses the transaction path on purpose."""
         cid = CollectionId(pgid.pool, pgid.seed)
         oid = ObjectId(name, shard=shard)
+        if hasattr(store, "_dev"):  # bluestore: rot a byte on the device
+            try:
+                onode = store._onode(cid, oid)
+            except (NoSuchObject, NoSuchCollection):
+                return False
+            idx = offset // 4096
+            if idx >= len(onode.pages) or onode.pages[idx][0] < 0:
+                return False
+            with store._lock:
+                store._flush_deferred()  # the device must hold the page
+                phys = onode.pages[idx][0]
+                page = bytearray(store._dev_read(phys))
+                page[offset % 4096] ^= 0xFF
+                store._dev_write(phys, page)
+                store._dev.flush()
+            return True
         try:
             obj = store._mem._obj(cid, oid) if hasattr(store, "_mem") \
                 else store._obj(cid, oid)

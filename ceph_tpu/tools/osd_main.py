@@ -28,7 +28,7 @@ def main(argv=None) -> int:
     ap.add_argument("--host", default=None,
                     help="failure-domain host label")
     ap.add_argument("--store", default="memstore",
-                    choices=("memstore", "filestore"))
+                    choices=("memstore", "filestore", "bluestore"))
     ap.add_argument("--store-path", default=None)
     ap.add_argument("--cfg", default="{}",
                     help="JSON object of config overrides")
