@@ -48,6 +48,8 @@ class RadosClient(Dispatcher):
         self.messenger.add_dispatcher(self)
         self.osdmap: OSDMap | None = None
         self._tids = itertools.count(1)
+        # per-pool write SnapContext: pool_id -> (seq, [snap ids desc])
+        self._snapc: dict[int, tuple[int, list]] = {}
         self._waiters: dict[int, threading.Event] = {}
         self._replies: dict[int, object] = {}
         self._map_cond = threading.Condition()
@@ -194,15 +196,20 @@ class RadosClient(Dispatcher):
                 return f"osd.{u}"
         raise RadosError(-5, f"pg {pool_id}.{seed:x} has no up osds")
 
+    _WRITE_OPS = ("write", "write_full", "remove", "snap_rollback")
+
     def _op(self, pool_name: str, oid: str, op: str, data: bytes = b"",
-            offset: int = 0, length: int = 0):
+            offset: int = 0, length: int = 0, snapid: int = 0):
         pool_id = self._pool_id(pool_name)
         last_error: RadosError | None = None
         for attempt in range(12):
             target = self._primary_for(pool_id, oid)
             tid = next(self._tids)
             m = MOSDOp(tid, self.name, pool_id, oid, op, offset, length,
-                       data, self.osdmap.epoch)
+                       data, self.osdmap.epoch, snapid=snapid)
+            if op in self._WRITE_OPS:
+                seq, snaps = self._snapc.get(pool_id, (0, []))
+                m.snap_seq, m.snaps = seq, list(snaps)
             try:
                 reply = self._rpc(target, m, tid)
             except TimeoutError_ as e:
@@ -272,9 +279,11 @@ class RadosClient(Dispatcher):
                         offset=offset).version
 
     def read(self, pool: str, oid: str, offset: int = 0,
-             length: int = 0) -> bytes:
+             length: int = 0, snapid: int = 0) -> bytes:
+        """snapid > 0 reads the object's state as of that snapshot
+        (rados_ioctx_snap_set_read role)."""
         return self._op(pool, oid, "read", offset=offset,
-                        length=length).data
+                        length=length, snapid=snapid).data
 
     def remove(self, pool: str, oid: str) -> None:
         self._op(pool, oid, "remove")
@@ -282,6 +291,42 @@ class RadosClient(Dispatcher):
     def stat(self, pool: str, oid: str) -> int:
         reply = self._op(pool, oid, "stat")
         return int.from_bytes(reply.data, "little")
+
+    # ------------------------------------------ self-managed snapshots
+    def set_snap_context(self, pool: str, seq: int, snaps: list) -> None:
+        """Explicit SnapContext for writes to this pool (newest-first
+        snap ids; the rados_ioctx_selfmanaged_snap_set_write_ctx role)."""
+        self._snapc[self._pool_id(pool)] = (int(seq),
+                                            sorted(snaps, reverse=True))
+
+    def selfmanaged_snap_create(self, pool: str) -> int:
+        """Mint a snapshot id from the monitor and fold it into this
+        client's write SnapContext."""
+        rep = self.mon_command({"prefix":
+                                "osd pool selfmanaged-snap-create",
+                                "pool": pool})
+        snapid = int(rep["snapid"])
+        pid = self._pool_id(pool)
+        seq, snaps = self._snapc.get(pid, (0, []))
+        self._snapc[pid] = (max(seq, snapid),
+                            sorted(set(snaps) | {snapid}, reverse=True))
+        return snapid
+
+    def selfmanaged_snap_remove(self, pool: str, snapid: int) -> None:
+        """Publish the snap's removal (OSDs trim its clones async)."""
+        self.mon_command({"prefix": "osd pool selfmanaged-snap-remove",
+                          "pool": pool, "snapid": int(snapid)})
+        pid = self._pool_id(pool)
+        seq, snaps = self._snapc.get(pid, (0, []))
+        self._snapc[pid] = (seq, [s for s in snaps if s != snapid])
+
+    def list_snaps(self, pool: str, oid: str) -> dict:
+        """SnapSet of one object: {seq, clones, sz, ov, head}."""
+        return self._unpack(self._op(pool, oid, "list_snaps").data)
+
+    def snap_rollback(self, pool: str, oid: str, snapid: int) -> None:
+        """Roll the head back to its state at snapid."""
+        self._op(pool, oid, "snap_rollback", snapid=snapid)
 
 
     # ------------------------------------------ extended ops (do_osd_ops)

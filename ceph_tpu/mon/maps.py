@@ -25,8 +25,13 @@ class PoolSpec(Encodable):
     min_size: int = 2
     pg_num: int = 32
     ec_profile: dict = field(default_factory=dict)
+    # self-managed snapshots (pg_pool_t snap_seq/removed_snaps role):
+    # snap ids are minted monotonically here; removal publishes the id so
+    # OSDs trim clones asynchronously
+    snap_seq: int = 0
+    removed_snaps: list = field(default_factory=list)
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e: Encoder):
@@ -37,13 +42,19 @@ class PoolSpec(Encodable):
             e.u32(self.min_size)
             e.u32(self.pg_num)
             e.mapping(self.ec_profile, Encoder.string, Encoder.string)
+            e.u64(self.snap_seq)           # v2 tail
+            e.seq(self.removed_snaps, Encoder.u64)
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "PoolSpec":
         def body(d: Decoder, v: int):
-            return cls(d.u64(), d.string(), d.string(), d.u32(), d.u32(),
-                       d.u32(), d.mapping(Decoder.string, Decoder.string))
+            p = cls(d.u64(), d.string(), d.string(), d.u32(), d.u32(),
+                    d.u32(), d.mapping(Decoder.string, Decoder.string))
+            if v >= 2:
+                p.snap_seq = d.u64()
+                p.removed_snaps = d.seq(Decoder.u64)
+            return p
         return dec.versioned(cls.VERSION, body)
 
 

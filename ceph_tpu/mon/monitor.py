@@ -684,6 +684,29 @@ class MonitorLite(Dispatcher):
                 info.primary_affinity = aff
                 self._commit_map(f"osd.{target} primary-affinity {aff}")
             return 0, {}
+        if prefix == "osd pool selfmanaged-snap-create":
+            # mint a pool-unique snap id (pg_pool_t::snap_seq role)
+            with self._lock:
+                pool = self._pool_by_name(cmd["pool"])
+                if pool is None:
+                    return -2, {"error": f"no pool {cmd['pool']!r}"}
+                pool.snap_seq += 1
+                snapid = pool.snap_seq
+                self._commit_map(f"pool {pool.name} snap {snapid}")
+            return 0, {"snapid": snapid, "seq": snapid}
+        if prefix == "osd pool selfmanaged-snap-remove":
+            with self._lock:
+                pool = self._pool_by_name(cmd["pool"])
+                if pool is None:
+                    return -2, {"error": f"no pool {cmd['pool']!r}"}
+                snapid = int(cmd["snapid"])
+                if snapid <= 0 or snapid > pool.snap_seq:
+                    return -22, {"error": f"bad snapid {snapid}"}
+                if snapid not in pool.removed_snaps:
+                    pool.removed_snaps.append(snapid)
+                    self._commit_map(
+                        f"pool {pool.name} snap {snapid} removed")
+            return 0, {}
         if prefix == "balancer optimize":
             return self._balancer_optimize(int(cmd.get("max_moves", 10)))
         if prefix == "osd dump":
@@ -767,6 +790,12 @@ class MonitorLite(Dispatcher):
     def _handle_stats(self, conn, m: MStatsReport) -> None:
         with self._lock:
             self._osd_stats[m.osd_id] = dict(m.stats)
+
+    def _pool_by_name(self, name: str):
+        for p in self.osdmap.pools.values():
+            if p.name == name:
+                return p
+        return None
 
     def _pool_create(self, cmd: dict):
         name = cmd["name"]

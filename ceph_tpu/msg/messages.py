@@ -41,21 +41,33 @@ class MOSDOp(Encodable):
     length: int = 0
     data: bytes = b""
     epoch: int = 0  # client's map epoch (staleness check)
+    # v2 tail: self-managed snapshots (SnapContext on writes, snapid on
+    # reads — the osd_op_t snapc/snapid role).  snapid 0 = head.
+    snapid: int = 0
+    snap_seq: int = 0
+    snaps: list = field(default_factory=list)  # newest-first snap ids
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
             e.u64(self.tid); e.string(self.client); e.u64(self.pool)
             e.string(self.oid); e.string(self.op); e.u64(self.offset)
             e.u64(self.length); e.blob(self.data); e.u64(self.epoch)
+            e.u64(self.snapid); e.u64(self.snap_seq)   # v2 tail
+            e.seq(self.snaps, Encoder.u64)
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "MOSDOp":
         def body(d, v):
-            return cls(d.u64(), d.string(), d.u64(), d.string(), d.string(),
-                       d.u64(), d.u64(), d.blob(), d.u64())
+            m = cls(d.u64(), d.string(), d.u64(), d.string(), d.string(),
+                    d.u64(), d.u64(), d.blob(), d.u64())
+            if v >= 2:
+                m.snapid = d.u64()
+                m.snap_seq = d.u64()
+                m.snaps = d.seq(Decoder.u64)
+            return m
         return dec.versioned(cls.VERSION, body)
 
 

@@ -58,6 +58,7 @@ from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scheduler import ClassParams, MClockScheduler
 from .scrub import FaultInjection, ScrubMixin
+from .snaps import SnapMixin, split_vname, to_oid, vname, vname_of
 
 EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
 
@@ -107,7 +108,7 @@ class _ClientConn:
         return self._daemon.messenger.send_message(self._client, msg)
 
 
-class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
+class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def __init__(self, osd_id: int, network: Network,
                  mon: str = "mon.0", store: ObjectStore | None = None,
                  cfg: Config | None = None, host: str | None = None,
@@ -169,6 +170,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         self.inject = FaultInjection()
         self.op_tracker = OpTracker()
         self._init_objops()
+        self._init_snaps()
         self._handlers = {
             MScrubRequest: self._handle_scrub_request,
             MScrubShard: self._handle_scrub_shard,
@@ -359,6 +361,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         if old is None or newmap.epoch > old.epoch:
             self._start_recovery()
             self._notify_demoted(old)
+            self._snap_trim_check()
 
     def _notify_demoted(self, old: OSDMap | None) -> None:
         """If I hold objects for PGs I am no longer an up member of, tell
@@ -548,15 +551,21 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
     # -- replicated pool ---------------------------------------------------
     def _rep_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
                    full: bool = True) -> None:
+        # snapshots: clone-on-first-write-after-snap + SnapSet upkeep,
+        # staged into the SAME transaction as the write (make_writeable)
+        snap_tx, rider = self._snap_prepare(pgid, m)
         version = self._next_version(pgid)
         cid = CollectionId(pgid.pool, pgid.seed)
         existed = self.store.exists(cid, ObjectId(m.oid))
+        was_whiteout = existed and self._head_whiteout(cid, m.oid)
+        extra_attrs = {"wh": 0} if was_whiteout else {}
         partial = not full and (m.offset > 0 or (
             existed and m.offset + len(m.data) < self.store.stat(
                 cid, ObjectId(m.oid))["size"]))
         if partial:
             self._apply_partial(pgid, m.oid, -1, [(m.offset, m.data)],
-                                version, create_ok=True)
+                                version, create_ok=True, pre_tx=snap_tx,
+                                extra_attrs=extra_attrs)
             if existed:
                 op, payload, off = "write_partial", m.data, m.offset
             else:
@@ -568,7 +577,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         else:
             op, payload, off = "write", m.data, 0
             self._apply_write(pgid, m.oid, -1, m.data,
-                              {"v": version, "len": len(m.data)})
+                              dict(extra_attrs, v=version,
+                                   len=len(m.data)), pre_tx=snap_tx)
         peers = [u for u in up if u is not None and u != self.osd_id]
         tid = next(self._tids)
         if not peers:
@@ -577,16 +587,26 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
             return
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, len(peers), version)
+        sub_attrs = dict(extra_attrs)
+        if rider is not None:
+            sub_attrs["_snap"] = rider
         for peer in peers:
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, -1, version, op, payload,
-                          offset=off))
+                          attrs=dict(sub_attrs), offset=off))
 
     def _rep_read(self, conn, m: MOSDOp, pgid: PgId) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         try:
-            bl = self.store.read(cid, ObjectId(m.oid))
+            # snapid resolution (find_object_context): head, a clone, or
+            # a whiteout'd ENOENT
+            target = self._snap_resolve(cid, m.oid, m.snapid)
+            if target is None:
+                conn.send(MOSDOpReply(m.tid, ENOENT,
+                                      epoch=self.osdmap.epoch))
+                return
+            bl = self.store.read(cid, target)
             data = bl.to_bytes()
             if m.length:
                 data = data[m.offset:m.offset + m.length]
@@ -598,12 +618,28 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
 
     def _rep_remove(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
-        version = self._next_version(pgid)
         cid = CollectionId(pgid.pool, pgid.seed)
-        if not self.store.exists(cid, ObjectId(m.oid)):
+        if not self.store.exists(cid, ObjectId(m.oid)) or \
+                self._head_whiteout(cid, m.oid):
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
             return
-        self._apply_remove(pgid, m.oid, -1, version)
+        # a head with clones (or a live SnapContext that first needs a
+        # clone) must leave its SnapSet behind: whiteout, not remove
+        snap_tx, rider = self._snap_prepare(pgid, m)
+        ss = self._load_ss(cid, m.oid)
+        # whiteout only when clones actually exist (or one is being
+        # staged right now) — a snapc alone must not leave a permanent
+        # zero-clone whiteout behind
+        whiteout = bool((ss or {}).get("clones")) or (
+            rider is not None and rider.get("clone", -1) >= 0)
+        version = self._next_version(pgid)
+        if whiteout:
+            self._apply_whiteout(pgid, m.oid, version, pre_tx=snap_tx)
+            sub_op, sub_attrs = "whiteout", (
+                {"_snap": rider} if rider is not None else {})
+        else:
+            self._apply_remove(pgid, m.oid, -1, version)
+            sub_op, sub_attrs = "remove", {}
         peers = [u for u in up if u is not None and u != self.osd_id]
         tid = next(self._tids)
         if not peers:
@@ -615,7 +651,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         for peer in peers:
             self.messenger.send_message(
                 f"osd.{peer}",
-                MSubWrite(tid, pgid, m.oid, -1, version, "remove"))
+                MSubWrite(tid, pgid, m.oid, -1, version, sub_op,
+                          attrs=dict(sub_attrs)))
 
     def _stat(self, conn, m: MOSDOp, pgid: PgId, shard: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -629,6 +666,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
                 attrs = self.store.getattrs(cid, cand)
             except NoSuchObject:
                 continue
+            if shard < 0 and attrs.get("wh"):
+                break  # whiteout head: logically deleted
             size = int(attrs.get("len", 0))
             conn.send(MOSDOpReply(m.tid, 0,
                                   data=size.to_bytes(8, "little"),
@@ -1112,7 +1151,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
                        extents: list, version: int,
                        create_ok: bool = False,
                        total_len: int | None = None,
-                       prev_version: int = -1) -> int:
+                       prev_version: int = -1,
+                       pre_tx: Transaction | None = None,
+                       extra_attrs: dict | None = None) -> int:
         """Apply extent overwrites to one shard chunk + refresh v/digest.
         Returns 0, ENOENT, or EAGAIN (no change on nonzero).
 
@@ -1127,8 +1168,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         prev_version bytes, so applying them over stale (or newer) data
         would desynchronize the stripe while stamping it current."""
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid, shard=shard)
+        obj = to_oid(oid, shard)
         tx = Transaction()
+        if pre_tx is not None:
+            tx.append(pre_tx)
         exists = self.store.exists(cid, obj)
         old_attrs: dict = {}
         if not exists:
@@ -1162,6 +1205,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         self.store.queue_transaction(tx)
         data = self.store.read(cid, obj).to_bytes()
         attrs = dict(self.store.getattrs(cid, obj))
+        if extra_attrs:
+            attrs.update(extra_attrs)
         attrs["v"] = version
         attrs["d"] = native_crc32c(data)
         if shard < 0:
@@ -1472,14 +1517,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
 
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
-                     attrs: dict, omap: dict | None = None) -> None:
+                     attrs: dict, omap: dict | None = None,
+                     pre_tx: Transaction | None = None) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid, shard=shard)
+        obj = to_oid(oid, shard)
+        oid = vname_of(obj)  # canonical: log/tombstones use the vname
         # stored digest for deep scrub (per-blob csum, BlueStore role)
         attrs = dict(attrs, d=native_crc32c(data))
         tx = Transaction()
         if cid not in self.store.list_collections():
             tx.create_collection(cid)
+        if pre_tx is not None:
+            tx.append(pre_tx)
         tx.touch(cid, obj)
         tx.truncate(cid, obj, 0)
         tx.write(cid, obj, 0, data)
@@ -1515,18 +1564,40 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
             # applying — a lost apply that scrub must later catch
             conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
             return
+        attrs = dict(m.attrs)
+        rider = attrs.pop("_snap", None)
+        pre_tx = (self._snap_apply_rider(m.pgid, m.oid, rider)
+                  if rider is not None else None)
         if m.op == "write":
             self._apply_write(m.pgid, m.oid, m.shard, m.data,
-                              dict(m.attrs, v=m.version))
+                              dict(attrs, v=m.version), pre_tx=pre_tx)
         elif m.op == "write_partial":
             code = self._apply_partial(m.pgid, m.oid, m.shard,
-                                       [(m.offset, m.data)], m.version)
+                                       [(m.offset, m.data)], m.version,
+                                       pre_tx=pre_tx, extra_attrs=attrs)
             if code != 0:
                 # replica lacks the object (recovery lag): refuse rather
                 # than fabricate a zero-prefixed copy at the new version
                 conn.send(MSubWriteReply(m.tid, m.pgid, m.shard,
                                          self.osd_id, code))
                 return
+        elif m.op == "whiteout":
+            self._apply_whiteout(m.pgid, m.oid, m.version, pre_tx=pre_tx)
+        elif m.op == "snap_rollback":
+            from ..msg.wire import unpack_value
+            p = unpack_value(m.data)
+            r = p.get("rider")
+            rb_pre = (self._snap_apply_rider(m.pgid, m.oid, r)
+                      if r else None)
+            self._apply_snap_rollback(m.pgid, m.oid, int(p["cloneid"]),
+                                      bytes(p["ss"]), m.version,
+                                      pre_tx=rb_pre)
+        elif m.op == "trim_clone":
+            from ..msg.wire import unpack_value
+            p = unpack_value(m.data)
+            self._apply_trim(m.pgid, m.oid, int(p["snapid"]),
+                             bytes(p["ss"]), bool(p["drop_head"]),
+                             m.version)
         elif m.op == "remove":
             self._apply_remove(m.pgid, m.oid, m.shard, m.version)
         elif m.op in ("omap_set", "omap_rm"):
@@ -1544,7 +1615,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
     def _apply_remove(self, pgid: PgId, oid: str, shard: int,
                       version: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid, shard=shard)
+        obj = to_oid(oid, shard)
+        oid = vname_of(obj)
         tx = Transaction()
         if self.store.exists(cid, obj):
             tx.remove(cid, obj)
@@ -1748,10 +1820,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         try:
             for oid in self.store.list_objects(cid):
                 if oid.shard <= -2:
-                    continue  # PG metadata (pglog), not user data
+                    continue  # PG metadata (pglog/snapmapper), not user data
                 attrs = self.store.getattrs(cid, oid)
                 v = attrs.get("v", 0)
-                out[(oid.name, oid.shard)] = v
+                # clones ride every (name, shard) subsystem as vnames
+                out[(vname_of(oid), oid.shard)] = v
         except Exception:  # noqa: BLE001 - collection may not exist yet
             pass
         return out
@@ -1881,13 +1954,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
             cid = CollectionId(pgid.pool, pgid.seed)
             push = {}
             for name, v in names.items():
+                obj = to_oid(name)
                 try:
-                    data = self.store.read(cid,
-                                           ObjectId(name)).to_bytes()
-                    attrs = self.store.getattrs(cid, ObjectId(name))
+                    data = self.store.read(cid, obj).to_bytes()
+                    attrs = self.store.getattrs(cid, obj)
                     push[name] = (int(attrs.get("v", v)), data, None,
-                                  self.store.omap_get(cid,
-                                                      ObjectId(name)))
+                                  self.store.omap_get(cid, obj),
+                                  self._push_attrs(attrs))
                 except NoSuchObject:
                     continue
             if push and peer != self.osd_id:
@@ -1909,10 +1982,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
                 continue  # demoted holders only feed pulls, not pushes
             pv = peer_inv.get((name, shard), -1)
             if pv < v:
-                data = self.store.read(cid, ObjectId(name, shard)).to_bytes()
+                obj = to_oid(name, shard)
+                data = self.store.read(cid, obj).to_bytes()
                 push[name] = (v, data, None,
-                              self.store.omap_get(cid,
-                                                  ObjectId(name, shard)))
+                              self.store.omap_get(cid, obj),
+                              self._push_attrs(
+                                  self.store.getattrs(cid, obj)))
         for (name, shard), pv in peer_inv.items():
             if dead.get(name, -1) >= pv:
                 deletes[name] = dead[name]  # peer missed the remove
@@ -1921,7 +1996,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         # locally apply missed removes too
         for (name, shard), v in my_inv.items():
             if dead.get(name, -1) >= v:
-                obj = ObjectId(name, shard)
+                obj = to_oid(name, shard)
                 if self.store.exists(cid, obj):
                     self.store.queue_transaction(
                         Transaction().remove(cid, obj))
@@ -1939,11 +2014,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         cid = CollectionId(m.pgid.pool, m.pgid.seed)
         push = {}
         for name in m.names:
+            obj = to_oid(name)
             try:
-                data = self.store.read(cid, ObjectId(name)).to_bytes()
-                attrs = self.store.getattrs(cid, ObjectId(name))
+                data = self.store.read(cid, obj).to_bytes()
+                attrs = self.store.getattrs(cid, obj)
                 push[name] = (int(attrs.get("v", 0)), data, None,
-                              self.store.omap_get(cid, ObjectId(name)))
+                              self.store.omap_get(cid, obj),
+                              self._push_attrs(attrs))
             except NoSuchObject:
                 continue
         if push:
@@ -2249,13 +2326,25 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
         fan_up = [None if u == peer else u for u in up]
         self._fan_shard_reads(tid, pgid, name, fan_up)
 
+    def _push_attrs(self, attrs: dict) -> dict:
+        """Attrs worth carrying on a recovery push: everything the apply
+        side does not recompute (v/len/d) — SnapSets, whiteouts, user
+        attrs survive recovery this way."""
+        return {k: v for k, v in attrs.items()
+                if k not in ("v", "len", "d")}
+
     def _handle_pg_push(self, conn, m: MPGPush) -> None:
         cid = CollectionId(m.pgid.pool, m.pgid.seed)
         for name, version in m.deletes.items():
             self._record_tombstone(m.pgid, name, version)
+            base, gen = split_vname(name)
             for oid in (list(self.store.list_objects(cid))
                         if cid in self.store.list_collections() else []):
-                if oid.name == name:
+                # a head tombstone must not nuke clones (they die only by
+                # snap trim, under their own vname tombstones)
+                if oid.name == base and (oid.generation == gen
+                                         or (gen < 0
+                                             and oid.generation < 0)):
                     self.store.queue_transaction(
                         Transaction().remove(cid, oid))
         dead = self._tombstones.get(m.pgid, {})
@@ -2270,8 +2359,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
             shard_id = m.shard if m.shard >= 0 else -1
             if not m.force:
                 try:
-                    cur = self.store.getattrs(cid, ObjectId(name,
-                                                            shard=shard_id))
+                    cur = self.store.getattrs(cid, to_oid(name, shard_id))
                     if int(cur.get("v", -1)) >= payload[0]:
                         continue
                 except NoSuchObject:
@@ -2285,8 +2373,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, Dispatcher):
             else:
                 version, data = payload[0], payload[1]
                 omap = payload[3] if len(payload) > 3 else None
-                self._apply_write(m.pgid, name, -1, data,
-                                  {"v": version, "len": len(data)},
+                attrs = {"v": version, "len": len(data)}
+                if len(payload) > 4 and payload[4]:
+                    attrs.update(payload[4])  # ss/wh/user attrs
+                self._apply_write(m.pgid, name, -1, data, attrs,
                                   omap=omap)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0),

@@ -45,7 +45,8 @@ class ObjOpsMixin:
 
     # ---------------------------------------------------------- dispatch
     EXTENDED_OPS = ("omap_get", "omap_set", "omap_rm", "watch",
-                    "unwatch", "notify", "call")
+                    "unwatch", "notify", "call", "list_snaps",
+                    "snap_rollback")
 
     def _handle_extended_op(self, conn, m, pgid: PgId, up: list) -> None:
         pool = self.osdmap.pools[m.pool]
@@ -62,6 +63,8 @@ class ObjOpsMixin:
             "unwatch": self._op_watch,
             "notify": self._op_notify,
             "call": self._op_call,
+            "list_snaps": self._op_list_snaps,
+            "snap_rollback": self._op_snap_rollback,
         }[m.op]
         handler(conn, m, pgid, up)
 

@@ -30,6 +30,7 @@ from ..ops import native
 from ..utils.log import dout
 from .objectstore import (CollectionId, NoSuchCollection, NoSuchObject,
                           ObjectId)
+from .snaps import to_oid, vname_of
 
 
 @dataclass
@@ -55,7 +56,8 @@ class ScrubMixin:
             return out
         for oid in oids:
             if oid.shard <= -2:
-                continue  # PG metadata (pglog), not user data
+                continue  # PG metadata (pglog/snapmapper), not user data
+            key = (vname_of(oid), oid.shard)  # clones scrub as vnames
             try:
                 attrs = self.store.getattrs(cid, oid)
                 entry = {"size": self.store.stat(cid, oid)["size"],
@@ -64,9 +66,9 @@ class ScrubMixin:
                     data = self.store.read(cid, oid).to_bytes()
                     entry["digest"] = native.crc32c(data)
                     entry["stored_digest"] = attrs.get("d")
-                out[(oid.name, oid.shard)] = entry
+                out[key] = entry
             except Exception as e:  # noqa: BLE001 - count unreadable objects
-                out[(oid.name, oid.shard)] = {"error": repr(e)}
+                out[key] = {"error": repr(e)}
         return out
 
     def _handle_scrub_request(self, conn, m: MScrubRequest) -> None:
@@ -237,16 +239,18 @@ class ScrubMixin:
                             MPGPull(ps.pgid, [name], force=True))
                         repaired += 1
                 continue
-            if target == self.osd_id or not self.store.exists(
-                    cid, ObjectId(name)):
+            obj = to_oid(name)
+            if target == self.osd_id or not self.store.exists(cid, obj):
                 continue
-            data = self.store.read(cid, ObjectId(name)).to_bytes()
-            attrs = self.store.getattrs(cid, ObjectId(name))
+            data = self.store.read(cid, obj).to_bytes()
+            attrs = self.store.getattrs(cid, obj)
             v = int(attrs.get("v", 0))
-            omap = self.store.omap_get(cid, ObjectId(name))
+            omap = self.store.omap_get(cid, obj)
             self.messenger.send_message(
                 f"osd.{target}",
-                MPGPush(ps.pgid, -1, {name: (v, data, None, omap)},
+                MPGPush(ps.pgid, -1,
+                        {name: (v, data, None, omap,
+                                self._push_attrs(attrs))},
                         force=True))
             repaired += 1
         return repaired

@@ -1,0 +1,432 @@
+"""RADOS self-managed snapshots: SnapSet clones, SnapMapper, trimming.
+
+The PrimaryLogPG snapshot machinery (ref src/osd/PrimaryLogPG.cc
+make_writeable — clone-on-first-write-after-snap, SnapSet bookkeeping in
+src/osd/osd_types.h, snapid resolution in find_object_context, SnapMapper
+in src/osd/SnapMapper.{h,cc}, trimming in src/osd/PrimaryLogPG.cc
+SnapTrimmer), redesigned for this codebase's single-dispatch daemons:
+
+- the client sends a SnapContext (seq + snap ids) on writes and a snapid
+  on reads (MOSDOp v2 tail);
+- on the first write after a new snap, the primary stages a `clone` op
+  into the SAME store transaction as the write: the head is cloned to
+  ObjectId(name, generation=snapid) (COW is free on BlueStore), the
+  head's "ss" attr (SnapSet: seq, clone list, sizes, overlaps) is
+  updated, and the PG-local SnapMapper object's omap gains a
+  snapid->name row for trim lookup;
+- replicas perform the identical clone via a "_snap" rider on the
+  sub-write's attrs (deterministic: the primary ships the new SnapSet
+  bytes, replicas don't recompute);
+- clones travel recovery, scrub, and the PG log under a VIRTUAL NAME
+  ("name\\0g<gen>"), so every (name, shard)-keyed subsystem handles them
+  unchanged — `to_oid`/`vname` translate at the store boundary;
+- deleting a head that has clones (or a live SnapContext) leaves a
+  WHITEOUT head (attr "wh"=1, size 0) so the SnapSet survives — the
+  reference's snapdir object role; a later write resurrects the head;
+- snap removal is a map change (pool.removed_snaps): each primary trims
+  asynchronously — remove the clone, update the SnapSet, tombstone the
+  virtual name so recovery never resurrects it.
+
+Replicated pools only (the reference gates snaps behind the same op
+breadth; EC-pool snapshot parity needs the EC overwrite log tier).
+"""
+
+from __future__ import annotations
+
+from ..msg.messages import MOSDOpReply, MSubWrite, PgId
+from ..msg.wire import pack_value as _pack, unpack_value as _unpack
+from ..ops.native import crc32c as _crc32c
+from .objectstore import (CollectionId, NoSuchCollection, NoSuchObject,
+                          ObjectId, Transaction)
+from .pglog import LogEntry
+
+ENOENT, EINVAL = -2, -22
+
+_VSEP = "\x00g"
+SNAPMAPPER = "_snapmapper"  # per-PG local metadata object (shard -2)
+
+
+# ----------------------------------------------------- virtual-name algebra
+def vname(name: str, gen: int = -1) -> str:
+    """Flatten (name, generation) into the single string every
+    (name, shard)-keyed subsystem (inventory, pushes, scrub, tombstones,
+    PG log) already carries."""
+    return name if gen < 0 else f"{name}{_VSEP}{gen}"
+
+
+def vname_of(oid: ObjectId) -> str:
+    return vname(oid.name, oid.generation)
+
+
+def split_vname(n: str) -> tuple[str, int]:
+    base, sep, g = n.partition(_VSEP)
+    if not sep:
+        return n, -1
+    try:
+        return base, int(g)
+    except ValueError:
+        return n, -1
+
+
+def to_oid(n: str, shard: int = -1) -> ObjectId:
+    base, gen = split_vname(n)
+    return ObjectId(base, shard=shard, generation=gen)
+
+
+def _sub_intervals(iv: list, off: int, length: int) -> list:
+    """Subtract [off, off+length) from an interval list (clone_overlap
+    maintenance, interval_set::subtract role)."""
+    out = []
+    lo, hi = off, off + length
+    for s, ln in iv:
+        e = s + ln
+        if e <= lo or s >= hi:
+            out.append([s, ln])
+            continue
+        if s < lo:
+            out.append([s, lo - s])
+        if e > hi:
+            out.append([hi, e - hi])
+    return out
+
+
+class SnapMixin:
+    """Mixed into OSDDaemon: clone-on-write, snap reads, trimming."""
+
+    def _init_snaps(self) -> None:
+        # (pool, seed, snapid) this OSD has trimmed AS PRIMARY.  Keyed
+        # per-PG so a failover makes the new primary re-trim (the trim is
+        # idempotent — the SnapMapper omap records what is left to do).
+        self._trimmed_snaps: set[tuple[int, int, int]] = set()
+
+    # ------------------------------------------------------------ SnapSet
+    def _smap_oid(self) -> ObjectId:
+        return ObjectId(SNAPMAPPER, shard=-2)
+
+    def _load_ss(self, cid: CollectionId, name: str) -> dict | None:
+        try:
+            raw = self.store.getattrs(cid, ObjectId(name)).get("ss")
+        except (NoSuchObject, NoSuchCollection):
+            return None
+        return _unpack(raw) if raw else None
+
+    def _head_whiteout(self, cid: CollectionId, name: str) -> bool:
+        try:
+            return bool(self.store.getattrs(cid,
+                                            ObjectId(name)).get("wh"))
+        except (NoSuchObject, NoSuchCollection):
+            return False
+
+    # --------------------------------------------- clone-on-write staging
+    def _snap_prepare(self, pgid: PgId, m) -> tuple[Transaction | None,
+                                                    dict | None]:
+        """Primary, before a head write/remove: stage the make_writeable
+        work.  Returns (pre_tx, rider) — pre_tx prepends to the write's
+        transaction, rider travels to replicas in the sub-write attrs."""
+        if not m.snap_seq or self.osdmap.pools[pgid.pool].kind == "ec":
+            return None, None
+        cid = CollectionId(pgid.pool, pgid.seed)
+        name = m.oid
+        head = ObjectId(name)
+        newest = max(m.snaps) if m.snaps else m.snap_seq
+        if not self.store.exists(cid, head):
+            # creating write under a snapc: record the birth seq so (a)
+            # later writes under the SAME snapc don't spuriously clone
+            # content written after the snap, and (b) reads at snapids
+            # from before the birth answer ENOENT
+            ss = {"seq": max(m.snap_seq, newest), "clones": [],
+                  "sz": {}, "ov": {}, "born": max(m.snap_seq, newest)}
+            ss_b = _pack(ss)
+            tx = Transaction()
+            tx.setattrs(cid, head, {"ss": ss_b})
+            return tx, {"clone": -1, "ss": ss_b, "v": -1}
+        ss = self._load_ss(cid, name) or \
+            {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+        whiteout = self._head_whiteout(cid, name)
+        if whiteout:
+            # resurrection: a new birth epoch — snapids in the dead
+            # window (after the last clone, before now) stay ENOENT
+            ss["born"] = max(ss.get("born", 0), m.snap_seq, newest)
+        need_clone = (m.snap_seq > ss["seq"] and newest not in ss["clones"]
+                      and not whiteout)
+        # overlap shrink applies on EVERY head write once clones exist
+        written: tuple[int, int] | None = None
+        if m.op == "write":
+            written = (m.offset, len(m.data))
+        elif m.op in ("write_full", "remove", "snap_rollback"):
+            try:
+                old_size = self.store.stat(cid, head)["size"]
+            except (NoSuchObject, NoSuchCollection):
+                old_size = 0
+            written = (0, max(old_size, len(getattr(m, "data", b""))))
+        tx = Transaction()
+        cloneid = -1
+        clone_v = -1
+        if need_clone:
+            cloneid = newest
+            clone = ObjectId(name, generation=cloneid)
+            tx.clone(cid, head, clone)
+            size = self.store.stat(cid, head)["size"]
+            ss["clones"] = sorted(set(ss["clones"]) | {cloneid})
+            ss["sz"][cloneid] = size
+            ss["ov"][cloneid] = [[0, size]]
+            clone_v = self._next_version(pgid)
+            self._log_apply(tx, pgid, LogEntry(
+                clone_v, "write", vname(name, cloneid), -1,
+                prev_version=-1))
+            tx.omap_setkeys(cid, self._smap_oid(),
+                            {f"{cloneid:016x}.{name}": b""})
+        ss["seq"] = max(ss["seq"], m.snap_seq, newest)
+        if written and ss["clones"]:
+            top = ss["clones"][-1]
+            ss["ov"][top] = _sub_intervals(
+                ss["ov"].get(top, []), written[0], written[1])
+        ss_b = _pack(ss)
+        tx.setattrs(cid, head, {"ss": ss_b})
+        rider = {"clone": cloneid, "ss": ss_b, "v": clone_v}
+        return tx, rider
+
+    def _snap_apply_rider(self, pgid: PgId, name: str,
+                          rider: dict) -> Transaction:
+        """Replica: rebuild the primary's snap pre-tx deterministically
+        from the rider (ships the final SnapSet bytes)."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        head = ObjectId(name)
+        tx = Transaction()
+        cloneid = int(rider.get("clone", -1))
+        if cloneid >= 0 and self.store.exists(cid, head) and \
+                not self.store.exists(cid, ObjectId(name,
+                                                    generation=cloneid)):
+            tx.clone(cid, head, ObjectId(name, generation=cloneid))
+            self._log_apply(tx, pgid, LogEntry(
+                int(rider.get("v", -1)), "write", vname(name, cloneid),
+                -1, prev_version=-1))
+            tx.omap_setkeys(cid, self._smap_oid(),
+                            {f"{cloneid:016x}.{name}": b""})
+        if self.store.exists(cid, head):
+            tx.setattrs(cid, head, {"ss": bytes(rider["ss"])})
+        return tx
+
+    # ------------------------------------------------------- read resolve
+    def _snap_resolve(self, cid: CollectionId, name: str,
+                      snapid: int) -> ObjectId | None:
+        """find_object_context role: which object serves a read at
+        snapid?  None = ENOENT."""
+        if snapid == 0:
+            if self._head_whiteout(cid, name):
+                return None
+            return ObjectId(name)
+        ss = self._load_ss(cid, name)
+        clones = (ss or {}).get("clones", [])
+        covering = [c for c in clones if c >= snapid]
+        if covering:
+            target = ObjectId(name, generation=min(covering))
+            if self.store.exists(cid, target):
+                return target
+            return None
+        # before the object's birth (created under a later snapc, or
+        # resurrected after a whiteout): it did not exist at that snap
+        if ss and snapid <= ss.get("born", 0):
+            return None
+        # newer than every clone: the head is the living state
+        if self._head_whiteout(cid, name):
+            return None
+        return ObjectId(name)
+
+    # ------------------------------------------------------- extended ops
+    def _op_list_snaps(self, conn, m, pgid: PgId, up: list) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        if not self.store.exists(cid, ObjectId(m.oid)):
+            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
+            return
+        ss = self._load_ss(cid, m.oid) or \
+            {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+        out = dict(ss)
+        out["head"] = not self._head_whiteout(cid, m.oid)
+        conn.send(MOSDOpReply(m.tid, 0, data=_pack(out),
+                              epoch=self.osdmap.epoch))
+
+    def _op_snap_rollback(self, conn, m, pgid: PgId, up: list) -> None:
+        """Roll the head back to its state at snapid (the rados rollback
+        op: PrimaryLogPG _rollback_to)."""
+        key = (pgid, m.oid)
+
+        def thunk(conn=conn, m=m, pgid=pgid, key=key):
+            up2 = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+            self._do_snap_rollback(conn, m, pgid, up2, lock_key=key)
+
+        self._obj_lock(key, thunk)
+
+    def _do_snap_rollback(self, conn, m, pgid: PgId, up: list,
+                          lock_key) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        name = m.oid
+        # rollback is a head WRITE: it goes through make_writeable, so
+        # head state owed to a newer snapshot gets its clone first
+        snap_tx, rider = self._snap_prepare(pgid, m)
+        ss = (_unpack(bytes(rider["ss"])) if rider is not None
+              else self._load_ss(cid, name)) or \
+            {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+        covering = [c for c in ss["clones"] if c >= m.snapid]
+        if not covering:
+            # head already IS the state at snapid (or nothing exists)
+            code = 0 if (self.store.exists(cid, ObjectId(name))
+                         and not self._head_whiteout(cid, name)) else ENOENT
+            conn.send(MOSDOpReply(m.tid, code, epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
+            return
+        cloneid = min(covering)
+        version = self._next_version(pgid)
+        ss_b = _pack(ss)
+        self._apply_snap_rollback(pgid, name, cloneid, ss_b, version,
+                                  pre_tx=snap_tx)
+        peers = [u for u in up if u is not None and u != self.osd_id]
+        if not peers:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
+            return
+        tid = next(self._tids)
+        from .daemon import _PendingWrite
+        pw = _PendingWrite(m.client, m.tid, len(peers), version)
+        pw.lock_key = lock_key
+        self._pending_writes[tid] = pw
+        payload = _pack({"cloneid": cloneid, "ss": ss_b,
+                         "rider": rider})
+        for peer in peers:
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MSubWrite(tid, pgid, name, -1, version, "snap_rollback",
+                          payload))
+
+    def _apply_snap_rollback(self, pgid: PgId, name: str, cloneid: int,
+                             ss_b: bytes, version: int,
+                             pre_tx: Transaction | None = None) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        head, clone = ObjectId(name), ObjectId(name, generation=cloneid)
+        if not self.store.exists(cid, clone):
+            return
+        tx = Transaction()
+        if pre_tx is not None:  # make_writeable clone of the current head
+            tx.append(pre_tx)
+        data = self.store.read(cid, clone).to_bytes()
+        if self.store.exists(cid, head):
+            tx.remove(cid, head)
+        tx.clone(cid, clone, head)
+        # the clone's copied attrs carry a STALE SnapSet and version:
+        # restamp with the live ones (and clear any whiteout)
+        tx.setattrs(cid, head, {"ss": ss_b, "v": version, "wh": 0,
+                                "len": len(data), "d": _crc32c(data)})
+        self._log_apply(tx, pgid, LogEntry(version, "write", name, -1,
+                                           prev_version=-1))
+        self.store.queue_transaction(tx)
+
+    # ----------------------------------------------------------- whiteout
+    def _apply_whiteout(self, pgid: PgId, name: str, version: int,
+                        pre_tx: Transaction | None = None) -> None:
+        """Delete a head that has clones: the object becomes a zero-size
+        whiteout so the SnapSet survives (the snapdir role)."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        head = ObjectId(name)
+        tx = Transaction()
+        if pre_tx is not None:
+            tx.append(pre_tx)
+        if not self.store.exists(cid, head):
+            return
+        tx.truncate(cid, head, 0)
+        tx.setattrs(cid, head, {"wh": 1, "v": version, "len": 0,
+                                "d": _crc32c(b"")})
+        self._log_apply(tx, pgid, LogEntry(version, "write", name, -1,
+                                           prev_version=-1))
+        self.store.queue_transaction(tx)
+
+    # ---------------------------------------------------------- trimming
+    def _snap_trim_check(self) -> None:
+        """After a map update: trim clones of newly removed snaps on
+        every PG this OSD leads (SnapTrimmer role; idempotent)."""
+        if self.osdmap is None:
+            return
+        for pool in list(self.osdmap.pools.values()):
+            for snapid in pool.removed_snaps:
+                self._trim_snap(pool, snapid)
+
+    def _trim_snap(self, pool, snapid: int) -> None:
+        for seed in range(pool.pg_num):
+            up = self.osdmap.pg_to_up_osds(pool.pool_id, seed)
+            if self._primary_of(up) != self.osd_id:
+                continue
+            key = (pool.pool_id, seed, snapid)
+            if key in self._trimmed_snaps:
+                continue
+            self._trimmed_snaps.add(key)
+            pgid = PgId(pool.pool_id, seed)
+            cid = CollectionId(pool.pool_id, seed)
+            try:
+                smap = self.store.omap_get(cid, self._smap_oid())
+            except (NoSuchObject, NoSuchCollection):
+                continue
+            prefix = f"{snapid:016x}."
+            for k in sorted(smap):
+                if not k.startswith(prefix):
+                    continue
+                name = k[len(prefix):]
+                key = (pgid, name)
+
+                def thunk(name=name, pgid=pgid, snapid=snapid, key=key):
+                    try:
+                        self._trim_one(pgid, name, snapid)
+                    finally:
+                        self._obj_unlock(key)
+
+                self._obj_lock(key, thunk)
+
+    def _trim_one(self, pgid: PgId, name: str, snapid: int) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        version = self._next_version(pgid)
+        ss = self._load_ss(cid, name) or \
+            {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+        ss["clones"] = [c for c in ss["clones"] if c != snapid]
+        ss["sz"].pop(snapid, None)
+        ss["ov"].pop(snapid, None)
+        drop_head = (not ss["clones"]
+                     and self._head_whiteout(cid, name))
+        ss_b = _pack(ss)
+        self._apply_trim(pgid, name, snapid, ss_b, drop_head, version)
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        payload = _pack({"snapid": snapid, "ss": ss_b,
+                         "drop_head": drop_head})
+        tid = next(self._tids)
+        for peer in up:
+            if peer is not None and peer != self.osd_id:
+                self.messenger.send_message(
+                    f"osd.{peer}",
+                    MSubWrite(tid, pgid, name, -1, version, "trim_clone",
+                              payload))
+        self.perf.inc("snap_trims")
+
+    def _apply_trim(self, pgid: PgId, name: str, snapid: int, ss_b: bytes,
+                    drop_head: bool, version: int) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        clone = ObjectId(name, generation=snapid)
+        tx = Transaction()
+        if self.store.exists(cid, clone):
+            tx.remove(cid, clone)
+        if self.store.exists(cid, ObjectId(name)):
+            if drop_head:
+                tx.remove(cid, ObjectId(name))
+            else:
+                tx.setattrs(cid, ObjectId(name), {"ss": ss_b})
+        try:
+            if f"{snapid:016x}.{name}" in self.store.omap_get(
+                    cid, self._smap_oid()):
+                tx.omap_rmkeys(cid, self._smap_oid(),
+                               [f"{snapid:016x}.{name}"])
+        except (NoSuchObject, NoSuchCollection):
+            pass
+        self._log_apply(tx, pgid, LogEntry(
+            version, "remove", vname(name, snapid), -1, prev_version=-1))
+        if not tx.empty():
+            self.store.queue_transaction(tx)
+        self._record_tombstone(pgid, vname(name, snapid), version)
+        if drop_head:
+            self._record_tombstone(pgid, name, version)

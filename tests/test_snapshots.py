@@ -1,0 +1,284 @@
+"""RADOS self-managed snapshots: clone-on-write, snap reads, whiteouts,
+rollback, trimming, and clone recovery across OSD death."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.osd.objectstore import CollectionId, ObjectId
+from ceph_tpu.osd.snaps import _sub_intervals, split_vname, to_oid, vname
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(13)
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=4, cfg=make_cfg()).start()
+    yield c
+    c.stop()
+
+
+def test_vname_algebra():
+    assert vname("o", -1) == "o"
+    assert vname("o", 7) == "o\x00g7"
+    assert split_vname("o") == ("o", -1)
+    assert split_vname("o\x00g7") == ("o", 7)
+    oid = to_oid("o\x00g7", shard=-1)
+    assert oid.name == "o" and oid.generation == 7
+    assert to_oid("plain").generation == -1
+
+
+def test_sub_intervals():
+    assert _sub_intervals([[0, 100]], 10, 20) == [[0, 10], [30, 70]]
+    assert _sub_intervals([[0, 10]], 0, 10) == []
+    assert _sub_intervals([[0, 10], [20, 10]], 5, 18) == [[0, 5], [23, 7]]
+
+
+def test_snapshot_read_after_overwrite(cluster):
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    v1 = b"generation-one" * 100
+    v2 = b"generation-TWO" * 120
+    client.write_full("rbd", "obj", v1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "obj", v2)
+
+    assert client.read("rbd", "obj") == v2
+    assert client.read("rbd", "obj", snapid=s1) == v1
+    ss = client.list_snaps("rbd", "obj")
+    assert ss["clones"] == [s1]
+    assert ss["sz"][s1] == len(v1)
+    assert ss["head"] is True
+    # a full overwrite leaves no overlap with the clone
+    assert ss["ov"][s1] == []
+
+
+def test_multiple_snaps_and_partial_overlap(cluster):
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    base = bytearray(b"A" * 10_000)
+    client.write_full("rbd", "obj", bytes(base))
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write("rbd", "obj", b"B" * 100, offset=1000)  # clone @ s1
+    s2 = client.selfmanaged_snap_create("rbd")
+    client.write("rbd", "obj", b"C" * 50, offset=5000)   # clone @ s2
+
+    at_s1 = client.read("rbd", "obj", snapid=s1)
+    assert at_s1 == b"A" * 10_000
+    at_s2 = bytearray(b"A" * 10_000)
+    at_s2[1000:1100] = b"B" * 100
+    assert client.read("rbd", "obj", snapid=s2) == bytes(at_s2)
+    head = bytearray(at_s2)
+    head[5000:5050] = b"C" * 50
+    assert client.read("rbd", "obj") == bytes(head)
+
+    ss = client.list_snaps("rbd", "obj")
+    assert ss["clones"] == [s1, s2]
+    # the s2 clone still overlaps the head everywhere except the C-range
+    assert ss["ov"][s2] == [[0, 5000], [5050, 10_000 - 5050]]
+
+
+def test_remove_with_clones_is_whiteout_and_resurrects(cluster):
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    v1 = b"keep-me" * 300
+    client.write_full("rbd", "obj", v1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.remove("rbd", "obj")
+    # head is logically gone...
+    with pytest.raises(RadosError):
+        client.read("rbd", "obj")
+    with pytest.raises(RadosError):
+        client.stat("rbd", "obj")
+    # ...but the snapshot still reads
+    assert client.read("rbd", "obj", snapid=s1) == v1
+    ss = client.list_snaps("rbd", "obj")
+    assert ss["head"] is False and ss["clones"] == [s1]
+    # a new write resurrects the head
+    client.write_full("rbd", "obj", b"reborn")
+    assert client.read("rbd", "obj") == b"reborn"
+    assert client.read("rbd", "obj", snapid=s1) == v1
+    assert client.list_snaps("rbd", "obj")["head"] is True
+
+
+def test_snap_rollback(cluster):
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    v1 = RNG.integers(0, 256, 7000, dtype=np.uint8).tobytes()
+    client.write_full("rbd", "obj", v1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "obj", b"scribble" * 10)
+    client.snap_rollback("rbd", "obj", s1)
+    assert client.read("rbd", "obj") == v1
+    # the clone survives the rollback
+    assert client.read("rbd", "obj", snapid=s1) == v1
+
+
+def test_snap_remove_trims_clones(cluster):
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    v1 = b"trim-me" * 200
+    client.write_full("rbd", "obj", v1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "obj", b"current")
+    assert client.read("rbd", "obj", snapid=s1) == v1
+    client.selfmanaged_snap_remove("rbd", s1)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.list_snaps("rbd", "obj")["clones"] == []:
+            break
+        time.sleep(0.1)
+    assert client.list_snaps("rbd", "obj")["clones"] == []
+    # the clone object is gone from every store
+    pool_id = client._pool_id("rbd")
+    seed = cluster.mon.osdmap.object_to_pg(pool_id, "obj")
+    cid = CollectionId(pool_id, seed)
+    for osd in cluster.osds.values():
+        assert not osd.store.exists(cid, ObjectId("obj", generation=s1))
+    # head unaffected
+    assert client.read("rbd", "obj") == b"current"
+    # reading the dead snap now falls through to the head (no covering
+    # clone) — matching librados after a snap is deleted
+    assert client.read("rbd", "obj", snapid=s1) == b"current"
+
+
+def test_trim_drops_whiteout_head_when_last_clone_dies(cluster):
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    client.write_full("rbd", "obj", b"x" * 100)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.remove("rbd", "obj")  # whiteout (clone preserved)
+    assert client.read("rbd", "obj", snapid=s1) == b"x" * 100
+    client.selfmanaged_snap_remove("rbd", s1)
+    pool_id = client._pool_id("rbd")
+    seed = cluster.mon.osdmap.object_to_pg(pool_id, "obj")
+    cid = CollectionId(pool_id, seed)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if not any(o.store.exists(cid, ObjectId("obj"))
+                   for o in cluster.osds.values()):
+            break
+        time.sleep(0.1)
+    for osd in cluster.osds.values():
+        assert not osd.store.exists(cid, ObjectId("obj"))
+        assert not osd.store.exists(cid, ObjectId("obj", generation=s1))
+
+
+def test_clones_survive_osd_death_and_recover(cluster):
+    """Clones travel recovery as virtual names: after a replica dies and
+    a spare backfills, the clone exists there too, with the SnapSet."""
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    v1 = RNG.integers(0, 256, 6000, dtype=np.uint8).tobytes()
+    client.write_full("rbd", "obj", v1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "obj", b"head-now" * 50)
+
+    pool_id = client._pool_id("rbd")
+    seed = cluster.mon.osdmap.object_to_pg(pool_id, "obj")
+    up = cluster.mon.osdmap.pg_to_up_osds(pool_id, seed)
+    victim = up[1]
+    cluster.kill_osd(victim)
+    cluster.wait_for_up(3)
+    cluster.settle(1.0)
+    # reads still fine degraded
+    assert client.read("rbd", "obj", snapid=s1) == v1
+    # the spare (the OSD not in the original up set) must have received
+    # the clone through recovery
+    spare = next(o for o in range(4) if o not in up)
+    cid = CollectionId(pool_id, seed)
+    deadline = time.time() + 15
+    clone = ObjectId("obj", generation=s1)
+    while time.time() < deadline:
+        if cluster.osds[spare].store.exists(cid, clone):
+            break
+        time.sleep(0.2)
+    st = cluster.osds[spare].store
+    assert st.exists(cid, clone), "clone did not recover to the spare"
+    assert st.read(cid, clone).to_bytes() == v1
+    attrs = st.getattrs(cid, ObjectId("obj"))
+    assert attrs.get("ss"), "SnapSet attr lost in recovery"
+    assert client.read("rbd", "obj") == b"head-now" * 50
+
+
+def test_rollback_preserves_newer_snapshot(cluster):
+    """Rollback is a head write: state owed to a NEWER snap must be
+    cloned before the head is replaced (make_writeable on rollback)."""
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    v1, v2 = b"one" * 100, b"two" * 150
+    client.write_full("rbd", "obj", v1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "obj", v2)      # clone@s1 = v1
+    s2 = client.selfmanaged_snap_create("rbd")
+    client.snap_rollback("rbd", "obj", s1)   # must clone v2 @ s2 first
+    assert client.read("rbd", "obj") == v1
+    assert client.read("rbd", "obj", snapid=s2) == v2, \
+        "rollback destroyed the s2 snapshot's state"
+    assert client.read("rbd", "obj", snapid=s1) == v1
+
+
+def test_object_created_after_snap_reads_enoent_at_that_snap(cluster):
+    """An object born under a snapc did not exist at earlier snaps: no
+    bogus clone on the next write, ENOENT at the pre-birth snapid."""
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "newborn", b"A" * 50)   # born after s1
+    client.write_full("rbd", "newborn", b"B" * 60)   # same snapc: NO clone
+    ss = client.list_snaps("rbd", "newborn")
+    assert ss["clones"] == [], f"spurious clone: {ss}"
+    with pytest.raises(RadosError):
+        client.read("rbd", "newborn", snapid=s1)
+    assert client.read("rbd", "newborn") == b"B" * 60
+
+
+def test_remove_after_trim_really_deletes(cluster):
+    """Once every clone is trimmed, a remove under a live snapc must be
+    a real delete — not a permanent zero-clone whiteout."""
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=1)
+    client.write_full("rbd", "obj", b"x" * 100)
+    s1 = client.selfmanaged_snap_create("rbd")
+    client.write_full("rbd", "obj", b"y" * 100)      # clone@s1
+    client.selfmanaged_snap_remove("rbd", s1)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.list_snaps("rbd", "obj")["clones"] == []:
+            break
+        time.sleep(0.1)
+    client.remove("rbd", "obj")
+    pool_id = client._pool_id("rbd")
+    seed = cluster.mon.osdmap.object_to_pg(pool_id, "obj")
+    cid = CollectionId(pool_id, seed)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if not any(o.store.exists(cid, ObjectId("obj"))
+                   for o in cluster.osds.values()):
+            break
+        time.sleep(0.1)
+    for osd in cluster.osds.values():
+        assert not osd.store.exists(cid, ObjectId("obj")), \
+            "head lingered as a zero-clone whiteout"
+
+
+def test_no_snapc_pools_unaffected(cluster):
+    """Plain pools (no snap context ever set) keep exact old behavior."""
+    client = cluster.client()
+    client.create_pool("rbd", size=3, pg_num=2)
+    client.write_full("rbd", "o", b"plain")
+    client.write("rbd", "o", b"X", offset=1)
+    assert client.read("rbd", "o") == b"pXain"
+    client.remove("rbd", "o")
+    with pytest.raises(RadosError):
+        client.read("rbd", "o")
+    # fully removed, not whiteout
+    pool_id = client._pool_id("rbd")
+    seed = cluster.mon.osdmap.object_to_pg(pool_id, "o")
+    cid = CollectionId(pool_id, seed)
+    for osd in cluster.osds.values():
+        assert not osd.store.exists(cid, ObjectId("o"))
