@@ -93,8 +93,18 @@ class RadosClient(Dispatcher):
         if isinstance(msg, MMapPush):
             changed = False
             with self._map_cond:
-                m = OSDMap.decode_bytes(msg.map_bytes)
-                if self.osdmap is None or m.epoch > self.osdmap.epoch:
+                from ..mon.maps import apply_map_push
+                m, request = apply_map_push(self.osdmap, msg)
+                if request == "full":
+                    self.messenger.send_message(
+                        self.mon, MMonSubscribe("osdmap"))
+                elif request == "chain":
+                    self.messenger.send_message(
+                        self.mon,
+                        MMonSubscribe("osdmap",
+                                      have_epoch=self.osdmap.epoch))
+                if m is not None and (self.osdmap is None
+                                      or m.epoch > self.osdmap.epoch):
                     self.osdmap = m
                     changed = True
                 self._map_cond.notify_all()

@@ -96,10 +96,106 @@ class OsdInfo(Encodable):
         return dec.versioned(cls.VERSION, body)
 
 
+class OSDMapIncremental(Encodable):
+    """One epoch's worth of map change (OSDMap::Incremental,
+    src/osd/OSDMap.h): changed records only, applied in epoch order."""
+
+    VERSION, COMPAT = 1, 1
+
+    def __init__(self, base_epoch: int = 0, new_epoch: int = 0):
+        self.base_epoch = base_epoch
+        self.new_epoch = new_epoch
+        self.osds: list[OsdInfo] = []
+        self.pools: list[PoolSpec] = []
+        self.removed_pools: list[int] = []
+        self.upmap_set: dict[tuple[int, int], list[int]] = {}
+        self.upmap_rm: list[tuple[int, int]] = []
+        self.pg_temp_set: dict[tuple[int, int], list[int]] = {}
+        self.pg_temp_rm: list[tuple[int, int]] = []
+        self.primary_temp_set: dict[tuple[int, int], int] = {}
+        self.primary_temp_rm: list[tuple[int, int]] = []
+        self.next_pool_id = 1
+
+    def encode(self, enc: Encoder) -> None:
+        def kv_list(e, items, val_enc):
+            e.seq(sorted(items),
+                  lambda ee, kv: (ee.u64(kv[0][0]), ee.u64(kv[0][1]),
+                                  val_enc(ee, kv[1])))
+
+        def key_list(e, keys):
+            e.seq(sorted(keys),
+                  lambda ee, k: (ee.u64(k[0]), ee.u64(k[1])))
+
+        def body(e: Encoder):
+            e.u64(self.base_epoch)
+            e.u64(self.new_epoch)
+            e.seq(self.osds, lambda ee, o: o.encode(ee))
+            e.seq(self.pools, lambda ee, p: p.encode(ee))
+            e.seq(self.removed_pools, Encoder.u64)
+            kv_list(e, self.upmap_set.items(),
+                    lambda ee, v: ee.seq(v, Encoder.i64))
+            key_list(e, self.upmap_rm)
+            kv_list(e, self.pg_temp_set.items(),
+                    lambda ee, v: ee.seq(v, Encoder.i64))
+            key_list(e, self.pg_temp_rm)
+            kv_list(e, self.primary_temp_set.items(),
+                    lambda ee, v: ee.i64(v))
+            key_list(e, self.primary_temp_rm)
+            e.u64(self.next_pool_id)
+        enc.versioned(self.VERSION, self.COMPAT, body)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "OSDMapIncremental":
+        def body(d: Decoder, v: int):
+            inc = cls(d.u64(), d.u64())
+            inc.osds = d.seq(OsdInfo.decode)
+            inc.pools = d.seq(PoolSpec.decode)
+            inc.removed_pools = d.seq(Decoder.u64)
+
+            def kv_item(val_dec):
+                def item(dd: Decoder):
+                    return (dd.u64(), dd.u64()), val_dec(dd)
+                return item
+
+            def key_item(dd: Decoder):
+                return (dd.u64(), dd.u64())
+
+            inc.upmap_set = dict(d.seq(kv_item(
+                lambda dd: dd.seq(Decoder.i64))))
+            inc.upmap_rm = d.seq(key_item)
+            inc.pg_temp_set = dict(d.seq(kv_item(
+                lambda dd: dd.seq(Decoder.i64))))
+            inc.pg_temp_rm = d.seq(key_item)
+            inc.primary_temp_set = dict(d.seq(kv_item(Decoder.i64)))
+            inc.primary_temp_rm = d.seq(key_item)
+            inc.next_pool_id = d.u64()
+            return inc
+        return dec.versioned(cls.VERSION, body)
+
+
+def apply_map_push(current, msg):
+    """Shared receiver state machine for MMapPush (OSDs and clients):
+    returns (newmap | None, request | None) where request asks the
+    caller to re-subscribe — "full" (no map yet) or "chain" (gap:
+    subscribe with have_epoch)."""
+    if msg.map_bytes:
+        return OSDMap.decode_bytes(msg.map_bytes), None
+    if current is None:
+        return None, "full"
+    if current.epoch == msg.base_epoch:
+        inc = OSDMapIncremental.decode_bytes(msg.inc_bytes)
+        m = current.deepcopy()
+        m.apply_incremental(inc)
+        return m, None
+    if msg.epoch > current.epoch:
+        return None, "chain"
+    return None, None  # stale push: nothing to do
+
+
 class OSDMap(Encodable):
     """Epoch-versioned cluster map; placement is a pure function of it."""
 
-    VERSION, COMPAT = 2, 1
+    VERSION, COMPAT = 3, 1
 
     def __init__(self):
         self.epoch = 0
@@ -109,6 +205,13 @@ class OSDMap(Encodable):
         # explicit placement overrides (the pg_upmap/read-balancer
         # machinery, ref OSDMap.cc upmap handling): (pool, seed) -> osds
         self.pg_upmap: dict[tuple[int, int], list[int]] = {}
+        # temporary acting-set overrides during backfill (the pg_temp /
+        # primary_temp machinery, ref OSDMap.h pg_temp): a freshly
+        # promoted-but-behind primary asks the mon to keep the caught-up
+        # members serving until recovery lands (replicated pools; EC
+        # keeps position-stable shards)
+        self.pg_temp: dict[tuple[int, int], list[int]] = {}
+        self.primary_temp: dict[tuple[int, int], int] = {}
 
     # -- mutation (monitor-side; bumps epoch through Monitor) --------------
     def add_osd(self, osd_id: int, host: str, addr: str = "",
@@ -153,12 +256,16 @@ class OSDMap(Encodable):
         key = hash_combine("pg", pool_id, pg_seed)
         return self.placement().select(key, pool.size)
 
-    def pg_to_up_osds(self, pool_id: int, pg_seed: int) -> list[int]:
-        """Up set: raw placement with down devices re-drawn, honoring
-        pg_upmap overrides and primary affinity (the up/acting
-        derivation of OSDMap::_pg_to_up_acting_osds :3143).  For EC
-        pools, positions are shard ids, so a down device leaves a hole
-        (None) rather than shifting shards."""
+    def pg_to_up_osds(self, pool_id: int, pg_seed: int,
+                      ignore_temp: bool = False) -> list[int]:
+        """Acting set: raw placement with down devices re-drawn,
+        honoring pg_temp/primary_temp and pg_upmap overrides and primary
+        affinity (the up/acting derivation of
+        OSDMap::_pg_to_up_acting_osds :3143).  ignore_temp=True yields
+        the UP set — what the map would choose with no temp overrides
+        (needed to decide when a pg_temp can clear).  For EC pools,
+        positions are shard ids, so a down device leaves a hole (None)
+        rather than shifting shards."""
         pool = self.pools[pool_id]
         key = hash_combine("pg", pool_id, pg_seed)
         pm = self.placement()
@@ -167,6 +274,16 @@ class OSDMap(Encodable):
             o = self.osds.get(dev_id)
             return o is None or not o.up
 
+        # pg_temp wins over everything for replicated pools: the acting
+        # set the (behind) primary requested stays in charge until the
+        # mon clears it (OSDMap::_get_temp_osds role)
+        if pool.kind != "ec" and not ignore_temp:
+            temp = self.pg_temp.get((pool_id, pg_seed))
+            if temp:
+                alive = [d for d in temp if not down(d)]
+                if alive:
+                    return self._apply_primary_temp(pool_id, pg_seed,
+                                                    alive)
         override = self.pg_upmap.get((pool_id, pg_seed))
         if override is not None:
             # dead mapped members re-draw from healthy placement (the
@@ -185,7 +302,9 @@ class OSDMap(Encodable):
             filled = [d for d in override if not down(d)]
             while len(filled) < pool.size and spares:
                 filled.append(spares.pop(0))
-            return self._apply_affinity(filled)
+            filled = self._apply_affinity(filled)
+            return filled if ignore_temp else \
+                self._apply_primary_temp(pool_id, pg_seed, filled)
         raw = pm.select(key, pool.size)
         if pool.kind == "ec":
             # keep shard positions stable; holes where devices are down
@@ -198,8 +317,19 @@ class OSDMap(Encodable):
                 else:
                     out.append(spares.pop(0) if spares else None)
             return out
-        return self._apply_affinity(pm.select(key, pool.size,
-                                              reject=down))
+        chosen = self._apply_affinity(pm.select(key, pool.size,
+                                                reject=down))
+        return chosen if ignore_temp else \
+            self._apply_primary_temp(pool_id, pg_seed, chosen)
+
+    def _apply_primary_temp(self, pool_id: int, pg_seed: int,
+                            up: list[int]) -> list[int]:
+        """primary_temp: rotate the designated member to the front
+        (replicated pools; callers for EC never route through here)."""
+        want = self.primary_temp.get((pool_id, pg_seed))
+        if want is not None and want in up and up and up[0] != want:
+            up = [want] + [d for d in up if d != want]
+        return up
 
     def _apply_affinity(self, up: list[int]) -> list[int]:
         """Primary affinity (OSDMap primary-affinity role): rotate the
@@ -217,6 +347,59 @@ class OSDMap(Encodable):
 
     def object_to_pg(self, pool_id: int, name: str) -> int:
         return pg_of_object(name, self.pools[pool_id].pg_num)
+
+    # -- incrementals ------------------------------------------------------
+    def diff_from(self, old: "OSDMap") -> "OSDMapIncremental":
+        """Build the incremental old -> self (OSDMap::Incremental role).
+        Whole changed records travel (OsdInfo/PoolSpec are small); the
+        win is not resending the unchanged bulk of a large map."""
+        inc = OSDMapIncremental(old.epoch, self.epoch)
+        for oid_, info in self.osds.items():
+            if old.osds.get(oid_) != info:
+                inc.osds.append(info)
+        for pid, pool in self.pools.items():
+            if old.pools.get(pid) != pool:
+                inc.pools.append(pool)
+        inc.removed_pools = [p for p in old.pools if p not in self.pools]
+        for k, v in self.pg_upmap.items():
+            if old.pg_upmap.get(k) != v:
+                inc.upmap_set[k] = v
+        inc.upmap_rm = [k for k in old.pg_upmap if k not in self.pg_upmap]
+        for k, v in self.pg_temp.items():
+            if old.pg_temp.get(k) != v:
+                inc.pg_temp_set[k] = v
+        inc.pg_temp_rm = [k for k in old.pg_temp if k not in self.pg_temp]
+        for k, v in self.primary_temp.items():
+            if old.primary_temp.get(k) != v:
+                inc.primary_temp_set[k] = v
+        inc.primary_temp_rm = [k for k in old.primary_temp
+                               if k not in self.primary_temp]
+        inc.next_pool_id = self.next_pool_id
+        return inc
+
+    def apply_incremental(self, inc: "OSDMapIncremental") -> None:
+        """Mutate this map by one incremental; caller must have checked
+        inc.base_epoch == self.epoch."""
+        if inc.base_epoch != self.epoch:
+            raise ValueError(
+                f"inc base {inc.base_epoch} != epoch {self.epoch}")
+        for info in inc.osds:
+            self.osds[info.osd_id] = info
+        for pool in inc.pools:
+            self.pools[pool.pool_id] = pool
+        for pid in inc.removed_pools:
+            self.pools.pop(pid, None)
+        self.pg_upmap.update(inc.upmap_set)
+        for k in inc.upmap_rm:
+            self.pg_upmap.pop(k, None)
+        self.pg_temp.update(inc.pg_temp_set)
+        for k in inc.pg_temp_rm:
+            self.pg_temp.pop(k, None)
+        self.primary_temp.update(inc.primary_temp_set)
+        for k in inc.primary_temp_rm:
+            self.primary_temp.pop(k, None)
+        self.next_pool_id = inc.next_pool_id
+        self.epoch = inc.new_epoch
 
     def up_osds(self) -> list[int]:
         return sorted(o.osd_id for o in self.osds.values() if o.up)
@@ -237,6 +420,13 @@ class OSDMap(Encodable):
             e.seq(sorted(self.pg_upmap.items()),
                   lambda ee, kv: (ee.u64(kv[0][0]), ee.u64(kv[0][1]),
                                   ee.seq(kv[1], Encoder.i64)))
+            # v3 tail: temp acting overrides
+            e.seq(sorted(self.pg_temp.items()),
+                  lambda ee, kv: (ee.u64(kv[0][0]), ee.u64(kv[0][1]),
+                                  ee.seq(kv[1], Encoder.i64)))
+            e.seq(sorted(self.primary_temp.items()),
+                  lambda ee, kv: (ee.u64(kv[0][0]), ee.u64(kv[0][1]),
+                                  ee.i64(kv[1])))
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -255,5 +445,14 @@ class OSDMap(Encodable):
                     return (pool, seed), dd.seq(Decoder.i64)
                 for k, vlist in d.seq(upmap_item):
                     m.pg_upmap[k] = vlist
+            if v >= 3:
+                for k, vlist in d.seq(upmap_item):
+                    m.pg_temp[k] = vlist
+
+                def ptemp_item(dd: Decoder):
+                    pool, seed = dd.u64(), dd.u64()
+                    return (pool, seed), dd.i64()
+                for k, who in d.seq(ptemp_item):
+                    m.primary_temp[k] = who
             return m
         return dec.versioned(cls.VERSION, body)

@@ -37,7 +37,7 @@ from ..msg.messages import (MFailureReport, MMapPush, MMonClaim,
                             MMonForward, MMonFwdReply, MMonPing,
                             MMonPropAck, MMonPropose, MMonSubscribe,
                             MMonSyncEntries, MMonSyncReq, MMonVote,
-                            MOSDBoot, MStatsReport)
+                            MOSDBoot, MOSDPGTemp, MStatsReport)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..msg.wire import decode_frame, encode_frame
 from ..ops import native
@@ -45,7 +45,8 @@ from ..utils.config import Config, default_config
 from ..utils.log import dout
 from .maps import OSDMap, PoolSpec
 
-_FORWARDED = (MOSDBoot, MMonCommand, MFailureReport, MStatsReport)
+_FORWARDED = (MOSDBoot, MMonCommand, MFailureReport, MStatsReport,
+              MOSDPGTemp)
 
 
 class MonStore:
@@ -236,6 +237,11 @@ class MonitorLite(Dispatcher):
         if self.store.kv.get("osdmap"):
             self.osdmap = OSDMap.decode_bytes(self.store.kv["osdmap"])
         self._subscribers: set[str] = set()
+        # incremental distribution: snapshot of the map as of the last
+        # commit (diff base) + a ring of recent incrementals keyed by
+        # their base epoch, for subscriber catch-up chains
+        self._prev_map: OSDMap | None = None
+        self._inc_ring: dict[int, tuple[int, bytes]] = {}
         # failure accounting: target -> reporter -> (first, last) stamps
         self._failure_reports: dict[int, dict[int, tuple[float, float]]] = {}
         self._boot_times: dict[int, float] = {}
@@ -261,6 +267,7 @@ class MonitorLite(Dispatcher):
             MFailureReport: self._handle_failure,
             MMonCommand: self._handle_command,
             MStatsReport: self._handle_stats,
+            MOSDPGTemp: self._handle_pg_temp,
             MMonPing: self._handle_mon_ping,
             MMonElect: self._handle_elect,
             MMonVote: self._handle_vote,
@@ -457,6 +464,10 @@ class MonitorLite(Dispatcher):
         with self._lock:
             if m.term < self._term:
                 return
+            if self._role == "leader" and m.name != self.name:
+                # deposed: incrementals minted under the old term may
+                # describe commits the new leader never saw
+                self._inc_ring.clear()
             self._term = m.term
             self._role = "follower"
             self._leader = m.name
@@ -513,6 +524,11 @@ class MonitorLite(Dispatcher):
         with self._lock:
             if m.snap_kv is not None and \
                     m.snap_version > self.store.version:
+                # adopting someone else's history: any incrementals this
+                # mon minted while (wrongly) leading describe commits
+                # that were rolled back — serving them would diverge a
+                # subscriber's map permanently
+                self._inc_ring.clear()
                 self.store.reset_to(m.snap_version, m.snap_kv)
                 if self.store.kv.get("osdmap"):
                     self.osdmap = OSDMap.decode_bytes(
@@ -521,6 +537,8 @@ class MonitorLite(Dispatcher):
                                     self.store.kv["osdmap"])
                     for sub in list(self._subscribers):
                         self._post(sub, push)
+            if m.snap_kv is not None and self.store.kv.get("osdmap"):
+                self._prev_map = self.osdmap.deepcopy()
             for version, desc, key, value in m.entries:
                 if version != self.store.version + 1:
                     continue
@@ -533,17 +551,37 @@ class MonitorLite(Dispatcher):
         self.store.commit_at(version, key, value, desc)
         if key == "osdmap":
             self.osdmap = OSDMap.decode_bytes(value)
+            # keep the diff base fresh so a promotion to leader can
+            # continue the incremental stream seamlessly
+            self._prev_map = self.osdmap.deepcopy()
             push = MMapPush(self.osdmap.epoch, value)
             for sub in list(self._subscribers):
                 self._post(sub, push)
 
     # ------------------------------------------------------------ map flow
+    INC_RING_KEEP = 128
+
     def _commit_map(self, desc: str) -> None:
+        old = self._prev_map
         self.osdmap.epoch = self.store.version + 1
         raw = self.osdmap.encode_bytes()
         self.store.commit("osdmap", raw, desc)
         dout("mon", 3)("epoch %d: %s", self.osdmap.epoch, desc)
-        push = MMapPush(self.osdmap.epoch, raw)
+        # routine pushes travel as incrementals (full maps only on
+        # boot/subscribe/catch-up gaps); a receiver not at the base
+        # epoch asks back with its have_epoch
+        if old is not None:
+            inc = self.osdmap.diff_from(old)
+            inc_b = inc.encode_bytes()
+            self._inc_ring[old.epoch] = (self.osdmap.epoch, inc_b)
+            if len(self._inc_ring) > self.INC_RING_KEEP:
+                for k in sorted(self._inc_ring)[:-self.INC_RING_KEEP]:
+                    del self._inc_ring[k]
+            push = MMapPush(self.osdmap.epoch, inc_bytes=inc_b,
+                            base_epoch=old.epoch)
+        else:
+            push = MMapPush(self.osdmap.epoch, raw)
+        self._prev_map = self.osdmap.deepcopy()
         for sub in list(self._subscribers):
             self._post(sub, push)
         prop = MMonPropose(self._term, self.store.version, "osdmap", raw,
@@ -573,12 +611,57 @@ class MonitorLite(Dispatcher):
     def _handle_subscribe(self, conn, m: MMonSubscribe) -> None:
         with self._lock:
             self._subscribers.add(conn.peer)
-            # push even an empty epoch-0 map: a daemon whose boot was
-            # dropped during an election sees itself absent and
-            # re-asserts (without this, a cold 3-mon cluster can wedge
-            # with every boot lost and no commit to trigger a push)
+            have = getattr(m, "have_epoch", -1)
+            # catch-up gap: serve the chain of incrementals from the
+            # receiver's epoch if the ring still covers it (OSDMonitor
+            # send_incremental role); otherwise — or for a fresh
+            # subscriber — the full map.  Push even an empty epoch-0 map:
+            # a daemon whose boot was dropped during an election sees
+            # itself absent and re-asserts.
+            if 0 <= have < self.osdmap.epoch:
+                chain = []
+                base = have
+                while base != self.osdmap.epoch:
+                    step = self._inc_ring.get(base)
+                    if step is None:
+                        chain = None
+                        break
+                    new_epoch, inc_b = step
+                    chain.append(MMapPush(new_epoch, inc_bytes=inc_b,
+                                          base_epoch=base))
+                    base = new_epoch
+                if chain is not None:
+                    for push in chain:
+                        conn.send(push)
+                    return
             conn.send(MMapPush(self.osdmap.epoch,
                                self.osdmap.encode_bytes()))
+
+    def _handle_pg_temp(self, conn, m: MOSDPGTemp) -> None:
+        """Commit (or clear) a temporary acting set requested by a
+        backfilling primary (OSDMonitor::preprocess_pgtemp role)."""
+        with self._lock:
+            key = (m.pgid.pool, m.pgid.seed)
+            pool = self.osdmap.pools.get(m.pgid.pool)
+            if pool is None or pool.kind == "ec":
+                # EC placement is position-stable and ignores pg_temp; a
+                # committed entry there could never clear
+                return
+            osds = [int(o) for o in m.osds]
+            if osds:
+                known = [o for o in osds if o in self.osdmap.osds]
+                if known != osds or self.osdmap.pg_temp.get(key) == osds:
+                    return
+                self.osdmap.pg_temp[key] = osds
+                self._commit_map(
+                    f"pg_temp {m.pgid.pool}.{m.pgid.seed:x} -> {osds} "
+                    f"(osd.{m.osd_id})")
+            elif key in self.osdmap.pg_temp:
+                del self.osdmap.pg_temp[key]
+                self.osdmap.primary_temp.pop(key, None)
+                self._commit_map(
+                    f"pg_temp {m.pgid.pool}.{m.pgid.seed:x} cleared "
+                    f"(osd.{m.osd_id})")
 
     # -- failure detection (prepare_failure / check_failure role) ----------
     def _grace_for(self, target: int) -> float:
@@ -664,6 +747,36 @@ class MonitorLite(Dispatcher):
                     return -22, {"error": f"unknown osds {unknown}"}
                 self.osdmap.pg_upmap[(pool_id, seed)] = osds
                 self._commit_map(f"pg-upmap {pool_id}.{seed} -> {osds}")
+            return 0, {}
+        if prefix == "osd pg-temp":
+            pool_id, seed = int(cmd["pool"]), int(cmd["seed"])
+            osds = [int(x) for x in cmd.get("osds", [])]
+            with self._lock:
+                if pool_id not in self.osdmap.pools:
+                    return -2, {"error": f"no pool {pool_id}"}
+                if self.osdmap.pools[pool_id].kind == "ec":
+                    return -22, {"error": "pg-temp: EC placement is "
+                                 "position-stable (no temp overrides)"}
+                key = (pool_id, seed)
+                if osds:
+                    self.osdmap.pg_temp[key] = osds
+                else:
+                    self.osdmap.pg_temp.pop(key, None)
+                    self.osdmap.primary_temp.pop(key, None)
+                self._commit_map(f"pg-temp {pool_id}.{seed:x} {osds}")
+            return 0, {}
+        if prefix == "osd primary-temp":
+            pool_id, seed = int(cmd["pool"]), int(cmd["seed"])
+            with self._lock:
+                if pool_id not in self.osdmap.pools:
+                    return -2, {"error": f"no pool {pool_id}"}
+                key = (pool_id, seed)
+                who = int(cmd.get("id", -1))
+                if who >= 0:
+                    self.osdmap.primary_temp[key] = who
+                else:
+                    self.osdmap.primary_temp.pop(key, None)
+                self._commit_map(f"primary-temp {pool_id}.{seed:x} {who}")
             return 0, {}
         if prefix == "osd rm-pg-upmap":
             pool_id, seed = int(cmd["pool"]), int(cmd["seed"])

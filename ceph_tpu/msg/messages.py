@@ -209,15 +209,33 @@ class MFailureReport:
 # ---------------------------------------------------------------- maps/mon
 @dataclass
 class MMapPush:
-    """Monitor -> subscriber: full map (incrementals are future work)."""
+    """Monitor -> subscriber: a map update.  Routine commits travel as
+    INCREMENTALS (inc_bytes, applied iff the receiver sits at
+    base_epoch); boots, subscriptions, and catch-up gaps get the full
+    map (map_bytes).  Exactly one of the two is populated."""
 
     epoch: int
-    map_bytes: bytes  # encoded OSDMap (travels the versioned codec)
+    map_bytes: bytes = b""   # encoded OSDMap
+    inc_bytes: bytes = b""   # encoded OSDMapIncremental
+    base_epoch: int = -1     # the epoch inc_bytes applies on top of
 
 
 @dataclass
 class MMonSubscribe:
     what: str = "osdmap"
+    # the receiver's current epoch: lets the mon serve the gap as a
+    # chain of incrementals instead of a full map (-1 = send full)
+    have_epoch: int = -1
+
+
+@dataclass
+class MOSDPGTemp:
+    """OSD -> mon: request (or clear) a temporary acting set for one PG
+    while its new primary backfills (MOSDPGTemp role)."""
+
+    osd_id: int
+    pgid: PgId
+    osds: list  # proposed acting set; empty = clear the override
 
 
 @dataclass

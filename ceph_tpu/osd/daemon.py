@@ -40,6 +40,7 @@ from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
                             MNotifyAck, MOSDBoot, MOSDOp, MOSDOpReply,
                             MOSDPing, MOSDPingReply, MPGInfo, MPGPull,
+                            MOSDPGTemp,
                             MPGPush, MPGQuery, MPGRollback, MStatsReport,
                             MSubDelta, MSubPartialWrite, MSubRead,
                             MSubReadReply, MSubWrite, MSubWriteReply,
@@ -197,7 +198,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             "subop_r", "recovery_push", "recovery_delta",
                             "rollbacks", "failure_reports",
                             "scrubs", "scrub_errors", "ec_cache_hit",
-                            "ec_cache_miss"])
+                            "ec_cache_miss", "map_inc", "map_full",
+                            "snap_trims"])
         self.perf.add("op_lat", CounterType.TIME)
         # op scheduler (OpScheduler/mClockScheduler role): the messenger
         # thread classifies+enqueues; ONE dequeue worker executes
@@ -305,12 +307,26 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     # ------------------------------------------------------------- mapping
     def _handle_map(self, conn, msg: MMapPush) -> None:
-        newmap = OSDMap.decode_bytes(msg.map_bytes)
         # ANY push — even a stale/equal epoch answering a beacon
         # re-subscribe — proves the mon link is alive; without this a
         # quiescent cluster's beacons rotate monitors forever
         self._last_map = time.time()
         old = self.osdmap
+        from ..mon.maps import apply_map_push
+        newmap, request = apply_map_push(old, msg)
+        if newmap is None:
+            # inc we cannot use: ask for a full map (no map yet — the
+            # boot race where our own boot-commit's inc arrives first)
+            # or the missing chain (gap)
+            if request == "full":
+                self.messenger.send_message(
+                    self.mon, MMonSubscribe("osdmap"))
+            elif request == "chain":
+                self.messenger.send_message(
+                    self.mon, MMonSubscribe("osdmap",
+                                            have_epoch=old.epoch))
+            return
+        self.perf.inc("map_full" if msg.map_bytes else "map_inc")
         if old is not None and newmap.epoch <= old.epoch:
             return
         self.osdmap = newmap
@@ -1688,6 +1704,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 continue
             self._sweep_pending(now)
             ticks += 1
+            # active pg_temp overrides I lead: keep peering rounds
+            # turning until the real primary verifies in sync and the
+            # override clears (nothing else re-queries once the
+            # recovery pushes have landed)
+            for (pool, seed) in list(self.osdmap.pg_temp):
+                spec = self.osdmap.pools.get(pool)
+                if spec is None or spec.kind == "ec":
+                    continue
+                up_t = self.osdmap.pg_to_up_osds(pool, seed)
+                if self._primary_of(up_t) == self.osd_id:
+                    self._requery_pg(PgId(pool, seed))
             for peer in self.osdmap.up_osds():
                 if peer == self.osd_id:
                     continue
@@ -1887,6 +1914,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if m.lean:
             self._delta_recover(m.pgid, pool, up, m.from_osd,
                                 m.last_complete, dead)
+            if m.last_complete >= self._pglog(m.pgid).last_version():
+                self._maybe_clear_pg_temp(m.pgid, m.from_osd)
         else:
             self._peer_invs.setdefault(m.pgid, {})[m.from_osd] = peer_inv
             if pool.kind == "ec":
@@ -1903,6 +1932,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     f"osd.{m.from_osd}",
                     MPGPush(m.pgid, -2, {}, {},
                             checkpoint=self._pglog(m.pgid).last_version()))
+                self._maybe_clear_pg_temp(m.pgid, m.from_osd)
         if pool.kind == "ec" and (done_peering
                                   or m.pgid not in self._peering):
             # reconcile on completion AND on post-peering updates: a
@@ -1918,6 +1948,19 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 # must never roll back writes committed since collection
                 self._reconcile_ec(m.pgid, pool, up,
                                    lc_authority=done_peering)
+
+    def _maybe_clear_pg_temp(self, pgid: PgId, peer: int) -> None:
+        """If a pg_temp override is active and the REAL primary (the up
+        set's head with no temp applied) just verified in sync, the
+        override has served its purpose — ask the mon to clear it."""
+        key = (pgid.pool, pgid.seed)
+        if not self.osdmap.pg_temp.get(key):
+            return
+        real = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed,
+                                         ignore_temp=True)
+        if peer == self._primary_of(real):
+            self.messenger.send_message(
+                self.mon, MOSDPGTemp(self.osd_id, pgid, []))
 
     def _delta_recover(self, pgid: PgId, pool, up, peer: int,
                        peer_lc: int, dead: dict) -> None:
@@ -2005,9 +2048,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self.messenger.send_message(
                 f"osd.{peer}", MPGPush(pgid, -1, push, deletes))
         if pull:
-            # the primary itself is behind (e.g. revived empty): pull
+            # the primary itself is behind (e.g. revived empty): pull,
+            # and ask the mon to keep the caught-up peer serving in the
+            # meantime (pg_temp — clients follow the acting set)
             self.messenger.send_message(
                 f"osd.{peer}", MPGPull(pgid, pull))
+            if peer_is_member:
+                temp = [peer] + [u for u in up
+                                 if u is not None and u != peer]
+                self.messenger.send_message(
+                    self.mon, MOSDPGTemp(self.osd_id, pgid, temp))
         return len(push) + len(deletes) + len(pull)
 
     def _handle_pg_pull(self, conn, m: MPGPull) -> None:

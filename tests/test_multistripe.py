@@ -125,9 +125,13 @@ def test_multistripe_degraded_read_and_partial(cluster):
 def test_64mib_object_64k_chunks():
     """The judge's size gate: a 64 MiB object with 64 KiB chunks,
     overwritten and read back degraded."""
-    # own cluster with a generous in-flight op expiry: a 64 MiB fan-out
-    # under full-suite CPU contention can straddle the default 5 s sweep
-    c = MiniCluster(n_osds=8, cfg=make_cfg(osd_op_timeout=30.0)).start()
+    # own cluster with a generous in-flight op expiry (a 64 MiB fan-out
+    # under full-suite CPU contention can straddle the default 5 s
+    # sweep) and failure detection off (a stalled dispatch thread must
+    # not get the OSD marked down mid-write — this test is about size,
+    # not fault handling)
+    c = MiniCluster(n_osds=8, cfg=make_cfg(
+        osd_op_timeout=30.0, mon_osd_min_down_reporters=99)).start()
     try:
         _test_64mib_body(c)
     finally:

@@ -81,6 +81,7 @@ def _sample(cls):
         M.MWatchNotify: M.MWatchNotify(9, 2, "obj", "client.1",
                                        b"payload"),
         M.MNotifyAck: M.MNotifyAck(9, "client.2"),
+        M.MOSDPGTemp: M.MOSDPGTemp(2, pg, [3, 0, 1]),
     }
     return samples[cls]
 
