@@ -308,6 +308,20 @@ class MPGPush:
 
 
 @dataclass
+class MRecoveryReserve:
+    """Backfill/recovery reservation handshake (MBackfillReserve /
+    MRecoveryReserve role, src/messages/MBackfillReserve.h): the primary
+    REQUESTs a remote-reserver slot from a recovery target before moving
+    bulk data at it; the target GRANTs when its osd_max_backfills slots
+    allow; the primary RELEASEs when the PG's recovery ops drain."""
+
+    pgid: PgId
+    from_osd: int
+    action: str  # request | grant | release
+    priority: int = 180
+
+
+@dataclass
 class MPGRollback:
     """Primary -> shard holder: your shard applied writes on `oid` past
     the version the stripe can decode at (< k shards committed them) —

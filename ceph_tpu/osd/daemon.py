@@ -41,10 +41,12 @@ from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
                             MNotifyAck, MOSDBoot, MOSDOp, MOSDOpReply,
                             MOSDPing, MOSDPingReply, MPGInfo, MPGPull,
                             MOSDPGTemp,
-                            MPGPush, MPGQuery, MPGRollback, MStatsReport,
+                            MPGPush, MPGQuery, MPGRollback,
+                            MRecoveryReserve, MStatsReport,
                             MSubDelta, MSubPartialWrite, MSubRead,
                             MSubReadReply, MSubWrite, MSubWriteReply,
                             PgId)
+from ..utils.reserver import AsyncReserver
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
@@ -168,6 +170,20 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._obj_locks: dict[tuple, object] = {}
         self._requery_at: dict[tuple, float] = {}
         self._pending_scrubs: dict = {}
+        # recovery reservations + initiation throttle (AsyncReserver /
+        # osd_max_backfills / osd_recovery_max_active roles): bulk
+        # recovery data movement queues behind a per-PG local
+        # reservation, a per-(PG,target) remote grant, and a bounded
+        # in-flight op count with optional sleep pacing
+        self._local_reserver = AsyncReserver(self.cfg["osd_max_backfills"])
+        self._remote_reserver = AsyncReserver(self.cfg["osd_max_backfills"])
+        self._local_waiting: dict[PgId, list] = {}
+        self._remote_waiting: dict[tuple, list] = {}
+        self._remote_held: set = set()
+        self._remote_pending_at: dict[tuple, float] = {}
+        self._recovery_q: collections.deque = collections.deque()
+        self._recovery_inflight = 0
+        self._recovery_pg_ops: dict[PgId, int] = {}
         self.inject = FaultInjection()
         self.op_tracker = OpTracker()
         self._init_objops()
@@ -191,6 +207,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MPGPull: self._handle_pg_pull,
             MPGPush: self._handle_pg_push,
             MPGRollback: self._handle_pg_rollback,
+            MRecoveryReserve: self._handle_recovery_reserve,
             MNotifyAck: self._handle_notify_ack,
         }
         self.perf = global_perf().create(self.name)
@@ -374,10 +391,29 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 MOSDBoot(self.osd_id, self.host, net.addr_of(self.name),
                          hb_addr=net.addr_of(self.hb_messenger.name)))
         self._ensure_collections()
+        self._reservation_map_change(newmap)
         if old is None or newmap.epoch > old.epoch:
             self._start_recovery()
             self._notify_demoted(old)
             self._snap_trim_check()
+
+    def _reservation_map_change(self, newmap: OSDMap) -> None:
+        """A recovery target marked down can never grant: fail its
+        waiting ops open NOW (the sweep's timeout is the slow path for
+        silent deaths the map has not caught yet)."""
+        rescued = []
+        with self._pending_lock:
+            for key in list(self._remote_waiting):
+                _pg, target = key
+                o = newmap.osds.get(target)
+                if o is None or not o.up:
+                    self._remote_pending_at.pop(key, None)
+                    self._remote_held.add(key)
+                    rescued.append((key[0],
+                                    self._remote_waiting.pop(key)))
+        for pgid, thunks in rescued:
+            for t in thunks:
+                self._recovery_enqueue(pgid, t)
 
     def _notify_demoted(self, old: OSDMap | None) -> None:
         """If I hold objects for PGs I am no longer an up member of, tell
@@ -1624,6 +1660,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             from ..msg.wire import unpack_value
             self._apply_cls_effects(m.pgid, m.oid, unpack_value(m.data),
                                     m.version)
+        elif m.op == "multi_effects":
+            from ..msg.wire import unpack_value
+            self._apply_multi_effects(m.pgid, m.oid,
+                                      unpack_value(m.data), m.version,
+                                      pre_tx=pre_tx)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0), m.version)
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
@@ -1765,6 +1806,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         for pr in expired_r:
             self._finish_ec_read(pr)  # decodes if >= k arrived, else err
         self._sweep_notifies(now, max_age)
+        self._sweep_reservations(now)
 
     def _report_stats(self, budget: float = 0.5) -> None:
         """Usage/perf summary to the monitor (MMgrReport/PGStats role).
@@ -1800,6 +1842,160 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _handle_ping_reply(self, conn, m: MOSDPingReply) -> None:
         self._hb_last[m.sender] = time.time()
+
+    # ------------------------------------- recovery reservations/throttle
+    # Bulk recovery data movement (pushes, shard rebuilds, migrations)
+    # funnels through _recovery_op: the op waits for the PG's LOCAL
+    # backfill reservation, then a REMOTE grant from its target OSD,
+    # then an osd_recovery_max_active initiation slot (paced by
+    # osd_recovery_sleep).  Peering/inventory traffic stays immediate —
+    # client IO blocks on it (reference serves peering unthrottled).
+
+    def _recovery_prio(self, pgid: PgId) -> int:
+        # client IO blocked on missing objects = forced-recovery urgency
+        return 255 if self._stale_objects.get(pgid) else 180
+
+    def _recovery_op(self, pgid: PgId, target: int | None, thunk) -> None:
+        prio = self._recovery_prio(pgid)
+        with self._pending_lock:
+            self._recovery_pg_ops[pgid] = \
+                self._recovery_pg_ops.get(pgid, 0) + 1
+            self._local_waiting.setdefault(pgid, []).append(
+                lambda: self._remote_gate(pgid, target, prio, thunk))
+        self._local_reserver.request(
+            pgid, prio, lambda: self._flush_local_waiting(pgid))
+        if self._local_reserver.held(pgid):
+            # request() was a no-op (already held): drain ourselves
+            self._flush_local_waiting(pgid)
+
+    def _flush_local_waiting(self, pgid: PgId) -> None:
+        with self._pending_lock:
+            thunks = self._local_waiting.pop(pgid, [])
+        for t in thunks:
+            t()
+
+    def _remote_gate(self, pgid: PgId, target: int | None, prio: int,
+                     thunk) -> None:
+        if target is None or target == self.osd_id:
+            self._recovery_enqueue(pgid, thunk)
+            return
+        key = (pgid, target)
+        with self._pending_lock:
+            if key in self._remote_held:
+                held, first = True, False
+            else:
+                held = False
+                w = self._remote_waiting.setdefault(key, [])
+                w.append(thunk)
+                first = len(w) == 1
+                if first:
+                    self._remote_pending_at[key] = time.time()
+        if held:
+            self._recovery_enqueue(pgid, thunk)
+        elif first:
+            self.messenger.send_message(
+                f"osd.{target}",
+                MRecoveryReserve(pgid, self.osd_id, "request", prio))
+
+    def _handle_recovery_reserve(self, conn, m: MRecoveryReserve) -> None:
+        key = (m.pgid, m.from_osd)
+        if m.action == "request":
+            self._remote_reserver.request(
+                key, m.priority,
+                lambda: self.messenger.send_message(
+                    f"osd.{m.from_osd}",
+                    MRecoveryReserve(m.pgid, self.osd_id, "grant")))
+        elif m.action == "grant":
+            with self._pending_lock:
+                self._remote_pending_at.pop(key, None)
+                thunks = self._remote_waiting.pop(key, [])
+                # a grant landing after a fail-open timeout drained this
+                # PG's ops must hand the slot straight back, not leak it
+                stale = (not thunks
+                         and m.pgid not in self._recovery_pg_ops)
+                if not stale:
+                    self._remote_held.add(key)
+            if stale:
+                self.messenger.send_message(
+                    f"osd.{m.from_osd}",
+                    MRecoveryReserve(m.pgid, self.osd_id, "release"))
+                return
+            for t in thunks:
+                self._recovery_enqueue(m.pgid, t)
+        elif m.action == "release":
+            self._remote_reserver.release(key)
+
+    def _recovery_enqueue(self, pgid: PgId, thunk) -> None:
+        with self._pending_lock:
+            self._recovery_q.append((pgid, thunk))
+        self._pump_recovery()
+
+    def _pump_recovery(self) -> None:
+        sleep = self.cfg["osd_recovery_sleep"]
+        while True:
+            with self._pending_lock:
+                if (self._recovery_inflight
+                        >= self.cfg["osd_recovery_max_active"]
+                        or not self._recovery_q):
+                    return
+                self._recovery_inflight += 1
+                pgid, thunk = self._recovery_q.popleft()
+            try:
+                thunk()
+            except Exception:  # noqa: BLE001 - one op must not wedge the pump
+                dout("osd", 0)("%s: recovery op failed for %s",
+                               self.name, pgid)
+            finally:
+                with self._pending_lock:
+                    self._recovery_inflight -= 1
+                self._recovery_op_done(pgid)
+            if sleep > 0:
+                t = threading.Timer(sleep, self._pump_recovery)
+                t.daemon = True
+                t.start()
+                return
+
+    def _recovery_op_done(self, pgid: PgId) -> None:
+        release_local = False
+        targets: list[tuple] = []
+        with self._pending_lock:
+            n = self._recovery_pg_ops.get(pgid, 1) - 1
+            if n <= 0:
+                self._recovery_pg_ops.pop(pgid, None)
+                release_local = True
+                targets = [k for k in self._remote_held if k[0] == pgid]
+                for k in targets:
+                    self._remote_held.discard(k)
+            else:
+                self._recovery_pg_ops[pgid] = n
+        if release_local:
+            self._local_reserver.release(pgid)
+            for pg, target in targets:
+                self.messenger.send_message(
+                    f"osd.{target}",
+                    MRecoveryReserve(pg, self.osd_id, "release"))
+
+    def _sweep_reservations(self, now: float) -> None:
+        """Heartbeat-thread GC: fail open on remote grants that never
+        came (target dead/partitioned — recovery must not wedge), and
+        free remote slots whose requesting primary went down."""
+        timeout = self.cfg["osd_recovery_reserve_timeout"]
+        expired = []
+        with self._pending_lock:
+            for key, at in list(self._remote_pending_at.items()):
+                if now - at > timeout:
+                    del self._remote_pending_at[key]
+                    self._remote_held.add(key)
+                    expired.append((key, self._remote_waiting.pop(key, [])))
+        for (pgid, _t), thunks in expired:
+            for t in thunks:
+                self._recovery_enqueue(pgid, t)
+        if self.osdmap is not None:
+            for key in self._remote_reserver.keys():
+                _pg, requester = key
+                o = self.osdmap.osds.get(requester)
+                if o is None or not o.up:
+                    self._remote_reserver.release(key)
 
     # ------------------------------------------------------ peering/recovery
     def _start_recovery(self) -> None:
@@ -1992,24 +2188,30 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 if osd != peer:
                     continue
                 for name, v in names.items():
-                    self._rebuild_shard(pgid, name, shard, peer, v)
-        else:
-            cid = CollectionId(pgid.pool, pgid.seed)
-            push = {}
-            for name, v in names.items():
-                obj = to_oid(name)
-                try:
-                    data = self.store.read(cid, obj).to_bytes()
-                    attrs = self.store.getattrs(cid, obj)
-                    push[name] = (int(attrs.get("v", v)), data, None,
-                                  self.store.omap_get(cid, obj),
-                                  self._push_attrs(attrs))
-                except NoSuchObject:
-                    continue
-            if push and peer != self.osd_id:
-                self.perf.inc("recovery_push", len(push))
-                self.messenger.send_message(
-                    f"osd.{peer}", MPGPush(pgid, -1, push))
+                    self._recovery_op(
+                        pgid, peer,
+                        lambda name=name, shard=shard, v=v:
+                        self._rebuild_shard(pgid, name, shard, peer, v))
+        elif peer != self.osd_id:
+            def push_delta(pgid=pgid, peer=peer, names=dict(names)):
+                cid = CollectionId(pgid.pool, pgid.seed)
+                push = {}
+                for name, v in names.items():
+                    obj = to_oid(name)
+                    try:
+                        data = self.store.read(cid, obj).to_bytes()
+                        attrs = self.store.getattrs(cid, obj)
+                        push[name] = (int(attrs.get("v", v)), data, None,
+                                      self.store.omap_get(cid, obj),
+                                      self._push_attrs(attrs))
+                    except NoSuchObject:
+                        continue
+                if push:
+                    self.perf.inc("recovery_push", len(push))
+                    self.messenger.send_message(
+                        f"osd.{peer}", MPGPush(pgid, -1, push))
+
+            self._recovery_op(pgid, peer, push_delta)
 
     def _recover_replicated(self, pgid, up, peer, peer_inv, my_inv,
                             dead) -> int:
@@ -2017,7 +2219,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return 0
         peer_is_member = peer in [u for u in up if u is not None]
         cid = CollectionId(pgid.pool, pgid.seed)
-        push, pull, deletes = {}, [], {}
+        push, pull, deletes = [], [], {}
         for (name, shard), v in my_inv.items():
             if dead.get(name, -1) >= v:
                 continue  # deleted; never resurrect
@@ -2025,12 +2227,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 continue  # demoted holders only feed pulls, not pushes
             pv = peer_inv.get((name, shard), -1)
             if pv < v:
-                obj = to_oid(name, shard)
-                data = self.store.read(cid, obj).to_bytes()
-                push[name] = (v, data, None,
-                              self.store.omap_get(cid, obj),
-                              self._push_attrs(
-                                  self.store.getattrs(cid, obj)))
+                push.append((name, shard))
         for (name, shard), pv in peer_inv.items():
             if dead.get(name, -1) >= pv:
                 deletes[name] = dead[name]  # peer missed the remove
@@ -2045,14 +2242,38 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         Transaction().remove(cid, obj))
         if push or deletes:
             self.perf.inc("recovery_push", len(push))
-            self.messenger.send_message(
-                f"osd.{peer}", MPGPush(pgid, -1, push, deletes))
+
+            def push_objs(pgid=pgid, peer=peer, push=list(push),
+                          deletes=dict(deletes)):
+                # read at EXECUTION time: the op may queue behind
+                # reservations, and a stale closure would pin memory and
+                # push bytes the receiver's version guard just discards
+                out = {}
+                for name, shard in push:
+                    obj = to_oid(name, shard)
+                    try:
+                        data = self.store.read(cid, obj).to_bytes()
+                        attrs = self.store.getattrs(cid, obj)
+                        out[name] = (int(attrs.get("v", 0)), data, None,
+                                     self.store.omap_get(cid, obj),
+                                     self._push_attrs(attrs))
+                    except NoSuchObject:
+                        continue
+                if out or deletes:
+                    self.messenger.send_message(
+                        f"osd.{peer}", MPGPush(pgid, -1, out, deletes))
+
+            self._recovery_op(pgid, peer, push_objs)
         if pull:
             # the primary itself is behind (e.g. revived empty): pull,
             # and ask the mon to keep the caught-up peer serving in the
-            # meantime (pg_temp — clients follow the acting set)
-            self.messenger.send_message(
-                f"osd.{peer}", MPGPull(pgid, pull))
+            # meantime (pg_temp — clients follow the acting set).
+            # Pulls unblock client IO, so they ride the reservation
+            # queue at forced priority (stale objects exist by now).
+            self._recovery_op(
+                pgid, peer,
+                lambda pull=list(pull): self.messenger.send_message(
+                    f"osd.{peer}", MPGPull(pgid, pull)))
             if peer_is_member:
                 temp = [peer] + [u for u in up
                                  if u is not None and u != peer]
@@ -2116,7 +2337,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 holder = up[shard]
                 if holder is None or holder == peer:
                     continue
-                self._fetch_and_push(pgid, name, shard, peer, holder, v)
+                self._recovery_op(
+                    pgid, holder,
+                    lambda name=name, shard=shard, v=v, holder=holder:
+                    self._fetch_and_push(pgid, name, shard, peer,
+                                         holder, v))
                 scheduled += 1
             return scheduled
         for shard, osd in enumerate(up):
@@ -2124,7 +2349,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 for name, version in names.items():
                     if peer_inv.get((name, shard), -1) >= version:
                         continue  # peer current for its shard
-                    self._rebuild_shard(pgid, name, shard, peer, version)
+                    self._recovery_op(
+                        pgid, peer,
+                        lambda name=name, shard=shard, version=version:
+                        self._rebuild_shard(pgid, name, shard, peer,
+                                            version))
                     scheduled += 1
             elif osd == self.osd_id:
                 # the peer's inventory may reveal objects where MY OWN
@@ -2132,8 +2361,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 for name, version in names.items():
                     if my_inv.get((name, shard), -1) >= version:
                         continue
-                    self._rebuild_shard(pgid, name, shard, self.osd_id,
-                                        version)
+                    self._recovery_op(
+                        pgid, None,
+                        lambda name=name, shard=shard, version=version:
+                        self._rebuild_shard(pgid, name, shard,
+                                            self.osd_id, version))
                     scheduled += 1
         return scheduled
 
@@ -2344,7 +2576,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         if pr.shard_vers.get(s) == vmax}
                 if len(cand) >= codec.k or (shard in cand and not force):
                     chunks = cand
-                    push_version = max(version, vmax)
+                    # stamp what the agreed set actually decodes — NOT
+                    # the requested version: a rebuild scheduled from a
+                    # pre-rollback inventory would otherwise fabricate
+                    # old bytes labelled with the rolled-back version,
+                    # re-tearing the stripe it was meant to heal
+                    push_version = vmax
                 else:
                     self._requery_pg(pgid, force_full=True)
                     return  # no consistent set yet; the requery retries

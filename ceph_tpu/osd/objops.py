@@ -22,6 +22,16 @@ from . import classes as cls_mod
 from .objectstore import CollectionId, NoSuchObject, ObjectId, Transaction
 
 EIO, ENOENT, EINVAL = -5, -2, -22
+EEXIST, ERANGE = -17, -34
+
+# user xattrs live in the object attr dict under this prefix so they can
+# never collide with the internal v/d/len bookkeeping attrs
+_XATTR_PREFIX = "u:"
+
+
+def _user_xattrs(attrs: dict) -> dict:
+    return {k[len(_XATTR_PREFIX):]: bytes(v) for k, v in attrs.items()
+            if isinstance(k, str) and k.startswith(_XATTR_PREFIX)}
 
 
 @dataclass
@@ -46,7 +56,8 @@ class ObjOpsMixin:
     # ---------------------------------------------------------- dispatch
     EXTENDED_OPS = ("omap_get", "omap_set", "omap_rm", "watch",
                     "unwatch", "notify", "call", "list_snaps",
-                    "snap_rollback")
+                    "snap_rollback", "multi_write", "multi_read",
+                    "getxattrs")
 
     def _handle_extended_op(self, conn, m, pgid: PgId, up: list) -> None:
         pool = self.osdmap.pools[m.pool]
@@ -65,6 +76,9 @@ class ObjOpsMixin:
             "call": self._op_call,
             "list_snaps": self._op_list_snaps,
             "snap_rollback": self._op_snap_rollback,
+            "multi_write": self._op_multi_write,
+            "multi_read": self._op_multi_read,
+            "getxattrs": self._op_getxattrs,
         }[m.op]
         handler(conn, m, pgid, up)
 
@@ -275,5 +289,279 @@ class ObjOpsMixin:
         tx.setattrs(cid, obj, {"v": version, "d": _crc32c(data),
                                "len": len(data)})
         self._log_apply(tx, pgid, LogEntry(version, "cls", oid, -1,
+                                           prev_version=-1))
+        self.store.queue_transaction(tx)
+
+    # -------------------------------------------------------- user xattrs
+    def _op_getxattrs(self, conn, m, pgid: PgId, up: list) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        try:
+            attrs = self.store.getattrs(cid, ObjectId(m.oid))
+        except NoSuchObject:
+            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
+            return
+        conn.send(MOSDOpReply(m.tid, 0, data=_pack(_user_xattrs(attrs)),
+                              epoch=self.osdmap.epoch))
+
+    # ------------------------------------------------------- compound ops
+    # The do_osd_ops batching contract (PrimaryLogPG::do_osd_ops executes
+    # the op vector in order inside one transaction; any failing step
+    # unwinds the whole op): guards are checked against a simulated object
+    # state, mutations fold into ONE effects record, and nothing touches
+    # the store until every step has passed.
+
+    def _op_multi_read(self, conn, m, pgid: PgId, up: list) -> None:
+        steps = _unpack(m.data)
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(m.oid)
+        exists = (self.store.exists(cid, obj)
+                  and not self._head_whiteout(cid, m.oid))
+        data = self.store.read(cid, obj).to_bytes() if exists else b""
+        results = []
+        for st in steps:
+            op = st.get("op")
+            if op == "assert_exists":
+                if not exists:
+                    conn.send(MOSDOpReply(m.tid, ENOENT,
+                                          epoch=self.osdmap.epoch))
+                    return
+                results.append(None)
+            elif op == "read":
+                if not exists:
+                    conn.send(MOSDOpReply(m.tid, ENOENT,
+                                          epoch=self.osdmap.epoch))
+                    return
+                off = int(st.get("off", 0))
+                ln = int(st.get("len", 0)) or len(data) - off
+                results.append(data[off:off + max(ln, 0)])
+            elif op == "stat":
+                if not exists:
+                    conn.send(MOSDOpReply(m.tid, ENOENT,
+                                          epoch=self.osdmap.epoch))
+                    return
+                results.append(len(data))
+            elif op == "omap_get":
+                results.append(self.store.omap_get(cid, obj)
+                               if exists else {})
+            elif op == "getxattrs":
+                results.append(_user_xattrs(
+                    self.store.getattrs(cid, obj) if exists else {}))
+            else:
+                conn.send(MOSDOpReply(m.tid, EINVAL,
+                                      epoch=self.osdmap.epoch))
+                return
+        conn.send(MOSDOpReply(m.tid, 0, data=_pack(results),
+                              epoch=self.osdmap.epoch))
+
+    def _op_multi_write(self, conn, m, pgid: PgId, up: list) -> None:
+        key = (pgid, m.oid)
+
+        def thunk(conn=conn, m=m, pgid=pgid, key=key):
+            self._exec_multi_write(conn, m, pgid, key)
+
+        self._obj_lock(key, thunk)
+
+    def _exec_multi_write(self, conn, m, pgid: PgId, key: tuple) -> None:
+        """Runs under the object write lock.  Every reply path must
+        either hand the lock to a _PendingWrite (released on final ack)
+        or release it here."""
+        steps = _unpack(m.data)
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(m.oid)
+        present = self.store.exists(cid, obj)
+        attrs = self.store.getattrs(cid, obj) if present else {}
+        was_whiteout = present and bool(attrs.get("wh"))
+        # a whiteout'd head is logically absent (snapshot tombstone)
+        exists = present and not was_whiteout
+        data = self.store.read(cid, obj).to_bytes() if exists else b""
+        cur_version = int(attrs.get("v", 0))
+
+        def fail(code: int) -> None:
+            conn.send(MOSDOpReply(m.tid, code, epoch=self.osdmap.epoch))
+            self._obj_unlock(key)
+
+        # simulate, folding mutations into the final-state effects record
+        eff = {"remove": False, "create": not exists, "data": None,
+               "set": {}, "rm": [], "xset": {}, "xrm": []}
+        touched = False
+        for st in steps:
+            op = st.get("op")
+            if eff["remove"]:
+                # a final-state effects record cannot express
+                # remove-then-mutate (stale omap would survive on
+                # replicas): remove must be the batch's last step
+                return fail(EINVAL)
+            if op == "assert_exists":
+                if not exists:
+                    return fail(ENOENT)
+            elif op == "assert_version":
+                if cur_version != int(st.get("ver", -1)):
+                    return fail(ERANGE)
+            elif op == "create":
+                if exists and st.get("excl"):
+                    return fail(EEXIST)
+                exists, touched = True, True
+            elif op == "write_full":
+                data = bytes(st["data"])
+                exists = touched = True
+                eff["data"] = data
+            elif op == "write":
+                off = int(st.get("off", 0))
+                buf = bytes(st["data"])
+                if off > len(data):
+                    data = data + b"\x00" * (off - len(data))
+                data = data[:off] + buf + data[off + len(buf):]
+                exists = touched = True
+                eff["data"] = data
+            elif op == "append":
+                data = data + bytes(st["data"])
+                exists = touched = True
+                eff["data"] = data
+            elif op == "truncate":
+                size = int(st.get("size", 0))
+                data = (data[:size] if size <= len(data)
+                        else data + b"\x00" * (size - len(data)))
+                exists = touched = True
+                eff["data"] = data
+            elif op == "zero":
+                off, ln = int(st.get("off", 0)), int(st.get("len", 0))
+                if off < len(data) and ln > 0:
+                    end = min(off + ln, len(data))
+                    data = data[:off] + b"\x00" * (end - off) + data[end:]
+                    eff["data"] = data
+                exists = touched = True
+            elif op == "remove":
+                if not exists:
+                    return fail(ENOENT)
+                exists, touched = False, True
+                data = b""
+                eff.update(remove=True, create=False, data=None,
+                           set={}, rm=[], xset={}, xrm=[])
+            elif op == "setxattr":
+                eff["xset"][str(st["name"])] = bytes(st["value"])
+                exists = touched = True
+            elif op == "rmxattr":
+                name = str(st["name"])
+                eff["xset"].pop(name, None)
+                eff["xrm"].append(name)
+                touched = True
+            elif op == "omap_set":
+                eff["set"].update({str(k): bytes(v)
+                                   for k, v in st["kv"].items()})
+                eff["rm"] = [k for k in eff["rm"] if k not in st["kv"]]
+                exists = touched = True
+            elif op == "omap_rm":
+                for k in st["keys"]:
+                    eff["set"].pop(str(k), None)
+                    eff["rm"].append(str(k))
+                touched = True
+            else:
+                return fail(EINVAL)
+        if eff["remove"]:
+            eff["create"] = False
+        if not touched:  # pure-guard batch: nothing to write or replicate
+            conn.send(MOSDOpReply(m.tid, 0, version=cur_version,
+                                  epoch=self.osdmap.epoch))
+            self._obj_unlock(key)
+            return
+
+        # snapshots: the batch's net effect is one head write — stage
+        # clone-on-write exactly like _rep_write/_rep_remove do
+        # (make_writeable; the rider replicates the staged clone)
+        from types import SimpleNamespace
+        if eff["remove"]:
+            shim_op = "remove"
+        elif eff["data"] is not None:
+            shim_op = "write_full"
+        else:
+            shim_op = "attr"  # omap/xattr only: clone, but no overlap shrink
+        shim = SimpleNamespace(
+            oid=m.oid, op=shim_op, offset=0,
+            data=eff["data"] if eff["data"] is not None else b"",
+            snap_seq=getattr(m, "snap_seq", 0),
+            snaps=list(getattr(m, "snaps", []) or []))
+        snap_tx, rider = self._snap_prepare(pgid, shim)
+        if eff["remove"]:
+            # a head with clones (or one staged this instant) must
+            # whiteout, not vanish — its SnapSet serves snapshot reads
+            ss = self._load_ss(cid, m.oid) or {}
+            if ss.get("clones") or (rider is not None
+                                    and rider.get("clone", -1) >= 0):
+                eff["remove"] = False
+                eff["whiteout"] = True
+        if was_whiteout and not eff["remove"] and not eff.get("whiteout"):
+            eff["clear_wh"] = True  # resurrection clears the tombstone
+
+        version = self._next_version(pgid)
+        self._apply_multi_effects(pgid, m.oid, eff, version,
+                                  pre_tx=snap_tx)
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        peers = [u for u in up if u is not None and u != self.osd_id]
+        if not peers:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            self._obj_unlock(key)
+            return
+        tid = next(self._tids)
+        from .daemon import _PendingWrite
+        pw = _PendingWrite(m.client, m.tid, len(peers), version)
+        pw.lock_key = key
+        self._pending_writes[tid] = pw
+        payload = _pack(eff)
+        sub_attrs = {"_snap": rider} if rider is not None else {}
+        for peer in peers:
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MSubWrite(tid, pgid, m.oid, -1, version, "multi_effects",
+                          payload, attrs=dict(sub_attrs)))
+
+    def _apply_multi_effects(self, pgid: PgId, oid: str, eff: dict,
+                             version: int, pre_tx=None) -> None:
+        """Apply one compound-write effects record in ONE transaction
+        (primary and replicas run the identical code; pre_tx carries the
+        staged clone-on-write from _snap_prepare / the replica rider)."""
+        from .pglog import LogEntry
+        if eff.get("whiteout"):
+            self._apply_whiteout(pgid, oid, version, pre_tx=pre_tx)
+            return
+        if eff.get("remove"):
+            self._apply_remove(pgid, oid, -1, version)
+            return
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = ObjectId(oid)
+        tx = pre_tx if pre_tx is not None else Transaction()
+        exists = self.store.exists(cid, obj)
+        if not exists:
+            tx.touch(cid, obj)
+            if not eff.get("create") and eff.get("data") is None:
+                # replica lagging a previous create: the touch above
+                # materializes it, deltas below still apply cleanly
+                pass
+        if eff.get("data") is not None:
+            tx.truncate(cid, obj, 0)
+            tx.write(cid, obj, 0, bytes(eff["data"]))
+            data = bytes(eff["data"])
+        else:
+            data = self.store.read(cid, obj).to_bytes() if exists else b""
+        if eff.get("set"):
+            tx.omap_setkeys(cid, obj, {str(k): bytes(v)
+                                       for k, v in eff["set"].items()})
+        if eff.get("rm"):
+            have = set(self.store.omap_get(cid, obj)) if exists else set()
+            tx.omap_rmkeys(cid, obj,
+                           [k for k in eff["rm"] if k in have])
+        newattrs = {"v": version, "d": _crc32c(data), "len": len(data)}
+        if eff.get("clear_wh"):
+            newattrs["wh"] = 0
+        for name, value in (eff.get("xset") or {}).items():
+            newattrs[_XATTR_PREFIX + str(name)] = bytes(value)
+        tx.setattrs(cid, obj, newattrs)
+        if eff.get("xrm") and exists:
+            have = self.store.getattrs(cid, obj)
+            for name in eff["xrm"]:
+                k = _XATTR_PREFIX + str(name)
+                if k in have and k not in newattrs:
+                    tx.rmattr(cid, obj, k)
+        self._log_apply(tx, pgid, LogEntry(version, "multi", oid, -1,
                                            prev_version=-1))
         self.store.queue_transaction(tx)

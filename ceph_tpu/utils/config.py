@@ -217,6 +217,21 @@ OPTIONS: list[Option] = [
            "scrub weight", min=0.001),
     Option("osd_mclock_scrub_lim", float, 0.0, OptionLevel.ADVANCED,
            "scrub limit (ops/s; 0 unlimited)", min=0.0),
+    # recovery reservations + throttles (AsyncReserver / osd_max_backfills
+    # / osd_recovery_max_active / osd_recovery_sleep roles)
+    Option("osd_max_backfills", int, 2, OptionLevel.ADVANCED,
+           "max PGs concurrently holding a local (and, per target, "
+           "remote) recovery reservation on this OSD", min=1),
+    Option("osd_recovery_max_active", int, 4, OptionLevel.ADVANCED,
+           "max recovery data-movement ops initiated concurrently",
+           min=1),
+    Option("osd_recovery_sleep", float, 0.0, OptionLevel.ADVANCED,
+           "pause between successive recovery op initiations (seconds; "
+           "0 = none)", min=0.0),
+    Option("osd_recovery_reserve_timeout", float, 10.0,
+           OptionLevel.ADVANCED,
+           "seconds to wait for a remote reservation grant before "
+           "failing open (target presumed dead)", min=0.5),
 ]
 
 

@@ -1,0 +1,217 @@
+"""librados AIO surface + compound ObjectWrite/ReadOperation batches
+(ref src/librados/librados_cxx.cc aio_* / *_op_operate;
+PrimaryLogPG::do_osd_ops executes op vectors atomically)."""
+
+import threading
+
+import pytest
+
+from ceph_tpu.client.operations import (ObjectReadOperation,
+                                        ObjectWriteOperation)
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = MiniCluster(n_osds=4, cfg=make_cfg()).start()
+    client = c.client()
+    client.create_pool("p", size=3, pg_num=4)
+    yield c
+    c.stop()
+
+
+def client_of(cluster):
+    return cluster.clients[0]
+
+
+# ---------------------------------------------------------- compound write
+def test_write_op_atomic_batch(cluster):
+    client = client_of(cluster)
+    op = (ObjectWriteOperation()
+          .create(exclusive=True)
+          .write_full(b"hello world")
+          .setxattr("tag", b"v1")
+          .omap_set({"k1": b"a", "k2": b"b"}))
+    ver = client.operate("p", "batch", op)
+    assert ver > 0
+    assert client.read("p", "batch") == b"hello world"
+    assert client.getxattr("p", "batch", "tag") == b"v1"
+    assert client.omap_get("p", "batch") == {"k1": b"a", "k2": b"b"}
+
+
+def test_write_op_guard_failure_applies_nothing(cluster):
+    client = client_of(cluster)
+    client.write_full("p", "guarded", b"old")
+    op = (ObjectWriteOperation()
+          .write_full(b"clobbered")
+          .create(exclusive=True))      # fails EEXIST AFTER the write step
+    with pytest.raises(RadosError) as ei:
+        client.operate("p", "guarded", op)
+    assert ei.value.code == -17  # EEXIST
+    # the earlier write_full step must NOT have applied
+    assert client.read("p", "guarded") == b"old"
+
+
+def test_write_op_assert_version(cluster):
+    client = client_of(cluster)
+    ver = client.write_full("p", "av", b"x")
+    client.operate("p", "av",
+                   ObjectWriteOperation().assert_version(ver)
+                   .write(b"y", 0))
+    with pytest.raises(RadosError) as ei:
+        client.operate("p", "av",
+                       ObjectWriteOperation().assert_version(ver)
+                       .write_full(b"z"))
+    assert ei.value.code == -34  # ERANGE: version moved on
+    assert client.read("p", "av") == b"y"
+
+
+def test_write_op_append_truncate_zero(cluster):
+    client = client_of(cluster)
+    client.operate("p", "atz",
+                   ObjectWriteOperation().write_full(b"abcdef")
+                   .append(b"ghij").truncate(8).zero(2, 3))
+    assert client.read("p", "atz") == b"ab\x00\x00\x00fgh"
+
+
+def test_write_op_remove_is_terminal(cluster):
+    client = client_of(cluster)
+    client.write_full("p", "rmlast", b"x")
+    with pytest.raises(RadosError) as ei:
+        client.operate("p", "rmlast",
+                       ObjectWriteOperation().remove().write_full(b"y"))
+    assert ei.value.code == -22  # EINVAL
+    assert client.read("p", "rmlast") == b"x"  # nothing applied
+    client.operate("p", "rmlast", ObjectWriteOperation().remove())
+    with pytest.raises(RadosError):
+        client.stat("p", "rmlast")
+
+
+def test_write_op_replicates(cluster):
+    """Compound effects reach replicas: kill the primary, verify from
+    the survivor."""
+    client = client_of(cluster)
+    client.operate("p", "repl",
+                   ObjectWriteOperation().write_full(b"payload")
+                   .setxattr("a", b"1").omap_set({"m": b"2"}))
+    pool_id = client._pool_id("p")
+    seed = cluster.mon.osdmap.object_to_pg(pool_id, "repl")
+    up = cluster.mon.osdmap.pg_to_up_osds(pool_id, seed)
+    cluster.settle(0.3)
+    epoch = cluster.mon.osdmap.epoch
+    cluster.kill_osd(up[0])
+    cluster.wait_for_epoch(epoch + 1)
+    cluster.settle(0.5)
+    assert client.read("p", "repl") == b"payload"
+    assert client.getxattr("p", "repl", "a") == b"1"
+    assert client.omap_get("p", "repl") == {"m": b"2"}
+
+
+# ----------------------------------------------------------- compound read
+def test_read_op_batch(cluster):
+    client = client_of(cluster)
+    client.operate("p", "ro",
+                   ObjectWriteOperation().write_full(b"0123456789")
+                   .setxattr("x", b"y").omap_set({"o": b"m"}))
+    res = client.operate_read(
+        "p", "ro",
+        ObjectReadOperation().stat().read(2, 4).omap_get().getxattrs())
+    assert res == [10, b"2345", {"o": b"m"}, {"x": b"y"}]
+
+
+def test_read_op_missing_object(cluster):
+    client = client_of(cluster)
+    with pytest.raises(RadosError) as ei:
+        client.operate_read("p", "nope",
+                            ObjectReadOperation().assert_exists().read())
+    assert ei.value.code == -2
+
+
+# -------------------------------------------------------------------- xattr
+def test_xattr_single_ops(cluster):
+    client = client_of(cluster)
+    client.write_full("p", "xa", b"d")
+    client.setxattr("p", "xa", "k", b"v")
+    assert client.getxattr("p", "xa", "k") == b"v"
+    assert client.getxattrs("p", "xa") == {"k": b"v"}
+    client.rmxattr("p", "xa", "k")
+    assert client.getxattrs("p", "xa") == {}
+    with pytest.raises(RadosError):
+        client.getxattr("p", "xa", "k")
+
+
+# ------------------------------------------------------ snapshots interop
+def test_write_op_respects_snapshots(cluster):
+    """Compound writes stage clone-on-write like plain writes: snapshot
+    reads survive a post-snap operate()."""
+    client = client_of(cluster)
+    client.write_full("p", "snapobj", b"old-bytes")
+    client.omap_set("p", "snapobj", {"k": b"old"})
+    snapid = client.selfmanaged_snap_create("p")
+    client.operate("p", "snapobj",
+                   ObjectWriteOperation().write_full(b"new-bytes")
+                   .setxattr("t", b"1").omap_set({"k": b"new"}))
+    assert client.read("p", "snapobj") == b"new-bytes"
+    assert client.read("p", "snapobj", snapid=snapid) == b"old-bytes"
+    client.selfmanaged_snap_remove("p", snapid)
+
+
+def test_write_op_remove_whiteouts_under_snaps(cluster):
+    client = client_of(cluster)
+    client.write_full("p", "snaprm", b"keep-me")
+    snapid = client.selfmanaged_snap_create("p")
+    client.operate("p", "snaprm", ObjectWriteOperation().remove())
+    with pytest.raises(RadosError):
+        client.read("p", "snaprm")
+    # the snapshot still serves the pre-remove content
+    assert client.read("p", "snaprm", snapid=snapid) == b"keep-me"
+    # resurrection through a compound create clears the whiteout
+    client.operate("p", "snaprm",
+                   ObjectWriteOperation().write_full(b"back"))
+    assert client.read("p", "snaprm") == b"back"
+    client.selfmanaged_snap_remove("p", snapid)
+
+
+# ---------------------------------------------------------------------- aio
+def test_aio_write_read_roundtrip(cluster):
+    client = client_of(cluster)
+    comps = [client.aio_write_full("p", f"aio-{i}", bytes([i]) * 100)
+             for i in range(16)]
+    client.aio_flush()
+    assert all(c.is_complete() for c in comps)
+    assert all(c.get_return_value() > 0 for c in comps)
+    reads = [client.aio_read("p", f"aio-{i}") for i in range(16)]
+    client.aio_flush()
+    for i, c in enumerate(reads):
+        assert c.get_return_value() == bytes([i]) * 100
+
+
+def test_aio_callback_and_error(cluster):
+    client = client_of(cluster)
+    fired = threading.Event()
+    seen = []
+
+    def cb(comp):
+        seen.append(comp)
+        fired.set()
+
+    comp = client.aio_read("p", "no-such-object", callback=cb)
+    assert fired.wait(10.0)
+    assert seen == [comp]
+    with pytest.raises(RadosError) as ei:
+        comp.get_return_value()
+    assert ei.value.code == -2
+
+
+def test_aio_operate(cluster):
+    client = client_of(cluster)
+    c1 = client.aio_operate(
+        "p", "aop", ObjectWriteOperation().write_full(b"abc")
+        .omap_set({"q": b"r"}))
+    assert c1.wait_for_complete(10.0)
+    c2 = client.aio_operate_read(
+        "p", "aop", ObjectReadOperation().read().omap_get())
+    assert c2.wait_for_complete(10.0)
+    assert c2.get_return_value() == [b"abc", {"q": b"r"}]

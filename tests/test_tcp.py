@@ -82,6 +82,7 @@ def _sample(cls):
                                        b"payload"),
         M.MNotifyAck: M.MNotifyAck(9, "client.2"),
         M.MOSDPGTemp: M.MOSDPGTemp(2, pg, [3, 0, 1]),
+        M.MRecoveryReserve: M.MRecoveryReserve(pg, 4, "request", 255),
     }
     return samples[cls]
 
