@@ -11,10 +11,18 @@ cls_rgw omap on index objects, object data striped over RADOS):
 - objects: PUT /b/k stores the body striped over RADOS objects
   (Striper), GET retrieves (with Range: bytes=a-b support), HEAD
   returns metadata, DELETE removes; ETag is the body's MD5 as S3
-  defines it.
+  defines it;
+- auth: AWS SigV4 header auth when the gateway is given a user
+  registry (rgw_auth_s3.cc role, via services/s3auth.py); anonymous
+  when not;
+- multipart upload (rgw_multi.cc / RGWCompleteMultipart roles):
+  initiate (POST ?uploads), UploadPart (PUT ?partNumber&uploadId),
+  complete (POST ?uploadId, manifest-based — part data stays in its
+  part objects, as RGW's manifest does), abort (DELETE ?uploadId),
+  ListParts, ListMultipartUploads; completed-object reads (incl.
+  Range) stitch across the manifest.
 
-Anonymous access this round (AWS SigV4 is the auth slice's next step);
-multipart upload and versioning are planned.
+Versioning and multisite sync are planned.
 """
 
 from __future__ import annotations
@@ -23,25 +31,34 @@ import hashlib
 import threading
 import time
 import urllib.parse
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from xml.etree import ElementTree
 from xml.sax.saxutils import escape
 
 from ..client.rados import RadosClient, RadosError
 from ..client.striper import FileLayout, StripedObject
 from ..msg.wire import pack_value, unpack_value
+from . import s3auth
 
 _BUCKETS_OID = "rgw_buckets"
 _INDEX_OID = "rgw_index.{bucket}"
 _DATA_PREFIX = "rgw_data.{bucket}.{key}"
+_UPLOADS_OID = "rgw_uploads.{bucket}"
+_PART_PREFIX = "rgw_mp.{bucket}.{upload}.{part:05d}"
 
 
 class RgwGateway:
     """The HTTP frontend + SAL-ish store glue (rgw_process role)."""
 
     def __init__(self, client: RadosClient, pool: str,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 users: dict[str, str] | None = None):
+        """users: access_key -> secret_key registry (RGWUserInfo role);
+        None = anonymous gateway (no auth enforced)."""
         self.client = client
         self.pool = pool
+        self.users = dict(users) if users is not None else None
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -79,20 +96,47 @@ class RgwGateway:
                     if len(parts) > 1 else None
                 return bucket, key, query
 
+            def _qs(self, query: str) -> dict:
+                return {k: v[0] for k, v in
+                        urllib.parse.parse_qs(
+                            query, keep_blank_values=True).items()}
+
+            def _auth(self, body: bytes = b"") -> bool:
+                """SigV4 gate on every verb when a user registry is
+                configured; replies the S3 error shape on failure."""
+                if gw.users is None:
+                    return True
+                path = self.path.split("?", 1)[0]
+                query = self.path.split("?", 1)[1] \
+                    if "?" in self.path else ""
+                try:
+                    s3auth.verify(self.command, path, query,
+                                  {k: v for k, v in self.headers.items()},
+                                  body, gw.users.get)
+                    return True
+                except s3auth.AuthError as e:
+                    self._error(e.http, e.s3code)
+                    return False
+
             # ----------------------------------------------------- verbs
             def do_GET(self):  # noqa: N802
+                if not self._auth():
+                    return
                 bucket, key, query = self._route()
+                qs = self._qs(query)
                 try:
                     if bucket is None:
                         self._send(200, gw.list_buckets_xml())
+                    elif key is None and "uploads" in qs:
+                        self._send(200, gw.list_uploads_xml(bucket))
                     elif key is None:
-                        prefix = ""
-                        for part in query.split("&"):
-                            if part.startswith("prefix="):
-                                prefix = urllib.parse.unquote(
-                                    part[len("prefix="):])
+                        prefix = urllib.parse.unquote(
+                            qs.get("prefix", ""))
                         self._send(200, gw.list_objects_xml(bucket,
                                                             prefix))
+                    elif "uploadId" in qs:
+                        self._send(200, gw.list_parts_xml(
+                            bucket, key, qs["uploadId"]))
                     else:
                         rng = self.headers.get("Range")
                         data, meta, status = gw.get_object(bucket, key,
@@ -103,7 +147,49 @@ class RgwGateway:
                 except KeyError:
                     self._error(404, "NoSuchKey")
 
+            def do_POST(self):  # noqa: N802
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length) if length else b""
+                if not self._auth(body):
+                    return
+                bucket, key, query = self._route()
+                qs = self._qs(query)
+                try:
+                    if key is not None and "uploads" in qs:
+                        upload_id = gw.initiate_multipart(bucket, key)
+                        xml = (f'<?xml version="1.0"?>'
+                               f"<InitiateMultipartUploadResult>"
+                               f"<Bucket>{escape(bucket)}</Bucket>"
+                               f"<Key>{escape(key)}</Key>"
+                               f"<UploadId>{upload_id}</UploadId>"
+                               f"</InitiateMultipartUploadResult>")
+                        self._send(200, xml.encode())
+                    elif key is not None and "uploadId" in qs:
+                        parts = []
+                        root = ElementTree.fromstring(body)
+                        for p in root.iter():
+                            if p.tag.endswith("Part"):
+                                n = int(p.findtext("PartNumber"))
+                                etag = (p.findtext("ETag") or "").strip('"')
+                                parts.append((n, etag))
+                        etag = gw.complete_multipart(
+                            bucket, key, qs["uploadId"], parts)
+                        xml = (f'<?xml version="1.0"?>'
+                               f"<CompleteMultipartUploadResult>"
+                               f"<Key>{escape(key)}</Key>"
+                               f'<ETag>"{etag}"</ETag>'
+                               f"</CompleteMultipartUploadResult>")
+                        self._send(200, xml.encode())
+                    else:
+                        self._error(400, "InvalidRequest")
+                except KeyError:
+                    self._error(404, "NoSuchUpload")
+                except ValueError:
+                    self._error(400, "InvalidPart")
+
             def do_HEAD(self):  # noqa: N802
+                if not self._auth():
+                    return
                 bucket, key, _ = self._route()
                 try:
                     if key is None:
@@ -118,13 +204,20 @@ class RgwGateway:
                     self._error(404, "NoSuchKey")
 
             def do_PUT(self):  # noqa: N802
-                bucket, key, _ = self._route()
+                bucket, key, query = self._route()
+                qs = self._qs(query)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                if not self._auth(body):
+                    return
                 try:
                     if key is None:
                         gw.create_bucket(bucket)
                         self._send(200)
+                    elif "partNumber" in qs and "uploadId" in qs:
+                        etag = gw.put_part(bucket, key, qs["uploadId"],
+                                           int(qs["partNumber"]), body)
+                        self._send(200, headers={"ETag": f'"{etag}"'})
                     else:
                         etag = gw.put_object(bucket, key, body)
                         self._send(200, headers={"ETag": f'"{etag}"'})
@@ -132,9 +225,14 @@ class RgwGateway:
                     self._error(404, "NoSuchBucket")
 
             def do_DELETE(self):  # noqa: N802
-                bucket, key, _ = self._route()
+                if not self._auth():
+                    return
+                bucket, key, query = self._route()
+                qs = self._qs(query)
                 try:
-                    if key is None:
+                    if key is not None and "uploadId" in qs:
+                        gw.abort_multipart(bucket, key, qs["uploadId"])
+                    elif key is None:
                         gw.delete_bucket(bucket)
                     else:
                         gw.delete_object(bucket, key)
@@ -229,14 +327,154 @@ class RgwGateway:
 
     def put_object(self, bucket: str, key: str, body: bytes) -> str:
         self.check_bucket(bucket)
+        self._drop_object_data(bucket, key)  # replace semantics
         so = self._striped(bucket, key)
-        so.remove()  # replace semantics
         if body:
             so.write(0, body)
         etag = hashlib.md5(body).hexdigest()
         self._index_set(bucket, key, {"size": len(body), "etag": etag,
                                       "mtime": time.time()})
         return etag
+
+    def _drop_object_data(self, bucket: str, key: str) -> None:
+        """Remove whatever backs the current head: the plain striped
+        object AND, for a manifest head, its part objects."""
+        meta = self._index(bucket).get(key)
+        if meta and meta.get("parts"):
+            for n, _size in meta["parts"]:
+                self._part_striped(bucket, meta["upload"], n).remove()
+        self._striped(bucket, key).remove()
+
+    # -------------------------------------------------- multipart uploads
+    def _part_striped(self, bucket: str, upload_id: str,
+                      part_no: int) -> StripedObject:
+        return StripedObject(
+            self.client, self.pool,
+            _PART_PREFIX.format(bucket=bucket, upload=upload_id,
+                                part=part_no),
+            FileLayout(stripe_unit=65536, stripe_count=4,
+                       object_size=1 << 22))
+
+    def _uploads_oid(self, bucket: str) -> str:
+        return _UPLOADS_OID.format(bucket=bucket)
+
+    def initiate_multipart(self, bucket: str, key: str) -> str:
+        """POST ?uploads (RGWInitMultipart): mint an upload id; parts
+        accumulate against it until complete/abort."""
+        self.check_bucket(bucket)
+        upload_id = uuid.uuid4().hex
+        self.client.omap_set(self.pool, self._uploads_oid(bucket),
+                             {upload_id: pack_value({"key": key})})
+        return upload_id
+
+    def _upload_session(self, bucket: str, upload_id: str) -> dict:
+        raw = self.client.omap_get(self.pool, self._uploads_oid(bucket))
+        if upload_id not in raw:
+            raise KeyError(upload_id)
+        return {k: unpack_value(v) for k, v in raw.items()
+                if k == upload_id or k.startswith(upload_id + ".")}
+
+    def put_part(self, bucket: str, key: str, upload_id: str,
+                 part_no: int, body: bytes) -> str:
+        """UploadPart: each part is its own striped object and its own
+        omap record — concurrent part uploads never contend."""
+        self._upload_session(bucket, upload_id)  # NoSuchUpload check
+        so = self._part_striped(bucket, upload_id, part_no)
+        so.remove()  # re-upload of a part replaces it
+        if body:
+            so.write(0, body)
+        etag = hashlib.md5(body).hexdigest()
+        self.client.omap_set(
+            self.pool, self._uploads_oid(bucket),
+            {f"{upload_id}.{part_no:05d}":
+             pack_value({"size": len(body), "etag": etag})})
+        return etag
+
+    def complete_multipart(self, bucket: str, key: str, upload_id: str,
+                           parts: list[tuple[int, str]]) -> str:
+        """CompleteMultipartUpload (RGWCompleteMultipart): validate the
+        client's part list against what was stored, then publish a
+        MANIFEST head — part data stays in the part objects, exactly the
+        reference's manifest model (no copy)."""
+        session = self._upload_session(bucket, upload_id)
+        stored = {int(k.rsplit(".", 1)[1]): v
+                  for k, v in session.items() if "." in k}
+        if not parts:
+            raise ValueError("empty part list")
+        manifest, digests, total = [], b"", 0
+        prev_n = 0
+        for n, etag in sorted(parts):
+            if n <= prev_n:  # S3 InvalidPartOrder: strictly ascending
+                raise ValueError(f"duplicate/unordered part {n}")
+            prev_n = n
+            meta = stored.get(n)
+            if meta is None or meta["etag"] != etag:
+                raise ValueError(f"part {n} unknown or etag mismatch")
+            manifest.append([n, meta["size"]])
+            digests += bytes.fromhex(meta["etag"])
+            total += meta["size"]
+        # S3 multipart etag convention: md5 of the part digests, -N
+        etag = f"{hashlib.md5(digests).hexdigest()}-{len(manifest)}"
+        self._drop_object_data(bucket, key)  # replace any old head
+        self._index_set(bucket, key,
+                        {"size": total, "etag": etag,
+                         "mtime": time.time(), "parts": manifest,
+                         "upload": upload_id})
+        # retire the session; uploaded-but-unlisted parts are garbage
+        for n in stored:
+            if n not in {p[0] for p in manifest}:
+                self._part_striped(bucket, upload_id, n).remove()
+        self.client.omap_rm(self.pool, self._uploads_oid(bucket),
+                            [upload_id] + [f"{upload_id}.{n:05d}"
+                                           for n in stored])
+        return etag
+
+    def abort_multipart(self, bucket: str, key: str,
+                        upload_id: str) -> None:
+        session = self._upload_session(bucket, upload_id)
+        for k in session:
+            if "." in k:
+                n = int(k.rsplit(".", 1)[1])
+                self._part_striped(bucket, upload_id, n).remove()
+        self.client.omap_rm(self.pool, self._uploads_oid(bucket),
+                            list(session))
+
+    def list_parts_xml(self, bucket: str, key: str,
+                       upload_id: str) -> bytes:
+        session = self._upload_session(bucket, upload_id)
+        items = []
+        for k in sorted(session):
+            if "." not in k:
+                continue
+            n = int(k.rsplit(".", 1)[1])
+            meta = session[k]
+            items.append(f"<Part><PartNumber>{n}</PartNumber>"
+                         f"<Size>{meta['size']}</Size>"
+                         f"<ETag>&quot;{meta['etag']}&quot;</ETag></Part>")
+        return (f'<?xml version="1.0"?><ListPartsResult>'
+                f"<Key>{escape(key)}</Key>"
+                f"<UploadId>{upload_id}</UploadId>"
+                f"{''.join(items)}</ListPartsResult>").encode()
+
+    def list_uploads_xml(self, bucket: str) -> bytes:
+        self.check_bucket(bucket)
+        try:
+            raw = self.client.omap_get(self.pool,
+                                       self._uploads_oid(bucket))
+        except RadosError:
+            raw = {}
+        items = []
+        for k in sorted(raw):
+            if "." in k:
+                continue
+            sess = unpack_value(raw[k])
+            items.append(f"<Upload><Key>{escape(sess['key'])}</Key>"
+                         f"<UploadId>{k}</UploadId></Upload>")
+        return (f'<?xml version="1.0"?>'
+                f"<ListMultipartUploadsResult>"
+                f"<Bucket>{escape(bucket)}</Bucket>"
+                f"{''.join(items)}"
+                f"</ListMultipartUploadsResult>").encode()
 
     def head_object(self, bucket: str, key: str) -> dict:
         self.check_bucket(bucket)
@@ -245,10 +483,33 @@ class RgwGateway:
             raise KeyError(key)
         return meta
 
+    def _read_extent(self, bucket: str, key: str, meta: dict,
+                     start: int, length: int) -> bytes:
+        """Read [start, start+length) of the head — directly for a plain
+        object, stitched across part objects for a manifest head (the
+        RGWObjManifest iterator role)."""
+        if length <= 0:
+            return b""
+        if not meta.get("parts"):
+            return self._striped(bucket, key).read(start, length)
+        out, pos = [], 0
+        end = start + length
+        for n, size in meta["parts"]:
+            if pos + size <= start:
+                pos += size
+                continue
+            if pos >= end:
+                break
+            lo = max(0, start - pos)
+            hi = min(size, end - pos)
+            out.append(self._part_striped(bucket, meta["upload"], n)
+                       .read(lo, hi - lo))
+            pos += size
+        return b"".join(out)
+
     def get_object(self, bucket: str, key: str,
                    range_header: str | None = None):
         meta = self.head_object(bucket, key)
-        so = self._striped(bucket, key)
         if range_header and range_header.startswith("bytes="):
             spec = range_header[len("bytes="):]
             start_s, _, end_s = spec.partition("-")
@@ -260,11 +521,13 @@ class RgwGateway:
             else:
                 start = int(start_s)
                 end = int(end_s) if end_s else meta["size"] - 1
-            data = so.read(start, max(0, end - start + 1))
+            data = self._read_extent(bucket, key, meta, start,
+                                     max(0, end - start + 1))
             return data, meta, 206
-        return so.read(0, meta["size"]), meta, 200
+        return self._read_extent(bucket, key, meta, 0,
+                                 meta["size"]), meta, 200
 
     def delete_object(self, bucket: str, key: str) -> None:
         self.head_object(bucket, key)
-        self._striped(bucket, key).remove()
+        self._drop_object_data(bucket, key)
         self._index_rm(bucket, key)
