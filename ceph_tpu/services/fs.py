@@ -1,147 +1,236 @@
-"""fs-lite: a POSIX-ish file layer over RADOS.
+"""fs-lite: a POSIX-ish file layer over RADOS, metadata via mds-lite.
 
-The capability slice of CephFS's data path (src/mds metadata +
-src/client Client.cc -> Striper -> RADOS): directories are omap-backed
-metadata objects (the dentry table of a dir frag), file data stripes
-over RADOS objects via the same file_layout_t algebra the reference's
-Striper uses, and path resolution walks the directory chain.
+The capability slice of CephFS (src/mds + src/client/Client.cc):
+metadata ops route through an MdsDaemon whose mutations are MDLog-
+journaled (services/mds.py) — directories are omap-backed dentry
+tables, and an MDS crash/restart replays the journal.  File DATA never
+touches the MDS: it stripes over RADOS objects via the same
+file_layout_t algebra the reference's Striper uses (Client ->
+Objecter -> OSD).
 
-What the reference's MDS adds beyond this slice — distributed cache
-with capabilities (leases), journaling via MDLog, multi-active subtree
-partitioning and rebalancing, snapshots — is the planned widening;
-this layer gives the POSIX surface (mkdir/readdir/create/read/write/
-truncate/unlink/rename/stat) with single-writer semantics.
+Capabilities: `open()` returns an FsFile holding caps from the MDS —
+"r" caches the file content client-side (cached reads, Fc/Fr role),
+"w" buffers writes locally (Fb/Fw role) until flush/close/revoke.  A
+conflicting open on another mount revokes first: the holder flushes
+synchronously, so readers-after-writers always see flushed bytes.
+
+Multi-active subtree partitioning is the next widening; snapshots are
+not implemented.
 """
 
 from __future__ import annotations
 
 import posixpath
+import threading
 import time
 import uuid
 
 from ..client.rados import RadosClient, RadosError
 from ..client.striper import FileLayout, StripedObject
-from ..msg.wire import pack_value, unpack_value
+from .mds import FsError, MdsDaemon, _norm
 
-_DIR_OID = "fs_dir.{path}"
 _DATA_PREFIX = "fs_data.{ino}"
 
-
-class FsError(Exception):
-    def __init__(self, code: int, what: str):
-        super().__init__(what)
-        self.code = code
+__all__ = ["FsClient", "FsError", "FsFile", "MdsDaemon"]
 
 
-def _norm(path: str) -> str:
-    # POSIX quirk: normpath("//x") keeps the double slash; strip leading
-    # slashes before re-rooting
-    return posixpath.normpath("/" + path.strip().lstrip("/"))
+class FsFile:
+    """An open file handle under caps (the Fh + cap-ref role)."""
+
+    def __init__(self, fs: "FsClient", path: str, ent: dict, caps: str):
+        self._fs = fs
+        self.path = path
+        self.ino = ent["ino"]
+        self.caps = caps
+        self._size = ent["size"]
+        self._cache: bytes | None = None       # "r": whole-file cache
+        self._buffered: list[tuple[int, bytes]] = []  # "w": write-back
+        self._lock = threading.RLock()
+        self.cache_reads = 0    # served from cache (observability/tests)
+        self.closed = False
+
+    # ---------------------------------------------------------------- io
+    # Lock order is ALWAYS mds._lock -> handle._lock (both RLocks): the
+    # revoke path (MdsDaemon.open holds mds._lock, calls _on_revoke
+    # which takes h._lock) and the io paths below (which call back into
+    # the MDS) would otherwise ABBA-deadlock across two mounts.
+    def read(self, offset: int = 0, length: int | None = None) -> bytes:
+        with self._fs.mds._lock, self._lock:
+            self._assert_open()
+            if "r" not in self.caps and not self._buffered:
+                # no lease: the size may have moved under us — re-stat
+                # from the MDS (the uncapped read goes to authority)
+                self._size = int(self._fs.mds.lookup(self.path)
+                                 .get("size", 0))
+            if length is None:
+                length = max(0, self._size - offset)
+            length = max(0, min(length, self._size - offset))
+            if self._buffered:
+                # read-your-writes through the buffer: flatten first
+                self._flush_locked()
+            if "r" in self.caps:
+                if self._cache is None:
+                    self._cache = self._fs._data(self.ino).read(
+                        0, self._size)
+                else:
+                    self.cache_reads += 1
+                return self._cache[offset:offset + length]
+            return self._fs._data(self.ino).read(offset, length)
+
+    def write(self, data: bytes, offset: int | None = None) -> None:
+        with self._fs.mds._lock, self._lock:
+            self._assert_open()
+            if "w" not in self.caps:
+                raise FsError(-9, f"{self.path!r} not open for write")
+            if offset is None:
+                offset = self._size
+            self._buffered.append((offset, bytes(data)))
+            self._size = max(self._size, offset + len(data))
+            self._cache = None  # cache no longer covers the new extent
+
+    def flush(self) -> None:
+        with self._fs.mds._lock, self._lock:
+            self._assert_open()
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if not self._buffered:
+            return
+        so = self._fs._data(self.ino)
+        for offset, data in self._buffered:
+            so.write(offset, data)
+        self._buffered.clear()
+        ent = self._fs.mds.lookup(self.path)
+        if self._size != ent.get("size"):
+            ent["size"] = max(int(ent.get("size", 0)), self._size)
+            ent["mtime"] = time.time()
+            self._fs.mds.set_entry(self.path, ent)
+        self._size = ent["size"]
+
+    def close(self) -> None:
+        with self._fs.mds._lock, self._lock:
+            if self.closed:
+                return
+            self._flush_locked()
+            self.closed = True
+        self._fs._close_handle(self)
+
+    def _assert_open(self) -> None:
+        if self.closed:
+            raise FsError(-9, f"{self.path!r} is closed")
+
+    def __enter__(self) -> "FsFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class FsClient:
-    """One mounted filesystem view (libcephfs Client shape)."""
+    """One mounted filesystem view (libcephfs Client shape).  Shares an
+    MdsDaemon with other mounts; creates a private one when none is
+    given (still journaled — single-mount callers get crash safety for
+    free)."""
 
     def __init__(self, client: RadosClient, pool: str,
-                 layout: FileLayout | None = None):
+                 layout: FileLayout | None = None,
+                 mds: MdsDaemon | None = None,
+                 client_id: str | None = None):
         self.client = client
         self.pool = pool
         self.layout = layout or FileLayout(stripe_unit=65536,
                                            stripe_count=4,
                                            object_size=1 << 22)
-        # ensure the root exists
-        try:
-            self.client.omap_get(self.pool, _DIR_OID.format(path="/"))
-        except RadosError:
-            self.client.omap_set(self.pool, _DIR_OID.format(path="/"),
-                                 {})
+        self.mds = mds or MdsDaemon(client, pool)
+        self.client_id = client_id or f"fsclient-{uuid.uuid4().hex[:8]}"
+        self._handles: dict[str, list[FsFile]] = {}
+        self._hlock = threading.Lock()
+        self.mds.register_session(self.client_id, self._on_revoke)
+
+    def unmount(self) -> None:
+        with self._hlock:
+            handles = [h for hs in self._handles.values() for h in hs]
+        for h in handles:
+            h.close()
+        self.mds.unregister_session(self.client_id)
 
     # ------------------------------------------------------------ helpers
-    def _dir_oid(self, path: str) -> str:
-        return _DIR_OID.format(path=_norm(path))
-
-    def _entries(self, dirpath: str) -> dict:
-        try:
-            raw = self.client.omap_get(self.pool, self._dir_oid(dirpath))
-        except RadosError:
-            raise FsError(-2, f"no such directory {dirpath!r}") from None
-        return {k: unpack_value(v) for k, v in raw.items()}
-
-    def _lookup(self, path: str) -> dict:
-        path = _norm(path)
-        if path == "/":
-            return {"type": "dir"}
-        parent, name = posixpath.split(path)
-        ent = self._entries(parent).get(name)
-        if ent is None:
-            raise FsError(-2, f"no such entry {path!r}")
-        return ent
-
-    def _set_entry(self, path: str, ent: dict) -> None:
-        parent, name = posixpath.split(_norm(path))
-        self.client.omap_set(self.pool, self._dir_oid(parent),
-                             {name: pack_value(ent)})
-
-    def _rm_entry(self, path: str) -> None:
-        parent, name = posixpath.split(_norm(path))
-        self.client.omap_rm(self.pool, self._dir_oid(parent), [name])
-
     def _data(self, ino: str) -> StripedObject:
         return StripedObject(self.client, self.pool,
                              _DATA_PREFIX.format(ino=ino), self.layout)
 
+    def _on_revoke(self, path: str) -> None:
+        """MDS cap revoke: flush buffered writes, drop caches (the
+        client-side CHECK_CAPS/flush path)."""
+        with self._hlock:
+            handles = list(self._handles.get(path, []))
+        for h in handles:
+            with h._lock:
+                if not h.closed:
+                    h._flush_locked()
+                h._cache = None
+                h.caps = ""
+
+    def _close_handle(self, h: FsFile) -> None:
+        with self._hlock:
+            hs = self._handles.get(h.path, [])
+            if h in hs:
+                hs.remove(h)
+            if not hs:
+                self._handles.pop(h.path, None)
+        self.mds.release(self.client_id, h.path)
+
+    # ------------------------------------------------------ open/caps API
+    def open(self, path: str, mode: str = "r") -> FsFile:
+        """Open with caps: "r" (cached reads), "w"/"rw" (buffered
+        writes); "w" creates if missing."""
+        path = _norm(path)
+        if "w" in mode:
+            try:
+                self.mds.lookup(path)
+            except FsError:
+                self.create(path)
+        grant = self.mds.open(self.client_id, path, mode)
+        h = FsFile(self, path, grant["ent"], grant["caps"])
+        with self._hlock:
+            self._handles.setdefault(path, []).append(h)
+        return h
+
     # ---------------------------------------------------------- directory
     def mkdir(self, path: str) -> None:
-        path = _norm(path)
-        parent, name = posixpath.split(path)
-        ents = self._entries(parent)  # raises if parent missing
-        if name in ents:
-            raise FsError(-17, f"{path!r} exists")
-        self.client.omap_set(self.pool, self._dir_oid(path), {})
-        self._set_entry(path, {"type": "dir", "mtime": time.time()})
+        self.mds.mkdir(path)
 
     def listdir(self, path: str) -> list[str]:
         self._assert_dir(path)
-        return sorted(self._entries(path))
+        return sorted(self.mds.entries(_norm(path)))
 
     def rmdir(self, path: str) -> None:
-        path = _norm(path)
-        if path == "/":
-            raise FsError(-22, "cannot remove the root")
-        self._assert_dir(path)
-        if self._entries(path):
-            raise FsError(-39, f"{path!r} not empty")
-        self.client.remove(self.pool, self._dir_oid(path))
-        self._rm_entry(path)
+        self.mds.rmdir(path)
 
     def _assert_dir(self, path: str) -> None:
-        ent = self._lookup(path)
+        ent = self.mds.lookup(path)
         if ent["type"] != "dir":
             raise FsError(-20, f"{path!r} is not a directory")
 
     # --------------------------------------------------------------- files
     def create(self, path: str) -> None:
-        path = _norm(path)
-        parent, name = posixpath.split(path)
-        ents = self._entries(parent)
-        if name in ents:
-            raise FsError(-17, f"{path!r} exists")
-        self._set_entry(path, {"type": "file", "size": 0,
-                               "ino": uuid.uuid4().hex,
-                               "mtime": time.time()})
+        self.mds.create(path)
 
     def write_file(self, path: str, data: bytes, offset: int = 0) -> None:
-        ent = self._lookup(path)
+        ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory")
+        # cap-less mutation: revoke holders first (their caches/buffers
+        # must not survive a write they never saw)
+        self.mds.invalidate(path)
         self._data(ent["ino"]).write(offset, data)
         ent["size"] = max(ent["size"], offset + len(data))
         ent["mtime"] = time.time()
-        self._set_entry(path, ent)
+        self.mds.set_entry(path, ent)
 
     def read_file(self, path: str, offset: int = 0,
                   length: int | None = None) -> bytes:
-        ent = self._lookup(path)
+        ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory")
         if length is None:
@@ -150,9 +239,11 @@ class FsClient:
         return self._data(ent["ino"]).read(offset, length)
 
     def truncate(self, path: str, size: int) -> None:
-        ent = self._lookup(path)
+        ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory")
+        self.mds.invalidate(path)
+        ent = self.mds.lookup(path)  # revokes may have flushed size
         if size > ent["size"]:
             self._data(ent["ino"]).write(
                 ent["size"], b"\0" * (size - ent["size"]))
@@ -163,44 +254,22 @@ class FsClient:
                 size, b"\0" * (ent["size"] - size))
         ent["size"] = size
         ent["mtime"] = time.time()
-        self._set_entry(path, ent)
+        self.mds.set_entry(path, ent)
 
     def unlink(self, path: str) -> None:
-        ent = self._lookup(path)
+        ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory (use rmdir)")
+        self.mds.invalidate(path)
         self._data(ent["ino"]).remove()
-        self._rm_entry(path)
+        self.mds.rm_entry(path)
 
     def stat(self, path: str) -> dict:
-        ent = dict(self._lookup(path))
+        ent = dict(self.mds.lookup(path))
         ent.setdefault("size", 0)
         return ent
 
     def rename(self, src: str, dst: str) -> None:
-        """Same-type rename; directories move their SUBTREE by renaming
-        the dir object path keys (the subtree-migration slice of the
-        MDS, minus the distributed locking)."""
-        src, dst = _norm(src), _norm(dst)
-        if dst == src or dst.startswith(src + "/"):
-            raise FsError(-22,
-                          f"cannot move {src!r} into itself ({dst!r})")
-        ent = self._lookup(src)
-        parent, name = posixpath.split(dst)
-        dents = self._entries(parent)
-        if name in dents:
-            raise FsError(-17, f"{dst!r} exists")
-        if ent["type"] == "dir":
-            self._rename_dir_tree(src, dst)
-        self._set_entry(dst, ent)
-        self._rm_entry(src)
-
-    def _rename_dir_tree(self, src: str, dst: str) -> None:
-        ents = self._entries(src)
-        self.client.omap_set(self.pool, self._dir_oid(dst),
-                             {k: pack_value(v) for k, v in ents.items()})
-        for name, ent in ents.items():
-            if ent["type"] == "dir":
-                self._rename_dir_tree(posixpath.join(src, name),
-                                      posixpath.join(dst, name))
-        self.client.remove(self.pool, self._dir_oid(src))
+        """Same-type rename; directory renames move the SUBTREE (the
+        single-rank slice of the MDS rename machinery)."""
+        self.mds.rename(src, dst)

@@ -1,0 +1,356 @@
+"""mds-lite: journaled metadata server with capability leases.
+
+The capability slice of the reference MDS core (src/mds/):
+
+- **MDLog / EMetaBlob** (MDLog.cc, journal/EMetaBlob.cc): every
+  metadata mutation is appended to a durable RADOS-backed journal
+  BEFORE it touches the dentry tables; a crash between journal append
+  and apply replays on the next start (Journaler replay role), so the
+  namespace can never lose an acked mutation.  Applied entries are
+  expired and trimmed in segments (LogSegment trim role).
+- **Capabilities** (Capability.h, Locker.cc): a client opens a file and
+  is granted caps — "r" lets it cache reads, "w" lets it buffer
+  writes.  Conflicting opens REVOKE outstanding caps first: the holder
+  flushes its buffered state synchronously before the new grant is
+  issued (the cap revoke round-trip).  Grants carry a lease TTL; an
+  expired lease is reclaimable without a round-trip (session-death
+  safety).
+
+Single-active rank here; multi-active subtree partitioning builds on
+this in services/fs.py's widening.  The daemon is an in-process object
+shared by FsClient mounts (the fs.py data path stays client->RADOS,
+exactly the reference's split: metadata through the MDS, file bytes
+never touch it).
+"""
+
+from __future__ import annotations
+
+import posixpath
+import threading
+import time
+import uuid
+
+from ..client.rados import RadosClient, RadosError
+from ..msg.wire import pack_value, unpack_value
+
+_DIR_OID = "fs_dir.{path}"
+_JOURNAL_OID = "mds_journal.{rank}"
+_APPLIED_KEY = "_applied"          # journal omap: high-water of applied seqs
+_TRIM_EVERY = 64                   # expire applied entries in batches
+
+
+class FsError(Exception):
+    def __init__(self, code: int, what: str):
+        super().__init__(what)
+        self.code = code
+
+
+def _norm(path: str) -> str:
+    return posixpath.normpath("/" + path.strip().lstrip("/"))
+
+
+class _Session:
+    def __init__(self, client_id: str, revoke_cb):
+        self.client_id = client_id
+        self.revoke_cb = revoke_cb   # revoke_cb(path) -> None (flush+drop)
+
+
+class MdsDaemon:
+    LEASE_TTL = 30.0  # seconds; mirrors mds_session_cap lease behavior
+
+    def __init__(self, client: RadosClient, pool: str, rank: int = 0):
+        self.client = client
+        self.pool = pool
+        self.rank = rank
+        self._lock = threading.RLock()
+        self._sessions: dict[str, _Session] = {}
+        # path -> {client_id: (caps "r"/"rw", expires_at)}
+        self._caps: dict[str, dict[str, tuple[str, float]]] = {}
+        self._journal_oid = _JOURNAL_OID.format(rank=rank)
+        self._seq = 0
+        self._applied = 0
+        self._ensure_root()
+        self.replay()
+
+    # ------------------------------------------------------------- journal
+    def _ensure_root(self) -> None:
+        try:
+            self.client.omap_get(self.pool, _DIR_OID.format(path="/"))
+        except RadosError:
+            self.client.omap_set(self.pool, _DIR_OID.format(path="/"), {})
+
+    def _journal_entries(self) -> dict:
+        try:
+            return self.client.omap_get(self.pool, self._journal_oid)
+        except RadosError:
+            return {}
+
+    def replay(self) -> int:
+        """Re-apply journal entries past the applied high-water (MDLog
+        replay on MDS start).  Apply is idempotent, so a crash anywhere
+        in journal->apply->mark is safe.  Returns entries replayed."""
+        raw = self._journal_entries()
+        self._applied = int(raw.get(_APPLIED_KEY, b"0") or 0)
+        seqs = sorted(int(k, 16) for k in raw if k != _APPLIED_KEY)
+        self._seq = max(seqs) if seqs else self._applied
+        replayed = 0
+        for seq in seqs:
+            if seq <= self._applied:
+                continue
+            self._apply(unpack_value(raw[f"{seq:016x}"]))
+            self._mark_applied(seq)
+            replayed += 1
+        return replayed
+
+    def submit(self, op: dict) -> None:
+        """Journal, then apply, then advance the applied mark — the
+        EMetaBlob submit_entry/flush contract (durability before ack)."""
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            self.client.omap_set(self.pool, self._journal_oid,
+                                 {f"{seq:016x}": pack_value(op)})
+            self._apply(op)
+            self._mark_applied(seq)
+
+    def _mark_applied(self, seq: int) -> None:
+        self._applied = max(self._applied, seq)
+        self.client.omap_set(self.pool, self._journal_oid,
+                             {_APPLIED_KEY: str(self._applied).encode()})
+        if seq % _TRIM_EVERY == 0:
+            self._trim()
+
+    def _trim(self) -> None:
+        """Expire applied entries (LogSegment trim): the journal stays
+        bounded; only the unapplied tail matters for recovery."""
+        raw = self._journal_entries()
+        dead = [k for k in raw
+                if k != _APPLIED_KEY and int(k, 16) <= self._applied]
+        if dead:
+            self.client.omap_rm(self.pool, self._journal_oid, dead)
+
+    # -------------------------------------------------- dentry-table apply
+    # All mutations are expressed as idempotent journal ops: re-applying
+    # any prefix/suffix after a crash converges to the same state.
+    def _dir_oid(self, path: str) -> str:
+        return _DIR_OID.format(path=_norm(path))
+
+    def _raw_entries(self, dirpath: str) -> dict | None:
+        try:
+            return self.client.omap_get(self.pool, self._dir_oid(dirpath))
+        except RadosError:
+            return None
+
+    def _apply(self, op: dict) -> None:
+        kind = op["op"]
+        if kind == "mkdir":
+            path = op["path"]
+            self.client.omap_set(self.pool, self._dir_oid(path), {})
+            self._apply_set_entry(path, op["ent"])
+        elif kind == "set_entry":
+            self._apply_set_entry(op["path"], op["ent"])
+        elif kind == "rm_entry":
+            parent, name = posixpath.split(_norm(op["path"]))
+            self.client.omap_rm(self.pool, self._dir_oid(parent), [name])
+        elif kind == "rmdir":
+            path = op["path"]
+            try:
+                self.client.remove(self.pool, self._dir_oid(path))
+            except RadosError:
+                pass  # replay after the remove landed
+            parent, name = posixpath.split(_norm(path))
+            self.client.omap_rm(self.pool, self._dir_oid(parent), [name])
+        elif kind == "rename":
+            self._apply_rename(op["src"], op["dst"], op["ent"])
+        else:  # pragma: no cover - forward-compat guard
+            raise FsError(-22, f"unknown journal op {kind!r}")
+
+    def _apply_set_entry(self, path: str, ent: dict) -> None:
+        parent, name = posixpath.split(_norm(path))
+        self.client.omap_set(self.pool, self._dir_oid(parent),
+                             {name: pack_value(ent)})
+
+    def _apply_rename(self, src: str, dst: str, ent: dict) -> None:
+        if ent["type"] == "dir":
+            self._copy_dir_tree(src, dst)
+        self._apply_set_entry(dst, ent)
+        parent, name = posixpath.split(_norm(src))
+        self.client.omap_rm(self.pool, self._dir_oid(parent), [name])
+        if ent["type"] == "dir":
+            self._drop_dir_tree(src)
+
+    def _copy_dir_tree(self, src: str, dst: str) -> None:
+        ents = self._raw_entries(src)
+        if ents is None:
+            return  # replay: source tree already moved
+        self.client.omap_set(self.pool, self._dir_oid(dst), dict(ents))
+        for name, raw in ents.items():
+            if unpack_value(raw)["type"] == "dir":
+                self._copy_dir_tree(posixpath.join(src, name),
+                                    posixpath.join(dst, name))
+
+    def _drop_dir_tree(self, src: str) -> None:
+        ents = self._raw_entries(src)
+        for name, raw in (ents or {}).items():
+            if unpack_value(raw)["type"] == "dir":
+                self._drop_dir_tree(posixpath.join(src, name))
+        try:
+            self.client.remove(self.pool, self._dir_oid(src))
+        except RadosError:
+            pass
+
+    # --------------------------------------------------- metadata service
+    def entries(self, dirpath: str) -> dict:
+        raw = self._raw_entries(dirpath)
+        if raw is None:
+            raise FsError(-2, f"no such directory {dirpath!r}")
+        return {k: unpack_value(v) for k, v in raw.items()}
+
+    def lookup(self, path: str) -> dict:
+        path = _norm(path)
+        if path == "/":
+            return {"type": "dir"}
+        parent, name = posixpath.split(path)
+        ent = self.entries(parent).get(name)
+        if ent is None:
+            raise FsError(-2, f"no such entry {path!r}")
+        return ent
+
+    def mkdir(self, path: str) -> None:
+        with self._lock:
+            path = _norm(path)
+            parent, name = posixpath.split(path)
+            if name in self.entries(parent):
+                raise FsError(-17, f"{path!r} exists")
+            self.submit({"op": "mkdir", "path": path,
+                         "ent": {"type": "dir", "mtime": time.time()}})
+
+    def rmdir(self, path: str) -> None:
+        with self._lock:
+            path = _norm(path)
+            if path == "/":
+                raise FsError(-22, "cannot remove the root")
+            ent = self.lookup(path)
+            if ent["type"] != "dir":
+                raise FsError(-20, f"{path!r} is not a directory")
+            if self.entries(path):
+                raise FsError(-39, f"{path!r} not empty")
+            self.submit({"op": "rmdir", "path": path})
+
+    def create(self, path: str) -> dict:
+        with self._lock:
+            path = _norm(path)
+            parent, name = posixpath.split(path)
+            if name in self.entries(parent):
+                raise FsError(-17, f"{path!r} exists")
+            ent = {"type": "file", "size": 0, "ino": uuid.uuid4().hex,
+                   "mtime": time.time()}
+            self.submit({"op": "set_entry", "path": path, "ent": ent})
+            return ent
+
+    def set_entry(self, path: str, ent: dict) -> None:
+        with self._lock:
+            self.submit({"op": "set_entry", "path": _norm(path),
+                         "ent": ent})
+
+    def rm_entry(self, path: str) -> None:
+        with self._lock:
+            self.submit({"op": "rm_entry", "path": _norm(path)})
+
+    def rename(self, src: str, dst: str) -> None:
+        with self._lock:
+            src, dst = _norm(src), _norm(dst)
+            if dst == src or dst.startswith(src + "/"):
+                raise FsError(-22,
+                              f"cannot move {src!r} into itself "
+                              f"({dst!r})")
+            ent = self.lookup(src)
+            parent, name = posixpath.split(dst)
+            if name in self.entries(parent):
+                raise FsError(-17, f"{dst!r} exists")
+            self._revoke_subtree(src, exclude=None)
+            self.submit({"op": "rename", "src": src, "dst": dst,
+                         "ent": ent})
+
+    # ------------------------------------------------------- capabilities
+    def register_session(self, client_id: str, revoke_cb) -> None:
+        with self._lock:
+            self._sessions[client_id] = _Session(client_id, revoke_cb)
+
+    def unregister_session(self, client_id: str) -> None:
+        with self._lock:
+            self._sessions.pop(client_id, None)
+            for holders in self._caps.values():
+                holders.pop(client_id, None)
+
+    def open(self, client_id: str, path: str, mode: str) -> dict:
+        """Grant caps on a file (Locker issue path): mode "r" wants
+        cached reads, "w"/"rw" buffered writes.  Conflicting holders
+        are revoked (synchronously flushed) first.  Returns the entry
+        plus the granted caps + lease expiry."""
+        path = _norm(path)
+        want_w = "w" in mode
+        with self._lock:
+            ent = self.lookup(path)
+            if ent["type"] != "file":
+                raise FsError(-21, f"{path!r} is a directory")
+            now = time.time()
+            holders = self._caps.setdefault(path, {})
+            # expired leases are reclaimable without a round-trip
+            for cid, (_c, exp) in list(holders.items()):
+                if exp < now or cid not in self._sessions:
+                    del holders[cid]
+            revoke = []
+            for cid, (caps, _exp) in holders.items():
+                if cid == client_id:
+                    continue
+                if want_w or "w" in caps:
+                    # multiple readers share; any writer is exclusive
+                    revoke.append(cid)
+            for cid in revoke:
+                self._revoke_one(path, cid)
+            ent = self.lookup(path)  # revokes may have flushed size
+            caps = "rw" if want_w else "r"
+            expires = now + self.LEASE_TTL
+            holders[client_id] = (caps, expires)
+            return {"ent": ent, "caps": caps, "expires": expires}
+
+    def release(self, client_id: str, path: str) -> None:
+        with self._lock:
+            holders = self._caps.get(_norm(path), {})
+            holders.pop(client_id, None)
+
+    def _revoke_one(self, path: str, client_id: str) -> None:
+        """Call the holder's revoke callback (it flushes buffered writes
+        through the normal data+set_entry path), then drop the cap.
+        RLock: the flush may re-enter set_entry on this thread."""
+        sess = self._sessions.get(client_id)
+        if sess is not None:
+            try:
+                sess.revoke_cb(path)
+            except Exception:  # noqa: BLE001 - a dead client must not wedge opens
+                pass
+        self._caps.get(path, {}).pop(client_id, None)
+
+    def _revoke_subtree(self, path: str, exclude: str | None) -> None:
+        """Renames invalidate cached paths under the moved subtree."""
+        for cap_path in list(self._caps):
+            if cap_path == path or cap_path.startswith(path + "/"):
+                for cid in list(self._caps[cap_path]):
+                    if cid != exclude:
+                        self._revoke_one(cap_path, cid)
+
+    def invalidate(self, path: str, exclude: str | None = None) -> None:
+        """Revoke every cap on `path` (used by cap-less mutation APIs —
+        write_file/truncate/unlink — so 'r' holders cannot keep serving
+        pre-mutation cache)."""
+        path = _norm(path)
+        with self._lock:
+            for cid in list(self._caps.get(path, {})):
+                if cid != exclude:
+                    self._revoke_one(path, cid)
+
+    def caps_held(self, path: str) -> dict:
+        with self._lock:
+            return {cid: caps for cid, (caps, _e)
+                    in self._caps.get(_norm(path), {}).items()}
