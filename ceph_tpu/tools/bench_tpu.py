@@ -13,9 +13,18 @@ to complete while moving almost nothing over the tunnel; the digest is
 checked against the CPU oracle, so a kernel that did not really run (or
 ran wrong) cannot produce a timing at all.  Reported numbers:
 
-- kernel_gbps: device-resident lanes in HBM -> parity in HBM, measured
-  as median(per-rep digest-forced time) - median RTT, over DISTINCT
-  input buffers (the tunnel memoizes repeated identical executions).
+- kernel_gbps: device-resident lanes in HBM -> parity in HBM.  A single
+  encode at any HBM-fittable batch finishes far inside the tunnel's RTT,
+  so one-dispatch-per-rep timing is RTT-bound and unresolvable; instead
+  each timed dispatch runs ITERS encodes in a rolled lax.fori_loop,
+  iteration i encoding (lanes ^ i) and folding an XOR-digest of the
+  parity into the loop carry, so N*kernel time dominates the one RTT
+  (subtracted).  The digest still proves every loop ran real math: GF
+  encode is XOR-linear, the per-iteration constant region contributes 0
+  to an XOR-digest over an even lane count, so with ITERS odd the
+  expected accumulator equals the XOR-digest of the base buffer's CPU
+  parity — checked per rep, over DISTINCT input buffers (the tunnel
+  memoizes repeated identical executions).
 - staging_gbps: host -> device transfer rate (device_put, landing forced
   by a one-element fetch).
 - e2e_gbps: host bytes in -> full parity bytes back on host, one shot
@@ -55,6 +64,10 @@ def main() -> int:
     p.add_argument("--skip-e2e", action="store_true",
                    help="skip the full-parity-fetch end-to-end rep "
                         "(slow over the tunnel)")
+    p.add_argument("--candidate-budget", type=float, default=150.0,
+                   help="soft per-candidate wall-clock budget (s): the "
+                        "iteration ladder stops escalating when the "
+                        "projected timing cost exceeds it")
     args = p.parse_args()
 
     import jax
@@ -90,7 +103,29 @@ def main() -> int:
             return y32, jnp.sum(y32, dtype=jnp.uint32)
         return jax.jit(fn)
 
+    from jax import lax
+
+    def xordig(y32):
+        return lax.reduce(y32, jnp.uint32(0), lax.bitwise_xor,
+                          tuple(range(y32.ndim)))
+
+    def with_loop(core, iters: int):
+        """ITERS encodes per dispatch (see module docstring); returns
+        only the 4-byte XOR-digest accumulator."""
+        def fn(x32):
+            def body(i, acc):
+                y32 = core(jnp.bitwise_xor(x32, jnp.uint32(i)))
+                return jnp.bitwise_xor(acc, xordig(y32))
+            return lax.fori_loop(0, iters, body, jnp.uint32(0))
+        return jax.jit(fn)
+
     candidates: dict[str, object] = {}
+    candidates_core: dict[str, object] = {}
+
+    def register(name, core):
+        candidates_core[name] = core
+        candidates[name] = with_digest(core)
+
     if args.kernel in ("auto", "pallas") and (
             rm._use_pallas or args.kernel == "pallas"):
         # off-TPU, _lanes_op degenerates to the same jnp graph as "xla" —
@@ -98,11 +133,11 @@ def main() -> int:
         # kernel in interpret mode (honest label, interpreter speed)
         if not rm._use_pallas:
             rm = RegionMatmul(M, interpret=True)
-        candidates["pallas"] = with_digest(rm._lanes_op(n4))
+        register("pallas", rm._lanes_op(n4))
     if args.kernel in ("auto", "xla"):
         from ceph_tpu.ops.ec_kernels import _rows_op, _terms
         terms = _terms(M)
-        candidates["xla"] = with_digest(lambda x32: _rows_op(x32, terms))
+        register("xla", lambda x32: _rows_op(x32, terms))
     if args.kernel in ("auto", "mxu"):
         try:
             mxu = gf_matmul_mxu_graph(M)
@@ -113,12 +148,16 @@ def main() -> int:
                 return jax.lax.bitcast_convert_type(
                     y8.reshape(r, x32.shape[-1], 4), jnp.uint32)
 
-            candidates["mxu"] = with_digest(mxu_core)
+            register("mxu", mxu_core)
         except ValueError:
             if args.kernel == "mxu":
                 raise  # explicitly requested but unsupported (k > 32)
 
+    def progress(msg: str) -> None:
+        print(f"bench_tpu: {msg}", file=sys.stderr, flush=True)
+
     # ---- RTT: trivial computation + 4-byte fetch, distinct inputs ------
+    progress(f"backend={backend} measuring rtt")
     bump = jax.jit(lambda s: s + jnp.uint32(1))
     int(bump(jnp.uint32(0)))  # compile
     rtts = []
@@ -132,6 +171,8 @@ def main() -> int:
     # reps timed + 1 warm/verify; one EXTRA host buffer is reserved for
     # the e2e shot and never staged here, so neither its transfer nor its
     # execution can be served from the tunnel's memo
+    progress(f"rtt {rtt:.4f}s; staging {args.reps + 2} buffers of "
+             f"{k * n4 * 4 / 2**20:.0f} MiB")
     hosts = [rng.integers(0, 2**32, (k, n4), dtype=np.uint32)
              for _ in range(args.reps + 2)]
     nbytes = hosts[0].nbytes
@@ -150,17 +191,30 @@ def main() -> int:
                     else round(n_timed * nbytes / staging_dt / 2**30, 4))
 
     # ---- per-buffer oracle digests (prove every timed execution) -------
-    def oracle_digest(h) -> int:
-        par = (native.encode_region(M, h.view(np.uint8))
-               if native.available()
-               else gf256.encode_region(M, h.view(np.uint8)))
+    def oracle_parity(h):
+        return (native.encode_region(M, h.view(np.uint8))
+                if native.available()
+                else gf256.encode_region(M, h.view(np.uint8)))
+
+    def sum_digest(par) -> int:
         return int(np.sum(par.view(np.uint32), dtype=np.uint32))
 
-    wants = [oracle_digest(h) for h in hosts[:-1]]
+    def xor_digest(par) -> int:
+        return int(np.bitwise_xor.reduce(par.view(np.uint32), axis=None))
 
-    # ---- per-candidate: verify then time -------------------------------
+    progress(f"staged ({staging_gbps} GB/s); computing oracle digests")
+    parities = [oracle_parity(h) for h in hosts[:-1]]
+    wants_sum = [sum_digest(p) for p in parities]
+    wants_xor = [xor_digest(p) for p in parities]
+    # odd ITERS + even lane count make the loop accumulator equal the
+    # base buffer's parity XOR-digest (module docstring)
+    assert n4 % 2 == 0, "xor-digest identity needs an even lane count"
+    ITER_LADDER = (255, 2047, 16383)
+
+    # ---- per-candidate: verify single-shot, then time the looped form --
     results = {}
     for name, fn in candidates.items():
+        progress(f"{name}: compile + single-shot verify")
         try:
             t0 = time.perf_counter()
             _, dig = fn(bufs[-1])
@@ -169,39 +223,74 @@ def main() -> int:
         except Exception as e:  # compile/runtime failure: skip candidate
             print(f"bench_tpu: {name} failed: {e}", file=sys.stderr)
             continue
-        if got != wants[-1]:
-            print(f"bench_tpu: {name} WRONG digest {got} != {wants[-1]}",
-                  file=sys.stderr)
+        if got != wants_sum[-1]:
+            print(f"bench_tpu: {name} WRONG digest {got} != "
+                  f"{wants_sum[-1]}", file=sys.stderr)
             continue
-        times = []
-        bad = False
-        for i in range(args.reps):
-            t0 = time.perf_counter()
-            _, dig = fn(bufs[i])
-            got = int(dig)
-            times.append(time.perf_counter() - t0)
-            if got != wants[i]:
-                print(f"bench_tpu: {name} rep {i} WRONG digest", file=sys.stderr)
-                bad = True
+        entry = {"kernel_gbps": None, "compile_s": round(compile_s, 3)}
+        spent = 0.0
+        prev = None  # (iters, median) from the rung below
+        for iters in ITER_LADDER:
+            if prev is not None:
+                projected = prev[1] * iters / prev[0] * (args.reps + 1)
+                if spent + projected > args.candidate_budget:
+                    print(f"bench_tpu: {name} stopping ladder at "
+                          f"x{prev[0]} (x{iters} projected "
+                          f"{projected:.0f}s over budget)",
+                          file=sys.stderr)
+                    break
+            progress(f"{name}: loop x{iters} compile + warm")
+            lfn = with_loop(candidates_core[name], iters)
+            try:
+                t0 = time.perf_counter()
+                got = int(lfn(bufs[-1]))  # compile + warm verify
+                warm_s = time.perf_counter() - t0
+            except Exception as e:
+                print(f"bench_tpu: {name} loop x{iters} failed: {e}",
+                      file=sys.stderr)
                 break
-        if bad:
-            continue
-        dt = statistics.median(times) - rtt
-        if dt <= rtt:  # RTT-dominated: the batch is too small to resolve
-            print(f"bench_tpu: {name} unmeasurable at this size "
-                  f"(median rep {statistics.median(times):.6f}s vs rtt "
-                  f"{rtt:.6f}s) — raise --batch", file=sys.stderr)
-            results[name] = {
-                "kernel_gbps": None,
-                "rep_times_s": [round(t, 6) for t in times],
-                "compile_s": round(compile_s, 3),
-            }
-            continue
-        results[name] = {
-            "kernel_gbps": nbytes / dt / 2**30,
-            "rep_times_s": [round(t, 6) for t in times],
-            "compile_s": round(compile_s, 3),
-        }
+            spent += warm_s
+            if got != wants_xor[-1]:
+                # the digest gate comes FIRST: a wrong kernel must never
+                # publish a number, not even the warm bound below
+                print(f"bench_tpu: {name} loop x{iters} WRONG xor-digest "
+                      f"{got} != {wants_xor[-1]}", file=sys.stderr)
+                break
+            if warm_s * args.reps > args.candidate_budget:
+                # kernel too slow to time at even this rung: report the
+                # warm run as a (pessimistic, compile-inclusive) bound
+                print(f"bench_tpu: {name} x{iters} warm run took "
+                      f"{warm_s:.0f}s — skipping timed reps",
+                      file=sys.stderr)
+                entry["warm_bound_gbps"] = round(
+                    iters * nbytes / warm_s / 2**30, 4)
+                entry["iters"] = iters
+                break
+            times, bad = [], False
+            for i in range(args.reps):
+                t0 = time.perf_counter()
+                got = int(lfn(bufs[i]))
+                times.append(time.perf_counter() - t0)
+                if got != wants_xor[i]:
+                    print(f"bench_tpu: {name} loop rep {i} WRONG "
+                          f"xor-digest", file=sys.stderr)
+                    bad = True
+                    break
+            if bad:
+                break
+            med = statistics.median(times)
+            spent += sum(times)
+            prev = (iters, med)
+            entry["rep_times_s"] = [round(t, 6) for t in times]
+            entry["iters"] = iters
+            if med - rtt <= rtt:  # still RTT-dominated: climb the ladder
+                print(f"bench_tpu: {name} x{iters} RTT-bound "
+                      f"(median {med:.4f}s vs rtt {rtt:.4f}s), "
+                      f"escalating", file=sys.stderr)
+                continue
+            entry["kernel_gbps"] = iters * nbytes / (med - rtt) / 2**30
+            break
+        results[name] = entry
     measurable = {n: v for n, v in results.items()
                   if v["kernel_gbps"] is not None}
     if not measurable:
