@@ -27,12 +27,18 @@ import struct
 import threading
 import time
 
+import collections
+import secrets
+
 from ..utils.log import dout
 from .messenger import Network
 from .wire import decode_frame, encode_frame
 
 _AUTH_MAGIC = b"CTPX1\0"
+_RESM_MAGIC = b"RESM"
 _TAG_LEN = 16
+_RING_MAX = 512          # replayable frames kept per session
+_STASH_MAX = 64          # dead sessions kept for resume
 
 
 def _mac(key: bytes, *parts: bytes) -> bytes:
@@ -52,16 +58,117 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
     return bytes(buf)
 
 
+class _SessState:
+    """Resumable session state that OUTLIVES any one socket (the
+    ProtocolV2 connection cookie + out_queue/replay role): sequenced
+    sent frames in a bounded ring, and the last seq received."""
+
+    __slots__ = ("cookie", "send_seq", "recv_seq", "ring")
+
+    def __init__(self):
+        self.cookie = secrets.token_bytes(16)
+        self.send_seq = 0
+        self.recv_seq = 0
+        self.ring: collections.deque = collections.deque(maxlen=_RING_MAX)
+        # ring holds (seq, flags, plain_payload)
+
+    def ring_floor(self) -> int:
+        return self.ring[0][0] if self.ring else self.send_seq + 1
+
+
 class _Conn:
     """One live socket + send lock (shared by both directions)."""
 
-    __slots__ = ("sock", "lock", "alive", "session_key")
+    __slots__ = ("sock", "lock", "alive", "session_key", "state",
+                 "enc_send", "enc_recv", "enc_send_n", "enc_recv_n")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.lock = threading.Lock()
         self.alive = True
         self.session_key: bytes | None = None  # cephx-lite session
+        self.state: _SessState | None = None   # resume session
+        # secure-mode per-direction cipher keys + frame counters
+        self.enc_send: bytes | None = None
+        self.enc_recv: bytes | None = None
+        self.enc_send_n = 0
+        self.enc_recv_n = 0
+
+    def arm_secure(self, role: str) -> None:
+        """Derive per-direction ChaCha20 keys from the cephx session key
+        (crypto_onwire rx/tx stream role).  role: "c" connector."""
+        a = _mac(self.session_key, b"enc-c2s")
+        b = _mac(self.session_key, b"enc-s2c")
+        self.enc_send, self.enc_recv = (a, b) if role == "c" else (b, a)
+
+    def _seal(self, payload: bytes) -> bytes:
+        if self.enc_send is not None:
+            from ..ops.native import chacha20_xor
+            nonce = b"\x00" * 4 + self.enc_send_n.to_bytes(8, "little")
+            self.enc_send_n += 1
+            payload = chacha20_xor(self.enc_send, nonce, payload)
+        if self.session_key is not None:
+            payload = payload + _mac(self.session_key, payload)[:_TAG_LEN]
+        return payload
+
+    def unseal(self, payload: bytes) -> bytes | None:
+        if self.session_key is not None:
+            if len(payload) < _TAG_LEN:
+                return None
+            payload, tag = payload[:-_TAG_LEN], payload[-_TAG_LEN:]
+            want = _mac(self.session_key, payload)[:_TAG_LEN]
+            if not hmac.compare_digest(tag, want):
+                return None
+        if self.enc_recv is not None:
+            from ..ops.native import chacha20_xor
+            nonce = b"\x00" * 4 + self.enc_recv_n.to_bytes(8, "little")
+            self.enc_recv_n += 1
+            payload = chacha20_xor(self.enc_recv, nonce, payload)
+        return payload
+
+    SENT, DEAD, RINGED = 1, 0, -1
+
+    def send_payload(self, flags: int, plain: bytes) -> int:
+        """Sequence (resume mode), seal, frame, send — atomically, so
+        seq order on the wire matches ring order.  Returns SENT, DEAD
+        (nothing ringed), or RINGED (in the ring but the socket died —
+        a session resume will replay it; the caller must NOT re-send or
+        the peer gets it twice under a fresh seq)."""
+        with self.lock:
+            if not self.alive:
+                return self.DEAD
+            ringed = False
+            if self.state is not None:
+                self.state.send_seq += 1
+                seq = self.state.send_seq
+                self.state.ring.append((seq, flags, plain))
+                plain = struct.pack("<Q", seq) + plain
+                ringed = True
+            body = self._seal(plain)
+            try:
+                self.sock.sendall(
+                    struct.pack("<I", len(body) | flags) + body)
+                return self.SENT
+            except OSError:
+                self.alive = False
+                return self.RINGED if ringed else self.DEAD
+
+    def replay_from(self, last_recv: int) -> bool:
+        """Resend ring entries the peer never saw (resume replay)."""
+        with self.lock:
+            if not self.alive or self.state is None:
+                return False
+            for seq, flags, plain in list(self.state.ring):
+                if seq <= last_recv:
+                    continue
+                body = self._seal(struct.pack("<Q", seq) + plain)
+                try:
+                    self.sock.sendall(
+                        struct.pack("<I", len(body) | flags) + body)
+                except OSError:
+                    self.alive = False
+                    return False
+            return True
 
     def send_frame(self, frame: bytes) -> bool:
         with self.lock:
@@ -93,9 +200,27 @@ _COMPRESSED = 0x8000_0000  # frame-length flag bit (msgr v2
 class TcpNetwork(Network):
     def __init__(self, host: str = "127.0.0.1", seed: int = 0,
                  compress: str = "none", compress_min: int = 4096,
-                 auth_secret: bytes | None = None):
+                 auth_secret: bytes | None = None,
+                 secure: bool = False, resume: bool = True):
         super().__init__(seed)
         self._host = host
+        # msgr2 secure mode (crypto_onwire role): ChaCha20 per-direction
+        # streams keyed from the cephx session key, under the existing
+        # per-frame HMAC tag (encrypt-then-MAC)
+        if secure and auth_secret is None:
+            raise ValueError("secure mode requires auth_secret")
+        self._secure = secure
+        # ProtocolV2 session resume: sequenced frames + replay ring; a
+        # reconnect replays the tail the peer never received
+        self._resume = resume
+        self._stash: dict[bytes, _SessState] = {}   # cookie -> dead sess
+        # live server-side sessions: a reconnect may arrive BEFORE the
+        # zombie connection's read loop has noticed the death and
+        # stashed its state — resume takes over from the live table too
+        self._states: dict[bytes, tuple[_SessState, "_Conn"]] = {}
+        self._by_addr: dict[str, tuple[bytes, _SessState]] = {}
+        # ^ client side: addr -> (server_cookie, my session state)
+        self.resumed = 0  # observability: successful resumes
         # cephx-lite (src/auth/cephx role): shared-secret mutual
         # challenge/response on connect derives a per-connection session
         # key; every frame carries a truncated HMAC tag under it.  A
@@ -114,6 +239,10 @@ class TcpNetwork(Network):
         self._routes: dict[str, _Conn] = {}  # learned reply routes
         self._out: dict[str, _Conn] = {}     # outgoing conns by addr
         self._net_lock = threading.RLock()
+        # serializes dialing PER ADDRESS: two racing connects must not
+        # both adopt (and replay) the same resumable session state; a
+        # global lock would let one unreachable peer stall every dial
+        self._dial_locks: dict[str, threading.Lock] = {}
         self._stopping = False
 
     # -- registry / addressing --------------------------------------------
@@ -228,7 +357,86 @@ class TcpNetwork(Network):
                 conn.close()
                 return
             conn.session_key = key
+            if self._secure:
+                conn.arm_secure("s")
+        if self._resume and not self._resume_server(conn):
+            conn.close()
+            return
         self._read_loop(conn)
+
+    # -- session resume handshake -----------------------------------------
+    # client: RESM | peer_cookie(16, zeros=fresh) | last_recv(u64)
+    # server: RESM | my_cookie(16) | flag(u8: 1=resumed) | last_recv(u64)
+    # On resume both sides replay ring entries past the peer's last_recv.
+    def _resume_server(self, conn: _Conn) -> bool:
+        sock = conn.sock
+        sock.settimeout(5)
+        try:
+            blk = _recv_exact(sock, len(_RESM_MAGIC) + 16 + 8)
+            if blk is None or not blk.startswith(_RESM_MAGIC):
+                return False
+            peer_cookie = blk[len(_RESM_MAGIC):len(_RESM_MAGIC) + 16]
+            (last_recv,) = struct.unpack("<Q", blk[-8:])
+            state = None
+            zombie = None
+            with self._net_lock:
+                prev = self._stash.pop(peer_cookie, None)
+                if prev is None and peer_cookie in self._states:
+                    # takeover: the old conn hasn't died visibly yet
+                    prev, zombie = self._states.pop(peer_cookie)
+                    zombie.state = None  # its cleanup must not stash
+                if prev is not None and last_recv + 1 >= \
+                        prev.ring_floor():
+                    state = prev
+            if zombie is not None:
+                zombie.close()
+            resumed = state is not None
+            if state is None:
+                state = _SessState()
+            conn.state = state
+            with self._net_lock:
+                self._states[state.cookie] = (state, conn)
+            sock.sendall(_RESM_MAGIC + state.cookie
+                         + bytes([1 if resumed else 0])
+                         + struct.pack("<Q", state.recv_seq))
+            if resumed:
+                self.resumed += 1
+                conn.replay_from(last_recv)
+            return True
+        except OSError:
+            return False
+        finally:
+            sock.settimeout(None)
+
+    def _resume_client(self, conn: _Conn, addr: str) -> bool:
+        sock = conn.sock
+        sock.settimeout(5)
+        try:
+            with self._net_lock:
+                prev = self._by_addr.get(addr)
+            cookie = prev[0] if prev else b"\x00" * 16
+            state = prev[1] if prev else _SessState()
+            sock.sendall(_RESM_MAGIC + cookie
+                         + struct.pack("<Q", state.recv_seq))
+            blk = _recv_exact(sock, len(_RESM_MAGIC) + 16 + 1 + 8)
+            if blk is None or not blk.startswith(_RESM_MAGIC):
+                return False
+            srv_cookie = blk[len(_RESM_MAGIC):len(_RESM_MAGIC) + 16]
+            resumed = blk[len(_RESM_MAGIC) + 16] == 1
+            (srv_last,) = struct.unpack("<Q", blk[-8:])
+            if not resumed:
+                state = _SessState()  # server lost us: fresh numbering
+            conn.state = state
+            with self._net_lock:
+                self._by_addr[addr] = (srv_cookie, state)
+            if resumed:
+                self.resumed += 1
+                conn.replay_from(srv_last)
+            return True
+        except OSError:
+            return False
+        finally:
+            sock.settimeout(None)
 
     MAX_FRAME = 256 << 20  # recovery pushes batch objects; cap garbage
 
@@ -249,17 +457,29 @@ class TcpNetwork(Network):
             payload = _recv_exact(sock, length)
             if payload is None:
                 break
-            if conn.session_key is not None:
-                # verify-and-strip the per-frame signature (cephx
-                # message signing role)
-                if len(payload) < _TAG_LEN:
+            # verify-and-strip signature + decrypt (cephx signing /
+            # secure-mode stream)
+            payload = conn.unseal(payload)
+            if payload is None:
+                dout("msg", 0)("tcp: BAD frame signature; dropping "
+                               "connection")
+                break
+            # snapshot: a resume takeover may null conn.state mid-frame
+            state = conn.state
+            if state is not None:
+                if len(payload) < 8:
                     break
-                payload, tag = payload[:-_TAG_LEN], payload[-_TAG_LEN:]
-                want = _mac(conn.session_key, payload)[:_TAG_LEN]
-                if not hmac.compare_digest(tag, want):
-                    dout("msg", 0)("tcp: BAD frame signature; dropping "
-                                   "connection")
+                (seq,) = struct.unpack("<Q", payload[:8])
+                payload = payload[8:]
+                if seq <= state.recv_seq:
+                    continue  # resume replay of a frame we already have
+                if seq != state.recv_seq + 1:
+                    # a hole the wire can't have produced: the sender
+                    # lied/lost frames — force a reconnect+resume
+                    dout("msg", 1)("tcp: seq gap (%d after %d)", seq,
+                                   state.recv_seq)
                     break
+                state.recv_seq = seq
             if compressed:
                 if self._compressor is None or len(payload) < 4:
                     dout("msg", 1)("tcp: compressed frame but no "
@@ -296,6 +516,17 @@ class TcpNetwork(Network):
         with self._net_lock:
             for k in [k for k, v in self._routes.items() if v is conn]:
                 del self._routes[k]
+            state = conn.state
+            if state is not None and \
+                    self._states.get(state.cookie, (None, None))[1] is conn:
+                # stash for resume; bounded (oldest evicted).  Only
+                # server-registered sessions: a client-side state is
+                # resumed via _by_addr, and stashing its (peer-unknown)
+                # cookie would evict genuinely resumable sessions
+                del self._states[state.cookie]
+                self._stash[state.cookie] = state
+                while len(self._stash) > _STASH_MAX:
+                    self._stash.pop(next(iter(self._stash)))
 
     # -- send side ---------------------------------------------------------
     def _connect(self, addr: str) -> _Conn | None:
@@ -313,6 +544,12 @@ class TcpNetwork(Network):
                 conn.close()
                 return None
             conn.session_key = key
+            if self._secure:
+                conn.arm_secure("c")
+        if self._resume and not self._resume_client(conn, addr):
+            dout("msg", 1)("tcp: resume handshake to %s failed", addr)
+            conn.close()
+            return None
         # outgoing pipes are bidirectional: replies come back on them
         threading.Thread(target=self._read_loop, args=(conn,),
                          name=f"tcp-read-out-{addr}", daemon=True).start()
@@ -329,15 +566,18 @@ class TcpNetwork(Network):
             conn = self._out.get(addr)
             if conn is not None and conn.alive:
                 return conn
-        conn = self._connect(addr)
-        if conn is None:
-            return None
         with self._net_lock:
-            cur = self._out.get(addr)
-            if cur is not None and cur.alive:
-                conn.close()
-                return cur
-            self._out[addr] = conn
+            dial = self._dial_locks.setdefault(addr, threading.Lock())
+        with dial:
+            with self._net_lock:  # re-check under the dial lock
+                conn = self._out.get(addr)
+                if conn is not None and conn.alive:
+                    return conn
+            conn = self._connect(addr)
+            if conn is None:
+                return None
+            with self._net_lock:
+                self._out[addr] = conn
         return conn
 
     def deliver(self, src: str, dst: str, msg) -> bool:
@@ -363,22 +603,21 @@ class TcpNetwork(Network):
         conn = self._conn_for(dst)
         if conn is None:
             return False
-        if conn.send_frame(self._finalize(conn, flags, payload)):
+        rc = conn.send_payload(flags, payload)
+        if rc == _Conn.SENT:
             return True
-        # stale cached pipe: retry once on a fresh connection
+        old_state = conn.state
+        # stale cached pipe: retry once on a fresh connection (which
+        # resumes the session and replays the ring tail)
         with self._net_lock:
             for table in (self._routes, self._out):
                 for k in [k for k, v in table.items() if v is conn]:
                     del table[k]
         conn2 = self._conn_for(dst)
-        return conn2 is not None and \
-            conn2.send_frame(self._finalize(conn2, flags, payload))
-
-    @staticmethod
-    def _finalize(conn: _Conn, flags: int, payload: bytes) -> bytes:
-        """Per-connection frame finalization: sign under the session key
-        (cephx message signing) and length-prefix."""
-        if conn.session_key is not None:
-            payload = payload + _mac(conn.session_key,
-                                     payload)[:_TAG_LEN]
-        return struct.pack("<I", len(payload) | flags) + payload
+        if conn2 is None:
+            return False
+        if rc == _Conn.RINGED and conn2.state is old_state:
+            # the frame rode the resume replay — re-sending would
+            # duplicate it under a fresh seq
+            return True
+        return conn2.send_payload(flags, payload) == _Conn.SENT

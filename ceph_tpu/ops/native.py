@@ -98,6 +98,9 @@ def _load() -> ctypes.CDLL:
         L.ct_xxhash32.argtypes = [ctypes.c_uint32, u8p, ctypes.c_size_t]
         L.ct_xxhash64.restype = ctypes.c_uint64
         L.ct_xxhash64.argtypes = [ctypes.c_uint64, u8p, ctypes.c_size_t]
+        L.chacha20_xor.restype = None
+        L.chacha20_xor.argtypes = [u8p, u8p, ctypes.c_uint32, u8p,
+                                   ctypes.c_uint64]
         L.ct_init()
         return L
 
@@ -243,3 +246,19 @@ def checksummer(kind: str):
         return CSUM_FUNCS[kind]
     except KeyError:
         raise ValueError(f"unknown checksum {kind!r}") from None
+
+
+def chacha20_xor(key: bytes, nonce: bytes, data: bytes,
+                 counter: int = 0) -> bytes:
+    """ChaCha20 keystream XOR (RFC 8439): encrypt == decrypt.  The
+    messenger's secure-mode cipher (the crypto_onwire role; the
+    reference uses AES-GCM via openssl, this library is dependency-free
+    so the wire cipher is ChaCha20 + the messenger's HMAC tag)."""
+    if len(key) != 32 or len(nonce) != 12:
+        raise ValueError("chacha20 wants a 32-byte key, 12-byte nonce")
+    buf = np.frombuffer(bytes(data), dtype=np.uint8).copy()
+    k = np.frombuffer(key, dtype=np.uint8)
+    n = np.frombuffer(nonce, dtype=np.uint8)
+    if buf.size:
+        lib().chacha20_xor(_u8p(k), _u8p(n), counter, _u8p(buf), buf.size)
+    return buf.tobytes()

@@ -138,13 +138,15 @@ class BlueStore(ObjectStore):
     """Durable ObjectStore: block-device pages + KV onodes (see module
     docstring for the layout and crash-ordering rules)."""
 
-    def __init__(self, path: str, defer_limit: int = DEFER_LIMIT):
+    def __init__(self, path: str, defer_limit: int = DEFER_LIMIT,
+                 kv_backend: str = "wal"):
         self.path = path
         self.defer_limit = defer_limit
+        self.kv_backend = kv_backend  # "wal" or "sst" (RocksDB-tier LSM)
         self._lock = threading.RLock()
         self._mounted = False
         self._dev = None
-        self._kv: WalKV | None = None
+        self._kv = None
         self._colls: dict[CollectionId, dict[ObjectId, Onode]] = {}
         self._free: list[int] = []        # heap of free page numbers
         self._refs: dict[int, int] = {}   # phys -> refcount (live pages)
@@ -157,7 +159,10 @@ class BlueStore(ObjectStore):
             if self._mounted:
                 return
             os.makedirs(self.path, exist_ok=True)
-            self._kv = WalKV(self.path)
+            from .kvstore import create_kv
+            self._kv = (WalKV(self.path) if self.kv_backend == "wal"
+                        else create_kv(self.kv_backend,
+                                       os.path.join(self.path, "kv")))
             super_raw = self._kv.get(_P_SUPER, "super")
             if super_raw is None:
                 self._kv.put(_P_SUPER, "super", str(PAGE).encode())

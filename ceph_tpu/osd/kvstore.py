@@ -209,11 +209,17 @@ class WalKV(MemKV):
 
 
 def create_kv(kind: str, path: str | None = None) -> KeyValueDB:
-    """Factory (KeyValueDB::create role): 'mem' or 'wal'."""
+    """Factory (KeyValueDB::create role): 'mem', 'wal', or 'sst'
+    (leveled LSM, the RocksDB-tier backend)."""
     if kind == "mem":
         return MemKV()
     if kind == "wal":
         if not path:
             raise ValueError("wal kv needs a path")
         return WalKV(path)
+    if kind == "sst":
+        if not path:
+            raise ValueError("sst kv needs a path")
+        from .sstkv import SstKV
+        return SstKV(path)
     raise ValueError(f"unknown kv backend {kind!r}")

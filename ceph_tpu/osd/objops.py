@@ -300,6 +300,9 @@ class ObjOpsMixin:
         except NoSuchObject:
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
             return
+        if attrs.get("wh"):  # whiteout tombstone = logically absent
+            conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
+            return
         conn.send(MOSDOpReply(m.tid, 0, data=_pack(_user_xattrs(attrs)),
                               epoch=self.osdmap.epoch))
 
@@ -316,7 +319,23 @@ class ObjOpsMixin:
         obj = ObjectId(m.oid)
         exists = (self.store.exists(cid, obj)
                   and not self._head_whiteout(cid, m.oid))
-        data = self.store.read(cid, obj).to_bytes() if exists else b""
+        data: bytes | None = None  # loaded on the first step that needs it
+
+        def cur() -> bytes:
+            nonlocal data
+            if data is None:
+                data = (self.store.read(cid, obj).to_bytes()
+                        if exists else b"")
+            return data
+
+        def size() -> int:
+            if data is not None:
+                return len(data)
+            attrs = self.store.getattrs(cid, obj) if exists else {}
+            ln = attrs.get("len")  # NOT .get(k, len(cur())): the
+            # default would evaluate eagerly and always load the body
+            return int(ln) if ln is not None else len(cur())
+
         results = []
         for st in steps:
             op = st.get("op")
@@ -332,14 +351,14 @@ class ObjOpsMixin:
                                           epoch=self.osdmap.epoch))
                     return
                 off = int(st.get("off", 0))
-                ln = int(st.get("len", 0)) or len(data) - off
-                results.append(data[off:off + max(ln, 0)])
+                ln = int(st.get("len", 0)) or len(cur()) - off
+                results.append(cur()[off:off + max(ln, 0)])
             elif op == "stat":
                 if not exists:
                     conn.send(MOSDOpReply(m.tid, ENOENT,
                                           epoch=self.osdmap.epoch))
                     return
-                results.append(len(data))
+                results.append(size())
             elif op == "omap_get":
                 results.append(self.store.omap_get(cid, obj)
                                if exists else {})
@@ -373,8 +392,18 @@ class ObjOpsMixin:
         was_whiteout = present and bool(attrs.get("wh"))
         # a whiteout'd head is logically absent (snapshot tombstone)
         exists = present and not was_whiteout
-        data = self.store.read(cid, obj).to_bytes() if exists else b""
         cur_version = int(attrs.get("v", 0))
+        # body loads lazily: omap/xattr-only batches on a large object
+        # must not pay a full read
+        data = b""
+        loaded = not exists
+
+        def cur() -> bytes:
+            nonlocal data, loaded
+            if not loaded:
+                data = self.store.read(cid, obj).to_bytes()
+                loaded = True
+            return data
 
         def fail(code: int) -> None:
             conn.send(MOSDOpReply(m.tid, code, epoch=self.osdmap.epoch))
@@ -402,39 +431,42 @@ class ObjOpsMixin:
                     return fail(EEXIST)
                 exists, touched = True, True
             elif op == "write_full":
-                data = bytes(st["data"])
+                data, loaded = bytes(st["data"]), True
                 exists = touched = True
                 eff["data"] = data
             elif op == "write":
                 off = int(st.get("off", 0))
                 buf = bytes(st["data"])
-                if off > len(data):
-                    data = data + b"\x00" * (off - len(data))
-                data = data[:off] + buf + data[off + len(buf):]
+                base = cur()
+                if off > len(base):
+                    base = base + b"\x00" * (off - len(base))
+                data = base[:off] + buf + base[off + len(buf):]
                 exists = touched = True
                 eff["data"] = data
             elif op == "append":
-                data = data + bytes(st["data"])
+                data = cur() + bytes(st["data"])
                 exists = touched = True
                 eff["data"] = data
             elif op == "truncate":
                 size = int(st.get("size", 0))
-                data = (data[:size] if size <= len(data)
-                        else data + b"\x00" * (size - len(data)))
+                base = cur()
+                data = (base[:size] if size <= len(base)
+                        else base + b"\x00" * (size - len(base)))
                 exists = touched = True
                 eff["data"] = data
             elif op == "zero":
                 off, ln = int(st.get("off", 0)), int(st.get("len", 0))
-                if off < len(data) and ln > 0:
-                    end = min(off + ln, len(data))
-                    data = data[:off] + b"\x00" * (end - off) + data[end:]
+                base = cur()
+                if off < len(base) and ln > 0:
+                    end = min(off + ln, len(base))
+                    data = base[:off] + b"\x00" * (end - off) + base[end:]
                     eff["data"] = data
                 exists = touched = True
             elif op == "remove":
                 if not exists:
                     return fail(ENOENT)
                 exists, touched = False, True
-                data = b""
+                data, loaded = b"", True
                 eff.update(remove=True, create=False, data=None,
                            set={}, rm=[], xset={}, xrm=[])
             elif op == "setxattr":
@@ -542,7 +574,7 @@ class ObjOpsMixin:
             tx.write(cid, obj, 0, bytes(eff["data"]))
             data = bytes(eff["data"])
         else:
-            data = self.store.read(cid, obj).to_bytes() if exists else b""
+            data = None  # content untouched: existing d/len stay valid
         if eff.get("set"):
             tx.omap_setkeys(cid, obj, {str(k): bytes(v)
                                        for k, v in eff["set"].items()})
@@ -550,7 +582,13 @@ class ObjOpsMixin:
             have = set(self.store.omap_get(cid, obj)) if exists else set()
             tx.omap_rmkeys(cid, obj,
                            [k for k in eff["rm"] if k in have])
-        newattrs = {"v": version, "d": _crc32c(data), "len": len(data)}
+        if data is not None:
+            newattrs = {"v": version, "d": _crc32c(data),
+                        "len": len(data)}
+        elif exists:
+            newattrs = {"v": version}
+        else:  # fresh object with no data step (e.g. bare create)
+            newattrs = {"v": version, "d": _crc32c(b""), "len": 0}
         if eff.get("clear_wh"):
             newattrs["wh"] = 0
         for name, value in (eff.get("xset") or {}).items():

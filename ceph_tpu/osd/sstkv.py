@@ -1,0 +1,450 @@
+"""SstKV: a leveled LSM / SSTable KeyValueDB backend.
+
+The capability of the reference's RocksDBStore tier (src/kv/
+RocksDBStore.cc over RocksDB's LSM): writes land in a WAL-backed
+memtable; full memtables flush to immutable sorted-table files at L0;
+L0 files (overlapping, newest-first) compact into non-overlapping
+L1/L2/... runs; reads consult memtable -> L0 newest->oldest -> one
+file per deeper level, each gated by a bloom filter and located via a
+sparse block index; tombstones shadow older values and are dropped
+when a compaction reaches the bottom level.
+
+File format (sst_NNNNNNNN.sst):
+    [records: u32 klen | key | u8 tomb | u32 vlen | value]*
+    [bloom bits]
+    [index: u32 n | (u32 koff_len | first_key | u64 file_off)*]
+    [footer: u64 bloom_off | u64 index_off | u32 n_records |
+             u32 crc32c(bloom..index) | magic "SSTB"]
+
+The MANIFEST (levels layout + next file seq) rewrites atomically via
+tmp+rename; the memtable WAL uses the store family's crc-framed
+fsync'd record contract with torn-tail discard.  Composite keys are
+``prefix \\x00 key`` so per-prefix iteration is a contiguous range.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import struct
+import threading
+
+from ..ops.native import crc32c
+from ..utils.codec import Decoder, Encoder
+from .kvstore import KeyValueDB, KVTransaction
+
+_MAGIC = b"SSTB"
+_TOMB = 1
+_BLOCK = 4096              # sparse-index granularity (bytes of records)
+_REC_TX = 1
+
+
+def _ckey(prefix: str, key: str) -> bytes:
+    return prefix.encode() + b"\x00" + key.encode()
+
+
+def _split(ck: bytes) -> tuple[str, str]:
+    p, _, k = ck.partition(b"\x00")
+    return p.decode(), k.decode()
+
+
+class _Bloom:
+    """Fixed-k bloom filter (BloomFilterPolicy role): ~10 bits/key."""
+
+    K = 3
+
+    def __init__(self, bits: bytearray):
+        self.bits = bits
+
+    @classmethod
+    def build(cls, keys: list[bytes]) -> "_Bloom":
+        m = max(64, 10 * len(keys))
+        bits = bytearray((m + 7) // 8)
+        bloom = cls(bits)
+        for k in keys:
+            for h in bloom._hashes(k):
+                bits[h >> 3] |= 1 << (h & 7)
+        return bloom
+
+    def _hashes(self, key: bytes):
+        m = len(self.bits) * 8
+        for i in range(self.K):
+            d = hashlib.blake2b(key, digest_size=8,
+                                salt=bytes([i]) * 16).digest()
+            yield int.from_bytes(d, "little") % m
+
+    def maybe(self, key: bytes) -> bool:
+        return all(self.bits[h >> 3] & (1 << (h & 7))
+                   for h in self._hashes(key))
+
+
+class _Sst:
+    """One immutable sorted table: bloom + sparse index resident, data
+    read on demand."""
+
+    def __init__(self, path: str):
+        self.path = path
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            end = f.tell()
+            f.seek(end - 28)
+            footer = f.read(28)
+            bloom_off, index_off, self.n, crc = struct.unpack(
+                "<QQII", footer[:-4])
+            if footer[-4:] != _MAGIC:
+                raise IOError(f"{path}: bad sst magic")
+            f.seek(bloom_off)
+            meta = f.read(end - 28 - bloom_off)
+            if crc32c(meta) != crc:
+                raise IOError(f"{path}: sst meta crc mismatch")
+        self.bloom = _Bloom(bytearray(meta[:index_off - bloom_off]))
+        d = Decoder(meta[index_off - bloom_off:])
+        self.index: list[tuple[bytes, int]] = []
+        for _ in range(d.u32()):
+            first = d.blob()
+            off = d.u64()
+            self.index.append((first, off))
+        self._data_end = bloom_off
+        self.first = self.index[0][0] if self.index else b""
+        self.last = self._last_key() if self.index else b""
+
+    def _last_key(self) -> bytes:
+        last = b""
+        for ck, _tomb, _v in self.scan(self.index[-1][0]):
+            last = ck
+        return last
+
+    @staticmethod
+    def write(path: str, items: list[tuple[bytes, int, bytes]]) -> "_Sst":
+        """items: sorted (composite_key, tomb, value)."""
+        tmp = path + ".tmp"
+        index: list[tuple[bytes, int]] = []
+        with open(tmp, "wb") as f:
+            block_start = 0
+            for ck, tomb, val in items:
+                off = f.tell()
+                if off == 0 or off - block_start >= _BLOCK:
+                    index.append((ck, off))
+                    block_start = off
+                f.write(struct.pack("<I", len(ck)))
+                f.write(ck)
+                f.write(struct.pack("<BI", tomb, len(val)))
+                f.write(val)
+            bloom_off = f.tell()
+            bloom = _Bloom.build([ck for ck, _t, _v in items])
+            e = Encoder()
+            e.u32(len(index))
+            for first, off in index:
+                e.blob(first)
+                e.u64(off)
+            meta = bytes(bloom.bits) + e.tobytes()
+            f.write(meta)
+            f.write(struct.pack("<QQII", bloom_off,
+                                bloom_off + len(bloom.bits),
+                                len(items), crc32c(meta)))
+            f.write(_MAGIC)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        return _Sst(path)
+
+    def scan(self, start_ck: bytes = b""):
+        """Yield (ck, tomb, value) from the first key >= start_ck."""
+        if not self.index:
+            return
+        # binary search the sparse index for the covering block
+        lo, hi = 0, len(self.index) - 1
+        pos = 0
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            if self.index[mid][0] <= start_ck:
+                pos = self.index[mid][1]
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        with open(self.path, "rb") as f:
+            f.seek(pos)
+            while f.tell() < self._data_end:
+                (klen,) = struct.unpack("<I", f.read(4))
+                ck = f.read(klen)
+                tomb, vlen = struct.unpack("<BI", f.read(5))
+                val = f.read(vlen)
+                if ck >= start_ck:
+                    yield ck, tomb, val
+
+    def get(self, ck: bytes):
+        """(tomb, value) or None."""
+        if not (self.first <= ck <= self.last) or not self.bloom.maybe(ck):
+            return None
+        for k, tomb, val in self.scan(ck):
+            if k == ck:
+                return tomb, val
+            if k > ck:
+                return None
+        return None
+
+
+class SstKV(KeyValueDB):
+    L0_COMPACT_FILES = 4
+    LEVEL_BASE_BYTES = 1 << 20      # L1 target; 10x per deeper level
+    LEVEL_FANOUT = 10
+    SST_SPLIT_BYTES = 1 << 20       # split compaction output files
+
+    def __init__(self, path: str, memtable_bytes: int = 256 * 1024):
+        os.makedirs(path, exist_ok=True)
+        self._dir = path
+        self._memtable_bytes = memtable_bytes
+        self._lock = threading.RLock()
+        self._mem: dict[bytes, tuple[int, bytes]] = {}  # ck->(tomb,val)
+        self._mem_size = 0
+        self._levels: list[list[_Sst]] = []  # [0]=L0 newest-first
+        self._seq = 0
+        self._manifest = os.path.join(path, "MANIFEST")
+        self._wal_path = os.path.join(path, "memtable.wal")
+        self._load_manifest()
+        self._replay_wal()
+        self._wal = open(self._wal_path, "ab")
+
+    # ------------------------------------------------------- manifest/wal
+    def _load_manifest(self) -> None:
+        if not os.path.exists(self._manifest):
+            self._levels = [[]]
+            return
+        with open(self._manifest, "rb") as f:
+            d = Decoder(f.read())
+        self._seq = d.u64()
+        self._levels = []
+        for _ in range(d.u32()):
+            names = [d.string() for _ in range(d.u32())]
+            self._levels.append([_Sst(os.path.join(self._dir, n))
+                                 for n in names])
+        if not self._levels:
+            self._levels = [[]]
+
+    def _save_manifest(self) -> None:
+        e = Encoder()
+        e.u64(self._seq)
+        e.u32(len(self._levels))
+        for level in self._levels:
+            e.u32(len(level))
+            for sst in level:
+                e.string(os.path.basename(sst.path))
+        tmp = self._manifest + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(e.tobytes())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest)
+
+    def _replay_wal(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            raw = f.read()
+        pos = 0
+        while pos + 8 <= len(raw):
+            length, crc = struct.unpack_from("<II", raw, pos)
+            payload = raw[pos + 8: pos + 8 + length]
+            if len(payload) < length or crc32c(payload) != crc:
+                break  # torn tail
+            d = Decoder(payload)
+            if d.u8() == _REC_TX:
+                for _ in range(d.u32()):
+                    ck, tomb, val = d.blob(), d.u8(), d.blob()
+                    self._mem_put(ck, tomb, val)
+            pos += 8 + length
+        if pos < len(raw):
+            with open(self._wal_path, "r+b") as f:
+                f.truncate(pos)
+
+    def _mem_put(self, ck: bytes, tomb: int, val: bytes) -> None:
+        old = self._mem.get(ck)
+        if old is not None:
+            self._mem_size -= len(ck) + len(old[1])
+        self._mem[ck] = (tomb, val)
+        self._mem_size += len(ck) + len(val)
+
+    # ----------------------------------------------------------------- api
+    def submit(self, tx: KVTransaction) -> None:
+        with self._lock:
+            flat: list[tuple[bytes, int, bytes]] = []
+            for op, prefix, key, val in tx.ops:
+                if op == "put":
+                    flat.append((_ckey(prefix, key), 0, val))
+                elif op == "rm":
+                    flat.append((_ckey(prefix, key), _TOMB, b""))
+                else:  # rm_prefix: tombstone every live key in range —
+                    # including keys PUT earlier in this same tx
+                    # (KVTransaction ops apply in order, as MemKV does)
+                    doomed = {_ckey(prefix, k)
+                              for k, _v in self.iterate(prefix)}
+                    pfx = prefix.encode() + b"\x00"
+                    doomed |= {ck for ck, t, _v in flat
+                               if ck.startswith(pfx) and not t}
+                    flat.extend((ck, _TOMB, b"") for ck in sorted(doomed))
+            e = Encoder()
+            e.u8(_REC_TX)
+            e.u32(len(flat))
+            for ck, tomb, val in flat:
+                e.blob(ck)
+                e.u8(tomb)
+                e.blob(val)
+            payload = e.tobytes()
+            self._wal.write(struct.pack("<II", len(payload),
+                                        crc32c(payload)) + payload)
+            self._wal.flush()
+            os.fsync(self._wal.fileno())
+            for ck, tomb, val in flat:
+                self._mem_put(ck, tomb, val)
+            if self._mem_size >= self._memtable_bytes:
+                self._flush_memtable()
+
+    def get(self, prefix: str, key: str) -> bytes | None:
+        ck = _ckey(prefix, key)
+        with self._lock:
+            hit = self._mem.get(ck)
+            if hit is not None:
+                return None if hit[0] else hit[1]
+            for sst in self._levels[0]:            # L0 newest-first
+                hit = sst.get(ck)
+                if hit is not None:
+                    return None if hit[0] else hit[1]
+            for level in self._levels[1:]:         # non-overlapping
+                for sst in level:
+                    if sst.first <= ck <= sst.last:
+                        hit = sst.get(ck)
+                        if hit is not None:
+                            return None if hit[0] else hit[1]
+                        break
+        return None
+
+    def iterate(self, prefix: str, start: str = ""):
+        """Merged newest-wins iteration over memtable + every level."""
+        lo = _ckey(prefix, start)
+        hi = prefix.encode() + b"\x01"  # end of the prefix's range
+        with self._lock:
+            sources: list[list[tuple[bytes, int, bytes]]] = []
+            mem = [(ck, tv[0], tv[1])
+                   for ck, tv in sorted(self._mem.items())
+                   if lo <= ck < hi]
+            sources.append(mem)
+            for sst in self._levels[0]:
+                sources.append([(ck, t, v) for ck, t, v in sst.scan(lo)
+                                if ck < hi])
+            for level in self._levels[1:]:
+                run: list[tuple[bytes, int, bytes]] = []
+                for sst in level:
+                    if sst.last < lo or sst.first >= hi:
+                        continue
+                    run.extend((ck, t, v) for ck, t, v in sst.scan(lo)
+                               if ck < hi)
+                sources.append(run)
+        # newest-wins merge: earlier sources shadow later ones
+        seen: dict[bytes, tuple[int, bytes]] = {}
+        for src in sources:
+            for ck, tomb, val in src:
+                if ck not in seen:
+                    seen[ck] = (tomb, val)
+        for ck in sorted(seen):
+            tomb, val = seen[ck]
+            if not tomb:
+                yield _split(ck)[1], val
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal:
+                self._wal.close()
+                self._wal = None
+
+    # ------------------------------------------------------ flush/compact
+    def _next_name(self) -> str:
+        self._seq += 1
+        return f"sst_{self._seq:08d}.sst"
+
+    def _flush_memtable(self) -> None:
+        """Memtable -> new L0 file; WAL truncates after the flush is
+        durable (the flush IS the durability point for these keys)."""
+        if not self._mem:
+            return
+        items = [(ck, t, v) for ck, (t, v) in sorted(self._mem.items())]
+        sst = _Sst.write(os.path.join(self._dir, self._next_name()),
+                         items)
+        self._levels[0].insert(0, sst)  # newest first
+        self._save_manifest()
+        self._mem.clear()
+        self._mem_size = 0
+        self._wal.close()
+        self._wal = open(self._wal_path, "wb")
+        self._maybe_compact()
+
+    def _maybe_compact(self) -> None:
+        if len(self._levels[0]) > self.L0_COMPACT_FILES:
+            self._compact_level(0)
+        limit = self.LEVEL_BASE_BYTES
+        for ln in range(1, len(self._levels)):
+            size = sum(os.path.getsize(s.path)
+                       for s in self._levels[ln])
+            if size > limit:
+                self._compact_level(ln)
+            limit *= self.LEVEL_FANOUT
+
+    def _compact_level(self, ln: int) -> None:
+        """Merge level ln (+ the overlapping files of ln+1) into ln+1.
+        Tombstones drop when the output is the bottom-most data."""
+        while len(self._levels) <= ln + 1:
+            self._levels.append([])
+        upper = list(self._levels[ln])
+        if not upper:
+            return
+        lo = min(s.first for s in upper)
+        hi = max(s.last for s in upper)
+        lower, keep = [], []
+        for s in self._levels[ln + 1]:
+            (lower if not (s.last < lo or s.first > hi)
+             else keep).append(s)
+        # newest-wins merge: L0 files are newest-first; the lower level
+        # is older than everything above it
+        merged: dict[bytes, tuple[int, bytes]] = {}
+        for s in list(upper) + lower:
+            for ck, tomb, val in s.scan():
+                if ck not in merged:
+                    merged[ck] = (tomb, val)
+        bottom = (ln + 2 >= len(self._levels)
+                  or all(not lvl for lvl in self._levels[ln + 2:]))
+        out_items: list[tuple[bytes, int, bytes]] = []
+        for ck in sorted(merged):
+            tomb, val = merged[ck]
+            if tomb and bottom:
+                continue  # tombstone reached the bottom: drop for real
+            out_items.append((ck, tomb, val))
+        new_ssts: list[_Sst] = []
+        chunk: list[tuple[bytes, int, bytes]] = []
+        size = 0
+        for item in out_items:
+            chunk.append(item)
+            size += len(item[0]) + len(item[2])
+            if size >= self.SST_SPLIT_BYTES:
+                new_ssts.append(_Sst.write(
+                    os.path.join(self._dir, self._next_name()), chunk))
+                chunk, size = [], 0
+        if chunk or not new_ssts:
+            new_ssts.append(_Sst.write(
+                os.path.join(self._dir, self._next_name()), chunk))
+        dead = upper + lower
+        self._levels[ln] = [] if ln > 0 else \
+            [s for s in self._levels[0] if s not in upper]
+        self._levels[ln + 1] = sorted(keep + new_ssts,
+                                      key=lambda s: s.first)
+        self._save_manifest()
+        for s in dead:
+            try:
+                os.remove(s.path)
+            except OSError:
+                pass
+
+    # ------------------------------------------------------- observability
+    def stats(self) -> dict:
+        with self._lock:
+            return {"memtable_bytes": self._mem_size,
+                    "levels": [len(lv) for lv in self._levels],
+                    "files": sum(len(lv) for lv in self._levels)}

@@ -38,6 +38,8 @@ def main(argv=None) -> int:
                     help="cephx-lite shared secret (hex)")
     ap.add_argument("--compress", default="none",
                     help="on-wire compression algorithm")
+    ap.add_argument("--secure", action="store_true",
+                    help="msgr2-secure-mode on-wire encryption")
     args = ap.parse_args(argv)
 
     from ..msg.tcp import TcpNetwork
@@ -49,7 +51,8 @@ def main(argv=None) -> int:
     cfg.apply_dict(json.loads(args.cfg))
     secret = bytes.fromhex(args.auth_secret_hex) \
         if args.auth_secret_hex is not None else None
-    net = TcpNetwork(auth_secret=secret, compress=args.compress)
+    net = TcpNetwork(auth_secret=secret, compress=args.compress,
+                     secure=args.secure)
     net.set_addr(args.mon_name, args.mon_addr)
     store_kw = {"path": args.store_path} if args.store_path else {}
     store = ObjectStore.create(args.store, **store_kw)

@@ -25,18 +25,21 @@ class MiniCluster:
                  admin_dir: str | None = None,
                  metrics_port: int | None = None,
                  tcp_auth_secret: bytes | None = None,
-                 tcp_compress: str = "none"):
+                 tcp_compress: str = "none",
+                 tcp_secure: bool = False):
         self.cfg = cfg or default_config()
         if transport == "tcp":
             from ..msg.tcp import TcpNetwork
             self.network = TcpNetwork(auth_secret=tcp_auth_secret,
-                                      compress=tcp_compress)
+                                      compress=tcp_compress,
+                                      secure=tcp_secure)
         elif transport == "local":
             self.network = LocalNetwork()
         else:
             raise ValueError(f"unknown transport {transport!r}")
         self._tcp_auth_secret = tcp_auth_secret
         self._tcp_compress = tcp_compress
+        self._tcp_secure = tcp_secure
         self.mon_names = [f"mon.{i}" for i in range(n_mons)]
         self.mons: dict[int, MonitorLite] = {}
         self._mon_path = mon_path
@@ -171,6 +174,8 @@ class MiniCluster:
             argv += ["--auth-secret-hex", self._tcp_auth_secret.hex()]
         if self._tcp_compress != "none":
             argv += ["--compress", self._tcp_compress]
+        if self._tcp_secure:
+            argv += ["--secure"]
         # the child must find the package regardless of caller cwd
         pkg_root = os.path.dirname(os.path.dirname(
             os.path.abspath(ceph_tpu.__file__)))

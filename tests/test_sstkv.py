@@ -1,0 +1,172 @@
+"""SstKV leveled LSM backend (ref src/kv/RocksDBStore.cc over RocksDB's
+memtable/L0/leveled-compaction model)."""
+
+import os
+import random
+
+import pytest
+
+from ceph_tpu.osd.kvstore import KVTransaction, MemKV, create_kv
+from ceph_tpu.osd.sstkv import SstKV
+
+
+@pytest.fixture
+def kv(tmp_path):
+    db = SstKV(str(tmp_path / "kv"), memtable_bytes=2048)
+    yield db
+    db.close()
+
+
+def test_basic_put_get_rm(kv):
+    kv.put("p", "a", b"1")
+    kv.put("p", "b", b"2")
+    kv.put("q", "a", b"other")
+    assert kv.get("p", "a") == b"1"
+    assert kv.get("p", "b") == b"2"
+    assert kv.get("q", "a") == b"other"
+    assert kv.get("p", "zz") is None
+    kv.rm("p", "a")
+    assert kv.get("p", "a") is None
+    assert kv.get("q", "a") == b"other"
+
+
+def test_flush_compaction_and_reads_across_levels(kv):
+    # small memtable (2 KiB) forces many flushes and L0 compactions
+    for i in range(400):
+        kv.put("p", f"k{i:04d}", f"v{i}".encode() * 7)
+    assert kv.stats()["files"] > 0
+    for i in range(0, 400, 17):
+        assert kv.get("p", f"k{i:04d}") == f"v{i}".encode() * 7
+    # overwrites win over older levels
+    kv.put("p", "k0005", b"NEW")
+    assert kv.get("p", "k0005") == b"NEW"
+    # tombstones shadow flushed values
+    kv.rm("p", "k0100")
+    assert kv.get("p", "k0100") is None
+    keys = [k for k, _ in kv.iterate("p")]
+    assert "k0100" not in keys and "k0005" in keys
+    assert keys == sorted(keys)
+
+
+def test_iterate_with_start_and_prefix_isolation(kv):
+    for i in range(50):
+        kv.put("a", f"x{i:02d}", b"v")
+        kv.put("b", f"x{i:02d}", b"w")
+    out = list(kv.iterate("a", start="x40"))
+    assert [k for k, _ in out] == [f"x{i}" for i in range(40, 50)]
+    assert all(v == b"v" for _k, v in out)
+
+
+def test_reopen_preserves_state(tmp_path):
+    path = str(tmp_path / "kv")
+    db = SstKV(path, memtable_bytes=1024)
+    for i in range(100):
+        db.put("p", f"k{i:03d}", f"v{i}".encode())
+    db.rm("p", "k050")
+    db.close()
+    db2 = SstKV(path, memtable_bytes=1024)
+    assert db2.get("p", "k007") == b"v7"
+    assert db2.get("p", "k050") is None
+    assert len(list(db2.iterate("p"))) == 99
+    db2.close()
+
+
+def test_crash_replay_memtable_wal(tmp_path):
+    """Keys in the memtable (not yet flushed) survive a crash via the
+    WAL; a torn tail is discarded."""
+    path = str(tmp_path / "kv")
+    db = SstKV(path, memtable_bytes=1 << 20)  # nothing flushes
+    db.put("p", "durable", b"yes")
+    # crash: no close(); reopen replays the WAL
+    db2 = SstKV(path, memtable_bytes=1 << 20)
+    assert db2.get("p", "durable") == b"yes"
+    db2.close()
+    # torn tail: append garbage to the WAL
+    with open(os.path.join(path, "memtable.wal"), "ab") as f:
+        f.write(b"\x99" * 11)
+    db3 = SstKV(path, memtable_bytes=1 << 20)
+    assert db3.get("p", "durable") == b"yes"
+    db3.close()
+
+
+def test_rm_prefix(kv):
+    for i in range(30):
+        kv.put("gone", f"k{i}", b"x")
+        kv.put("keep", f"k{i}", b"y")
+    kv.submit(KVTransaction().rm_prefix("gone"))
+    assert list(kv.iterate("gone")) == []
+    assert len(list(kv.iterate("keep"))) == 30
+
+
+def test_fuzz_against_model(tmp_path):
+    """Random op stream: SstKV must match MemKV exactly, across a
+    mid-stream reopen."""
+    rng = random.Random(7)
+    path = str(tmp_path / "kv")
+    db = SstKV(path, memtable_bytes=512)
+    model = MemKV()
+    keys = [f"k{i:02d}" for i in range(40)]
+    for step in range(1500):
+        op = rng.random()
+        prefix = rng.choice(["p1", "p2"])
+        key = rng.choice(keys)
+        if op < 0.55:
+            val = os.urandom(rng.randrange(1, 40))
+            db.put(prefix, key, val)
+            model.put(prefix, key, val)
+        elif op < 0.8:
+            db.rm(prefix, key)
+            model.rm(prefix, key)
+        else:
+            assert db.get(prefix, key) == model.get(prefix, key)
+        if step == 900:
+            db.close()
+            db = SstKV(path, memtable_bytes=512)
+    for prefix in ("p1", "p2"):
+        assert list(db.iterate(prefix)) == list(model.iterate(prefix))
+    db.close()
+
+
+def test_factory(tmp_path):
+    db = create_kv("sst", str(tmp_path / "f"))
+    db.put("p", "k", b"v")
+    assert db.get("p", "k") == b"v"
+    db.close()
+
+
+def test_bluestore_over_sst(tmp_path):
+    """BlueStore-lite metadata on the LSM tier: write/read/omap survive
+    a remount (the BlueStore-on-RocksDB pairing)."""
+    from ceph_tpu.osd.bluestore import BlueStore
+    from ceph_tpu.osd.objectstore import CollectionId, ObjectId, Transaction
+    st = BlueStore(str(tmp_path / "bs"), kv_backend="sst")
+    st.mount()
+    cid = CollectionId(1, 0)
+    st.queue_transaction(Transaction().create_collection(cid))
+    obj = ObjectId("o")
+    tx = Transaction().touch(cid, obj).write(cid, obj, 0, b"lsm-bytes")
+    tx.omap_setkeys(cid, obj, {"k": b"v"})
+    st.queue_transaction(tx)
+    st.umount()
+    st2 = BlueStore(str(tmp_path / "bs"), kv_backend="sst")
+    st2.mount()
+    assert st2.read(cid, obj).to_bytes() == b"lsm-bytes"
+    assert st2.omap_get(cid, obj) == {"k": b"v"}
+    errors = st2.fsck()
+    assert not errors.get("errors"), errors
+    st2.umount()
+
+
+def test_rm_prefix_in_tx_order(tmp_path):
+    """Ops apply in order within a transaction: a put BEFORE rm_prefix
+    dies with the prefix, a put AFTER survives (MemKV parity)."""
+    db = SstKV(str(tmp_path / "kv"))
+    tx = (KVTransaction().put("p", "early", b"1").rm_prefix("p")
+          .put("p", "late", b"2"))
+    db.submit(tx)
+    assert db.get("p", "early") is None
+    assert db.get("p", "late") == b"2"
+    model = MemKV()
+    model.submit(tx)
+    assert list(db.iterate("p")) == list(model.iterate("p"))
+    db.close()
