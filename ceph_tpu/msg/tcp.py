@@ -63,17 +63,28 @@ class _SessState:
     ProtocolV2 connection cookie + out_queue/replay role): sequenced
     sent frames in a bounded ring, and the last seq received."""
 
-    __slots__ = ("cookie", "send_seq", "recv_seq", "ring")
+    __slots__ = ("cookie", "send_seq", "recv_seq", "ring", "lock")
 
     def __init__(self):
         self.cookie = secrets.token_bytes(16)
         self.send_seq = 0
         self.recv_seq = 0
         self.ring: collections.deque = collections.deque(maxlen=_RING_MAX)
-        # ring holds (seq, flags, plain_payload)
+        # ring holds (seq, flags, plain_payload); ring mutations under
+        # self.lock (the state outlives any one conn)
+        self.lock = threading.Lock()
 
     def ring_floor(self) -> int:
         return self.ring[0][0] if self.ring else self.send_seq + 1
+
+    def ring_drop(self, seq: int) -> None:
+        """Remove one entry (a frame the caller delivered another way —
+        a later resume replay must not deliver it twice)."""
+        with self.lock:
+            for item in list(self.ring):
+                if item[0] == seq:
+                    self.ring.remove(item)
+                    return
 
 
 class _Conn:
@@ -128,37 +139,40 @@ class _Conn:
 
     SENT, DEAD, RINGED = 1, 0, -1
 
-    def send_payload(self, flags: int, plain: bytes) -> int:
+    def send_payload(self, flags: int, plain: bytes) -> tuple[int, int]:
         """Sequence (resume mode), seal, frame, send — atomically, so
-        seq order on the wire matches ring order.  Returns SENT, DEAD
-        (nothing ringed), or RINGED (in the ring but the socket died —
-        a session resume will replay it; the caller must NOT re-send or
-        the peer gets it twice under a fresh seq)."""
+        seq order on the wire matches ring order.  Returns (rc, seq):
+        SENT; DEAD (nothing ringed); or RINGED (seq is in the ring but
+        the socket died — a session resume will replay it; the caller
+        must either trust the replay OR ring_drop(seq) before sending
+        the frame any other way, or the peer gets it twice)."""
         with self.lock:
             if not self.alive:
-                return self.DEAD
-            ringed = False
+                return self.DEAD, 0
+            seq = 0
             if self.state is not None:
-                self.state.send_seq += 1
-                seq = self.state.send_seq
-                self.state.ring.append((seq, flags, plain))
+                with self.state.lock:
+                    self.state.send_seq += 1
+                    seq = self.state.send_seq
+                    self.state.ring.append((seq, flags, plain))
                 plain = struct.pack("<Q", seq) + plain
-                ringed = True
             body = self._seal(plain)
             try:
                 self.sock.sendall(
                     struct.pack("<I", len(body) | flags) + body)
-                return self.SENT
+                return self.SENT, seq
             except OSError:
                 self.alive = False
-                return self.RINGED if ringed else self.DEAD
+                return (self.RINGED if seq else self.DEAD), seq
 
     def replay_from(self, last_recv: int) -> bool:
         """Resend ring entries the peer never saw (resume replay)."""
         with self.lock:
             if not self.alive or self.state is None:
                 return False
-            for seq, flags, plain in list(self.state.ring):
+            with self.state.lock:
+                pending = list(self.state.ring)
+            for seq, flags, plain in pending:
                 if seq <= last_recv:
                     continue
                 body = self._seal(struct.pack("<Q", seq) + plain)
@@ -169,17 +183,6 @@ class _Conn:
                     self.alive = False
                     return False
             return True
-
-    def send_frame(self, frame: bytes) -> bool:
-        with self.lock:
-            if not self.alive:
-                return False
-            try:
-                self.sock.sendall(frame)
-                return True
-            except OSError:
-                self.alive = False
-                return False
 
     def close(self) -> None:
         self.alive = False
@@ -603,7 +606,7 @@ class TcpNetwork(Network):
         conn = self._conn_for(dst)
         if conn is None:
             return False
-        rc = conn.send_payload(flags, payload)
+        rc, seq = conn.send_payload(flags, payload)
         if rc == _Conn.SENT:
             return True
         old_state = conn.state
@@ -616,8 +619,13 @@ class TcpNetwork(Network):
         conn2 = self._conn_for(dst)
         if conn2 is None:
             return False
-        if rc == _Conn.RINGED and conn2.state is old_state:
-            # the frame rode the resume replay — re-sending would
-            # duplicate it under a fresh seq
-            return True
-        return conn2.send_payload(flags, payload) == _Conn.SENT
+        if rc == _Conn.RINGED:
+            if conn2.state is old_state:
+                # the frame rode the resume replay — re-sending would
+                # duplicate it under a fresh seq
+                return True
+            # sending via a DIFFERENT session (e.g. an inbound route):
+            # pull the frame out of the old ring or a later resume of
+            # that session would deliver it a second time
+            old_state.ring_drop(seq)
+        return conn2.send_payload(flags, payload)[0] == _Conn.SENT

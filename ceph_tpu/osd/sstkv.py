@@ -148,8 +148,10 @@ class _Sst:
         os.replace(tmp, path)
         return _Sst(path)
 
-    def scan(self, start_ck: bytes = b""):
-        """Yield (ck, tomb, value) from the first key >= start_ck."""
+    def scan(self, start_ck: bytes = b"", stop_ck: bytes | None = None):
+        """Yield (ck, tomb, value) for start_ck <= ck < stop_ck.  The
+        stop bound matters: a prefix range over a large file must not
+        decode everything past it."""
         if not self.index:
             return
         # binary search the sparse index for the covering block
@@ -169,6 +171,8 @@ class _Sst:
                 ck = f.read(klen)
                 tomb, vlen = struct.unpack("<BI", f.read(5))
                 val = f.read(vlen)
+                if stop_ck is not None and ck >= stop_ck:
+                    return
                 if ck >= start_ck:
                     yield ck, tomb, val
 
@@ -329,15 +333,13 @@ class SstKV(KeyValueDB):
                    if lo <= ck < hi]
             sources.append(mem)
             for sst in self._levels[0]:
-                sources.append([(ck, t, v) for ck, t, v in sst.scan(lo)
-                                if ck < hi])
+                sources.append(list(sst.scan(lo, hi)))
             for level in self._levels[1:]:
                 run: list[tuple[bytes, int, bytes]] = []
                 for sst in level:
                     if sst.last < lo or sst.first >= hi:
                         continue
-                    run.extend((ck, t, v) for ck, t, v in sst.scan(lo)
-                               if ck < hi)
+                    run.extend(sst.scan(lo, hi))
                 sources.append(run)
         # newest-wins merge: earlier sources shadow later ones
         seen: dict[bytes, tuple[int, bytes]] = {}

@@ -16,11 +16,23 @@ The capability slice of the reference MDS core (src/mds/):
   expired lease is reclaimable without a round-trip (session-death
   safety).
 
-Single-active rank here; multi-active subtree partitioning builds on
-this in services/fs.py's widening.  The daemon is an in-process object
-shared by FsClient mounts (the fs.py data path stays client->RADOS,
-exactly the reference's split: metadata through the MDS, file bytes
-never touch it).
+Multi-active: `MdsCluster` runs N ranks over the same pool with
+DIRECTORY-SUBTREE authority partitioning (MDCache subtree map +
+Migrator roles): every op routes to the rank owning the dentry's
+parent directory (longest-prefix match over a durable subtree map),
+`export_subtree` hands a subtree to another rank (caps on the moved
+subtree are revoked; the map update is durable), and `balance()`
+re-exports the hottest subtree off the busiest rank (MDBalancer).
+Because dentry tables live in shared RADOS omap objects, migration is
+an authority transfer — no cache or journal copying, the part of the
+reference's Migrator that exists only because its metadata is cached
+per-MDS.  Cross-rank renames take both ranks' locks in rank order and
+journal in both (apply is idempotent, so dual-journal replay is safe).
+
+The daemons are in-process objects shared by FsClient mounts (the
+fs.py data path stays client->RADOS, exactly the reference's split:
+metadata through the MDS, file bytes never touch it).  MdsCluster
+exposes the same surface as MdsDaemon, so FsClient mounts either.
 """
 
 from __future__ import annotations
@@ -62,6 +74,8 @@ class MdsDaemon:
         self.client = client
         self.pool = pool
         self.rank = rank
+        # per-top-level-prefix op accounting (MDBalancer pop counters)
+        self.dir_ops: dict[str, int] = {}
         self._lock = threading.RLock()
         self._sessions: dict[str, _Session] = {}
         # path -> {client_id: (caps "r"/"rw", expires_at)}
@@ -102,9 +116,15 @@ class MdsDaemon:
             replayed += 1
         return replayed
 
+    def _account(self, path: str) -> None:
+        top = "/" + _norm(path).split("/", 2)[1] if _norm(path) != "/" \
+            else "/"
+        self.dir_ops[top] = self.dir_ops.get(top, 0) + 1
+
     def submit(self, op: dict) -> None:
         """Journal, then apply, then advance the applied mark — the
         EMetaBlob submit_entry/flush contract (durability before ack)."""
+        self._account(op.get("path") or op.get("src") or "/")
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -354,3 +374,196 @@ class MdsDaemon:
         with self._lock:
             return {cid: caps for cid, (caps, _e)
                     in self._caps.get(_norm(path), {}).items()}
+
+
+_SUBTREE_OID = "mds_subtreemap"
+
+
+class _OrderedLocks:
+    """Acquire a fixed list of locks in order; release in reverse."""
+
+    def __init__(self, locks):
+        self._locks = locks
+
+    def __enter__(self):
+        for lk in self._locks:
+            lk.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        for lk in reversed(self._locks):
+            lk.release()
+
+
+class MdsCluster:
+    """N active ranks with subtree authority partitioning (see module
+    docstring).  Drop-in for MdsDaemon in FsClient."""
+
+    def __init__(self, client: RadosClient, pool: str, n_ranks: int = 2):
+        self.client = client
+        self.pool = pool
+        self.ranks = [MdsDaemon(client, pool, rank=i)
+                      for i in range(n_ranks)]
+        self._maplock = threading.RLock()
+        try:
+            raw = client.omap_get(pool, _SUBTREE_OID)
+            self._map = {k: int(unpack_value(v)) for k, v in raw.items()}
+        except RadosError:
+            self._map = {}
+        if "/" not in self._map:
+            self._map["/"] = 0
+            self._save_map()
+
+    def _save_map(self) -> None:
+        self.client.omap_set(self.pool, _SUBTREE_OID,
+                             {k: pack_value(v)
+                              for k, v in self._map.items()})
+
+    # ------------------------------------------------------- authority map
+    def authority_rank(self, dirpath: str) -> int:
+        """Longest-prefix subtree match for a DIRECTORY path (the
+        MDCache subtree map get_subtree_root walk)."""
+        dirpath = _norm(dirpath)
+        with self._maplock:
+            best, best_len = 0, -1
+            for root, rank in self._map.items():
+                if (dirpath == root or root == "/"
+                        or dirpath.startswith(root + "/")):
+                    if len(root) > best_len:
+                        best, best_len = rank, len(root)
+            return best
+
+    def _dir_auth(self, dirpath: str) -> MdsDaemon:
+        return self.ranks[self.authority_rank(dirpath)]
+
+    def _entry_auth(self, path: str) -> MdsDaemon:
+        """Ops on a dentry route to its PARENT directory's authority
+        (the dentry lives in the parent's table, as in the MDS)."""
+        path = _norm(path)
+        if path == "/":
+            return self.ranks[0]
+        return self._dir_auth(posixpath.split(path)[0])
+
+    # ------------------------------------------- export/import + balancer
+    def export_subtree(self, path: str, to_rank: int) -> None:
+        """Hand authority for `path` (a directory) to another rank
+        (Migrator export_dir): revoke caps under the subtree at the old
+        authority, then commit the durable map update."""
+        path = _norm(path)
+        if not 0 <= to_rank < len(self.ranks):
+            raise FsError(-22, f"no such rank {to_rank}")
+        old = self._dir_auth(path)
+        if old.lookup(path)["type"] != "dir":
+            raise FsError(-20, f"{path!r} is not a directory")
+        old._revoke_subtree(path, exclude=None)
+        with self._maplock:
+            self._map[path] = to_rank
+            self._save_map()
+        # the moved subtree's heat moves WITH it: stale counters on the
+        # old rank would keep it looking busy forever
+        top = "/" + path.split("/", 2)[1] if path != "/" else "/"
+        heat = old.dir_ops.pop(top, 0)
+        dst = self.ranks[to_rank]
+        dst.dir_ops[top] = dst.dir_ops.get(top, 0) + heat
+
+    def balance(self) -> dict | None:
+        """One MDBalancer pass: move the hottest exportable subtree off
+        the busiest rank to the least busy.  Returns the move made.
+        Counters HALVE each pass (the balancer's decaying load
+        average), so one historical burst cannot drive moves forever."""
+        for r in self.ranks:
+            for k in list(r.dir_ops):
+                r.dir_ops[k] //= 2
+                if not r.dir_ops[k]:
+                    del r.dir_ops[k]
+        loads = [sum(r.dir_ops.values()) for r in self.ranks]
+        src = max(range(len(self.ranks)), key=lambda i: loads[i])
+        dst = min(range(len(self.ranks)), key=lambda i: loads[i])
+        if src == dst or loads[src] == 0:
+            return None
+        candidates = sorted(self.ranks[src].dir_ops.items(),
+                            key=lambda kv: -kv[1])
+        for top, _heat in candidates:
+            if top != "/" and self.authority_rank(top) == src:
+                try:
+                    self.export_subtree(top, dst)
+                except FsError:
+                    continue
+                return {"subtree": top, "from": src, "to": dst}
+        return None
+
+    # --------------------------------------- MdsDaemon-compatible surface
+    def register_session(self, client_id: str, revoke_cb) -> None:
+        for r in self.ranks:
+            r.register_session(client_id, revoke_cb)
+
+    def unregister_session(self, client_id: str) -> None:
+        for r in self.ranks:
+            r.unregister_session(client_id)
+
+    def lookup(self, path: str) -> dict:
+        return self._entry_auth(path).lookup(path)
+
+    def entries(self, dirpath: str) -> dict:
+        return self._dir_auth(dirpath).entries(dirpath)
+
+    def mkdir(self, path: str) -> None:
+        self._entry_auth(path).mkdir(path)
+
+    def rmdir(self, path: str) -> None:
+        self._entry_auth(path).rmdir(path)
+
+    def create(self, path: str) -> dict:
+        return self._entry_auth(path).create(path)
+
+    def set_entry(self, path: str, ent: dict) -> None:
+        self._entry_auth(path).set_entry(path, ent)
+
+    def rm_entry(self, path: str) -> None:
+        self._entry_auth(path).rm_entry(path)
+
+    def open(self, client_id: str, path: str, mode: str) -> dict:
+        auth = self._entry_auth(path)
+        auth._account(path)
+        return auth.open(client_id, path, mode)
+
+    def release(self, client_id: str, path: str) -> None:
+        self._entry_auth(path).release(client_id, path)
+
+    def invalidate(self, path: str, exclude: str | None = None) -> None:
+        self._entry_auth(path).invalidate(path, exclude)
+
+    # FsFile io paths take mds._lock BEFORE handle._lock (fs.py lock
+    # order).  For a cluster that must mean the RANK locks — in rank
+    # order — so the global order (rank0 < rank1 < ... < handles) holds
+    # for every path: rank.open takes (one rank, handle), flushes take
+    # (all ranks in order, handle), cross-rank rename takes (two ranks
+    # in order, handles via revoke).  No cycle exists.
+    @property
+    def _lock(self):
+        return _OrderedLocks([r._lock for r in self.ranks])
+
+    def rename(self, src: str, dst: str) -> None:
+        """Same-rank renames delegate; cross-rank renames take both
+        ranks' locks in RANK ORDER (no ABBA between two renames) and
+        journal the op in both ranks — apply is idempotent, so each
+        rank's replay converges (the slave-request rename role)."""
+        src, dst = _norm(src), _norm(dst)
+        a, b = self._entry_auth(src), self._entry_auth(dst)
+        if a is b:
+            a.rename(src, dst)
+            return
+        if dst == src or dst.startswith(src + "/"):
+            raise FsError(-22,
+                          f"cannot move {src!r} into itself ({dst!r})")
+        first, second = sorted((a, b), key=lambda r: r.rank)
+        with first._lock, second._lock:
+            ent = a.lookup(src)
+            parent, name = posixpath.split(dst)
+            if name in b.entries(parent):
+                raise FsError(-17, f"{dst!r} exists")
+            a._revoke_subtree(src, exclude=None)
+            b._revoke_subtree(src, exclude=None)
+            op = {"op": "rename", "src": src, "dst": dst, "ent": ent}
+            a.submit(op)
+            b.submit(op)  # idempotent re-apply; journals both replays

@@ -22,7 +22,14 @@ cls_rgw omap on index objects, object data striped over RADOS):
   ListParts, ListMultipartUploads; completed-object reads (incl.
   Range) stitch across the manifest.
 
-Versioning and multisite sync are planned.
+- multisite: every mutation appends to a per-bucket replication log
+  (the cls_rgw bilog role) stamped with its ORIGIN zone; the
+  /admin/bilog endpoint exposes the log tail, and services/
+  multisite.py's ZoneSyncAgent tails a peer zone and applies changes —
+  active-active safe (entries originated by the applying zone are
+  skipped, so changes never ping-pong).
+
+Versioning is planned.
 """
 
 from __future__ import annotations
@@ -46,6 +53,7 @@ _INDEX_OID = "rgw_index.{bucket}"
 _DATA_PREFIX = "rgw_data.{bucket}.{key}"
 _UPLOADS_OID = "rgw_uploads.{bucket}"
 _PART_PREFIX = "rgw_mp.{bucket}.{upload}.{part:05d}"
+_BILOG_OID = "rgw_bilog.{bucket}"
 
 
 class RgwGateway:
@@ -53,12 +61,17 @@ class RgwGateway:
 
     def __init__(self, client: RadosClient, pool: str,
                  host: str = "127.0.0.1", port: int = 0,
-                 users: dict[str, str] | None = None):
+                 users: dict[str, str] | None = None,
+                 zone: str = "default"):
         """users: access_key -> secret_key registry (RGWUserInfo role);
-        None = anonymous gateway (no auth enforced)."""
+        None = anonymous gateway (no auth enforced).  zone names this
+        gateway's multisite zone (bilog origin stamping)."""
         self.client = client
         self.pool = pool
         self.users = dict(users) if users is not None else None
+        self.zone = zone
+        self._bilog_lock = threading.Lock()
+        self._bilog_seq: dict[str, int] = {}
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -125,7 +138,16 @@ class RgwGateway:
                 bucket, key, query = self._route()
                 qs = self._qs(query)
                 try:
-                    if bucket is None:
+                    if bucket == "admin" and key == "bilog":
+                        # multisite: the bucket-index log tail (the
+                        # radosgw-admin bilog list / datalog role)
+                        import json as _json
+                        entries = gw.bilog_since(
+                            qs.get("bucket", ""),
+                            int(qs.get("marker", 0)))
+                        self._send(200, _json.dumps(entries).encode(),
+                                   ctype="application/json")
+                    elif bucket is None:
                         self._send(200, gw.list_buckets_xml())
                     elif key is None and "uploads" in qs:
                         self._send(200, gw.list_uploads_xml(bucket))
@@ -325,16 +347,67 @@ class RgwGateway:
             FileLayout(stripe_unit=65536, stripe_count=4,
                        object_size=1 << 22))
 
-    def put_object(self, bucket: str, key: str, body: bytes) -> str:
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   origin: str | None = None,
+                   mtime: float | None = None) -> str:
+        """origin: the zone whose client caused this change (multisite
+        sync applies peer changes with the PEER's zone so they are not
+        replicated back — the no-ping-pong rule).  mtime: preserve the
+        ORIGIN's timestamp on replicated applies, or LWW comparisons
+        against later origin entries would judge them stale."""
         self.check_bucket(bucket)
         self._drop_object_data(bucket, key)  # replace semantics
         so = self._striped(bucket, key)
         if body:
             so.write(0, body)
         etag = hashlib.md5(body).hexdigest()
+        mtime = time.time() if mtime is None else float(mtime)
         self._index_set(bucket, key, {"size": len(body), "etag": etag,
-                                      "mtime": time.time()})
+                                      "mtime": mtime})
+        self._bilog_append(bucket, {"op": "put", "key": key,
+                                    "etag": etag, "mtime": mtime,
+                                    "zone": origin or self.zone})
         return etag
+
+    # ----------------------------------------------------- multisite bilog
+    _BILOG_KEEP = 10_000
+
+    def _bilog_append(self, bucket: str, entry: dict) -> None:
+        with self._bilog_lock:
+            seq = self._bilog_seq.get(bucket)
+            if seq is None:
+                seq = max((int(k) for k in self._bilog_raw(bucket)),
+                          default=0)
+            seq += 1
+            self._bilog_seq[bucket] = seq
+            self.client.omap_set(
+                self.pool, _BILOG_OID.format(bucket=bucket),
+                {f"{seq:016d}": pack_value(dict(entry, seq=seq))})
+            if seq % 512 == 0:  # trim the tail so the log stays bounded
+                dead = [k for k in self._bilog_raw(bucket)
+                        if int(k) <= seq - self._BILOG_KEEP]
+                if dead:
+                    self.client.omap_rm(
+                        self.pool, _BILOG_OID.format(bucket=bucket),
+                        dead)
+
+    def _bilog_raw(self, bucket: str) -> dict:
+        try:
+            return self.client.omap_get(
+                self.pool, _BILOG_OID.format(bucket=bucket))
+        except RadosError:
+            return {}
+
+    def bilog_since(self, bucket: str, marker: int,
+                    limit: int = 1000) -> list[dict]:
+        raw = self._bilog_raw(bucket)
+        out = []
+        for k in sorted(raw):
+            if int(k) > marker:
+                out.append(unpack_value(raw[k]))
+                if len(out) >= limit:
+                    break
+        return out
 
     def _drop_object_data(self, bucket: str, key: str) -> None:
         """Remove whatever backs the current head: the plain striped
@@ -416,10 +489,14 @@ class RgwGateway:
         # S3 multipart etag convention: md5 of the part digests, -N
         etag = f"{hashlib.md5(digests).hexdigest()}-{len(manifest)}"
         self._drop_object_data(bucket, key)  # replace any old head
+        mtime = time.time()
         self._index_set(bucket, key,
                         {"size": total, "etag": etag,
-                         "mtime": time.time(), "parts": manifest,
+                         "mtime": mtime, "parts": manifest,
                          "upload": upload_id})
+        self._bilog_append(bucket, {"op": "put", "key": key,
+                                    "etag": etag, "mtime": mtime,
+                                    "zone": self.zone})
         # retire the session; uploaded-but-unlisted parts are garbage
         for n in stored:
             if n not in {p[0] for p in manifest}:
@@ -527,7 +604,11 @@ class RgwGateway:
         return self._read_extent(bucket, key, meta, 0,
                                  meta["size"]), meta, 200
 
-    def delete_object(self, bucket: str, key: str) -> None:
+    def delete_object(self, bucket: str, key: str,
+                      origin: str | None = None) -> None:
         self.head_object(bucket, key)
         self._drop_object_data(bucket, key)
         self._index_rm(bucket, key)
+        self._bilog_append(bucket, {"op": "delete", "key": key,
+                                    "etag": "", "mtime": time.time(),
+                                    "zone": origin or self.zone})
