@@ -89,6 +89,7 @@ class _PendingRead:
     attrs: dict = field(default_factory=dict)   # merged shard attrs (len/v)
     shard_vers: dict = field(default_factory=dict)  # shard -> version attr
     shard_attrs: dict = field(default_factory=dict)  # shard -> its attrs
+    omaps: dict = field(default_factory=dict)  # shard -> replicated omap
     replies: int = 0
     offset: int = 0
     length: int = 0
@@ -1387,7 +1388,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         obj = ObjectId(oid, shard=shard)
         try:
             data = self._read_shard_slices(cid, obj, extents)
-            attrs = self.store.getattrs(cid, obj)
+            attrs = dict(self.store.getattrs(cid, obj))
+            if extents is None:  # recovery read: omap rides along
+                omap = self.store.omap_get(cid, obj)
+                if omap:
+                    attrs["_omap"] = omap
             result = 0
         except NoSuchObject:
             data, attrs, result = b"", {}, ENOENT
@@ -1403,7 +1408,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         obj = ObjectId(m.oid, shard=m.shard)
         try:
             data = self._read_shard_slices(cid, obj, m.extents)
-            attrs = self.store.getattrs(cid, obj)
+            attrs = dict(self.store.getattrs(cid, obj))
+            # whole-shard reads serve recovery: the object's replicated
+            # omap rides along so a rebuilt shard lands WITH metadata
+            # (ECOmapJournal recovery contract); ranged client reads
+            # skip it
+            if m.extents is None:
+                omap = self.store.omap_get(cid, obj)
+                if omap:
+                    attrs["_omap"] = omap
             conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
                                     self.osd_id, 0, data, attrs))
         except NoSuchObject:
@@ -1425,6 +1438,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if result == 0:
                 pr.chunks[shard] = np.frombuffer(data, dtype=np.uint8)
                 if attrs:
+                    attrs = dict(attrs)
+                    omap = attrs.pop("_omap", None)
+                    if omap is not None:
+                        pr.omaps[shard] = omap
                     pr.attrs.update(attrs)
                     pr.shard_attrs[shard] = dict(attrs)
                     if "v" in attrs:
@@ -1655,16 +1672,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         elif m.op in ("omap_set", "omap_rm"):
             from ..msg.wire import unpack_value
             self._apply_omap(m.pgid, m.oid, m.op, unpack_value(m.data),
-                             m.version, create_ok=True)
+                             m.version, create_ok=True, shard=m.shard)
         elif m.op == "cls_effects":
             from ..msg.wire import unpack_value
             self._apply_cls_effects(m.pgid, m.oid, unpack_value(m.data),
-                                    m.version)
+                                    m.version, shard=m.shard)
         elif m.op == "multi_effects":
             from ..msg.wire import unpack_value
             self._apply_multi_effects(m.pgid, m.oid,
                                       unpack_value(m.data), m.version,
-                                      pre_tx=pre_tx)
+                                      pre_tx=pre_tx, shard=m.shard)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0), m.version)
         conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
@@ -2539,11 +2556,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 return
             total = self._ec_total_len(pr)
             self.perf.inc("recovery_push")
+            omap = pr.omaps.get(shard)
+            extra = (self._push_attrs(pr.shard_attrs[shard])
+                     if shard in pr.shard_attrs else {})
             self.messenger.send_message(
                 f"osd.{dst}",
                 MPGPush(pgid, shard,
                         {name: (version, pr.chunks[shard].tobytes(),
-                                total)}))
+                                total, omap, extra)}))
 
         pr = _PendingRead(None, 0, pgid.pool, name, total_shards=1,
                           on_done=on_done)
@@ -2599,10 +2619,22 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 rebuilt = out[shard]
             total = self._ec_total_len(pr)
             self.perf.inc("recovery_push")
+            # metadata travels with the rebuild — from a SURVIVING
+            # shard's reply when available (the pushing primary's own
+            # copy may itself be the one missing)
+            omap, extra = self._ec_meta_for(pgid, name)
+            for s in chunks:
+                if s in pr.omaps:
+                    omap = pr.omaps[s]
+                    break
+            src = next((s for s in chunks if s in pr.shard_attrs), None)
+            if src is not None:
+                extra = self._push_attrs(pr.shard_attrs[src])
             self.messenger.send_message(
                 f"osd.{peer}",
                 MPGPush(pgid, shard,
-                        {name: (push_version, rebuilt.tobytes(), total)},
+                        {name: (push_version, rebuilt.tobytes(), total,
+                                omap, extra)},
                         force=force))
 
         pr = _PendingRead(None, 0, pgid.pool, name,
@@ -2612,6 +2644,21 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._pending_reads[tid] = pr
         fan_up = [None if u == peer else u for u in up]
         self._fan_shard_reads(tid, pgid, name, fan_up)
+
+    def _ec_meta_for(self, pgid: PgId, name: str):
+        """(omap, user attrs) from MY shard copy of an EC object —
+        rides recovery pushes so rebuilt shards carry the replicated
+        metadata (the ECOmapJournal recovery contract)."""
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        myshard = up.index(self.osd_id) if self.osd_id in up else 0
+        cid = CollectionId(pgid.pool, pgid.seed)
+        obj = to_oid(name, myshard)
+        try:
+            omap = self.store.omap_get(cid, obj)
+            extra = self._push_attrs(self.store.getattrs(cid, obj))
+        except NoSuchObject:
+            return None, {}
+        return (omap or None), extra
 
     def _push_attrs(self, attrs: dict) -> dict:
         """Attrs worth carrying on a recovery push: everything the apply
@@ -2652,11 +2699,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 except NoSuchObject:
                     pass
             if m.shard >= 0:
-                version, data, total = payload
+                version, data, total = payload[0], payload[1], payload[2]
                 attrs = {"v": version}
                 if total is not None:
                     attrs["len"] = total
-                self._apply_write(m.pgid, name, m.shard, data, attrs)
+                if len(payload) > 4 and payload[4]:
+                    attrs.update(payload[4])  # user attrs ride along
+                self._apply_write(m.pgid, name, m.shard, data, attrs,
+                                  omap=payload[3]
+                                  if len(payload) > 3 else None)
             else:
                 version, data = payload[0], payload[1]
                 omap = payload[3] if len(payload) > 3 else None

@@ -3,10 +3,17 @@
 The PrimaryLogPG op breadth beyond read/write/remove/stat (ref
 PrimaryLogPG::do_osd_ops op-switch :6163 — omap get/set/rm ops,
 watch/notify via src/osd/Watch.cc, `call` into object classes), as a
-mixin on OSDDaemon.  Replicated pools only this round: EC omap needs
-the ECOmapJournal tier (planned); watch/notify state is primary-local
-soft state and clients re-register on map change, the reference's
-linger-op semantic.
+mixin on OSDDaemon.
+
+EC pools: omap and user xattrs are supported via full replication to
+EVERY shard holder's shard object (the ECOmapJournal capability,
+doc/dev/osd_internals/erasure_coding: metadata rides the same
+versioned, journaled, rollback-able path as shard data and survives
+any k-of-n subset; recovery pushes carry it).  Data-mutating steps in
+compound ops and data-mutating cls effects stay EINVAL on EC (they
+belong to the stripe pipeline); watch/notify is primary-local soft
+state (clients re-register on map change, the linger-op semantic) and
+works on either pool kind.
 """
 
 from __future__ import annotations
@@ -53,6 +60,32 @@ class ObjOpsMixin:
         self._watchers: dict[tuple, dict[str, tuple]] = {}
         self._pending_notifies: dict[int, _PendingNotify] = {}
 
+    # ------------------------------------------------------ shard routing
+    def _is_ec(self, pgid: PgId) -> bool:
+        return self.osdmap.pools[pgid.pool].kind == "ec"
+
+    def _my_shard(self, pgid: PgId) -> int:
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        return up.index(self.osd_id) if self.osd_id in up else 0
+
+    def _local_obj(self, pgid: PgId, oid: str) -> ObjectId:
+        """The store object this OSD holds for `oid`: plain on
+        replicated pools, MY shard object on EC (metadata replicates to
+        every shard holder — the ECOmapJournal durability model)."""
+        if self._is_ec(pgid):
+            return ObjectId(oid, shard=self._my_shard(pgid))
+        return ObjectId(oid)
+
+    def _meta_fanout(self, pgid: PgId, up: list) -> list[tuple[int, int]]:
+        """(peer_osd, shard_for_peer) for a metadata mutation: every
+        shard holder on EC, every replica on replicated pools."""
+        out = []
+        for pos, osd in enumerate(up):
+            if osd is None or osd == self.osd_id:
+                continue
+            out.append((osd, pos if self._is_ec(pgid) else -1))
+        return out
+
     # ---------------------------------------------------------- dispatch
     EXTENDED_OPS = ("omap_get", "omap_set", "omap_rm", "watch",
                     "unwatch", "notify", "call", "list_snaps",
@@ -61,8 +94,8 @@ class ObjOpsMixin:
 
     def _handle_extended_op(self, conn, m, pgid: PgId, up: list) -> None:
         pool = self.osdmap.pools[m.pool]
-        if pool.kind == "ec":
-            # EC omap/watch/cls need the ECOmapJournal tier (planned)
+        if pool.kind == "ec" and m.op in ("list_snaps", "snap_rollback"):
+            # self-managed snapshots are replicated-pool machinery
             conn.send(MOSDOpReply(m.tid, EINVAL,
                                   epoch=self.osdmap.epoch))
             return
@@ -86,7 +119,7 @@ class ObjOpsMixin:
     def _op_omap_get(self, conn, m, pgid: PgId, up: list) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         try:
-            omap = self.store.omap_get(cid, ObjectId(m.oid))
+            omap = self.store.omap_get(cid, self._local_obj(pgid, m.oid))
         except NoSuchObject:
             conn.send(MOSDOpReply(m.tid, ENOENT,
                                   epoch=self.osdmap.epoch))
@@ -96,33 +129,38 @@ class ObjOpsMixin:
 
     def _op_omap_mut(self, conn, m, pgid: PgId, up: list) -> None:
         """omap_set (data = packed {key: bytes}) / omap_rm (data =
-        packed [keys]); replicated like any write."""
+        packed [keys]); replicated like any write — on EC, to every
+        shard holder's shard object."""
         payload = _unpack(m.data)
         version = self._next_version(pgid)
+        my_shard = self._my_shard(pgid) if self._is_ec(pgid) else -1
         if not self._apply_omap(pgid, m.oid, m.op, payload, version,
-                                create_ok=(m.op == "omap_set")):
+                                create_ok=(m.op == "omap_set"),
+                                shard=my_shard):
             conn.send(MOSDOpReply(m.tid, ENOENT,
                                   epoch=self.osdmap.epoch))
             return
-        peers = [u for u in up if u is not None and u != self.osd_id]
-        if not peers:
+        fanout = self._meta_fanout(pgid, up)
+        if not fanout:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
             return
         tid = next(self._tids)
         from .daemon import _PendingWrite
         self._pending_writes[tid] = _PendingWrite(
-            m.client, m.tid, len(peers), version)
-        for peer in peers:
+            m.client, m.tid, len(fanout), version)
+        for peer, shard in fanout:
             self.messenger.send_message(
                 f"osd.{peer}",
-                MSubWrite(tid, pgid, m.oid, -1, version, m.op, m.data))
+                MSubWrite(tid, pgid, m.oid, shard, version, m.op,
+                          m.data))
 
     def _apply_omap(self, pgid: PgId, oid: str, op: str, payload,
-                    version: int, create_ok: bool = False) -> bool:
+                    version: int, create_ok: bool = False,
+                    shard: int = -1) -> bool:
         from .pglog import LogEntry
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid)
+        obj = ObjectId(oid, shard=shard)
         tx = Transaction()
         exists = self.store.exists(cid, obj)
         if not exists:
@@ -137,11 +175,18 @@ class ObjOpsMixin:
             have = set(self.store.omap_get(cid, obj))
             tx.omap_rmkeys(cid, obj, [k for k in keys if k in have])
         data = self.store.read(cid, obj).to_bytes() if exists else b""
-        tx.setattrs(cid, obj, {"v": version, "d": _crc32c(data),
-                               "len": len(data)})
+        attrs = {"v": version, "d": _crc32c(data)}
+        if shard >= 0 and exists:
+            # EC shard convention: "len" holds the TOTAL object length
+            # (set by the stripe write path) — preserve it
+            old_len = self.store.getattrs(cid, obj).get("len")
+            attrs["len"] = old_len if old_len is not None else len(data)
+        else:
+            attrs["len"] = len(data)
+        tx.setattrs(cid, obj, attrs)
         # every versioned mutation logs (last-complete must stay
         # contiguous; delta recovery replays the object WITH its omap)
-        self._log_apply(tx, pgid, LogEntry(version, "omap", oid, -1,
+        self._log_apply(tx, pgid, LogEntry(version, "omap", oid, shard,
                                            prev_version=-1))
         self.store.queue_transaction(tx)
         return True
@@ -214,12 +259,18 @@ class ObjOpsMixin:
     def _op_call(self, conn, m, pgid: PgId, up: list) -> None:
         """`call cls.method(input)`: run the class method against the
         object, then apply its queued effects through the replicated
-        write path (ClassHandler + do_osd_ops `call`)."""
+        write path (ClassHandler + do_osd_ops `call`).  On EC pools the
+        method sees omap/xattr state but NOT assembled stripe data
+        (ctx.data is empty), and data-mutating effects are rejected —
+        the built-in metadata classes (cls_lock, cls_version) are
+        exactly this shape."""
         req = _unpack(m.data)
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(m.oid)
+        is_ec = self._is_ec(pgid)
+        obj = self._local_obj(pgid, m.oid)
         exists = self.store.exists(cid, obj)
-        data = self.store.read(cid, obj).to_bytes() if exists else b""
+        data = (self.store.read(cid, obj).to_bytes()
+                if exists and not is_ec else b"")
         omap = self.store.omap_get(cid, obj) if exists else {}
         ctx = cls_mod.ClsContext(data, omap, exists)
         try:
@@ -234,6 +285,11 @@ class ObjOpsMixin:
             conn.send(MOSDOpReply(m.tid, EIO, data=_pack(repr(e)),
                                   epoch=self.osdmap.epoch))
             return
+        if is_ec and ctx.new_data is not None:
+            conn.send(MOSDOpReply(m.tid, EINVAL,
+                                  data=_pack("cls data write on EC"),
+                                  epoch=self.osdmap.epoch))
+            return
         mutated = (ctx.new_data is not None or ctx.omap_set
                    or ctx.omap_rm)
         if not mutated:
@@ -243,29 +299,31 @@ class ObjOpsMixin:
         version = self._next_version(pgid)
         effects = {"data": ctx.new_data, "set": dict(ctx.omap_set),
                    "rm": sorted(ctx.omap_rm)}
-        self._apply_cls_effects(pgid, m.oid, effects, version)
-        peers = [u for u in up if u is not None and u != self.osd_id]
-        if not peers:
+        self._apply_cls_effects(pgid, m.oid, effects, version,
+                                shard=self._my_shard(pgid) if is_ec
+                                else -1)
+        fanout = self._meta_fanout(pgid, up)
+        if not fanout:
             conn.send(MOSDOpReply(m.tid, 0, data=_pack(out),
                                   version=version,
                                   epoch=self.osdmap.epoch))
             return
         tid = next(self._tids)
         from .daemon import _PendingWrite
-        pw = _PendingWrite(m.client, m.tid, len(peers), version)
+        pw = _PendingWrite(m.client, m.tid, len(fanout), version)
         pw.reply_data = _pack(out)
         self._pending_writes[tid] = pw
-        for peer in peers:
+        for peer, shard in fanout:
             self.messenger.send_message(
                 f"osd.{peer}",
-                MSubWrite(tid, pgid, m.oid, -1, version, "cls_effects",
-                          _pack(effects)))
+                MSubWrite(tid, pgid, m.oid, shard, version,
+                          "cls_effects", _pack(effects)))
 
     def _apply_cls_effects(self, pgid: PgId, oid: str, effects: dict,
-                           version: int) -> None:
+                           version: int, shard: int = -1) -> None:
         from .pglog import LogEntry
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid)
+        obj = ObjectId(oid, shard=shard)
         tx = Transaction()
         exists = self.store.exists(cid, obj)
         if not exists:
@@ -286,9 +344,14 @@ class ObjOpsMixin:
                            [k for k in effects["rm"] if k in have])
         # digest/len must track the NEW content or deep scrub flags a
         # phantom mismatch and stat() reports the stale length
-        tx.setattrs(cid, obj, {"v": version, "d": _crc32c(data),
-                               "len": len(data)})
-        self._log_apply(tx, pgid, LogEntry(version, "cls", oid, -1,
+        attrs = {"v": version, "d": _crc32c(data)}
+        if shard >= 0 and exists and effects.get("data") is None:
+            old_len = self.store.getattrs(cid, obj).get("len")
+            attrs["len"] = old_len if old_len is not None else len(data)
+        else:
+            attrs["len"] = len(data)
+        tx.setattrs(cid, obj, attrs)
+        self._log_apply(tx, pgid, LogEntry(version, "cls", oid, shard,
                                            prev_version=-1))
         self.store.queue_transaction(tx)
 
@@ -296,7 +359,7 @@ class ObjOpsMixin:
     def _op_getxattrs(self, conn, m, pgid: PgId, up: list) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         try:
-            attrs = self.store.getattrs(cid, ObjectId(m.oid))
+            attrs = self.store.getattrs(cid, self._local_obj(pgid, m.oid))
         except NoSuchObject:
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
             return
@@ -316,7 +379,14 @@ class ObjOpsMixin:
     def _op_multi_read(self, conn, m, pgid: PgId, up: list) -> None:
         steps = _unpack(m.data)
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(m.oid)
+        is_ec = self._is_ec(pgid)
+        obj = self._local_obj(pgid, m.oid)
+        if is_ec and any(st.get("op") == "read" for st in steps):
+            # stripe data reads belong to the EC read pipeline (use the
+            # plain read op); metadata steps are served here
+            conn.send(MOSDOpReply(m.tid, EINVAL,
+                                  epoch=self.osdmap.epoch))
+            return
         exists = (self.store.exists(cid, obj)
                   and not self._head_whiteout(cid, m.oid))
         data: bytes | None = None  # loaded on the first step that needs it
@@ -386,7 +456,17 @@ class ObjOpsMixin:
         or release it here."""
         steps = _unpack(m.data)
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(m.oid)
+        is_ec = self._is_ec(pgid)
+        obj = self._local_obj(pgid, m.oid)
+        if is_ec and any(st.get("op") in ("write_full", "write",
+                                          "append", "truncate", "zero",
+                                          "remove")
+                         for st in steps):
+            # stripe data mutations belong to the EC write pipeline
+            conn.send(MOSDOpReply(m.tid, EINVAL,
+                                  epoch=self.osdmap.epoch))
+            self._obj_unlock(key)
+            return
         present = self.store.exists(cid, obj)
         attrs = self.store.getattrs(cid, obj) if present else {}
         was_whiteout = present and bool(attrs.get("wh"))
@@ -526,29 +606,33 @@ class ObjOpsMixin:
 
         version = self._next_version(pgid)
         self._apply_multi_effects(pgid, m.oid, eff, version,
-                                  pre_tx=snap_tx)
+                                  pre_tx=snap_tx,
+                                  shard=self._my_shard(pgid) if is_ec
+                                  else -1)
         up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
-        peers = [u for u in up if u is not None and u != self.osd_id]
-        if not peers:
+        fanout = self._meta_fanout(pgid, up)
+        if not fanout:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
             self._obj_unlock(key)
             return
         tid = next(self._tids)
         from .daemon import _PendingWrite
-        pw = _PendingWrite(m.client, m.tid, len(peers), version)
+        pw = _PendingWrite(m.client, m.tid, len(fanout), version)
         pw.lock_key = key
         self._pending_writes[tid] = pw
         payload = _pack(eff)
         sub_attrs = {"_snap": rider} if rider is not None else {}
-        for peer in peers:
+        for peer, shard in fanout:
             self.messenger.send_message(
                 f"osd.{peer}",
-                MSubWrite(tid, pgid, m.oid, -1, version, "multi_effects",
-                          payload, attrs=dict(sub_attrs)))
+                MSubWrite(tid, pgid, m.oid, shard, version,
+                          "multi_effects", payload,
+                          attrs=dict(sub_attrs)))
 
     def _apply_multi_effects(self, pgid: PgId, oid: str, eff: dict,
-                             version: int, pre_tx=None) -> None:
+                             version: int, pre_tx=None,
+                             shard: int = -1) -> None:
         """Apply one compound-write effects record in ONE transaction
         (primary and replicas run the identical code; pre_tx carries the
         staged clone-on-write from _snap_prepare / the replica rider)."""
@@ -557,10 +641,10 @@ class ObjOpsMixin:
             self._apply_whiteout(pgid, oid, version, pre_tx=pre_tx)
             return
         if eff.get("remove"):
-            self._apply_remove(pgid, oid, -1, version)
+            self._apply_remove(pgid, oid, shard, version)
             return
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid)
+        obj = ObjectId(oid, shard=shard)
         tx = pre_tx if pre_tx is not None else Transaction()
         exists = self.store.exists(cid, obj)
         if not exists:
@@ -600,6 +684,6 @@ class ObjOpsMixin:
                 k = _XATTR_PREFIX + str(name)
                 if k in have and k not in newattrs:
                     tx.rmattr(cid, obj, k)
-        self._log_apply(tx, pgid, LogEntry(version, "multi", oid, -1,
+        self._log_apply(tx, pgid, LogEntry(version, "multi", oid, shard,
                                            prev_version=-1))
         self.store.queue_transaction(tx)
