@@ -38,6 +38,7 @@ _AUTH_MAGIC = b"CTPX1\0"
 _RESM_MAGIC = b"RESM"
 _TAG_LEN = 16
 _RING_MAX = 512          # replayable frames kept per session
+_RING_MAX_BYTES = 32 << 20  # payload-byte budget per session ring
 _STASH_MAX = 64          # dead sessions kept for resume
 
 
@@ -63,16 +64,33 @@ class _SessState:
     ProtocolV2 connection cookie + out_queue/replay role): sequenced
     sent frames in a bounded ring, and the last seq received."""
 
-    __slots__ = ("cookie", "send_seq", "recv_seq", "ring", "lock")
+    __slots__ = ("cookie", "send_seq", "recv_seq", "ring", "ring_bytes",
+                 "lock")
 
     def __init__(self):
         self.cookie = secrets.token_bytes(16)
         self.send_seq = 0
         self.recv_seq = 0
-        self.ring: collections.deque = collections.deque(maxlen=_RING_MAX)
-        # ring holds (seq, flags, plain_payload); ring mutations under
-        # self.lock (the state outlives any one conn)
+        # ring holds (seq, flags, plain_payload), bounded both by entry
+        # count and payload bytes — recovery pushes can be huge frames,
+        # so a count-only cap could pin GiB of plaintext per session
+        # (the reference bounds replay state by bytes too).  Mutations
+        # under self.lock (the state outlives any one conn).
+        self.ring: collections.deque = collections.deque()
+        self.ring_bytes = 0
         self.lock = threading.Lock()
+
+    def ring_append(self, seq: int, flags: int, plain: bytes) -> None:
+        """Append under self.lock, evicting oldest past either budget.
+        The newest entry is never evicted — send_payload's RINGED
+        contract promises the just-appended frame is replayable, so one
+        oversized frame may transiently exceed the byte budget rather
+        than be silently lost."""
+        self.ring.append((seq, flags, plain))
+        self.ring_bytes += len(plain)
+        while len(self.ring) > 1 and (len(self.ring) > _RING_MAX or
+                                      self.ring_bytes > _RING_MAX_BYTES):
+            self.ring_bytes -= len(self.ring.popleft()[2])
 
     def ring_floor(self) -> int:
         return self.ring[0][0] if self.ring else self.send_seq + 1
@@ -84,6 +102,7 @@ class _SessState:
             for item in list(self.ring):
                 if item[0] == seq:
                     self.ring.remove(item)
+                    self.ring_bytes -= len(item[2])
                     return
 
 
@@ -154,7 +173,7 @@ class _Conn:
                 with self.state.lock:
                     self.state.send_seq += 1
                     seq = self.state.send_seq
-                    self.state.ring.append((seq, flags, plain))
+                    self.state.ring_append(seq, flags, plain)
                 plain = struct.pack("<Q", seq) + plain
             body = self._seal(plain)
             try:

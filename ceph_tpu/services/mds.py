@@ -543,27 +543,73 @@ class MdsCluster:
     def _lock(self):
         return _OrderedLocks([r._lock for r in self.ranks])
 
+    def _rename_subtree_map(self, src: str, dst: str) -> None:
+        """Rewrite durable subtree-map keys at/under src to dst paths —
+        a moved subtree keeps its authority assignment (Migrator keeps
+        subtree bounds across rename); without this the moved tree
+        silently reverts to rank 0 and a later mkdir at the old path
+        inherits a stale rank."""
+        with self._maplock:
+            moved, old_keys = {}, []
+            for root in list(self._map):
+                if root == src or root.startswith(src + "/"):
+                    moved[dst + root[len(src):]] = self._map.pop(root)
+                    old_keys.append(root)
+            if moved:
+                self._map.update(moved)
+                # write the merged map FIRST: a crash between the two
+                # ops then leaves a transient stale old-path key, never
+                # a lost assignment.  Remove exactly the keys we moved —
+                # another live MdsCluster on the same pool may have
+                # persisted keys this instance hasn't loaded, and they
+                # must survive.
+                self._save_map()
+                self.client.omap_rm(self.pool, _SUBTREE_OID, old_keys)
+
     def rename(self, src: str, dst: str) -> None:
-        """Same-rank renames delegate; cross-rank renames take both
-        ranks' locks in RANK ORDER (no ABBA between two renames) and
-        journal the op in both ranks — apply is idempotent, so each
-        rank's replay converges (the slave-request rename role)."""
+        """Renames take ALL rank locks in RANK ORDER (no ABBA between
+        two renames) because the moved subtree may contain interior
+        subtree roots whose caps live at ranks other than the two
+        parents' — those must be revoked too, and the authority-map
+        rewrite must be atomic with the namespace change (a lookup
+        between them would route to a stale rank).  The op is journaled
+        at both parents' ranks — apply is idempotent, so each rank's
+        replay converges (the slave-request rename role)."""
         src, dst = _norm(src), _norm(dst)
-        a, b = self._entry_auth(src), self._entry_auth(dst)
-        if a is b:
-            a.rename(src, dst)
-            return
         if dst == src or dst.startswith(src + "/"):
             raise FsError(-22,
                           f"cannot move {src!r} into itself ({dst!r})")
-        first, second = sorted((a, b), key=lambda r: r.rank)
-        with first._lock, second._lock:
+        a, b = self._entry_auth(src), self._entry_auth(dst)
+        # lock only the ranks the rename can touch: the two parents'
+        # plus any rank holding authority INSIDE the moved subtree
+        # (interior subtree roots — their cached caps must be revoked
+        # too).  The common same-rank, no-interior-subtree rename stays
+        # cheap instead of barriering the whole cluster.
+        with self._maplock:
+            interior = {rank for root, rank in self._map.items()
+                        if root == src or root.startswith(src + "/")}
+        involved = sorted({a.rank, b.rank} | interior)
+        with _OrderedLocks([self.ranks[i]._lock for i in involved]):
             ent = a.lookup(src)
             parent, name = posixpath.split(dst)
             if name in b.entries(parent):
                 raise FsError(-17, f"{dst!r} exists")
-            a._revoke_subtree(src, exclude=None)
-            b._revoke_subtree(src, exclude=None)
+            for i in involved:
+                self.ranks[i]._revoke_subtree(src, exclude=None)
             op = {"op": "rename", "src": src, "dst": dst, "ent": ent}
             a.submit(op)
-            b.submit(op)  # idempotent re-apply; journals both replays
+            if b is not a:
+                b.submit(op)  # idempotent re-apply; journals both
+            self._rename_subtree_map(src, dst)
+            # heat follows ONLY when the top-level entry itself moved
+            # (export_subtree's pattern); renaming one deep entry must
+            # not drain its old top-level dir's counters
+            if src != "/" and src == "/" + src.split("/", 2)[1]:
+                new_top = "/" + dst.split("/", 2)[1]
+                if new_top != src:
+                    for i in involved:
+                        r = self.ranks[i]
+                        heat = r.dir_ops.pop(src, 0)
+                        if heat:
+                            r.dir_ops[new_top] = (
+                                r.dir_ops.get(new_top, 0) + heat)

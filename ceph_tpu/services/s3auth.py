@@ -6,7 +6,9 @@ the canonical request from the received message, derive the signing key
 from the stored secret, and compare signatures constant-time).  One
 module serves both sides: `sign()` produces client headers, `verify()`
 checks a received request — so the canonicalization can never drift
-between signer and verifier.
+between signer and verifier.  verify() matches header names
+case-insensitively (botocore sends 'X-Amz-Date'; rgw_auth_s3.cc
+likewise lowercases before lookup).
 
 Scope: header-based auth (Authorization: AWS4-HMAC-SHA256), single-chunk
 payloads (x-amz-content-sha256 = hex digest).  Presigned URLs and
@@ -101,7 +103,8 @@ def verify(method: str, path: str, query: str, headers: dict,
     """Validate a received request; returns the access key (the
     authenticated principal).  lookup_secret(access_key) -> secret or
     None.  Raises AuthError on any failure."""
-    auth = headers.get("Authorization", "")
+    headers = {k.lower(): v for k, v in headers.items()}
+    auth = headers.get("authorization", "")
     if not auth.startswith(ALGO + " "):
         raise AuthError("AccessDenied")
     fields = {}
@@ -134,7 +137,7 @@ def verify(method: str, path: str, query: str, headers: dict,
     now = datetime.datetime.now(datetime.timezone.utc)
     if abs((now - stamp).total_seconds()) > MAX_SKEW_S:
         raise AuthError("RequestTimeTooSkewed")
-    canon = _canonical_request(method, path, query, dict(headers),
+    canon = _canonical_request(method, path, query, headers,
                                signed, payload_hash)
     scope = f"{date}/{region}/{SERVICE}/aws4_request"
     sts = "\n".join([ALGO, amzdate, scope,

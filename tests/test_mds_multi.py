@@ -129,3 +129,50 @@ def test_multi_mount_caps_across_ranks(cluster):
     assert w.caps == ""
     w.close(); r.close()
     m1.unmount(); m2.unmount()
+
+
+def test_rename_moves_subtree_authority(cluster):
+    """Renaming a directory that is (or contains) a subtree root moves
+    the durable authority assignment with it (ADVICE r2: stale _map keys
+    made the moved tree revert to rank 0)."""
+    client = cluster.clients[0]
+    mds = MdsCluster(client, "fs", n_ranks=2)
+    fs = FsClient(client, "fs", mds=mds, client_id="rn")
+    fs.mkdir("/team")
+    fs.mkdir("/team/sub")
+    mds.export_subtree("/team/sub", 1)
+    assert mds.authority_rank("/team/sub") == 1
+    fs.rename("/team", "/squad")
+    assert mds.authority_rank("/squad/sub") == 1
+    # the old path no longer carries an assignment: a fresh dir there
+    # inherits its parent's (rank 0), not the moved subtree's
+    fs.mkdir("/team")
+    fs.mkdir("/team/sub")
+    assert mds.authority_rank("/team/sub") == 0
+    # and a fresh MdsCluster loading the durable map agrees
+    mds2 = MdsCluster(client, "fs", n_ranks=2)
+    assert mds2.authority_rank("/squad/sub") == 1
+    assert mds2.authority_rank("/team/sub") == 0
+    fs.unmount()
+
+
+def test_rename_revokes_interior_subtree_caps(cluster):
+    """Caps held at an interior subtree's authority rank (not either
+    parent's rank) are revoked by a rename — the writer's buffered data
+    must be flushed before a reader opens through the new path."""
+    client = cluster.clients[0]
+    mds = MdsCluster(client, "fs", n_ranks=2)
+    w = FsClient(client, "fs", mds=mds, client_id="wi")
+    r = FsClient(client, "fs", mds=mds, client_id="ri")
+    w.mkdir("/grp")
+    w.mkdir("/grp/sub")
+    mds.export_subtree("/grp/sub", 1)
+    h = w.open("/grp/sub/f", "w")
+    h.write(b"buffered-at-rank-1")
+    # both parents of this rename live at rank 0; the caps live at rank 1
+    w.rename("/grp", "/org")
+    assert h.caps == ""  # revoked (and flushed) by the rename
+    rd = r.open("/org/sub/f", "r")
+    assert rd.read() == b"buffered-at-rank-1"
+    h.close(); rd.close()
+    w.unmount(); r.unmount()

@@ -272,3 +272,20 @@ def test_multipart_rejects_duplicate_parts(gateway):
     st, body, _ = _req(gw, "POST", f"/mpd/x?uploadId={upload_id}",
                        body=xml.encode())
     assert st == 400 and _req(gw, "HEAD", "/mpd/x")[0] == 404
+
+
+def test_sigv4_header_names_case_insensitive(auth_gateway):
+    """Standard clients send 'X-Amz-Date' / 'X-Amz-Content-SHA256'
+    (botocore casing); the verifier must match header names
+    case-insensitively like rgw_auth_s3.cc (ADVICE r2)."""
+    gw, s3auth = auth_gateway
+    path, body = "/b/cased", b"payload"
+    assert _signed(gw, s3auth, "PUT", "/b")[0] == 200
+    headers = s3auth.sign("PUT", f"127.0.0.1:{gw.port}", path, "",
+                          body, "AKIATEST", "sekrit")
+    recased = {{"x-amz-date": "X-Amz-Date",
+                "x-amz-content-sha256": "X-Amz-Content-SHA256"}
+               .get(k.lower(), k): v for k, v in headers.items()}
+    assert "X-Amz-Date" in recased and "Authorization" in recased
+    st, _, _ = _req(gw, "PUT", path, body=body, headers=recased)
+    assert st == 200

@@ -398,3 +398,35 @@ def test_resume_ring_replay_after_silent_loss():
         assert got == ["m1", "m2", "m3"], got
     finally:
         a.shutdown(); b.shutdown(); net.stop(); netb.stop()
+
+
+def test_resume_ring_byte_budget():
+    """The replay ring is bounded by payload BYTES as well as frame
+    count — large recovery frames must not pin unbounded plaintext
+    (ADVICE r2; the reference bounds replay state by bytes)."""
+    from ceph_tpu.msg import tcp as tcpmod
+    st = tcpmod._SessState()
+    big = b"x" * (8 << 20)
+    for i in range(1, 9):      # 64 MiB offered vs 32 MiB budget
+        st.ring_append(i, 0, big)
+    assert st.ring_bytes <= tcpmod._RING_MAX_BYTES
+    assert len(st.ring) == 4 and st.ring[0][0] == 5
+    # count cap still applies to small frames
+    st2 = tcpmod._SessState()
+    for i in range(1, tcpmod._RING_MAX + 100):
+        st2.ring_append(i, 0, b"s")
+    assert len(st2.ring) == tcpmod._RING_MAX
+    assert st2.ring_bytes == tcpmod._RING_MAX
+    # ring_drop keeps the byte ledger consistent
+    st.ring_drop(6)
+    assert st.ring_bytes == 3 * len(big)
+
+
+def test_resume_ring_never_evicts_newest():
+    """A single frame larger than the byte budget stays replayable —
+    send_payload's RINGED contract depends on it."""
+    from ceph_tpu.msg import tcp as tcpmod
+    st = tcpmod._SessState()
+    huge = b"y" * (tcpmod._RING_MAX_BYTES + 1)
+    st.ring_append(1, 0, huge)
+    assert len(st.ring) == 1 and st.ring[0][0] == 1
