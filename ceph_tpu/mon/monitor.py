@@ -11,14 +11,18 @@ replication Paxos.cc, Elector.cc leader election, forwarded requests):
   log's shape); `DurableMonStore` persists commits through a crc-framed
   fsync'd append-only log (the FileStore WAL framing) so a restarted
   monitor resumes with every pool/epoch intact;
-- multiple monitors form a quorum: an Elector-lite picks the leader
-  (newest store version wins, ties to the lowest rank — the shape of
-  ElectionLogic's epoch+rank rule), the leader replicates commits to
-  followers (primary-backup: proposals apply in version order, lagging
-  peers catch up via sync — full Paxos majority-ack is the next
-  widening step), and followers proxy client/daemon requests to the
-  leader (Monitor::forward_request) and serve map subscriptions from
-  replicated state;
+- multiple monitors form a quorum with MAJORITY-ACK commit (the
+  Paxos.cc collect/accept/commit shape, Raft-flavored): the Elector
+  picks the leader by most-complete ACCEPTED log (ties to lowest
+  rank), the leader durably accepts each mutation locally and
+  proposes it; followers durably accept and ack; the entry commits —
+  becomes visible to subscribers and releases gated client replies —
+  only once a majority has accepted it.  A new leader re-stamps and
+  re-proposes the inherited accepted tail (higher-ballot re-propose),
+  divergent tails from deposed leaders are truncated by proposed-term
+  mismatch, a minority-partitioned leader steps down after its lease,
+  and lagging peers catch up via entry/snapshot sync.  No committed
+  epoch can be lost or forked across any surviving majority;
 - failure detection: reporter-count thresholds + report-window span +
   uptime-adaptive grace, as before (leader-local soft state).
 """
@@ -50,9 +54,12 @@ _FORWARDED = (MOSDBoot, MMonCommand, MFailureReport, MStatsReport,
 
 
 class MonStore:
-    """Versioned commit log + latest-state KV (MonitorDBStore's shape).
-    The log keeps a bounded TAIL window (paxos-trim role): lagging peers
-    within the window sync by entries, older ones by snapshot."""
+    """Versioned commit log + latest-state KV (MonitorDBStore's shape),
+    plus an ACCEPTED tail — entries durably accepted but not yet known
+    majority-committed (the Paxos accepted-proposal state,
+    src/mon/Paxos.cc collect/accept vs commit).  The committed log
+    keeps a bounded TAIL window (paxos-trim role): lagging peers within
+    the window sync by entries, older ones by snapshot."""
 
     LOG_KEEP = 256
 
@@ -60,7 +67,18 @@ class MonStore:
         self.version = 0
         self.log: list[tuple[int, str, str, bytes]] = []
         self.kv: dict[str, bytes] = {}
+        # accepted-but-uncommitted tail: (version, pterm, desc, key, value)
+        self.accepted: list[tuple[int, int, str, str, bytes]] = []
+        # election-safety state that must survive a crash: the term of
+        # the newest log entry (Raft's lastLogTerm half of the voting
+        # comparator), the current term, and who we voted for in it (a
+        # restarted mon must never vote twice in one term — that is how
+        # two leaders happen)
+        self.last_term = 0
+        self.cur_term = 0
+        self.voted_for = ""
 
+    # -- committed prefix --------------------------------------------------
     def commit(self, key: str, value: bytes, desc: str) -> int:
         return self.commit_at(self.version + 1, key, value, desc)
 
@@ -70,6 +88,13 @@ class MonStore:
         path); versions must be gapless and in order."""
         if version != self.version + 1:
             raise ValueError(f"commit v{version} onto v{self.version}")
+        if self.accepted and self.accepted[0][0] == version:
+            # the commit supersedes (or confirms) the accepted head; a
+            # CONTENT mismatch means the rest of the tail chains off a
+            # deposed leader's divergent history — discard it all
+            ent = self.accepted.pop(0)
+            if ent[3] != key or ent[4] != value:
+                self.accepted = []
         self.version = version
         self.log.append((version, desc, key, value))
         self.kv[key] = value
@@ -92,6 +117,75 @@ class MonStore:
         self.version = version
         self.kv = dict(kv)
         self.log = []
+        self.accepted = []
+
+    # -- accepted tail (quorum replication) --------------------------------
+    @property
+    def accepted_version(self) -> int:
+        """Highest version this store has durably accepted (>= committed
+        version; the log-completeness score for elections)."""
+        return self.accepted[-1][0] if self.accepted else self.version
+
+    def accept_at(self, version: int, pterm: int, key: str, value: bytes,
+                  desc: str) -> None:
+        """Durably stage an entry (Paxos accept).  Gapless on top of
+        the accepted tail."""
+        if version != self.accepted_version + 1:
+            raise ValueError(
+                f"accept v{version} onto v{self.accepted_version}")
+        self.accepted.append((version, pterm, desc, key, value))
+        self.last_term = max(self.last_term, pterm)
+
+    def entry_pterm(self, version: int) -> int | None:
+        """pterm of the accepted entry at `version`, None if absent."""
+        for e in self.accepted:
+            if e[0] == version:
+                return e[1]
+        return None
+
+    def set_term(self, term: int, voted_for: str) -> None:
+        """Record the current term + vote (durably in the subclass)."""
+        self.cur_term = term
+        self.voted_for = voted_for
+
+    def note_term(self, term: int) -> None:
+        """Adopting entries from a leader at `term` (sync path) makes
+        our log as recent as that term for election purposes."""
+        self.last_term = max(self.last_term, term)
+
+    def truncate_accepted(self, from_version: int) -> bool:
+        """Drop accepted entries >= from_version (a deposed leader's
+        divergent tail being overwritten).  True if anything dropped."""
+        keep = [e for e in self.accepted if e[0] < from_version]
+        dropped = len(keep) != len(self.accepted)
+        self.accepted = keep
+        return dropped
+
+    def restamp_accepted(self, pterm: int) -> None:
+        """New leader: re-stamp inherited entries with its own term
+        before re-proposing them (the Paxos higher-ballot re-propose),
+        so acks gathered at the new term commit them safely."""
+        self.accepted = [(v, pterm, d, k, val)
+                         for (v, _t, d, k, val) in self.accepted]
+        if self.accepted:
+            self.last_term = max(self.last_term, pterm)
+
+    def commit_accepted_upto(self, upto: int,
+                             pterm: int | None = None) -> list:
+        """Commit the consecutive accepted prefix with version <= upto
+        (and, when given, pterm == pterm — entries accepted under an
+        older term must be re-proposed by the current leader before they
+        may commit, never committed by a stale pointer).  Returns the
+        committed (version, desc, key, value) entries."""
+        out = []
+        while self.accepted and self.accepted[0][0] <= upto and \
+                (pterm is None or self.accepted[0][1] == pterm):
+            v, _t, d, k, val = self.accepted[0]
+            # base-class apply on purpose: the durable subclass journals
+            # the commit POINT, not a second copy of the payload
+            MonStore.commit_at(self, v, k, val, d)
+            out.append((v, d, k, val))
+        return out
 
     def close(self) -> None:
         pass
@@ -99,6 +193,7 @@ class MonStore:
 
 # durable record kinds
 _REC_COMMIT, _REC_SNAPSHOT = 1, 2
+_REC_ACCEPT, _REC_CUPTO, _REC_TRUNC, _REC_RESTAMP, _REC_TERM = 3, 4, 5, 6, 7
 
 
 class DurableMonStore(MonStore):
@@ -151,6 +246,23 @@ class DurableMonStore(MonStore):
             version = d.u64()
             kv = {d.string(): d.blob() for _ in range(d.u32())}
             MonStore.reset_to(self, version, kv)
+            self.last_term = d.u64()
+            self.cur_term = d.u64()
+            self.voted_for = d.string()
+        elif kind == _REC_ACCEPT:
+            version, pterm = d.u64(), d.u64()
+            desc, key, value = d.string(), d.string(), d.blob()
+            MonStore.accept_at(self, version, pterm, key, value, desc)
+        elif kind == _REC_CUPTO:
+            MonStore.commit_accepted_upto(self, d.u64())
+        elif kind == _REC_TRUNC:
+            MonStore.truncate_accepted(self, d.u64())
+        elif kind == _REC_RESTAMP:
+            MonStore.restamp_accepted(self, d.u64())
+        elif kind == _REC_TERM:
+            self.cur_term = d.u64()
+            self.voted_for = d.string()
+            self.last_term = d.u64()
 
     @staticmethod
     def _commit_payload(version, key, value, desc) -> bytes:
@@ -163,14 +275,16 @@ class DurableMonStore(MonStore):
         e.blob(value)
         return e.tobytes()
 
+    def _append(self, payload: bytes) -> None:
+        self._file.write(self._frame(payload))
+        self._file.flush()
+        os.fsync(self._file.fileno())
+
     def commit_at(self, version: int, key: str, value: bytes,
                   desc: str) -> int:
         before = len(self.log)
         v = super().commit_at(version, key, value, desc)
-        self._file.write(self._frame(
-            self._commit_payload(version, key, value, desc)))
-        self._file.flush()
-        os.fsync(self._file.fileno())
+        self._append(self._commit_payload(version, key, value, desc))
         if len(self.log) < before:  # window trimmed: compact the file
             self._compact()
         return v
@@ -179,10 +293,85 @@ class DurableMonStore(MonStore):
         super().reset_to(version, kv)
         self._compact()
 
+    # -- accepted tail: each transition is one fsync'd record --------------
+    def accept_at(self, version: int, pterm: int, key: str, value: bytes,
+                  desc: str) -> None:
+        """The durable accept IS this monitor's Paxos promise — it must
+        hit disk before the ack leaves (Paxos.cc handle_begin journals
+        before sending accept)."""
+        from ..utils.codec import Encoder
+        super().accept_at(version, pterm, key, value, desc)
+        e = Encoder()
+        e.u8(_REC_ACCEPT)
+        e.u64(version)
+        e.u64(pterm)
+        e.string(desc)
+        e.string(key)
+        e.blob(value)
+        self._append(e.tobytes())
+
+    def commit_accepted_upto(self, upto: int,
+                             pterm: int | None = None) -> list:
+        """Journals only the commit POINT — the payload is already in
+        the accept record, so commit costs O(1) bytes, not a second
+        copy of the map."""
+        from ..utils.codec import Encoder
+        before = len(self.log)
+        out = super().commit_accepted_upto(upto, pterm)
+        if out:
+            e = Encoder()
+            e.u8(_REC_CUPTO)
+            e.u64(out[-1][0])
+            self._append(e.tobytes())
+            if len(self.log) < before:
+                self._compact()
+        return out
+
+    def truncate_accepted(self, from_version: int) -> bool:
+        from ..utils.codec import Encoder
+        dropped = super().truncate_accepted(from_version)
+        if dropped:
+            e = Encoder()
+            e.u8(_REC_TRUNC)
+            e.u64(from_version)
+            self._append(e.tobytes())
+        return dropped
+
+    def restamp_accepted(self, pterm: int) -> None:
+        from ..utils.codec import Encoder
+        super().restamp_accepted(pterm)
+        if self.accepted:
+            e = Encoder()
+            e.u8(_REC_RESTAMP)
+            e.u64(pterm)
+            self._append(e.tobytes())
+
+    def _persist_term(self) -> None:
+        from ..utils.codec import Encoder
+        e = Encoder()
+        e.u8(_REC_TERM)
+        e.u64(self.cur_term)
+        e.string(self.voted_for)
+        e.u64(self.last_term)
+        self._append(e.tobytes())
+
+    def set_term(self, term: int, voted_for: str) -> None:
+        """The durable vote IS the promise: it must hit disk before the
+        vote message leaves, or a restarted mon can vote twice in one
+        term and elect two leaders."""
+        super().set_term(term, voted_for)
+        self._persist_term()
+
+    def note_term(self, term: int) -> None:
+        if term > self.last_term:
+            super().note_term(term)
+            self._persist_term()
+
     def _compact(self) -> None:
-        """Rewrite the file as one snapshot of the CURRENT (version, kv),
-        atomically (tmp+rename).  The in-memory tail window still serves
-        peer entry-sync; restart replay is O(kv), not O(history)."""
+        """Rewrite the file as one snapshot of the CURRENT (version, kv)
+        plus the accepted tail, atomically (tmp+rename).  The in-memory
+        tail window still serves peer entry-sync; restart replay is
+        O(kv), not O(history)."""
         from ..utils.codec import Encoder
         e = Encoder()
         e.u8(_REC_SNAPSHOT)
@@ -191,9 +380,21 @@ class DurableMonStore(MonStore):
         for k in sorted(self.kv):
             e.string(k)
             e.blob(self.kv[k])
+        e.u64(self.last_term)
+        e.u64(self.cur_term)
+        e.string(self.voted_for)
         tmp = self._path + ".tmp"
         with open(tmp, "wb") as f:
             f.write(self._frame(e.tobytes()))
+            for version, pterm, desc, key, value in self.accepted:
+                a = Encoder()
+                a.u8(_REC_ACCEPT)
+                a.u64(version)
+                a.u64(pterm)
+                a.string(desc)
+                a.string(key)
+                a.blob(value)
+                f.write(self._frame(a.tobytes()))
             f.flush()
             os.fsync(f.fileno())
         if self._file:
@@ -247,14 +448,31 @@ class MonitorLite(Dispatcher):
         self._boot_times: dict[int, float] = {}
         self._lock = threading.RLock()
         self._osd_stats: dict[int, dict] = {}
-        # quorum state (single mon = permanent leader, zero overhead)
-        self._term = 0
+        # quorum state (single mon = permanent leader, zero overhead).
+        # term + vote resume from the durable store: a restarted mon
+        # must not vote twice in a term it already voted in
+        self._term = self.store.cur_term
         self._role = "leader" if not self.peers else "electing"
         self._leader: str | None = name if not self.peers else None
         self._votes: set[str] = set()
-        self._voted: tuple[int, str] | None = None  # (term, candidate)
+        self._voted: tuple[int, str] | None = (
+            (self.store.cur_term, self.store.voted_for)
+            if self.store.voted_for else None)
         self._election_at = 0.0
         self._leader_seen = time.monotonic()
+        # majority-ack commit state (leader-side): version -> acker
+        # names; a proposal becomes a commit only when a majority has
+        # durably accepted it (Paxos.cc accept/commit split)
+        self._pending_acks: dict[int, set[str]] = {}
+        # version -> (base_epoch, inc_bytes, raw) stashed at propose
+        # time, published to subscribers at commit time
+        self._pending_inc: dict[int, tuple] = {}
+        # version -> [(conn, reply)] client replies gated on commit: a
+        # client must never see success for a mutation that can still
+        # be rolled back by a leader change
+        self._reply_on_commit: dict[int, list] = {}
+        self._peer_seen: dict[str, float] = {}
+        self._became_leader = 0.0
         self._stop = threading.Event()
         # per-destination sender lanes: a blocking connect to one dead
         # peer must not head-of-line-block pings/proposals to the others
@@ -273,7 +491,7 @@ class MonitorLite(Dispatcher):
             MMonVote: self._handle_vote,
             MMonClaim: self._handle_claim,
             MMonPropose: self._handle_propose,
-            MMonPropAck: lambda conn, m: None,
+            MMonPropAck: self._handle_propack,
             MMonSyncReq: self._handle_sync_req,
             MMonSyncEntries: self._handle_sync_entries,
             MMonForward: self._handle_forward,
@@ -372,8 +590,14 @@ class MonitorLite(Dispatcher):
 
     # ------------------------------------------------------- quorum engine
     def _score(self) -> tuple:
-        """Newest data wins; ties to the lowest rank (ElectionLogic)."""
-        return (self.store.version, -self._rank)
+        """Most-complete log wins; ties to the lowest rank
+        (ElectionLogic).  (last entry's term, ACCEPTED version) — the
+        Raft §5.4.1 comparator: any majority-committed entry is
+        accepted on at least one member of every majority, and term-
+        before-length stops a long divergent stale-term tail from
+        beating newer committed history."""
+        return (self.store.last_term, self.store.accepted_version,
+                -self._rank)
 
     def _majority(self) -> int:
         return (len(self.peers) + 1) // 2 + 1
@@ -385,6 +609,18 @@ class MonitorLite(Dispatcher):
             now = time.monotonic()
             with self._lock:
                 role = self._role
+                if role == "leader" and self.peers:
+                    # a partitioned minority leader must stop serving:
+                    # it can neither commit nor prove its maps aren't
+                    # stale (Paxos lease expiry -> bootstrap)
+                    alive = 1 + sum(1 for t in self._peer_seen.values()
+                                    if now - t < lease)
+                    if alive < self._majority() and \
+                            now - self._became_leader > lease:
+                        dout("mon", 1)("%s: lost quorum contact, "
+                                       "stepping down", self.name)
+                        self._demote(to_role="electing")
+                        role = "electing"
             if role == "leader":
                 ping = MMonPing(self.name, self._term, "leader",
                                 self.store.version, time.time())
@@ -399,21 +635,51 @@ class MonitorLite(Dispatcher):
                 if now - self._election_at > 0.4 + 0.1 * self._rank:
                     self._start_election()
 
+    def _demote(self, to_role: str = "follower") -> None:
+        """Leave leadership: fail commit-gated replies (the client
+        retries against the new leader) and drop leader-only state.
+        The accepted tail STAYS — entries a majority accepted will be
+        re-proposed and committed by the next leader.  Caller holds
+        _lock."""
+        self._role = to_role
+        if to_role != "leader":
+            self._leader = None
+        fails = []
+        for waiters in self._reply_on_commit.values():
+            for conn, reply in waiters:
+                reply.result = -11  # EAGAIN: retry at new leader
+                reply.data = {"error": "leadership lost mid-commit"}
+                fails.append((conn, reply))
+        self._send_replies(fails)
+        self._reply_on_commit.clear()
+        self._pending_acks.clear()
+        self._pending_inc.clear()
+        self._peer_seen.clear()
+        # the working map may expose an epoch that never committed —
+        # drop back to committed state; if the tail commits after all,
+        # _commit_from_leader re-applies it
+        self._rollback_visible_map()
+
     def _start_election(self) -> None:
         with self._lock:
             if not self.peers:
                 return
+            if self._role == "leader":
+                self._demote(to_role="electing")
             self._term += 1
             self._role = "electing"
             self._leader = None
             self._votes = {self.name}
             self._voted = (self._term, self.name)  # my vote is spent
+            self.store.set_term(self._term, self.name)  # durable FIRST
             self._election_at = time.monotonic()
-            term, version = self._term, self.store.version
+            term, version = self._term, self.store.accepted_version
+            lterm = self.store.last_term
         dout("mon", 3)("%s: election term %d (v%d)", self.name, term,
                        version)
         for p in self.peers:
-            self._post(p, MMonElect(term, version, self._rank, self.name))
+            self._post(p, MMonElect(term, version, self._rank, self.name,
+                                    lterm=lterm))
 
     def _handle_elect(self, conn, m: MMonElect) -> None:
         with self._lock:
@@ -422,9 +688,10 @@ class MonitorLite(Dispatcher):
             if m.term > self._term:
                 self._term = m.term
                 self._votes = set()
+                self.store.set_term(m.term, "")  # durable term adoption
                 if self._role == "leader":
-                    self._role = "electing"
-            if (m.version, -m.rank) >= self._score():
+                    self._demote(to_role="electing")
+            if (m.lterm, m.version, -m.rank) >= self._score():
                 # at most ONE vote per term (the Raft votedFor rule —
                 # without it two candidates can both reach majority in
                 # the same term and split-brain)
@@ -434,11 +701,12 @@ class MonitorLite(Dispatcher):
                 # defer to a better (or equally-good, lower-rank)
                 # candidate
                 if self._role == "leader":
-                    self._role = "follower"
+                    self._demote()
                 self._voted = (m.term, m.name)
+                self.store.set_term(m.term, m.name)  # durable BEFORE send
                 self._leader_seen = time.monotonic()
                 self._post(m.name, MMonVote(m.term, self._rank, self.name,
-                                            self.store.version))
+                                            self.store.accepted_version))
                 return
         # I am strictly better: counter-candidacy at a higher term
         self._start_election()
@@ -446,19 +714,48 @@ class MonitorLite(Dispatcher):
     def _handle_vote(self, conn, m: MMonVote) -> None:
         claim = False
         with self._lock:
+            self._peer_seen[m.name] = time.monotonic()
             if m.term != self._term or self._role != "electing":
                 return
             self._votes.add(m.name)
             if len(self._votes) >= self._majority():
                 self._role = "leader"
                 self._leader = self.name
+                self._became_leader = time.monotonic()
+                self._peer_seen = {}
+                # inherit the accepted tail: re-stamp with my term and
+                # re-propose, so majority-accepted-but-uncommitted
+                # entries from the old leader finish committing (the
+                # Paxos collect->begin-with-higher-ballot phase; Raft's
+                # leader-completes-uncommitted-entries rule)
+                self.store.restamp_accepted(self._term)
+                self._pending_acks = {e[0]: {self.name}
+                                      for e in self.store.accepted}
+                self._pending_inc.clear()
+                self._inc_ring.clear()
+                # leader's working map = newest accepted state, so the
+                # epoch chain continues from the inherited tail
+                for e in reversed(self.store.accepted):
+                    if e[3] == "osdmap":
+                        self.osdmap = OSDMap.decode_bytes(e[4])
+                        break
+                self._prev_map = (self.osdmap.deepcopy()
+                                  if self.store.kv.get("osdmap")
+                                  or self.store.accepted else None)
                 claim = True
                 dout("mon", 1)("%s: leader for term %d (votes %s)",
                                self.name, self._term, sorted(self._votes))
         if claim:
             for p in self.peers:
-                self._post(p, MMonClaim(self._term, self.store.version,
+                self._post(p, MMonClaim(self._term,
+                                        self.store.accepted_version,
                                         self.name))
+            for (v, pterm, desc, key, value) in list(self.store.accepted):
+                prop = MMonPropose(self._term, v, key, value, desc,
+                                   pterm=pterm,
+                                   commit=self.store.version)
+                for p in self.peers:
+                    self._post(p, prop)
 
     def _handle_claim(self, conn, m: MMonClaim) -> None:
         with self._lock:
@@ -468,57 +765,181 @@ class MonitorLite(Dispatcher):
                 # deposed: incrementals minted under the old term may
                 # describe commits the new leader never saw
                 self._inc_ring.clear()
-            self._term = m.term
+                self._demote()
+            if m.term > self._term:
+                self._term = m.term
+                self.store.set_term(m.term, "")
             self._role = "follower"
             self._leader = m.name
             self._leader_seen = time.monotonic()
-            behind = m.version > self.store.version
+            behind = m.version > self.store.accepted_version
         if behind:
             self._post(m.name, MMonSyncReq(self.store.version, self.name))
 
+    def _ack_covers(self, version: int, pterm: int) -> bool:
+        """Does a cumulative ack up to (version, pterm) prove the acker
+        holds MY log prefix?  True iff its newest acked entry matches
+        mine there (prevLogTerm check) — an equal-length divergent tail
+        from a deposed leader must never be counted toward a commit.
+        Caller holds _lock."""
+        if version <= self.store.version:
+            return True  # covers only committed prefix: no pending gated
+        mine = self.store.entry_pterm(version)
+        return mine is not None and mine == pterm
+
+    def _count_ack(self, name: str, version: int, pterm: int) -> None:
+        """Record a verified cumulative accept-ack.  Caller holds
+        _lock and sends the returned replies afterwards."""
+        if not self._ack_covers(version, pterm):
+            return
+        for v, acks in self._pending_acks.items():
+            if v <= version:
+                acks.add(name)
+
     def _handle_mon_ping(self, conn, m: MMonPing) -> None:
+        if m.role == "follower":
+            # follower status ping: liveness + cumulative accept-ack
+            # (version = its accepted_version), so a lost MMonPropAck
+            # is healed by the next status ping
+            sends = []
+            with self._lock:
+                if self.is_leader and m.term == self._term:
+                    self._peer_seen[m.name] = time.monotonic()
+                    self._count_ack(m.name, m.version, m.lterm)
+                    sends = self._advance_commit()
+            self._send_replies(sends)
+            return
         if m.role != "leader":
             return
+        reply = None
+        behind = False
         with self._lock:
             if m.term < self._term:
                 return
-            self._term = m.term
+            if m.term > self._term:
+                self._term = m.term
+                self.store.set_term(m.term, "")
             if m.name != self.name:
+                if self._role == "leader":
+                    self._inc_ring.clear()
+                    self._demote()
                 self._role = "follower"
                 self._leader = m.name
                 self._leader_seen = time.monotonic()
-            behind = m.version > self.store.version
+                # m.version is the leader's COMMIT pointer: apply the
+                # accepted prefix it covers (entries accepted under the
+                # current term only — see commit_accepted_upto)
+                self._commit_from_leader(m.version, m.term)
+                behind = m.version > self.store.version
+                acc = self.store.accepted
+                reply = MMonPing(self.name, self._term, "follower",
+                                 self.store.accepted_version, time.time(),
+                                 lterm=(acc[-1][1] if acc
+                                        else self.store.last_term))
+        if reply:
+            self._post(m.name, reply)
         if behind:
             self._post(m.name, MMonSyncReq(self.store.version, self.name))
 
     # ---------------------------------------------------------- replication
     def _handle_propose(self, conn, m: MMonPropose) -> None:
+        """Follower accept phase: durably stage the entry, reconcile
+        divergent tails by pterm (Raft AppendEntries conflict rule),
+        apply the piggybacked commit pointer, and ack cumulatively."""
         with self._lock:
             if m.term < self._term:
                 return
-            self._term = m.term
+            if self._role == "leader" and \
+                    (m.term > self._term or conn.peer != self.name):
+                self._inc_ring.clear()
+                self._demote()
+            if m.term > self._term:
+                self._term = m.term
+                self.store.set_term(m.term, "")
             self._leader_seen = time.monotonic()
+            av = self.store.accepted_version
             if m.version <= self.store.version:
-                return  # already have it
-            if m.version > self.store.version + 1:
+                pass  # already committed; re-ack below
+            elif m.version <= av:
+                ent = next(e for e in self.store.accepted
+                           if e[0] == m.version)
+                if ent[1] != m.pterm:
+                    # divergent tail from a deposed leader: everything
+                    # from the conflict on is junk — replace it
+                    self.store.truncate_accepted(m.version)
+                    self._rollback_visible_map()
+                    self.store.accept_at(m.version, m.pterm, m.key,
+                                         m.value, m.desc)
+            elif m.version == av + 1:
+                self.store.accept_at(m.version, m.pterm, m.key,
+                                     m.value, m.desc)
+            else:
+                # gap: catch up out-of-band; do NOT ack what we lack
+                self._commit_from_leader(m.commit, m.term)
                 self._post(self._leader or conn.peer,
                            MMonSyncReq(self.store.version, self.name))
                 return
-            self._apply_replicated(m.version, m.key, m.value, m.desc)
-        self._post(conn.peer, MMonPropAck(m.term, m.version, self.name))
+            self._commit_from_leader(m.commit, m.term)
+            acked = self.store.accepted_version
+            acc = self.store.accepted
+            apt = acc[-1][1] if acc else self.store.last_term
+        self._post(conn.peer, MMonPropAck(m.term, acked, self.name,
+                                          pterm=apt))
+
+    def _rollback_visible_map(self) -> None:
+        """After truncating an accepted tail that included osdmap
+        entries, the visible map must drop back to committed state (a
+        deposed leader may have exposed an epoch that never existed).
+        With no committed map at all (cluster bootstrap), fall back to
+        the empty epoch-0 map.  Caller holds _lock."""
+        if self.osdmap.epoch <= self.store.version:
+            return
+        raw = self.store.kv.get("osdmap")
+        if raw is not None:
+            self.osdmap = OSDMap.decode_bytes(raw)
+            self._prev_map = self.osdmap.deepcopy()
+        else:
+            self.osdmap = OSDMap()
+            self._prev_map = None
+        self._inc_ring.clear()
+
+    def _commit_from_leader(self, upto: int, term: int) -> None:
+        """Advance the applied prefix to the leader's commit pointer.
+        Only entries accepted under `term` qualify — an older-term tail
+        must first be re-proposed (restamped) by the current leader,
+        else a stale pointer could commit a deposed leader's divergent
+        entry at the same version (fork).  Caller holds _lock."""
+        for version, desc, key, value in \
+                self.store.commit_accepted_upto(upto, pterm=term):
+            if key == "osdmap":
+                self.osdmap = OSDMap.decode_bytes(value)
+                self._prev_map = self.osdmap.deepcopy()
+                push = MMapPush(self.osdmap.epoch, value)
+                for sub in list(self._subscribers):
+                    self._post(sub, push)
 
     def _handle_sync_req(self, conn, m: MMonSyncReq) -> None:
         if not self.is_leader:
             return
+        with self._lock:
+            self._peer_seen[m.name] = time.monotonic()
         if m.from_version + 1 < self.store.oldest_logged():
             # peer is older than the trimmed log window: full sync
             self._post(m.name, MMonSyncEntries(
                 self._term, [], snap_version=self.store.version,
                 snap_kv=dict(self.store.kv)))
-            return
-        entries = self.store.entries_after(m.from_version)
-        if entries:
-            self._post(m.name, MMonSyncEntries(self._term, list(entries)))
+        else:
+            entries = self.store.entries_after(m.from_version)
+            if entries:
+                self._post(m.name,
+                           MMonSyncEntries(self._term, list(entries)))
+        # replay the accepted tail as proposals so the peer can accept
+        # and ack it (it may hold the vote that commits these)
+        for (v, pterm, desc, key, value) in list(self.store.accepted):
+            self._post(m.name,
+                       MMonPropose(self._term, v, key, value, desc,
+                                   pterm=pterm,
+                                   commit=self.store.version))
 
     def _handle_sync_entries(self, conn, m: MMonSyncEntries) -> None:
         with self._lock:
@@ -539,10 +960,16 @@ class MonitorLite(Dispatcher):
                         self._post(sub, push)
             if m.snap_kv is not None and self.store.kv.get("osdmap"):
                 self._prev_map = self.osdmap.deepcopy()
+            applied = False
             for version, desc, key, value in m.entries:
                 if version != self.store.version + 1:
                     continue
                 self._apply_replicated(version, key, value, desc)
+                applied = True
+            if applied or m.snap_kv is not None:
+                # our log is now as recent as the serving leader's term
+                # — election comparator (lastLogTerm) must reflect that
+                self.store.note_term(m.term)
 
     def _apply_replicated(self, version: int, key: str, value: bytes,
                           desc: str) -> None:
@@ -562,32 +989,108 @@ class MonitorLite(Dispatcher):
     INC_RING_KEEP = 128
 
     def _commit_map(self, desc: str) -> None:
+        """Leader: stage the next map epoch.  Single-mon commits
+        immediately; in a quorum the epoch is durably ACCEPTED locally
+        and proposed to the peers — it becomes a commit (published to
+        subscribers, client replies released) only when a majority has
+        accepted it (_advance_commit).  Caller holds _lock."""
         old = self._prev_map
-        self.osdmap.epoch = self.store.version + 1
+        v = self.store.accepted_version + 1
+        self.osdmap.epoch = v
         raw = self.osdmap.encode_bytes()
-        self.store.commit("osdmap", raw, desc)
-        dout("mon", 3)("epoch %d: %s", self.osdmap.epoch, desc)
-        # routine pushes travel as incrementals (full maps only on
-        # boot/subscribe/catch-up gaps); a receiver not at the base
-        # epoch asks back with its have_epoch
         if old is not None:
-            inc = self.osdmap.diff_from(old)
-            inc_b = inc.encode_bytes()
-            self._inc_ring[old.epoch] = (self.osdmap.epoch, inc_b)
+            inc_b = self.osdmap.diff_from(old).encode_bytes()
+            base = old.epoch
+        else:
+            inc_b, base = None, None
+        self._prev_map = self.osdmap.deepcopy()
+        dout("mon", 3)("epoch %d: %s", v, desc)
+        if not self.peers:
+            self.store.commit("osdmap", raw, desc)
+            self._publish_map(v, base, inc_b, raw)
+            return
+        self.store.accept_at(v, self._term, "osdmap", raw, desc)
+        self._pending_acks[v] = {self.name}
+        self._pending_inc[v] = (base, inc_b, raw)
+        prop = MMonPropose(self._term, v, "osdmap", raw, desc,
+                           pterm=self._term, commit=self.store.version)
+        for p in self.peers:
+            self._post(p, prop)
+
+    def _publish_map(self, epoch: int, base: int | None,
+                     inc_b: bytes | None, raw: bytes) -> None:
+        """Make a COMMITTED epoch visible: incremental-ring bookkeeping
+        + subscriber push.  Routine pushes travel as incrementals (full
+        maps only on boot/subscribe/catch-up gaps); a receiver not at
+        the base epoch asks back with its have_epoch."""
+        if base is not None and inc_b is not None:
+            self._inc_ring[base] = (epoch, inc_b)
             if len(self._inc_ring) > self.INC_RING_KEEP:
                 for k in sorted(self._inc_ring)[:-self.INC_RING_KEEP]:
                     del self._inc_ring[k]
-            push = MMapPush(self.osdmap.epoch, inc_bytes=inc_b,
-                            base_epoch=old.epoch)
+            push = MMapPush(epoch, inc_bytes=inc_b, base_epoch=base)
         else:
-            push = MMapPush(self.osdmap.epoch, raw)
-        self._prev_map = self.osdmap.deepcopy()
+            push = MMapPush(epoch, raw)
         for sub in list(self._subscribers):
             self._post(sub, push)
-        prop = MMonPropose(self._term, self.store.version, "osdmap", raw,
-                           desc)
+
+    def _handle_propack(self, conn, m: MMonPropAck) -> None:
+        sends = []
+        with self._lock:
+            if not self.is_leader or m.term != self._term:
+                return
+            self._peer_seen[m.name] = time.monotonic()
+            self._count_ack(m.name, m.version, m.pterm)
+            sends = self._advance_commit()
+        self._send_replies(sends)
+
+    def _send_replies(self, sends: list) -> None:
+        """Deliver gated client replies OFF the monitor lock and off
+        the dispatch thread: one wedged client connection must never
+        stall the quorum handlers behind _lock."""
+        for conn, reply in sends:
+            threading.Thread(
+                target=lambda c=conn, r=reply: self._safe_send(c, r),
+                name=f"{self.name}-reply", daemon=True).start()
+
+    @staticmethod
+    def _safe_send(conn, msg) -> None:
+        try:
+            conn.send(msg)
+        except Exception:  # noqa: BLE001 - client gone; it will retry
+            pass
+
+    def _advance_commit(self) -> list:
+        """Leader: commit every consecutive head version a majority has
+        accepted, publish the committed epochs, and tell followers the
+        new commit pointer.  Caller holds _lock and must pass the
+        returned gated client replies to _send_replies AFTER releasing
+        it."""
+        committed = []
+        while True:
+            v = self.store.version + 1
+            acks = self._pending_acks.get(v)
+            if acks is None or len(acks) < self._majority():
+                break
+            committed.extend(
+                self.store.commit_accepted_upto(v, pterm=self._term))
+            self._pending_acks.pop(v, None)
+        if not committed:
+            return []
+        sends = []
+        for (v, desc, key, raw) in committed:
+            if key == "osdmap":
+                base, inc_b, full = self._pending_inc.pop(
+                    v, (None, None, raw))
+                self._publish_map(v, base, inc_b, full)
+            sends.extend(self._reply_on_commit.pop(v, []))
+        # immediate commit-pointer broadcast (don't wait for the next
+        # status ping): followers apply + push to their subscribers
+        ping = MMonPing(self.name, self._term, "leader",
+                        self.store.version, time.time())
         for p in self.peers:
-            self._post(p, prop)
+            self._post(p, ping)
+        return sends
 
     def _handle_boot(self, conn, m: MOSDBoot) -> None:
         # teach the transport where this daemon lives (wire transports;
@@ -618,10 +1121,18 @@ class MonitorLite(Dispatcher):
             # subscriber — the full map.  Push even an empty epoch-0 map:
             # a daemon whose boot was dropped during an election sees
             # itself absent and re-asserts.
-            if 0 <= have < self.osdmap.epoch:
+            # serve COMMITTED state only: the working map may sit at an
+            # accepted-but-uncommitted epoch that a leader change can
+            # still roll back
+            cur = self.osdmap
+            if self.peers and cur.epoch > self.store.version:
+                raw = self.store.kv.get("osdmap")
+                cur = (OSDMap.decode_bytes(raw) if raw is not None
+                       else OSDMap())
+            if 0 <= have < cur.epoch:
                 chain = []
                 base = have
-                while base != self.osdmap.epoch:
+                while base != cur.epoch:
                     step = self._inc_ring.get(base)
                     if step is None:
                         chain = None
@@ -634,8 +1145,7 @@ class MonitorLite(Dispatcher):
                     for push in chain:
                         conn.send(push)
                     return
-            conn.send(MMapPush(self.osdmap.epoch,
-                               self.osdmap.encode_bytes()))
+            conn.send(MMapPush(cur.epoch, cur.encode_bytes()))
 
     def _handle_pg_temp(self, conn, m: MOSDPGTemp) -> None:
         """Commit (or clear) a temporary acting set requested by a
@@ -704,11 +1214,24 @@ class MonitorLite(Dispatcher):
             # reachable on a mid-election mon addressed directly
             conn.send(MMonCommandReply(m.tid, -11, {"error": "not leader"}))
             return
-        try:
-            result, data = self._run_command(m.cmd)
-        except Exception as e:  # noqa: BLE001 - commands must not kill mon
-            result, data = -22, {"error": repr(e)}
-        conn.send(MMonCommandReply(m.tid, result, data))
+        with self._lock:
+            pre = self.store.accepted_version
+            try:
+                result, data = self._run_command(m.cmd)
+            except Exception as e:  # noqa: BLE001 - must not kill mon
+                result, data = -22, {"error": repr(e)}
+            post = self.store.accepted_version
+            reply = MMonCommandReply(m.tid, result, data)
+            if result == 0 and post > self.store.version and post > pre \
+                    and self.peers:
+                # the mutation is proposed but not yet majority-
+                # committed: gate the success reply on the commit, so a
+                # client never acts on an epoch a leader change can
+                # still roll back
+                self._reply_on_commit.setdefault(post, []).append(
+                    (conn, reply))
+                return
+        conn.send(reply)
 
     def _run_command(self, cmd: dict):
         prefix = cmd.get("prefix")

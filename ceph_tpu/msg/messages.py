@@ -339,23 +339,32 @@ class MPGRollback:
 @dataclass
 class MMonPing:
     """Mon <-> mon liveness + role advertisement (the Elector's
-    connectivity stream role)."""
+    connectivity stream role).  Leader pings carry its COMMIT pointer
+    in `version`; follower status pings carry the follower's ACCEPTED
+    version (a cumulative accept-ack) with `lterm` = the pterm of its
+    newest accepted entry, so the leader can verify the acked prefix
+    matches its own log before counting the ack."""
 
     name: str
     term: int
     role: str   # leader | follower | electing
     version: int
     stamp: float
+    lterm: int = 0
 
 
 @dataclass
 class MMonElect:
-    """Candidate -> peers: I propose myself for `term` (Elector propose)."""
+    """Candidate -> peers: I propose myself for `term` (Elector
+    propose).  Voters compare (lterm, version, -rank) — the Raft
+    §5.4.1 last-log comparator: term of the newest log entry first,
+    then log length."""
 
     term: int
-    version: int  # candidate's store version (newest data wins)
+    version: int  # candidate's accepted (log-end) version
     rank: int
     name: str
+    lterm: int = 0  # pterm of the candidate's newest log entry
 
 
 @dataclass
@@ -379,21 +388,37 @@ class MMonClaim:
 
 @dataclass
 class MMonPropose:
-    """Leader -> follower: replicate one store commit (Paxos
-    begin/commit collapsed to primary-backup for this round)."""
+    """Leader -> follower: ACCEPT one store entry (the Paxos begin
+    phase).  The entry is durably accepted — NOT applied — by the
+    follower; `commit` piggybacks the leader's commit pointer (the
+    Paxos commit phase), advancing the follower's applied prefix.
+    `pterm` is the term the entry was proposed under (a new leader
+    re-proposes inherited entries restamped with its own term, so a
+    deposed leader's divergent tail is detected by pterm mismatch and
+    truncated — Raft's AppendEntries conflict rule)."""
 
     term: int
     version: int
     key: str
     value: bytes
     desc: str
+    pterm: int = 0
+    commit: int = 0
 
 
 @dataclass
 class MMonPropAck:
+    """Follower -> leader: I have durably accepted every entry up to
+    `version` (cumulative, so a lost ack is healed by the next).
+    `pterm` is the pterm of the acker's entry AT `version`: the leader
+    counts the ack only if that matches its own entry there (the
+    prevLogTerm-style proof that the acked prefix is the same log, not
+    a deposed leader's divergent tail of equal length)."""
+
     term: int
     version: int
     name: str
+    pterm: int = 0
 
 
 @dataclass
