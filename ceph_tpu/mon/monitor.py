@@ -246,9 +246,12 @@ class DurableMonStore(MonStore):
             version = d.u64()
             kv = {d.string(): d.blob() for _ in range(d.u32())}
             MonStore.reset_to(self, version, kv)
-            self.last_term = d.u64()
-            self.cur_term = d.u64()
-            self.voted_for = d.string()
+            if d.remaining():
+                # election-state tail (added later): a store compacted
+                # by the pre-change code ends here — default, don't crash
+                self.last_term = d.u64()
+                self.cur_term = d.u64()
+                self.voted_for = d.string()
         elif kind == _REC_ACCEPT:
             version, pterm = d.u64(), d.u64()
             desc, key, value = d.string(), d.string(), d.blob()

@@ -108,6 +108,10 @@ class MSubWrite:
     data: bytes = b""
     attrs: dict = field(default_factory=dict)
     offset: int = 0     # write_partial only
+    # map epoch the primary minted this write's version under: the
+    # replica stamps its log entry with it so both sides agree on the
+    # entry's interval (the eversion epoch, src/osd/osd_types.h)
+    epoch: int = 0
 
 
 @dataclass
@@ -131,6 +135,11 @@ class MSubPartialWrite:
     # data and be stamped current (the rollback-generation consistency
     # role, doc/dev/osd_internals/erasure_coding/ecbackend.rst:10-27)
     prev_version: int = -1  # -1 = unconditional
+    epoch: int = 0  # primary's minting epoch (see MSubWrite.epoch)
+    # snapshot rider (make_writeable, shard-wise): the shard clones its
+    # head object to the generation variant and stores the shipped
+    # SnapSet before applying the extents.  Empty = no snap work.
+    snap: dict = field(default_factory=dict)
 
 
 @dataclass
@@ -147,6 +156,8 @@ class MSubDelta:
     extents: list  # [(data_shard, shard_off, delta bytes)]
     total_len: int = -1  # new whole-object length; -1 = leave unchanged
     prev_version: int = -1  # conditional apply (see MSubPartialWrite)
+    epoch: int = 0  # primary's minting epoch (see MSubWrite.epoch)
+    snap: dict = field(default_factory=dict)  # see MSubPartialWrite.snap
 
 
 @dataclass
@@ -282,6 +293,18 @@ class MPGInfo:
     tombstones: dict = field(default_factory=dict)  # name -> delete version
     last_complete: int = -1  # contiguity point of this peer's pglog
     lean: bool = False  # no inventory attached: delta-resync from my log
+    # divergence-detection payload (PGLog.h:1344 merge inputs): the
+    # epoch of the sender's newest entry, and (full infos only) the
+    # version -> epoch map of its whole log tail window.  Two logs
+    # holding the same version under different epochs forked; the
+    # newer interval's entry is authoritative.
+    head_epoch: int = 0
+    log_evs: dict = field(default_factory=dict)  # version -> epoch
+    # the sender's last_epoch_started fence: entries another log holds
+    # beyond this sender's head with an epoch older than this fence
+    # never committed (an interval went active without them) and must
+    # be discarded, not adopted (find_best_info's les-first comparator)
+    les: int = 0
 
 
 @dataclass
@@ -333,6 +356,17 @@ class MPGRollback:
     oid: str
     shard: int
     to_version: int
+    # divergent-entry discard (PGLog._merge_divergent_entries role):
+    # the entries past to_version belong to a dead interval and never
+    # committed — drop objects lacking pre-images instead of keeping
+    # them (the authority re-pushes its own content right after)
+    divergent: bool = False
+    # epoch of the surviving interval the discard was judged against:
+    # entries past to_version stamped with an epoch >= this one belong
+    # to a LATER interval than the fork and are committed — their
+    # objects' content must be kept (only the phantom log entries
+    # below them are removed).  <= 0: discard unconditionally.
+    max_epoch: int = 0
 
 
 # ----------------------------------------------------- mon quorum (Raft-lite)

@@ -57,6 +57,7 @@ from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
 from .extent_cache import ECExtentCache
+from .intervals import INTERVALS_KEY, LES_KEY, PastIntervals
 from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scheduler import ClassParams, MClockScheduler
@@ -148,6 +149,21 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._stripes: dict[int, StripeInfo] = {}
         self._pglogs: dict[PgId, PGLog] = {}
         self._pg_lc: dict[PgId, int] = {}  # last-complete contiguity pt
+        # past-intervals peering state (PeeringState.h:1485 PastIntervals
+        # + last_epoch_started fence): membership history per PG, durable
+        # in the PG meta omap, driving the prior-set query on promotion
+        self._past_intervals: dict[PgId, PastIntervals] = {}
+        self._pg_les: dict[PgId, int] = {}
+        self._peering_epoch: dict[PgId, int] = {}  # epoch of the round
+        # non-blocking fence rounds: after recovery drains, the les
+        # fence needs one clean round of answers — but routine recovery
+        # completion must not re-block client IO, so these rounds drain
+        # a shadow waiting set instead of the peering gate
+        self._fence_round: dict[PgId, set[int]] = {}
+        # epoch the in-flight sub-op was minted under by its primary —
+        # per-thread because non-mclock dispatch runs handlers on the
+        # connection reader threads concurrently
+        self._sub_epoch = threading.local()
         # peering reconciliation: collected peer inventories + log
         # positions this round
         self._peer_invs: dict[PgId, dict[int, dict]] = {}
@@ -313,6 +329,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # must never queue behind the op scheduler
         if not self._use_mclock or isinstance(msg, (MOSDPing,
                                                     MOSDPingReply)):
+            self._sub_epoch.v = 0  # fresh epoch pin per dispatched op
             handler(conn, msg)
             return True
         klass = self._op_classes.get(type(msg), "system")
@@ -321,6 +338,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _run_scheduled(self, klass: str, item) -> None:
         handler, conn, msg = item
+        self._sub_epoch.v = 0  # fresh epoch pin per dispatched op
         handler(conn, msg)
 
     # ------------------------------------------------------------- mapping
@@ -394,6 +412,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._ensure_collections()
         self._reservation_map_change(newmap)
         if old is None or newmap.epoch > old.epoch:
+            self._note_intervals()
             self._start_recovery()
             self._notify_demoted(old)
             self._snap_trim_check()
@@ -440,10 +459,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             pgid = PgId(cid.pool, cid.pg_seed)
             inv = self._inventory(pgid)
             if inv:
+                ents = self._pglog(pgid).entries()  # one decode
                 self.messenger.send_message(
                     f"osd.{primary}",
                     MPGInfo(pgid, self.osd_id, -2, inv,
-                            dict(self._tombstones.get(pgid, {}))))
+                            dict(self._tombstones.get(pgid, {})),
+                            head_epoch=ents[-1].epoch if ents else 0,
+                            log_evs={e.version: e.epoch
+                                     for e in ents},
+                            les=self._les(pgid)))
 
     def _pools_pgs_for_me(self):
         """(pool, pg_seed, up_set, my_positions) for PGs mapping to me."""
@@ -564,6 +588,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _run_locked_thunk(self, key: tuple, thunk) -> None:
         """Run a queued write; a thrown thunk must release the lock or
         every later write to the object wedges behind it forever."""
+        self._sub_epoch.v = 0  # fresh epoch pin per deferred op
         try:
             thunk()
         except Exception:
@@ -647,7 +672,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, -1, version, op, payload,
-                          attrs=dict(sub_attrs), offset=off))
+                          attrs=dict(sub_attrs), offset=off,
+                          epoch=self._entry_epoch()))
 
     def _rep_read(self, conn, m: MOSDOp, pgid: PgId) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -705,7 +731,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, -1, version, sub_op,
-                          attrs=dict(sub_attrs)))
+                          attrs=dict(sub_attrs),
+                          epoch=self._entry_epoch()))
 
     def _stat(self, conn, m: MOSDOp, pgid: PgId, shard: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -719,8 +746,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 attrs = self.store.getattrs(cid, cand)
             except NoSuchObject:
                 continue
-            if shard < 0 and attrs.get("wh"):
-                break  # whiteout head: logically deleted
+            if attrs.get("wh"):
+                # whiteout head (any shard): logically deleted — and
+                # authoritatively so; never fall through to the remote
+                # stat fan (peers hold the same whiteout)
+                conn.send(MOSDOpReply(m.tid, ENOENT,
+                                      epoch=self.osdmap.epoch))
+                return
             size = int(attrs.get("len", 0))
             conn.send(MOSDOpReply(m.tid, 0,
                                   data=size.to_bytes(8, "little"),
@@ -788,18 +820,110 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if own:
             self.store.queue_transaction(tx)
 
+    def _entry_epoch(self) -> int:
+        """Epoch to stamp a fresh log entry with: the minting primary's
+        epoch when this thread is applying a sub-op (it rode the
+        message), else my own current map epoch PINNED on first use —
+        one logical op must mint ONE epoch for its local entry and
+        every sub-message even if a map push lands on another thread
+        mid-fan-out (two stamps for the same version would read as a
+        fork next peering round).  The pin is cleared at each dispatch/
+        thunk boundary."""
+        e = getattr(self._sub_epoch, "v", 0)
+        if not e:
+            e = self.osdmap.epoch if self.osdmap is not None else 0
+            self._sub_epoch.v = e
+        return e
+
     def _log_apply(self, tx: Transaction, pgid: PgId,
                    entry: LogEntry) -> None:
         """Append a log entry in the SAME transaction as its data write
         and advance the contiguity point when versions arrive in order
         (a gap means we missed a mutation: last-complete stays put and
         peering falls back to the inventory exchange)."""
+        if entry.epoch == 0:
+            entry.epoch = self._entry_epoch()
         pl = self._pglog(pgid)
         pl.append_to(tx, entry)
         pl.trim_to(tx)
         lc = self._lc(pgid)
         if entry.version == lc + 1:
             self._set_lc(pgid, entry.version, tx=tx)
+
+    # -- past intervals + the last-epoch-started fence ---------------------
+    def _pi(self, pgid: PgId) -> PastIntervals:
+        pi = self._past_intervals.get(pgid)
+        if pi is None:
+            cid = CollectionId(pgid.pool, pgid.seed)
+            try:
+                raw = self.store.omap_get(cid, PGLOG_OID).get(
+                    INTERVALS_KEY)
+                pi = (PastIntervals.decode_bytes(raw) if raw
+                      else PastIntervals())
+            except Exception:  # noqa: BLE001 - no log object yet
+                pi = PastIntervals()
+            self._past_intervals[pgid] = pi
+        return pi
+
+    def _save_pi(self, pgid: PgId) -> None:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        tx = Transaction()
+        if not self.store.exists(cid, PGLOG_OID):
+            tx.touch(cid, PGLOG_OID)
+        tx.omap_setkeys(cid, PGLOG_OID,
+                        {INTERVALS_KEY: self._pi(pgid).encode_bytes()})
+        self.store.queue_transaction(tx)
+
+    def _les(self, pgid: PgId) -> int:
+        """last_epoch_started: the newest epoch this PG completed
+        peering at (the interval fence — history older than it can no
+        longer hold writes the current membership missed)."""
+        les = self._pg_les.get(pgid)
+        if les is None:
+            cid = CollectionId(pgid.pool, pgid.seed)
+            try:
+                raw = self.store.omap_get(cid, PGLOG_OID).get(LES_KEY)
+                les = int.from_bytes(raw, "little") if raw else 0
+            except Exception:  # noqa: BLE001 - no log object yet
+                les = 0
+            self._pg_les[pgid] = les
+        return les
+
+    def _set_les(self, pgid: PgId, les: int) -> None:
+        if les <= self._les(pgid):
+            return
+        self._pg_les[pgid] = les
+        cid = CollectionId(pgid.pool, pgid.seed)
+        tx = Transaction()
+        if not self.store.exists(cid, PGLOG_OID):
+            tx.touch(cid, PGLOG_OID)
+        tx.omap_setkeys(cid, PGLOG_OID,
+                        {LES_KEY: les.to_bytes(8, "little")})
+        self.store.queue_transaction(tx)
+        # the fence moved: trim history that can no longer matter
+        pi = self._pi(pgid)
+        pi.trim_to(les)
+        self._save_pi(pgid)
+
+    def _note_intervals(self) -> None:
+        """Record membership changes for every PG I host or hold data
+        for (PastIntervals::check_new_interval role) — durably, in the
+        PG meta omap, so a revived OSD still knows who served while it
+        was away."""
+        if self.osdmap is None:
+            return
+        mine = {(pool_id, seed)
+                for pool_id, seed, _up in self._pools_pgs_for_me()}
+        for cid in self.store.list_collections():
+            if cid.pool in self.osdmap.pools and \
+                    cid.pg_seed < self.osdmap.pools[cid.pool].pg_num:
+                mine.add((cid.pool, cid.pg_seed))
+        for pool_id, seed in mine:
+            up = self.osdmap.pg_to_up_osds(pool_id, seed)
+            pgid = PgId(pool_id, seed)
+            pi = self._pi(pgid)
+            if pi.note(self.osdmap.epoch, up, self._primary_of(up)):
+                self._save_pi(pgid)
 
     def _pool_stripe(self, pool_id: int) -> StripeInfo:
         """The pool's stripe geometry (ECUtil stripe_info_t role): a FIXED
@@ -840,6 +964,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return
         total = None if full else self._ec_object_len(pgid, m.oid)
         si = self._pool_stripe(pgid.pool)
+        # snapshots: shard-wise clone-on-first-write-after-snap — the
+        # rider rides every shard mutation of this op (make_writeable)
+        _ign, rider = self._snap_prepare(pgid, m)
         if not full:
             object_size = total if total is not None else 0
             end = m.offset + len(m.data)
@@ -862,17 +989,19 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         max(object_size, end), create=object_size == 0,
                         prev_version=self._ec_object_version(pgid, m.oid)
                         if object_size else -1,
-                        lock_key=lock_key)
+                        lock_key=lock_key, rider=rider)
                 elif (plan.mode == "parity_delta" and end <= padded_end
                         and None not in up):
                     # delta only valid against rows that exist on EVERY
                     # shard; growth into new rows and degraded sets fall
                     # back to row-rmw
                     self._ec_partial_write(conn, m, pgid, up, codec, si,
-                                           object_size, lock_key)
+                                           object_size, lock_key,
+                                           rider=rider)
                 else:
                     self._ec_rmw_rows(conn, m, pgid, up, codec, si,
-                                      object_size, lock_key)
+                                      object_size, lock_key,
+                                      rider=rider)
                 return
         version = self._next_version(pgid)
         # whole-object (re)write: scatter the buffer into the RAID-0
@@ -882,6 +1011,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         streams = si.ro_scatter(m.data)
         parity = codec.encode_chunks(streams)
         attrs = {"v": version, "len": len(m.data)}
+        if self._ec_whiteout(pgid, m.oid):
+            attrs["wh"] = 0  # write resurrects a whiteout'd head
+        sub_attrs = dict(attrs)
+        if rider is not None:
+            sub_attrs["_snap"] = rider
         tid = next(self._tids)
         remote = 0
         for shard, osd in enumerate(up):
@@ -891,13 +1025,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 else parity[shard - codec.k]
             data = chunk.tobytes()
             if osd == self.osd_id:
-                self._apply_write(pgid, m.oid, shard, data, attrs)
+                pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                              shard=shard)
+                       if rider is not None else None)
+                self._apply_write(pgid, m.oid, shard, data, attrs,
+                                  pre_tx=pre)
             else:
                 remote += 1
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MSubWrite(tid, pgid, m.oid, shard, version, "write",
-                              data, dict(attrs)))
+                              data, dict(sub_attrs),
+                              epoch=self._entry_epoch()))
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
@@ -924,7 +1063,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                        si: StripeInfo, row0: int, row_bytes: bytes,
                        new_len: int, create: bool = False,
                        prev_version: int = -1,
-                       lock_key: tuple | None = None) -> None:
+                       lock_key: tuple | None = None,
+                       rider: dict | None = None) -> None:
         """Encode and store whole stripe rows [row0, row0+n) — the
         full-stripe branch of the WritePlan: no reads; every shard
         (parity included) takes an extent write at the row offsets,
@@ -945,10 +1085,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 else parity[shard - codec.k]
             ext = [(base, chunk.tobytes())]
             if osd == self.osd_id:
+                pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                              shard=shard)
+                       if rider else None)
                 code = self._apply_partial(pgid, m.oid, shard, ext, version,
                                            create_ok=create,
                                            total_len=new_len,
-                                           prev_version=prev_version)
+                                           prev_version=prev_version,
+                                           pre_tx=pre)
                 if code == EAGAIN:
                     local_retry += 1
                 elif code != 0:
@@ -959,7 +1103,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     f"osd.{osd}",
                     MSubPartialWrite(tid, pgid, m.oid, shard, version, ext,
                                      total_len=new_len, create=create,
-                                     prev_version=prev_version))
+                                     prev_version=prev_version,
+                                     epoch=self._entry_epoch(),
+                                     snap=rider or {}))
         if remote == 0:
             result = EIO if local_failed else (EAGAIN if local_retry else 0)
             conn.send(MOSDOpReply(m.tid, result,
@@ -972,7 +1118,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _ec_partial_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
                           codec, si: StripeInfo, object_size: int,
-                          lock_key: tuple | None = None) -> None:
+                          lock_key: tuple | None = None,
+                          rider: dict | None = None) -> None:
         """Parity-delta overwrite: read ONLY the old bytes being replaced,
         write the new bytes to their data-shard extents, and fold
         coef*delta into every parity shard at the same shard offsets — no
@@ -998,7 +1145,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 # parity — take the row-rmw path, which decodes from a
                 # version-agreed set instead
                 self._ec_rmw_rows(_ClientConn(self, m.client), m, pgid,
-                                  up, codec, si, object_size, lock_key)
+                                  up, codec, si, object_size, lock_key,
+                                  rider=rider)
                 return
             prev = vers.pop()
             version = self._next_version(pgid)
@@ -1037,16 +1185,22 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     continue
                 ext = news.get(shard, [])
                 if osd == self.osd_id:
+                    pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                                  shard=shard)
+                           if rider else None)
                     tally(self._apply_partial(pgid, m.oid, shard, ext,
                                               version, total_len=new_len,
-                                              prev_version=prev))
+                                              prev_version=prev,
+                                              pre_tx=pre))
                 else:
                     remote += 1
                     self.messenger.send_message(
                         f"osd.{osd}",
                         MSubPartialWrite(wtid, pgid, m.oid, shard, version,
                                          ext, total_len=new_len,
-                                         prev_version=prev))
+                                         prev_version=prev,
+                                         epoch=self._entry_epoch(),
+                                         snap=rider or {}))
             # parity shards: one delta message covering all data deltas
             flat = [(ds, soff, dbytes) for ds, lst in deltas.items()
                     for soff, dbytes in lst]
@@ -1054,17 +1208,23 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 if osd is None or shard < codec.k:
                     continue
                 if osd == self.osd_id:
+                    pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                                  shard=shard)
+                           if rider else None)
                     tally(self._apply_delta_local(pgid, m.oid, shard,
                                                   flat, version,
                                                   total_len=new_len,
-                                                  prev_version=prev))
+                                                  prev_version=prev,
+                                                  pre_tx=pre))
                 else:
                     remote += 1
                     self.messenger.send_message(
                         f"osd.{osd}",
                         MSubDelta(wtid, pgid, m.oid, shard, version,
                                   list(flat), total_len=new_len,
-                                  prev_version=prev))
+                                  prev_version=prev,
+                                  epoch=self._entry_epoch(),
+                                  snap=rider or {}))
             # refill the extent cache with the bytes just written (the
             # next overlapping overwrite skips the read fan); failure
             # paths invalidate
@@ -1128,7 +1288,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _ec_rmw_rows(self, conn, m: MOSDOp, pgid: PgId, up: list, codec,
                      si: StripeInfo, object_size: int,
-                     lock_key: tuple | None = None) -> None:
+                     lock_key: tuple | None = None,
+                     rider: dict | None = None) -> None:
         """Read-modify-write over the touched stripe rows ONLY (never the
         whole object): read the rows' shard extents from >= k shards
         (decoding when degraded), merge the new bytes, re-encode the rows,
@@ -1148,7 +1309,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                 create=object_size == 0,
                                 prev_version=self._ec_object_version(
                                     pgid, m.oid) if object_size else -1,
-                                lock_key=lock_key)
+                                lock_key=lock_key, rider=rider)
             return
         want_len = read_rows * si.chunk_size
         ext = [(row0 * si.chunk_size, want_len)]
@@ -1192,7 +1353,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             buf[start:start + len(m.data)] = m.data
             self._ec_write_rows(_ClientConn(self, m.client), m, pgid, up,
                                 codec, si, row0, bytes(buf), new_len,
-                                prev_version=vmax, lock_key=lock_key)
+                                prev_version=vmax, lock_key=lock_key,
+                                rider=rider)
 
         pr = _PendingRead(None, 0, pgid.pool, m.oid,
                           total_shards=sum(1 for u in up if u is not None),
@@ -1247,6 +1409,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                       len(data)).to_bytes()
                 old += b"\0" * (len(data) - len(old))
                 rollback.append((coff, old))
+        ev = self._entry_epoch()
         for coff, data in extents:
             tx.write(cid, obj, coff, data)
         self._log_apply(tx, pgid, LogEntry(
@@ -1254,13 +1417,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             prev_version=int(old_attrs.get("v", -1)),
             rollback=rollback,
             old_len=int(old_attrs.get("len", -1)),
-            old_shard_len=old_shard_len))
+            old_shard_len=old_shard_len, epoch=ev))
         self.store.queue_transaction(tx)
         data = self.store.read(cid, obj).to_bytes()
         attrs = dict(self.store.getattrs(cid, obj))
         if extra_attrs:
             attrs.update(extra_attrs)
+        if attrs.get("wh"):
+            attrs["wh"] = 0  # extents land = the object lives again
         attrs["v"] = version
+        attrs["ev"] = ev
         attrs["d"] = native_crc32c(data)
         if shard < 0:
             # replicated: the object IS the data; track its size for stat
@@ -1276,7 +1442,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _apply_delta_local(self, pgid: PgId, oid: str, parity_shard: int,
                            extents: list, version: int,
                            total_len: int | None = None,
-                           prev_version: int = -1) -> int:
+                           prev_version: int = -1,
+                           pre_tx: Transaction | None = None) -> int:
         """Fold coef*delta extents into the stored parity chunk via the
         plugin's apply_delta (one chunk read/write for the whole batch).
         Returns 0, ENOENT (parity chunk absent — shard not yet
@@ -1308,15 +1475,22 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                               {parity_shard: view})
         return self._apply_partial(pgid, oid, parity_shard,
                                    [(lo, buf.tobytes())], version,
-                                   total_len=total_len)
+                                   total_len=total_len, pre_tx=pre_tx)
 
     def _handle_sub_partial_write(self, conn, m: MSubPartialWrite) -> None:
         self.perf.inc("subop_w")
-        code = self._apply_partial(
-            m.pgid, m.oid, m.shard, m.extents, m.version,
-            create_ok=m.create,
-            total_len=m.total_len if m.total_len >= 0 else None,
-            prev_version=m.prev_version)
+        self._sub_epoch.v = m.epoch
+        try:
+            pre = (self._snap_apply_rider(m.pgid, m.oid, m.snap,
+                                          shard=m.shard)
+                   if m.snap else None)
+            code = self._apply_partial(
+                m.pgid, m.oid, m.shard, m.extents, m.version,
+                create_ok=m.create,
+                total_len=m.total_len if m.total_len >= 0 else None,
+                prev_version=m.prev_version, pre_tx=pre)
+        finally:
+            self._sub_epoch.v = 0
         if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
@@ -1325,10 +1499,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _handle_sub_delta(self, conn, m: MSubDelta) -> None:
         self.perf.inc("subop_w")
-        code = self._apply_delta_local(
-            m.pgid, m.oid, m.parity_shard, m.extents, m.version,
-            total_len=m.total_len if m.total_len >= 0 else None,
-            prev_version=m.prev_version)
+        self._sub_epoch.v = m.epoch
+        try:
+            pre = (self._snap_apply_rider(m.pgid, m.oid, m.snap,
+                                          shard=m.parity_shard)
+                   if m.snap else None)
+            code = self._apply_delta_local(
+                m.pgid, m.oid, m.parity_shard, m.extents, m.version,
+                total_len=m.total_len if m.total_len >= 0 else None,
+                prev_version=m.prev_version, pre_tx=pre)
+        finally:
+            self._sub_epoch.v = 0
         if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
@@ -1337,6 +1518,23 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
         si = self._pool_stripe(pgid.pool)
+        target = m.oid
+        if getattr(m, "snapid", 0):
+            # snapshot read: resolve to the clone vname serving snapid
+            # (find_object_context role; the shard reads then address
+            # each shard's generation object via to_oid)
+            target = self._ec_snap_resolve(pgid, m.oid, m.snapid)
+            if target is None:
+                conn.send(MOSDOpReply(m.tid, ENOENT,
+                                      epoch=self.osdmap.epoch))
+                return
+        elif self._ec_whiteout(pgid, m.oid):
+            conn.send(MOSDOpReply(m.tid, ENOENT,
+                                  epoch=self.osdmap.epoch))
+            return
+        if target != m.oid:
+            import dataclasses
+            m = dataclasses.replace(m, oid=target)
         tid = next(self._tids)
         extents = None
         row_base = row_len = 0
@@ -1385,7 +1583,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _deliver_local_shard_read(self, tid, pgid, oid, shard,
                                   extents: list | None = None) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = ObjectId(oid, shard=shard)
+        obj = to_oid(oid, shard)  # vname-aware: clones read their gen
         try:
             data = self._read_shard_slices(cid, obj, extents)
             attrs = dict(self.store.getattrs(cid, obj))
@@ -1405,7 +1603,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _handle_sub_read(self, conn, m: MSubRead) -> None:
         self.perf.inc("subop_r")
         cid = CollectionId(m.pgid.pool, m.pgid.seed)
-        obj = ObjectId(m.oid, shard=m.shard)
+        obj = to_oid(m.oid, m.shard)  # vname-aware (clone shards)
         try:
             data = self._read_shard_slices(cid, obj, m.extents)
             attrs = dict(self.store.getattrs(cid, obj))
@@ -1562,20 +1760,38 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _ec_remove(self, conn, m: MOSDOp, pgid: PgId, up: list,
                    lock_key: tuple | None = None) -> None:
+        # a head with clones (or a snapc staging one) must leave its
+        # SnapSet behind: per-shard whiteout, not removal (snapdir role)
+        _ign, rider = self._snap_prepare(pgid, m)
+        ss = self._ec_load_ss(pgid, m.oid)
+        whiteout = bool((ss or {}).get("clones")) or (
+            rider is not None and rider.get("clone", -1) >= 0)
         version = self._next_version(pgid)
-        self._record_tombstone(pgid, m.oid, version)
+        if not whiteout:
+            self._record_tombstone(pgid, m.oid, version)
         tid = next(self._tids)
         remote = 0
+        sub_attrs = {"_snap": rider} if rider is not None else {}
         for shard, osd in enumerate(up):
             if osd is None:
                 continue
             if osd == self.osd_id:
-                self._apply_remove(pgid, m.oid, shard, version)
+                if whiteout:
+                    pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                                  shard=shard)
+                           if rider is not None else None)
+                    self._apply_whiteout(pgid, m.oid, version,
+                                         pre_tx=pre, shard=shard)
+                else:
+                    self._apply_remove(pgid, m.oid, shard, version)
             else:
                 remote += 1
                 self.messenger.send_message(
                     f"osd.{osd}",
-                    MSubWrite(tid, pgid, m.oid, shard, version, "remove"))
+                    MSubWrite(tid, pgid, m.oid, shard, version,
+                              "whiteout" if whiteout else "remove",
+                              attrs=dict(sub_attrs),
+                              epoch=self._entry_epoch()))
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
@@ -1593,6 +1809,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         oid = vname_of(obj)  # canonical: log/tombstones use the vname
         # stored digest for deep scrub (per-blob csum, BlueStore role)
         attrs = dict(attrs, d=native_crc32c(data))
+        # entry epoch: a recovery push carries the authority's stamp in
+        # "ev" (it must survive verbatim or the re-pushed entry forks
+        # again); otherwise the minting/sub-op epoch
+        ev = int(attrs.get("ev", 0)) or self._entry_epoch()
+        attrs["ev"] = ev
         tx = Transaction()
         if cid not in self.store.list_collections():
             tx.create_collection(cid)
@@ -1623,7 +1844,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._log_apply(tx, pgid, LogEntry(
                 int(attrs["v"]), "write", oid, shard,
                 prev_version=int(old.get("v", -1)),
-                old_len=int(old.get("len", -1))))
+                old_len=int(old.get("len", -1)), epoch=ev))
         self.store.queue_transaction(tx)
 
     def _handle_sub_write(self, conn, m: MSubWrite) -> None:
@@ -1633,9 +1854,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             # applying — a lost apply that scrub must later catch
             conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
             return
+        self._sub_epoch.v = m.epoch
+        try:
+            self._do_sub_write(conn, m)
+        finally:
+            self._sub_epoch.v = 0
+
+    def _do_sub_write(self, conn, m: MSubWrite) -> None:
         attrs = dict(m.attrs)
         rider = attrs.pop("_snap", None)
-        pre_tx = (self._snap_apply_rider(m.pgid, m.oid, rider)
+        pre_tx = (self._snap_apply_rider(m.pgid, m.oid, rider,
+                                         shard=m.shard)
                   if rider is not None else None)
         if m.op == "write":
             self._apply_write(m.pgid, m.oid, m.shard, m.data,
@@ -1651,22 +1880,25 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                          self.osd_id, code))
                 return
         elif m.op == "whiteout":
-            self._apply_whiteout(m.pgid, m.oid, m.version, pre_tx=pre_tx)
+            self._apply_whiteout(m.pgid, m.oid, m.version, pre_tx=pre_tx,
+                                 shard=m.shard)
         elif m.op == "snap_rollback":
             from ..msg.wire import unpack_value
             p = unpack_value(m.data)
             r = p.get("rider")
-            rb_pre = (self._snap_apply_rider(m.pgid, m.oid, r)
+            rb_pre = (self._snap_apply_rider(m.pgid, m.oid, r,
+                                             shard=m.shard)
                       if r else None)
             self._apply_snap_rollback(m.pgid, m.oid, int(p["cloneid"]),
                                       bytes(p["ss"]), m.version,
-                                      pre_tx=rb_pre)
+                                      pre_tx=rb_pre, shard=m.shard,
+                                      total_len=int(p.get("total", -1)))
         elif m.op == "trim_clone":
             from ..msg.wire import unpack_value
             p = unpack_value(m.data)
             self._apply_trim(m.pgid, m.oid, int(p["snapid"]),
                              bytes(p["ss"]), bool(p["drop_head"]),
-                             m.version)
+                             m.version, shard=m.shard)
         elif m.op == "remove":
             self._apply_remove(m.pgid, m.oid, m.shard, m.version)
         elif m.op in ("omap_set", "omap_rm"):
@@ -1738,6 +1970,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         ticks = 0
         while not self._stop.wait(interval):
             now = time.time()
+            self._sub_epoch.v = 0  # fresh epoch pin per hb-thread sweep
             # osd-beacon role (runs even before the FIRST map arrives):
             # map silence means the mon dropped our subscription (marked
             # us down / lost our boot during an election) or died —
@@ -1957,6 +2190,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     return
                 self._recovery_inflight += 1
                 pgid, thunk = self._recovery_q.popleft()
+            self._sub_epoch.v = 0  # fresh epoch pin per recovery op
             try:
                 thunk()
             except Exception:  # noqa: BLE001 - one op must not wedge the pump
@@ -2015,44 +2249,97 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self._remote_reserver.release(key)
 
     # ------------------------------------------------------ peering/recovery
+    def _osd_alive(self, osd: int) -> bool:
+        info = self.osdmap.osds.get(osd) if self.osdmap else None
+        return info is not None and info.up
+
+    def _peer_query_set(self, pgid: PgId, up) -> set[int]:
+        """Who a peering round must hear from: the up members PLUS
+        every alive OSD that was a member of a maybe-active interval
+        since the les fence (PastIntervals prior-set construction,
+        PeeringState.h:1485).  An interval with NO surviving member
+        contributes the -1 Down sentinel, wedging the PG until a
+        revival/new map."""
+        peers = {osd for osd in up
+                 if osd is not None and osd != self.osd_id}
+        pi, les = self._pi(pgid), self._les(pgid)
+        prior = pi.prior_osds(since=les, exclude=self.osd_id)
+        peers |= {o for o in prior if self._osd_alive(o)}
+        for itv in pi.intervals:
+            if itv.last < les or not itv.maybe_went_active():
+                continue
+            members = {o for o in itv.up if o is not None}
+            if self.osd_id in members:
+                continue  # I was there: I hold that history myself
+            if members and not any(self._osd_alive(o)
+                                   for o in members):
+                dout("osd", 1)("%s: %s down — interval [%d,%d] has "
+                               "no surviving member", self.name,
+                               pgid, itv.first, itv.last)
+                peers.add(-1)
+        return peers
+
     def _start_recovery(self) -> None:
         """Primary-side: inventory peers for my PGs (recovery-lite).  PGs
         wait in 'peering' (IO blocked with EAGAIN) until every alive up
         member has answered, so a freshly-promoted primary cannot serve
-        stale data (the GetInfo/GetMissing phase of the peering FSM)."""
+        stale data (the GetInfo/GetMissing phase of the peering FSM).
+
+        The query set is the up members PLUS every alive OSD that was a
+        member of a maybe-active interval since the last peering fence
+        (PastIntervals prior-set construction, PeeringState.h:1485): a
+        re-promoted primary must hear from holders that took writes
+        while it was away.  An interval with NO surviving member blocks
+        the PG entirely (the reference's Down state) until one revives
+        or the membership changes."""
         for pool_id, seed, up in self._pools_pgs_for_me():
             if self._primary_of(up) != self.osd_id:
                 pg = PgId(pool_id, seed)
                 self._peering.pop(pg, None)
+                self._fence_round.pop(pg, None)
                 self._peer_invs.pop(pg, None)
                 self._peer_lcs.pop(pg, None)
                 continue
             pgid = PgId(pool_id, seed)
             # fresh round: stale cached inventories/log-positions must
             # not feed rollback decisions (they could roll back writes
-            # committed since they were collected)
+            # committed since they were collected) — and a stale shadow
+            # fence round must not close against the NEW epoch's round
+            # (its answers would fence an epoch whose prior-set queries
+            # never completed)
             self._peer_invs.pop(pgid, None)
             self._peer_lcs.pop(pgid, None)
-            peers = {osd for osd in up
-                     if osd is not None and osd != self.osd_id}
+            self._fence_round.pop(pgid, None)
+            self._peering_epoch[pgid] = self.osdmap.epoch
+            peers = self._peer_query_set(pgid, up)
             if peers:
                 self._peering[pgid] = set(peers)
             else:
                 self._peering.pop(pgid, None)
-            pl = self._pglog(pgid)
+                # trivially peered (no peers to hear from): fence now
+                self._set_les(pgid, self.osdmap.epoch)
+            ents = self._pglog(pgid).entries()  # one decode
+            last = ents[-1].version if ents else 0
+            floor_v = ents[0].version if ents else 0
             for osd in peers:
+                if osd < 0:
+                    continue  # the Down sentinel, not a peer
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MPGQuery(pgid, self.osdmap.epoch,
-                             primary_last=pl.last_version(),
-                             primary_floor=pl.floor()))
+                             primary_last=last,
+                             primary_floor=floor_v))
             # also reconcile my own shard inventory immediately
             self._handle_pg_info(None, self._my_pg_info(pgid))
 
     def _my_pg_info(self, pgid: PgId) -> MPGInfo:
+        ents = self._pglog(pgid).entries()  # one decode for head + evs
         return MPGInfo(pgid, self.osd_id, -2, self._inventory(pgid),
                        dict(self._tombstones.get(pgid, {})),
-                       last_complete=self._lc(pgid))
+                       last_complete=self._lc(pgid),
+                       head_epoch=ents[-1].epoch if ents else 0,
+                       log_evs={e.version: e.epoch for e in ents},
+                       les=self._les(pgid))
 
     def _inventory(self, pgid: PgId) -> dict:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -2070,22 +2357,204 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         return out
 
     def _handle_pg_query(self, conn, m: MPGQuery) -> None:
-        pl = self._pglog(m.pgid)
+        # ONE log decode feeds head/floor/evs (the peering hot path —
+        # every query/info otherwise re-reads the whole omap window)
+        ents = self._pglog(m.pgid).entries()
         lc = self._lc(m.pgid)
+        last = ents[-1].version if ents else 0
+        head_epoch = ents[-1].epoch if ents else 0
         # LEAN fast path (log-based GetLog): my log is gapless through lc
         # and the primary can delta-replay from there — skip the
-        # O(objects) inventory walk entirely
+        # O(objects) inventory walk entirely.  head_epoch rides along so
+        # the primary can detect a fork at my head (same version, other
+        # interval) and demand the full log.
         if (not m.force_full and m.primary_last >= 0
-                and lc == pl.last_version()
+                and lc == last
                 and lc <= m.primary_last
                 and (lc + 1 >= m.primary_floor or lc == m.primary_last)):
             conn.send(MPGInfo(m.pgid, self.osd_id, -2, {},
                               dict(self._tombstones.get(m.pgid, {})),
-                              last_complete=lc, lean=True))
+                              last_complete=lc, lean=True,
+                              head_epoch=head_epoch,
+                              les=self._les(m.pgid)))
             return
         conn.send(MPGInfo(m.pgid, self.osd_id, -2, self._inventory(m.pgid),
                           dict(self._tombstones.get(m.pgid, {})),
-                          last_complete=lc))
+                          last_complete=lc, head_epoch=head_epoch,
+                          log_evs={e.version: e.epoch for e in ents},
+                          les=self._les(m.pgid)))
+
+    def _rearm_peering(self, pgid: PgId, block: bool = True) -> None:
+        """Run another peering round.  block=True (a fork surfaced —
+        possibly after the round closed): the PG re-peers with IO
+        re-blocked until a round completes CLEAN.  block=False (routine
+        recovery completion): the les fence still needs one clean round
+        of answers, but client IO keeps flowing — the answers drain a
+        shadow waiting set instead of the peering gate."""
+        up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+        if self._primary_of(up) != self.osd_id:
+            return
+        # the SAME prior-set construction as _start_recovery: a re-armed
+        # round dropping the Down sentinel (or unanswered prior holders)
+        # would close clean, fence, and trim the very interval evidence
+        # that wedged the PG
+        peers = self._peer_query_set(pgid, up)
+        if not peers:
+            return
+        if not block and -1 in peers:
+            # a Down interval outlaws the fence anyway: nothing to do
+            return
+        self._peering_epoch[pgid] = self.osdmap.epoch
+        if block:
+            self._fence_round.pop(pgid, None)
+            self._peering[pgid] = set(peers)
+        else:
+            self._fence_round[pgid] = set(peers)
+        # queries go out DIRECTLY: _requery_pg's debounce could swallow
+        # a second rearm inside its window, leaving an armed wait set
+        # nobody will ever drain (client IO wedged until the next epoch)
+        ents = self._pglog(pgid).entries()
+        last = ents[-1].version if ents else 0
+        floor_v = ents[0].version if ents else 0
+        for osd in peers:
+            if osd < 0:
+                continue  # the Down sentinel, not a peer
+            self.messenger.send_message(
+                f"osd.{osd}",
+                MPGQuery(pgid, self.osdmap.epoch,
+                         primary_last=last, primary_floor=floor_v,
+                         force_full=block))
+
+    def _merge_peer_log(self, pgid: PgId, m: MPGInfo) -> bool:
+        """Divergent-entry merge (PGLog.h:1344 _merge_divergent_entries
+        re-shaped): the same version logged under two different epochs
+        is a fork — the entry from the NEWER interval is authoritative
+        (the older interval's primary lost quorum before committing it,
+        or the newer interval could never have re-minted the version).
+        Discard the loser's tail from the fork point and let normal
+        recovery re-push the authority's content.  Returns True when a
+        fork was found and resolution scheduled (the caller must not
+        schedule normal recovery off this info)."""
+        pl = self._pglog(pgid)
+        ents = pl.entries()  # one decode feeds every rule below
+        my_evs = {e.version: e.epoch for e in ents}
+        my_last = ents[-1].version if ents else 0
+        my_les = self._les(pgid)
+        if m.lean:
+            # lean infos carry only the head; a fork at the peer's head
+            # version is detectable, but the fork POINT needs its whole
+            # log — demand a full answer and resolve on that
+            if m.head_epoch <= 0 or m.last_complete <= 0:
+                return False
+            mine = my_evs.get(m.last_complete, 0)
+            same_v_fork = mine > 0 and mine != m.head_epoch
+            # a head BEYOND my log from an interval older than my fence
+            # is a phantom tail (never committed) — also needs full log
+            phantom_head = (m.last_complete > my_last
+                            and 0 < m.head_epoch < my_les)
+            # MY entries beyond the peer's head from intervals older
+            # than the peer's fence are phantoms of my own — delta-
+            # pushing them to the lean peer would resurrect a dead
+            # interval's writes; discard them instead (resolvable
+            # directly, no full log needed)
+            mine_ph = sorted(v for v, e in my_evs.items()
+                             if v > m.last_complete and 0 < e < m.les)
+            if mine_ph:
+                d = mine_ph[0]
+                dout("osd", 1)("%s: %s MY phantom tail from v%d "
+                               "(epoch %d < lean peer les %d): "
+                               "discarding", self.name, pgid, d,
+                               my_evs[d], m.les)
+                self._rearm_peering(pgid)
+                self._handle_pg_rollback(
+                    None, MPGRollback(pgid, "", -3, d - 1,
+                                      divergent=True, max_epoch=m.les))
+                self._handle_pg_info(None, m)
+                return True
+            if not same_v_fork and not phantom_head:
+                return False
+            dout("osd", 1)("%s: %s head fork at v%d with osd.%d "
+                           "(epoch %d vs %d, les %d): demanding full "
+                           "log", self.name, pgid, m.last_complete,
+                           m.from_osd, m.head_epoch, mine, my_les)
+            self._rearm_peering(pgid)
+            self.messenger.send_message(
+                f"osd.{m.from_osd}",
+                MPGQuery(pgid, self.osdmap.epoch,
+                         primary_last=my_last,
+                         primary_floor=ents[0].version if ents else 0,
+                         force_full=True))
+            return True
+        if not m.log_evs:
+            return False
+        conflicts = sorted(
+            v for v, pe in m.log_evs.items()
+            if pe and my_evs.get(v, 0) and my_evs[v] != pe)
+        if conflicts:
+            d = conflicts[0]
+            if my_evs[d] > m.log_evs[d]:
+                # the peer's tail is the dead interval's: it must
+                # discard from the fork point; its post-rollback info
+                # re-enters here and normal recovery re-pushes mine
+                dout("osd", 1)("%s: %s osd.%d divergent from v%d "
+                               "(epoch %d < %d): discarding its tail",
+                               self.name, pgid, m.from_osd, d,
+                               m.log_evs[d], my_evs[d])
+                self._rearm_peering(pgid)
+                self.messenger.send_message(
+                    f"osd.{m.from_osd}",
+                    MPGRollback(pgid, "", -3, d - 1, divergent=True,
+                                max_epoch=my_evs[d]))
+                return True
+            # I am the divergent one (re-promoted after my interval
+            # died): discard my own tail, then re-process this info
+            # with fresh state — peer objects I now miss get pulled
+            dout("osd", 1)("%s: %s MY log divergent from v%d (epoch "
+                           "%d < %d): discarding my tail", self.name,
+                           pgid, d, my_evs[d], m.log_evs[d])
+            self._rearm_peering(pgid)
+            self._handle_pg_rollback(
+                None, MPGRollback(pgid, "", -3, d - 1, divergent=True,
+                                  max_epoch=m.log_evs[d]))
+            self._handle_pg_info(None, m)
+            return True
+        # phantom tails (find_best_info's les-first rule): entries one
+        # side holds BEYOND the other's head, stamped with an interval
+        # older than the other's les fence, never committed — an
+        # interval went active without them.  Adopting them would
+        # resurrect writes whose absence was already served to readers.
+        phantom_peer = sorted(v for v, pe in m.log_evs.items()
+                              if v > my_last and 0 < pe < my_les)
+        if phantom_peer and self._stale_objects.get(pgid):
+            # my own log is known-incomplete (pulls outstanding): my
+            # fence cannot judge anyone — wait for recovery to finish
+            phantom_peer = []
+        if phantom_peer:
+            d = phantom_peer[0]
+            dout("osd", 1)("%s: %s osd.%d phantom tail from v%d "
+                           "(epoch %d < les %d): discarding", self.name,
+                           pgid, m.from_osd, d, m.log_evs[d], my_les)
+            self._rearm_peering(pgid)
+            self.messenger.send_message(
+                f"osd.{m.from_osd}",
+                MPGRollback(pgid, "", -3, d - 1, divergent=True,
+                            max_epoch=my_les))
+            return True
+        peer_last = max(m.log_evs) if m.log_evs else 0
+        phantom_mine = sorted(v for v, e in my_evs.items()
+                              if v > peer_last and 0 < e < m.les)
+        if phantom_mine:
+            d = phantom_mine[0]
+            dout("osd", 1)("%s: %s MY phantom tail from v%d (epoch %d "
+                           "< peer les %d): discarding", self.name,
+                           pgid, d, my_evs[d], m.les)
+            self._rearm_peering(pgid)
+            self._handle_pg_rollback(
+                None, MPGRollback(pgid, "", -3, d - 1, divergent=True,
+                                  max_epoch=m.les))
+            self._handle_pg_info(None, m)
+            return True
+        return False
 
     def _handle_pg_info(self, conn, m: MPGInfo) -> None:
         """Primary: compare a peer's state against authority and schedule
@@ -2103,17 +2572,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         for name, v in m.tombstones.items():
             self._record_tombstone(m.pgid, name, v)
         dead = self._tombstones.get(m.pgid, {})
-        # peering bookkeeping: learn versions, note objects I am behind on
-        # (they stay blocked until the pull lands), retire the peer
-        my_best: dict[str, int] = {}
-        for (name, _s), v in my_inv.items():
-            my_best[name] = max(my_best.get(name, -1), v)
-        stale = self._stale_objects.setdefault(m.pgid, {})
-        for (name, _s), v in peer_inv.items():
+        for (_name, _s), v in peer_inv.items():
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), v)
-            if v > my_best.get(name, -1) and dead.get(name, -1) < v:
-                stale[name] = max(stale.get(name, 0), v)
         waiting = self._peering.get(m.pgid)
         done_peering = False
         if waiting is not None:
@@ -2121,9 +2582,50 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if not waiting:
                 del self._peering[m.pgid]
                 done_peering = True
+        fence = self._fence_round.get(m.pgid)
+        fence_done = False
+        if fence is not None:
+            fence.discard(m.from_osd)
+            if not fence:
+                del self._fence_round[m.pgid]
+                fence_done = True  # shadow round closed clean
         if m.last_complete >= 0:
             self._peer_lcs.setdefault(m.pgid, {})[m.from_osd] = \
                 m.last_complete
+        if m.from_osd != self.osd_id and \
+                self._merge_peer_log(m.pgid, m):
+            # a fork was found: resolution (divergent-head discard +
+            # re-push from authority) is in flight; scheduling normal
+            # recovery (or a lean checkpoint) off this info would bless
+            # the divergent log.  The les fence deliberately does NOT
+            # advance here: trimming the interval history before the
+            # fork is resolved would lose the evidence that the prior
+            # holder must be consulted again after a crash.
+            return
+        # peering bookkeeping: note objects I am behind on (they stay
+        # blocked until the pull lands) — AFTER the fork check, so a
+        # divergent peer's doomed versions never wedge the stale gate
+        my_best: dict[str, int] = {}
+        for (name, _s), v in my_inv.items():
+            my_best[name] = max(my_best.get(name, -1), v)
+        stale = self._stale_objects.setdefault(m.pgid, {})
+        for (name, _s), v in peer_inv.items():
+            if v > my_best.get(name, -1) and dead.get(name, -1) < v:
+                stale[name] = max(stale.get(name, 0), v)
+        if (done_peering or fence_done) and not stale:
+            # every member (incl. prior-interval holders) answered a
+            # round that closed with no fork and nothing known-missing:
+            # the PG is peered — fence + trim the history.  The fence
+            # advances to the epoch of the round that COMPLETED (not
+            # the live map epoch: a straggler racing a map push must
+            # not fence an epoch whose prior-set query never ran), and
+            # ONLY via a closing round: fork resolution re-arms the
+            # round (below), so a fence can never be taken off the
+            # hollow mid-resolution state — round 4's first cut did,
+            # and the bogus fence made the phantom rule discard
+            # committed writes on a temp-primary.
+            self._set_les(m.pgid,
+                          self._peering_epoch.get(m.pgid, 0))
         if m.lean:
             self._delta_recover(m.pgid, pool, up, m.from_osd,
                                 m.last_complete, dead)
@@ -2482,17 +2984,37 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             span = sorted((e for e in pl.entries()
                            if e.version > m.to_version),
                           key=lambda e: e.version)
-            firsts: dict[tuple, LogEntry] = {}
+            groups: dict[tuple, list[LogEntry]] = {}
             for e in span:
-                firsts.setdefault((e.oid, e.shard), e)
-            for (oid, shard), first in firsts.items():
+                groups.setdefault((e.oid, e.shard), []).append(e)
+            for (oid, shard), group in groups.items():
+                if m.divergent and m.max_epoch > 0 and \
+                        group[-1].epoch >= m.max_epoch:
+                    # this object's NEWEST write belongs to an interval
+                    # that survived the fork (e.g. committed after a
+                    # rejoin): its content must be kept — only the
+                    # phantom entries below it are scrubbed from the
+                    # log (log hygiene without data loss)
+                    from .pglog import _key
+                    phantom = [e for e in group
+                               if e.epoch < m.max_epoch]
+                    if phantom:
+                        self.store.queue_transaction(
+                            Transaction().omap_rmkeys(
+                                cid, PGLOG_OID,
+                                [_key(e.version) for e in phantom]))
+                    continue
                 # PG-level undo is PRE-IMAGE ONLY: dropping a full-write
                 # shard here could destroy the only copy of its position
                 # without verifying the target version is decodable —
                 # that call belongs to the per-object reconcile, which
-                # checks k-support first
+                # checks k-support first.  EXCEPT divergent discard: the
+                # tail being dropped belongs to a dead interval and
+                # never committed — the authority re-pushes its own
+                # content, so dropping is the point (PGLog.h:1344)
                 self._rollback_one(m.pgid, pl, cid, oid, shard,
-                                   first.prev_version, allow_drop=False)
+                                   group[0].prev_version,
+                                   allow_drop=m.divergent)
         else:
             self._rollback_one(m.pgid, pl, cid, m.oid, m.shard,
                                m.to_version)
@@ -2724,6 +3246,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if stale:
             for name in list(m.objects) + list(m.deletes):
                 stale.pop(name, None)
+            if not stale and m.pgid not in self._peering:
+                # recovery just drained the last known-missing object:
+                # run one clean (non-blocking) round so the les fence
+                # (which only advances via a closing round) catches up
+                self._rearm_peering(m.pgid, block=False)
         if m.checkpoint >= 0 and m.checkpoint > self._lc(m.pgid):
             # the primary verified we need nothing through this version:
             # future peering rounds can take the lean (log) path
@@ -2751,12 +3278,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if self._primary_of(up) != self.osd_id:
             return
         self._requery_at[key] = now
-        pl = self._pglog(pgid)
+        ents = self._pglog(pgid).entries()  # one decode
+        last = ents[-1].version if ents else 0
+        floor_v = ents[0].version if ents else 0
         for osd in up:
             if osd is not None and osd != self.osd_id:
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MPGQuery(pgid, self.osdmap.epoch,
-                             primary_last=pl.last_version(),
-                             primary_floor=pl.floor(),
+                             primary_last=last,
+                             primary_floor=floor_v,
                              force_full=force_full))

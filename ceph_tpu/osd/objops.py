@@ -93,12 +93,6 @@ class ObjOpsMixin:
                     "getxattrs")
 
     def _handle_extended_op(self, conn, m, pgid: PgId, up: list) -> None:
-        pool = self.osdmap.pools[m.pool]
-        if pool.kind == "ec" and m.op in ("list_snaps", "snap_rollback"):
-            # self-managed snapshots are replicated-pool machinery
-            conn.send(MOSDOpReply(m.tid, EINVAL,
-                                  epoch=self.osdmap.epoch))
-            return
         handler = {
             "omap_get": self._op_omap_get,
             "omap_set": self._op_omap_mut,
@@ -153,7 +147,7 @@ class ObjOpsMixin:
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, shard, version, m.op,
-                          m.data))
+                          m.data, epoch=self._entry_epoch()))
 
     def _apply_omap(self, pgid: PgId, oid: str, op: str, payload,
                     version: int, create_ok: bool = False,
@@ -317,7 +311,8 @@ class ObjOpsMixin:
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, shard, version,
-                          "cls_effects", _pack(effects)))
+                          "cls_effects", _pack(effects),
+                          epoch=self._entry_epoch()))
 
     def _apply_cls_effects(self, pgid: PgId, oid: str, effects: dict,
                            version: int, shard: int = -1) -> None:
@@ -628,7 +623,8 @@ class ObjOpsMixin:
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, shard, version,
                           "multi_effects", payload,
-                          attrs=dict(sub_attrs)))
+                          attrs=dict(sub_attrs),
+                          epoch=self._entry_epoch()))
 
     def _apply_multi_effects(self, pgid: PgId, oid: str, eff: dict,
                              version: int, pre_tx=None,

@@ -43,6 +43,12 @@ class LogEntry:
     rollback: list = field(default_factory=list)
     old_len: int = -1   # object 'len' attr before the write (-1 unknown)
     old_shard_len: int = -1  # stored shard-stream bytes before the write
+    # map epoch of the interval the PRIMARY served this write in (the
+    # eversion_t epoch half, src/osd/osd_types.h): two entries at the
+    # same version from different epochs are divergent forks and the
+    # newer interval wins (src/osd/PGLog.h:1344 merge rule).  0 = legacy
+    # entry written before epochs were stamped (never treated as a fork)
+    epoch: int = 0
 
     def encode_bytes(self) -> bytes:
         e = Encoder()
@@ -59,7 +65,8 @@ class LogEntry:
                 se.blob(bytes(data))
             se.i64(self.old_len)
             se.i64(self.old_shard_len)  # v2 tail
-        e.versioned(2, 1, body)
+            se.u64(self.epoch)          # v3 tail
+        e.versioned(3, 1, body)
         return e.tobytes()
 
     @classmethod
@@ -74,8 +81,10 @@ class LogEntry:
             ent.old_len = sd.i64()
             if v >= 2:
                 ent.old_shard_len = sd.i64()
+            if v >= 3:
+                ent.epoch = sd.u64()
             return ent
-        return d.versioned(2, body)
+        return d.versioned(3, body)
 
 
 def _key(version: int) -> str:
@@ -137,6 +146,15 @@ class PGLog:
             return 0
         return LogEntry.decode_bytes(raw[max(raw)]).version
 
+    def last_epoch_version(self) -> tuple[int, int]:
+        """(epoch, version) of the newest entry — the log head as an
+        eversion: the divergence comparator (PGLog.h:1344)."""
+        raw = self._raw()
+        if not raw:
+            return (0, 0)
+        e = LogEntry.decode_bytes(raw[max(raw)])
+        return (e.epoch, e.version)
+
     def floor(self) -> int:
         """Oldest version still logged (0 = empty log)."""
         raw = self._raw()
@@ -169,7 +187,8 @@ class PGLog:
         rollback info (whole-object write or trimmed log) — the caller
         must then drop the shard object and let recovery rebuild it."""
         obj = ObjectId(oid, shard=shard)
-        span = sorted((e for e in self.entries_for(oid)
+        ents = self.entries_for(oid)  # one decode: span + ev lookup
+        span = sorted((e for e in ents
                        if e.shard == shard and e.version > to_version),
                       key=lambda e: -e.version)
         if not span:
@@ -192,6 +211,13 @@ class PGLog:
             data = data[: final.old_shard_len]
         attrs = dict(self._store.getattrs(self._cid, obj))
         attrs["v"] = to_version
+        # restore the entry-epoch attr too: leaving the discarded
+        # interval's stamp on a rolled-back object would ride a later
+        # recovery push and resurrect the dead interval's epoch in a
+        # fresh log entry (a phantom fork).  0 when the entry at
+        # to_version is trimmed/legacy — never treated as a fork.
+        attrs["ev"] = next((e.epoch for e in ents
+                            if e.version == to_version), 0)
         if final.old_len >= 0:
             attrs["len"] = final.old_len
         from ..ops.native import crc32c

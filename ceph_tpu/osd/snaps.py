@@ -27,8 +27,15 @@ SnapTrimmer), redesigned for this codebase's single-dispatch daemons:
   asynchronously — remove the clone, update the SnapSet, tombstone the
   virtual name so recovery never resurrects it.
 
-Replicated pools only (the reference gates snaps behind the same op
-breadth; EC-pool snapshot parity needs the EC overwrite log tier).
+EC pools take the same machinery SHARD-WISE: every helper carries a
+shard id (-1 = replicated head), the clone op copies each shard object
+to its generation variant (the encoded bytes of the head at snap time
+ARE the clone's encoded bytes — no re-encode), the SnapSet rides every
+shard's attrs like "len"/"wh" already do, and the rider travels on the
+EC sub-ops.  Rollback is per-shard clone->head copy; trim removes each
+shard's clone object.  (The reference keeps EC snapshots behind the
+overwrite journal — src/osd/PrimaryLogPG.cc snap paths + SnapMapper.cc;
+here the rollback-capable pglog plays that role.)
 """
 
 from __future__ import annotations
@@ -103,19 +110,42 @@ class SnapMixin:
     def _smap_oid(self) -> ObjectId:
         return ObjectId(SNAPMAPPER, shard=-2)
 
-    def _load_ss(self, cid: CollectionId, name: str) -> dict | None:
+    def _load_ss(self, cid: CollectionId, name: str,
+                 shard: int = -1) -> dict | None:
         try:
-            raw = self.store.getattrs(cid, ObjectId(name)).get("ss")
+            raw = self.store.getattrs(
+                cid, ObjectId(name, shard=shard)).get("ss")
         except (NoSuchObject, NoSuchCollection):
             return None
         return _unpack(raw) if raw else None
 
-    def _head_whiteout(self, cid: CollectionId, name: str) -> bool:
+    def _ec_load_ss(self, pgid: PgId, name: str) -> dict | None:
+        """SnapSet from ANY shard copy (the primary may hold any
+        position; a behind shard may lack the attr)."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        for shard in range(self.osdmap.pools[pgid.pool].size):
+            ss = self._load_ss(cid, name, shard=shard)
+            if ss is not None:
+                return ss
+        return None
+
+    def _head_whiteout(self, cid: CollectionId, name: str,
+                       shard: int = -1) -> bool:
         try:
-            return bool(self.store.getattrs(cid,
-                                            ObjectId(name)).get("wh"))
+            return bool(self.store.getattrs(
+                cid, ObjectId(name, shard=shard)).get("wh"))
         except (NoSuchObject, NoSuchCollection):
             return False
+
+    def _ec_whiteout(self, pgid: PgId, name: str) -> bool:
+        cid = CollectionId(pgid.pool, pgid.seed)
+        for shard in range(self.osdmap.pools[pgid.pool].size):
+            try:
+                a = self.store.getattrs(cid, ObjectId(name, shard=shard))
+            except (NoSuchObject, NoSuchCollection):
+                continue
+            return bool(a.get("wh"))
+        return False
 
     # --------------------------------------------- clone-on-write staging
     def _snap_prepare(self, pgid: PgId, m) -> tuple[Transaction | None,
@@ -123,8 +153,10 @@ class SnapMixin:
         """Primary, before a head write/remove: stage the make_writeable
         work.  Returns (pre_tx, rider) — pre_tx prepends to the write's
         transaction, rider travels to replicas in the sub-write attrs."""
-        if not m.snap_seq or self.osdmap.pools[pgid.pool].kind == "ec":
+        if not m.snap_seq:
             return None, None
+        if self.osdmap.pools[pgid.pool].kind == "ec":
+            return None, self._snap_prepare_ec(pgid, m)
         cid = CollectionId(pgid.pool, pgid.seed)
         name = m.oid
         head = ObjectId(name)
@@ -186,25 +218,70 @@ class SnapMixin:
         rider = {"clone": cloneid, "ss": ss_b, "v": clone_v}
         return tx, rider
 
+    def _snap_prepare_ec(self, pgid: PgId, m) -> dict | None:
+        """Primary, EC pool: compute the make_writeable decision and
+        the final SnapSet.  Returns the rider every shard holder
+        (primary included) applies locally via _snap_apply_rider — the
+        clone itself is per-shard (the encoded head bytes at snap time
+        ARE the clone's encoded bytes), so no pre-tx is staged here."""
+        name = m.oid
+        newest = max(m.snaps) if m.snaps else m.snap_seq
+        existing = self._ec_object_len(pgid, name)
+        if existing is None:
+            ss = {"seq": max(m.snap_seq, newest), "clones": [],
+                  "sz": {}, "ov": {},
+                  "born": max(m.snap_seq, newest)}
+            return {"clone": -1, "ss": _pack(ss), "v": -1}
+        ss = self._ec_load_ss(pgid, name) or \
+            {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+        whiteout = self._ec_whiteout(pgid, name)
+        if whiteout:
+            ss["born"] = max(ss.get("born", 0), m.snap_seq, newest)
+        need_clone = (m.snap_seq > ss["seq"]
+                      and newest not in ss["clones"] and not whiteout)
+        written: tuple[int, int] | None = None
+        if m.op == "write":
+            written = (m.offset, len(m.data))
+        elif m.op in ("write_full", "remove", "snap_rollback"):
+            written = (0, max(existing, len(getattr(m, "data", b""))))
+        cloneid = clone_v = -1
+        if need_clone:
+            cloneid = newest
+            ss["clones"] = sorted(set(ss["clones"]) | {cloneid})
+            ss["sz"][cloneid] = existing
+            ss["ov"][cloneid] = [[0, existing]]
+            clone_v = self._next_version(pgid)
+        ss["seq"] = max(ss["seq"], m.snap_seq, newest)
+        if written and ss["clones"]:
+            top = ss["clones"][-1]
+            ss["ov"][top] = _sub_intervals(
+                ss["ov"].get(top, []), written[0], written[1])
+        return {"clone": cloneid, "ss": _pack(ss), "v": clone_v}
+
     def _snap_apply_rider(self, pgid: PgId, name: str,
-                          rider: dict) -> Transaction:
-        """Replica: rebuild the primary's snap pre-tx deterministically
-        from the rider (ships the final SnapSet bytes)."""
+                          rider: dict, shard: int = -1) -> Transaction:
+        """Any holder: rebuild the primary's snap pre-tx
+        deterministically from the rider (ships the final SnapSet
+        bytes).  shard >= 0 clones/stamps that EC shard object."""
         cid = CollectionId(pgid.pool, pgid.seed)
-        head = ObjectId(name)
+        head = ObjectId(name, shard=shard)
         tx = Transaction()
         cloneid = int(rider.get("clone", -1))
+        clone = ObjectId(name, shard=shard, generation=cloneid)
         if cloneid >= 0 and self.store.exists(cid, head) and \
-                not self.store.exists(cid, ObjectId(name,
-                                                    generation=cloneid)):
-            tx.clone(cid, head, ObjectId(name, generation=cloneid))
+                not self.store.exists(cid, clone):
+            tx.clone(cid, head, clone)
             self._log_apply(tx, pgid, LogEntry(
                 int(rider.get("v", -1)), "write", vname(name, cloneid),
-                -1, prev_version=-1))
+                shard, prev_version=-1))
             tx.omap_setkeys(cid, self._smap_oid(),
                             {f"{cloneid:016x}.{name}": b""})
-        if self.store.exists(cid, head):
-            tx.setattrs(cid, head, {"ss": bytes(rider["ss"])})
+        if not self.store.exists(cid, head):
+            # creating write: the SnapSet (with its birth seq) must land
+            # WITH the object, or the next write under the same snapc
+            # sees no ss and stages a spurious clone of post-snap data
+            tx.touch(cid, head)
+        tx.setattrs(cid, head, {"ss": bytes(rider["ss"])})
         return tx
 
     # ------------------------------------------------------- read resolve
@@ -233,9 +310,40 @@ class SnapMixin:
             return None
         return ObjectId(name)
 
+    def _ec_snap_resolve(self, pgid: PgId, name: str,
+                         snapid: int) -> str | None:
+        """find_object_context for EC pools: which VNAME serves a read
+        at snapid?  None = ENOENT.  Existence is judged from the
+        SnapSet, not per-shard probes — degraded clones decode from the
+        surviving shards like any other object."""
+        if snapid == 0:
+            return None if self._ec_whiteout(pgid, name) else name
+        ss = self._ec_load_ss(pgid, name)
+        clones = (ss or {}).get("clones", [])
+        covering = [c for c in clones if c >= snapid]
+        if covering:
+            return vname(name, min(covering))
+        if ss and snapid <= ss.get("born", 0):
+            return None
+        if self._ec_whiteout(pgid, name):
+            return None
+        return name
+
     # ------------------------------------------------------- extended ops
     def _op_list_snaps(self, conn, m, pgid: PgId, up: list) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
+        if self.osdmap.pools[pgid.pool].kind == "ec":
+            if self._ec_object_len(pgid, m.oid) is None:
+                conn.send(MOSDOpReply(m.tid, ENOENT,
+                                      epoch=self.osdmap.epoch))
+                return
+            ss = self._ec_load_ss(pgid, m.oid) or \
+                {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+            out = dict(ss)
+            out["head"] = not self._ec_whiteout(pgid, m.oid)
+            conn.send(MOSDOpReply(m.tid, 0, data=_pack(out),
+                                  epoch=self.osdmap.epoch))
+            return
         if not self.store.exists(cid, ObjectId(m.oid)):
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
             return
@@ -259,6 +367,9 @@ class SnapMixin:
 
     def _do_snap_rollback(self, conn, m, pgid: PgId, up: list,
                           lock_key) -> None:
+        if self.osdmap.pools[pgid.pool].kind == "ec":
+            self._do_snap_rollback_ec(conn, m, pgid, up, lock_key)
+            return
         cid = CollectionId(pgid.pool, pgid.seed)
         name = m.oid
         # rollback is a head WRITE: it goes through make_writeable, so
@@ -297,13 +408,16 @@ class SnapMixin:
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, name, -1, version, "snap_rollback",
-                          payload))
+                          payload, epoch=self._entry_epoch()))
 
     def _apply_snap_rollback(self, pgid: PgId, name: str, cloneid: int,
                              ss_b: bytes, version: int,
-                             pre_tx: Transaction | None = None) -> None:
+                             pre_tx: Transaction | None = None,
+                             shard: int = -1,
+                             total_len: int = -1) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
-        head, clone = ObjectId(name), ObjectId(name, generation=cloneid)
+        head = ObjectId(name, shard=shard)
+        clone = ObjectId(name, shard=shard, generation=cloneid)
         if not self.store.exists(cid, clone):
             return
         tx = Transaction()
@@ -314,20 +428,81 @@ class SnapMixin:
             tx.remove(cid, head)
         tx.clone(cid, clone, head)
         # the clone's copied attrs carry a STALE SnapSet and version:
-        # restamp with the live ones (and clear any whiteout)
+        # restamp with the live ones (and clear any whiteout).  EC
+        # shards carry the WHOLE-object length in "len" (the snapshot's
+        # size from the SnapSet), not the shard-stream length.
         tx.setattrs(cid, head, {"ss": ss_b, "v": version, "wh": 0,
-                                "len": len(data), "d": _crc32c(data)})
-        self._log_apply(tx, pgid, LogEntry(version, "write", name, -1,
+                                "len": (total_len if shard >= 0
+                                        and total_len >= 0
+                                        else len(data)),
+                                "d": _crc32c(data)})
+        self._log_apply(tx, pgid, LogEntry(version, "write", name, shard,
                                            prev_version=-1))
         self.store.queue_transaction(tx)
 
+    def _do_snap_rollback_ec(self, conn, m, pgid: PgId, up: list,
+                             lock_key) -> None:
+        """EC rollback: each shard copies its clone object back over
+        its head shard — the clone's encoded bytes ARE the head's bytes
+        at snap time, so no decode/re-encode round trip is needed."""
+        name = m.oid
+        _tx, rider = self._snap_prepare(pgid, m)  # may clone the head
+        ss = (_unpack(bytes(rider["ss"])) if rider is not None
+              else self._ec_load_ss(pgid, name)) or \
+            {"seq": 0, "clones": [], "sz": {}, "ov": {}}
+        covering = [c for c in ss["clones"] if c >= m.snapid]
+        if not covering:
+            code = 0 if (self._ec_object_len(pgid, name) is not None
+                         and not self._ec_whiteout(pgid, name)) \
+                else ENOENT
+            conn.send(MOSDOpReply(m.tid, code, epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
+            return
+        cloneid = min(covering)
+        total = int(ss.get("sz", {}).get(cloneid, 0))
+        version = self._next_version(pgid)
+        ss_b = _pack(ss)
+        payload = _pack({"cloneid": cloneid, "ss": ss_b,
+                         "rider": rider, "total": total})
+        tid = next(self._tids)
+        remote = 0
+        epoch = self._entry_epoch()
+        for shard, osd in enumerate(up):
+            if osd is None:
+                continue
+            if osd == self.osd_id:
+                pre = (self._snap_apply_rider(pgid, name, rider,
+                                              shard=shard)
+                       if rider is not None else None)
+                self._apply_snap_rollback(pgid, name, cloneid, ss_b,
+                                          version, pre_tx=pre,
+                                          shard=shard, total_len=total)
+            else:
+                remote += 1
+                self.messenger.send_message(
+                    f"osd.{osd}",
+                    MSubWrite(tid, pgid, name, shard, version,
+                              "snap_rollback", payload, epoch=epoch))
+        self._ec_cache.invalidate(pgid, name)
+        if remote == 0:
+            conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                  epoch=self.osdmap.epoch))
+            self._obj_unlock(lock_key)
+            return
+        from .daemon import _PendingWrite
+        pw = _PendingWrite(m.client, m.tid, remote, version)
+        pw.lock_key = lock_key
+        self._pending_writes[tid] = pw
+
     # ----------------------------------------------------------- whiteout
     def _apply_whiteout(self, pgid: PgId, name: str, version: int,
-                        pre_tx: Transaction | None = None) -> None:
+                        pre_tx: Transaction | None = None,
+                        shard: int = -1) -> None:
         """Delete a head that has clones: the object becomes a zero-size
-        whiteout so the SnapSet survives (the snapdir role)."""
+        whiteout so the SnapSet survives (the snapdir role).  For EC,
+        each shard object whiteouts independently (shard >= 0)."""
         cid = CollectionId(pgid.pool, pgid.seed)
-        head = ObjectId(name)
+        head = ObjectId(name, shard=shard)
         tx = Transaction()
         if pre_tx is not None:
             tx.append(pre_tx)
@@ -336,7 +511,7 @@ class SnapMixin:
         tx.truncate(cid, head, 0)
         tx.setattrs(cid, head, {"wh": 1, "v": version, "len": 0,
                                 "d": _crc32c(b"")})
-        self._log_apply(tx, pgid, LogEntry(version, "write", name, -1,
+        self._log_apply(tx, pgid, LogEntry(version, "write", name, shard,
                                            prev_version=-1))
         self.store.queue_transaction(tx)
 
@@ -382,40 +557,61 @@ class SnapMixin:
 
     def _trim_one(self, pgid: PgId, name: str, snapid: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
+        is_ec = self.osdmap.pools[pgid.pool].kind == "ec"
         version = self._next_version(pgid)
-        ss = self._load_ss(cid, name) or \
+        ss = (self._ec_load_ss(pgid, name) if is_ec
+              else self._load_ss(cid, name)) or \
             {"seq": 0, "clones": [], "sz": {}, "ov": {}}
         ss["clones"] = [c for c in ss["clones"] if c != snapid]
         ss["sz"].pop(snapid, None)
         ss["ov"].pop(snapid, None)
         drop_head = (not ss["clones"]
-                     and self._head_whiteout(cid, name))
+                     and (self._ec_whiteout(pgid, name) if is_ec
+                          else self._head_whiteout(cid, name)))
         ss_b = _pack(ss)
-        self._apply_trim(pgid, name, snapid, ss_b, drop_head, version)
         up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
         payload = _pack({"snapid": snapid, "ss": ss_b,
                          "drop_head": drop_head})
         tid = next(self._tids)
-        for peer in up:
-            if peer is not None and peer != self.osd_id:
-                self.messenger.send_message(
-                    f"osd.{peer}",
-                    MSubWrite(tid, pgid, name, -1, version, "trim_clone",
-                              payload))
+        epoch = self._entry_epoch()
+        if is_ec:
+            # per-shard: each holder trims its own shard clone object
+            for shard, osd in enumerate(up):
+                if osd is None:
+                    continue
+                if osd == self.osd_id:
+                    self._apply_trim(pgid, name, snapid, ss_b,
+                                     drop_head, version, shard=shard)
+                else:
+                    self.messenger.send_message(
+                        f"osd.{osd}",
+                        MSubWrite(tid, pgid, name, shard, version,
+                                  "trim_clone", payload, epoch=epoch))
+        else:
+            self._apply_trim(pgid, name, snapid, ss_b, drop_head,
+                             version)
+            for peer in up:
+                if peer is not None and peer != self.osd_id:
+                    self.messenger.send_message(
+                        f"osd.{peer}",
+                        MSubWrite(tid, pgid, name, -1, version,
+                                  "trim_clone", payload, epoch=epoch))
         self.perf.inc("snap_trims")
 
     def _apply_trim(self, pgid: PgId, name: str, snapid: int, ss_b: bytes,
-                    drop_head: bool, version: int) -> None:
+                    drop_head: bool, version: int,
+                    shard: int = -1) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
-        clone = ObjectId(name, generation=snapid)
+        clone = ObjectId(name, shard=shard, generation=snapid)
+        head = ObjectId(name, shard=shard)
         tx = Transaction()
         if self.store.exists(cid, clone):
             tx.remove(cid, clone)
-        if self.store.exists(cid, ObjectId(name)):
+        if self.store.exists(cid, head):
             if drop_head:
-                tx.remove(cid, ObjectId(name))
+                tx.remove(cid, head)
             else:
-                tx.setattrs(cid, ObjectId(name), {"ss": ss_b})
+                tx.setattrs(cid, head, {"ss": ss_b})
         try:
             if f"{snapid:016x}.{name}" in self.store.omap_get(
                     cid, self._smap_oid()):
@@ -424,7 +620,8 @@ class SnapMixin:
         except (NoSuchObject, NoSuchCollection):
             pass
         self._log_apply(tx, pgid, LogEntry(
-            version, "remove", vname(name, snapid), -1, prev_version=-1))
+            version, "remove", vname(name, snapid), shard,
+            prev_version=-1))
         if not tx.empty():
             self.store.queue_transaction(tx)
         self._record_tombstone(pgid, vname(name, snapid), version)

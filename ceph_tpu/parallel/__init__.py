@@ -3,7 +3,8 @@ write/recovery steps (the TPU mapping of SURVEY.md §2.8's strategies —
 stripe batch = data parallel, shard axis = tensor parallel, collectives
 over ICI instead of the reference's messenger fan-out)."""
 
-from .mesh import make_mesh
+from .mesh import init_multihost, make_host_mesh, make_mesh
 from .distributed import DistributedStripeEC
 
-__all__ = ["make_mesh", "DistributedStripeEC"]
+__all__ = ["make_mesh", "make_host_mesh", "init_multihost",
+           "DistributedStripeEC"]

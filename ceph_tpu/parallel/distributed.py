@@ -47,11 +47,21 @@ class DistributedStripeEC:
     the shard axis (spare rows are zero — "spare OSD" slots).
     """
 
-    def __init__(self, codec: StripeCodec, mesh: Mesh):
+    def __init__(self, codec: StripeCodec, mesh: Mesh,
+                 batch_axes: Sequence[str] = ("dp",)):
         self.codec = codec
         self.mesh = mesh
         self.n_shard = mesh.shape["shard"]
-        self.n_dp = mesh.shape["dp"]
+        # the batch dimension may shard over several mesh axes — on a
+        # multi-host mesh it is ("host", "dp"): the slow DCN hop only
+        # ever carries batch-parallel work, while the chatty "shard"
+        # collectives (all_to_all / ppermute) stay inside one host's
+        # ICI domain (the scaling-book layout rule; SURVEY.md §2.3
+        # TPU-equivalent row — DCN via jax.distributed)
+        self.batch_axes = tuple(batch_axes)
+        self.n_dp = 1
+        for a in self.batch_axes:
+            self.n_dp *= mesh.shape[a]
         km = codec.k + codec.m
         self.S = -(-km // self.n_shard) * self.n_shard
         self.spare_rows = self.S - km
@@ -77,13 +87,15 @@ class DistributedStripeEC:
                                        concat_axis=2, tiled=True)
             # scrub digest: cluster-wide reduction of encoded bytes
             digest = jax.lax.psum(
-                jnp.sum(par.astype(jnp.uint32)), ("dp", "shard"))
+                jnp.sum(par.astype(jnp.uint32)),
+                (*self.batch_axes, "shard"))
             return stack, digest
 
+        B = self.batch_axes
         return shard_map(
             local, mesh=self.mesh,
-            in_specs=P("dp", None, "shard"),
-            out_specs=(P("dp", "shard", None), P()),
+            in_specs=P(B, None, "shard"),
+            out_specs=(P(B, "shard", None), P()),
         )
 
     # ---------------- rebalance / backfill ----------------
@@ -97,10 +109,11 @@ class DistributedStripeEC:
             perm = [(i, (i + rotate) % n) for i in range(n)]
             return jax.lax.ppermute(stack_local, "shard", perm)
 
+        B = self.batch_axes
         return shard_map(
             local, mesh=self.mesh,
-            in_specs=P("dp", "shard", None),
-            out_specs=P("dp", "shard", None),
+            in_specs=P(B, "shard", None),
+            out_specs=P(B, "shard", None),
         )
 
     # ---------------- degraded read / recovery ----------------
@@ -129,10 +142,62 @@ class DistributedStripeEC:
             folded = surv.transpose(1, 0, 2).reshape(k, b * Ll)
             return dec(folded).reshape(k, b, Ll).transpose(1, 0, 2)
 
+        B = self.batch_axes
         return shard_map(
             local, mesh=self.mesh,
-            in_specs=P("dp", "shard", None),
-            out_specs=P("dp", None, "shard"),
+            in_specs=P(B, "shard", None),
+            out_specs=P(B, None, "shard"),
+        )
+
+    # ---------------- partial write: parity delta ----------------
+    def make_delta_step(self):
+        """jit-able fn(stack (B,S,L) row-sharded, delta (B,k,L)
+        column-sharded) -> updated stack.
+
+        The parity-delta partial write (ECUtil encode_parity_delta,
+        ECUtil.cc:519-566): GF(2^8) addition is XOR, so
+        parity' = parity ^ encode(delta) and data' = data ^ delta —
+        the stripe updates without re-reading any other row.  The
+        delta encodes column-sharded (zero communication), then one
+        all_to_all re-lays it to chunk ownership and the XOR folds in
+        locally — same collective budget as a full write, a fraction
+        of the FLOPs."""
+        k, m, S = self.codec.k, self.codec.m, self.S
+        enc = self.codec.encode_graph()
+
+        def local(stack_local, d):  # d: (b, k, Lloc) column-sharded
+            b, _, Ll = d.shape
+            folded = d.transpose(1, 0, 2).reshape(k, b * Ll)
+            par = enc(folded).reshape(m, b, Ll).transpose(1, 0, 2)
+            zeros = jnp.zeros((b, S - k - m, Ll), jnp.uint8)
+            upd = jnp.concatenate([d, par, zeros], axis=1)
+            upd = jax.lax.all_to_all(upd, "shard", split_axis=1,
+                                     concat_axis=2, tiled=True)
+            return jnp.bitwise_xor(stack_local, upd)
+
+        B = self.batch_axes
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=(P(B, "shard", None), P(B, None, "shard")),
+            out_specs=P(B, "shard", None),
+        )
+
+    # ---------------- per-shard stats: dp-axis reduction ----------------
+    def make_stats_step(self):
+        """jit-able fn(stack (B,S,L)) -> (S,) uint32 per-chunk-row byte
+        totals, reduced over the BATCH axes only (each shard position
+        aggregates its own rows across every batch — the per-OSD stats
+        report, MPGStats -> mgr aggregation).  On a multi-host mesh this
+        is the reduction that rides DCN."""
+        def local(stack_local):
+            tot = jnp.sum(stack_local.astype(jnp.uint32), axis=(0, 2))
+            return jax.lax.psum(tot, self.batch_axes)
+
+        B = self.batch_axes
+        return shard_map(
+            local, mesh=self.mesh,
+            in_specs=P(B, "shard", None),
+            out_specs=P("shard"),
         )
 
     # ---------------- convenience: jitted end-to-end step ----------------

@@ -36,3 +36,67 @@ def make_mesh(n_devices: int | None = None,
         raise ValueError(f"{n} devices not divisible by shard={shard_axis}")
     arr = np.array(devices).reshape(n // shard_axis, shard_axis)
     return Mesh(arr, ("dp", "shard"))
+
+
+def init_multihost(coordinator_address: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None,
+                   local_device_ids=None) -> bool:
+    """Join a multi-host JAX cluster (the DCN fabric — the role the
+    reference's cluster messenger network plays across hosts,
+    src/ceph_osd.cc:550-630, re-based on jax.distributed + its gRPC
+    coordination service).  No-op (returns False) single-process, so
+    callers can share one code path.  Env fallbacks: JAX_COORDINATOR /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID."""
+    import os
+
+    coordinator_address = coordinator_address or \
+        os.environ.get("JAX_COORDINATOR")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if num_processes <= 1 or not coordinator_address:
+        return False
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    jax.distributed.initialize(coordinator_address, num_processes,
+                               process_id,
+                               local_device_ids=local_device_ids)
+    return True
+
+
+def make_host_mesh(n_hosts: int | None = None,
+                   shard_axis: int | None = None,
+                   devices=None) -> Mesh:
+    """("host", "dp", "shard") mesh: the OUTER host axis maps the DCN
+    hop (one slice per process), the inner axes the ICI domain.  Layout
+    rule (scaling-book): chatty per-stripe collectives stay on "shard"
+    (ICI); only batch-parallel reductions cross "host".
+
+    Under a real multi-host runtime the host axis follows
+    jax.process_index(); single-process (CI / the driver's virtual CPU
+    mesh) an n_hosts axis is synthesized by slicing the flat device
+    list — the same program compiles either way (SPMD is oblivious)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n_hosts is None:
+        n_hosts = max(1, jax.process_count())
+    if len(devices) % n_hosts:
+        raise ValueError(
+            f"{len(devices)} devices not divisible by {n_hosts} hosts")
+    per_host = len(devices) // n_hosts
+    # keep each host's devices contiguous (process-local under a real
+    # multi-host runtime, so "shard"/"dp" collectives never cross DCN)
+    devices = sorted(devices,
+                     key=lambda d: (getattr(d, "process_index", 0),
+                                    d.id))
+    if shard_axis is None:
+        shard_axis = 1
+        while shard_axis * 2 <= min(4, per_host) and \
+                per_host % (shard_axis * 2) == 0:
+            shard_axis *= 2
+    if per_host % shard_axis:
+        raise ValueError(
+            f"{per_host} per-host devices not divisible by "
+            f"shard={shard_axis}")
+    arr = np.array(devices).reshape(n_hosts, per_host // shard_axis,
+                                    shard_axis)
+    return Mesh(arr, ("host", "dp", "shard"))

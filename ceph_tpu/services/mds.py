@@ -579,37 +579,63 @@ class MdsCluster:
         if dst == src or dst.startswith(src + "/"):
             raise FsError(-22,
                           f"cannot move {src!r} into itself ({dst!r})")
-        a, b = self._entry_auth(src), self._entry_auth(dst)
-        # lock only the ranks the rename can touch: the two parents'
-        # plus any rank holding authority INSIDE the moved subtree
-        # (interior subtree roots — their cached caps must be revoked
-        # too).  The common same-rank, no-interior-subtree rename stays
-        # cheap instead of barriering the whole cluster.
-        with self._maplock:
-            interior = {rank for root, rank in self._map.items()
-                        if root == src or root.startswith(src + "/")}
-        involved = sorted({a.rank, b.rank} | interior)
-        with _OrderedLocks([self.ranks[i]._lock for i in involved]):
-            ent = a.lookup(src)
-            parent, name = posixpath.split(dst)
-            if name in b.entries(parent):
-                raise FsError(-17, f"{dst!r} exists")
-            for i in involved:
-                self.ranks[i]._revoke_subtree(src, exclude=None)
-            op = {"op": "rename", "src": src, "dst": dst, "ent": ent}
-            a.submit(op)
-            if b is not a:
-                b.submit(op)  # idempotent re-apply; journals both
-            self._rename_subtree_map(src, dst)
-            # heat follows ONLY when the top-level entry itself moved
-            # (export_subtree's pattern); renaming one deep entry must
-            # not drain its old top-level dir's counters
-            if src != "/" and src == "/" + src.split("/", 2)[1]:
-                new_top = "/" + dst.split("/", 2)[1]
-                if new_top != src:
-                    for i in involved:
-                        r = self.ranks[i]
-                        heat = r.dir_ops.pop(src, 0)
-                        if heat:
-                            r.dir_ops[new_top] = (
-                                r.dir_ops.get(new_top, 0) + heat)
+
+        def _involved():
+            a, b = self._entry_auth(src), self._entry_auth(dst)
+            # lock only the ranks the rename can touch: the two
+            # parents' plus any rank holding authority INSIDE the moved
+            # subtree (interior subtree roots — their cached caps must
+            # be revoked too).  The common same-rank,
+            # no-interior-subtree rename stays cheap instead of
+            # barriering the whole cluster.
+            with self._maplock:
+                interior = {rank for root, rank in self._map.items()
+                            if root == src
+                            or root.startswith(src + "/")}
+            return a, b, sorted({a.rank, b.rank} | interior)
+
+        a, b, involved = _involved()
+        while True:
+            with _OrderedLocks([self.ranks[i]._lock
+                                for i in involved]):
+                # a concurrent export_subtree()/balance() may have moved
+                # authority into the subtree between the snapshot and
+                # the lock grab — that rank would be neither locked nor
+                # revoked, and its buffered client data would survive
+                # the rename.  Re-derive under the locks; widen+retry
+                # until the set is stable — then hold _maplock through
+                # the body so no export can move authority mid-rename
+                # (export takes rank locks before _maplock, same order
+                # as here, so this cannot deadlock).
+                with self._maplock:
+                    a, b, needed = _involved()
+                    if needed == involved:
+                        self._locked_rename(a, b, involved, src, dst)
+                        return
+                involved = needed
+
+    def _locked_rename(self, a, b, involved, src: str, dst: str) -> None:
+        """The rename body; caller holds every involved rank lock."""
+        ent = a.lookup(src)
+        parent, name = posixpath.split(dst)
+        if name in b.entries(parent):
+            raise FsError(-17, f"{dst!r} exists")
+        for i in involved:
+            self.ranks[i]._revoke_subtree(src, exclude=None)
+        op = {"op": "rename", "src": src, "dst": dst, "ent": ent}
+        a.submit(op)
+        if b is not a:
+            b.submit(op)  # idempotent re-apply; journals both
+        self._rename_subtree_map(src, dst)
+        # heat follows ONLY when the top-level entry itself moved
+        # (export_subtree's pattern); renaming one deep entry must
+        # not drain its old top-level dir's counters
+        if src != "/" and src == "/" + src.split("/", 2)[1]:
+            new_top = "/" + dst.split("/", 2)[1]
+            if new_top != src:
+                for i in involved:
+                    r = self.ranks[i]
+                    heat = r.dir_ops.pop(src, 0)
+                    if heat:
+                        r.dir_ops[new_top] = (
+                            r.dir_ops.get(new_top, 0) + heat)

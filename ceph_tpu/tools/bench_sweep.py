@@ -1,0 +1,198 @@
+"""BASELINE.md sweep driver: every benchmark config, resumable.
+
+The reference's sweep (qa/workunits/erasure-code/bench.sh:38-62 — plugin
+x technique x k/m grid) plus the BASELINE.json configs 1-5, run as
+SUBPROCESSES with a hard timeout and retries: the axon TPU tunnel can
+wedge for hours, and one wedged config must neither hang the sweep nor
+lose the configs already measured.  Results append incrementally to the
+state file; a re-run (--resume, the default) skips configs that already
+carry a digest-verified result, so repeated invocations across tunnel
+outages eventually fill the whole table.
+
+Matrix codes (reed_sol_van / cauchy_good) ride the device kernel bench
+(bench_tpu: HBM-resident, digest-verified, pallas/xla/mxu candidates);
+SHEC and CLAY ride the plugin benchmark (ec_benchmark --json) whose jax
+backend routes region math through the same kernels.
+
+Usage:
+    python -m ceph_tpu.tools.bench_sweep                 # resume/fill
+    python -m ceph_tpu.tools.bench_sweep --fresh         # start over
+    python -m ceph_tpu.tools.bench_sweep --only headline_1M_b64
+    python -m ceph_tpu.tools.bench_sweep --cpu           # CPU leg only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+STATE = os.path.join(REPO, "BENCH_SWEEP.json")
+
+MiB = 1024 * 1024
+
+
+def configs() -> list[dict]:
+    out = []
+
+    def tpu(cid, k, m, stripe, batch, technique="reed_sol_van",
+            workload="encode", reps=3):
+        out.append({
+            "id": cid, "tool": "bench_tpu",
+            "argv": ["--k", str(k), "--m", str(m),
+                     "--stripe-bytes", str(stripe),
+                     "--batch", str(batch), "--reps", str(reps),
+                     "--technique", technique,
+                     "--workload", workload, "--skip-e2e"]})
+
+    def plugin(cid, name, params, workload="encode", size=8 * MiB,
+               iterations=5, erasures=1):
+        argv = ["--plugin", name, "--workload", workload,
+                "--size", str(size), "--iterations", str(iterations),
+                "--json"]
+        if workload == "decode":
+            argv += ["--erasures", str(erasures)]
+        for kv in params:
+            argv += ["--parameter", kv]
+        out.append({"id": cid, "tool": "ec_benchmark", "argv": argv})
+
+    # 1. BASELINE config 1: jerasure reed_sol_van k=2 m=1, 1 MiB stripe
+    tpu("rs_k2m1_1M_b64", 2, 1, MiB, 64)
+    # 2. headline k=8 m=3: 4K-4M stripe sweep (batch keeps ~64 MiB of
+    # source resident so the kernel, not the dispatch, dominates)
+    for stripe in (4096, 64 * 1024, MiB, 4 * MiB):
+        batch = max(1, min(64, (64 * MiB) // stripe))
+        tag = (f"{stripe // 1024}K" if stripe < MiB
+               else f"{stripe // MiB}M")
+        tpu(f"headline_{tag}_b{batch}", 8, 3, stripe, batch)
+    # batch scaling at the headline point
+    for batch in (2, 8, 16, 64):
+        tpu(f"headline_1M_batch{batch}", 8, 3, MiB, batch)
+    # decode (recovery hot path) at the headline point
+    tpu("headline_1M_decode", 8, 3, MiB, 64, workload="decode")
+    # 3. BASELINE config 3: isa cauchy k=8 m=4 encode + decode
+    tpu("cauchy_k8m4_1M", 8, 4, MiB, 64, technique="cauchy_good")
+    tpu("cauchy_k8m4_1M_decode", 8, 4, MiB, 64,
+        technique="cauchy_good", workload="decode")
+    # 4. BASELINE config 4: shec k=8 m=4 c=3 multi-failure decode
+    for backend in ("native", "jax"):
+        plugin(f"shec_k8m4c3_{backend}", "shec",
+               [f"backend={backend}", "k=8", "m=4", "c=3"])
+        plugin(f"shec_k8m4c3_{backend}_decode2", "shec",
+               [f"backend={backend}", "k=8", "m=4", "c=3"],
+               workload="decode", erasures=2)
+    # 5. BASELINE config 5: clay k=8 m=4 d=11 sub-chunk repair
+    for backend in ("native", "jax"):
+        plugin(f"clay_k8m4d11_{backend}", "clay",
+               [f"backend={backend}", "k=8", "m=4", "d=11"])
+        plugin(f"clay_k8m4d11_{backend}_repair1", "clay",
+               [f"backend={backend}", "k=8", "m=4", "d=11"],
+               workload="decode", erasures=1)
+    return out
+
+
+def run_config(cfg: dict, timeout: float, env: dict) -> dict:
+    cmd = [sys.executable, "-m", f"ceph_tpu.tools.{cfg['tool']}"] \
+        + cfg["argv"]
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout:.0f}s"}
+    if proc.returncode != 0:
+        return {"error": f"rc={proc.returncode}: "
+                         f"{proc.stderr.strip()[-500:]}"}
+    try:
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return {"error": f"bad output: {proc.stdout[-300:]}"}
+    result["wall_s"] = round(time.time() - t0, 1)
+    return {"result": result}
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--fresh", action="store_true",
+                   help="ignore (and overwrite) prior sweep state")
+    p.add_argument("--only", help="run just this config id")
+    p.add_argument("--cpu", action="store_true",
+                   help="force the CPU backend (hermetic; drops the "
+                        "axon tunnel entirely)")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="per-config subprocess timeout (s)")
+    p.add_argument("--retries", type=int, default=2)
+    args = p.parse_args()
+
+    global STATE
+    if args.cpu:
+        # the CPU leg fills its own table: a CPU number must never
+        # satisfy (and so skip) the device leg's resume check
+        STATE = os.path.join(REPO, "BENCH_SWEEP_CPU.json")
+    state: dict = {}
+    if not args.fresh and os.path.exists(STATE):
+        try:
+            with open(STATE) as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            state = {}
+
+    env = dict(os.environ)
+    if args.cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+
+    todo = [c for c in configs()
+            if (args.only is None or c["id"] == args.only)]
+    if args.cpu:
+        # hermetic leg: the plugin-bench jax backend and the device
+        # kernels would open the axon tunnel — force the CPU platform
+        # on kernel benches, drop jax-backend plugin configs
+        todo = [c for c in todo if "backend=jax" not in c["argv"]]
+        for c in todo:
+            if c["tool"] == "bench_tpu":
+                c["argv"].append("--force-cpu")
+    done = skipped = failed = 0
+    for cfg in todo:
+        cid = cfg["id"]
+        prior = state.get(cid, {})
+        if "result" in prior and args.only is None:
+            skipped += 1
+            continue
+        print(f"sweep: {cid} ...", file=sys.stderr, flush=True)
+        entry = {"error": "never ran"}
+        for attempt in range(args.retries + 1):
+            entry = run_config(cfg, args.timeout, env)
+            if "result" in entry:
+                break
+            print(f"sweep: {cid} attempt {attempt + 1} failed: "
+                  f"{entry['error'][:200]}", file=sys.stderr, flush=True)
+        entry["attempts"] = prior.get("attempts", 0) + attempt + 1
+        entry["utc"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        entry["backend_env"] = env.get("JAX_PLATFORMS", "(default)")
+        state[cid] = entry
+        if "result" in entry:
+            done += 1
+        else:
+            failed += 1
+        # persist after EVERY config — atomically, so a SIGKILL
+        # mid-dump (the tunnel-wedge scenario this tool exists for)
+        # can never truncate the table of already-measured results
+        tmp = STATE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f, indent=1, sort_keys=True)
+        os.replace(tmp, STATE)
+    measured = sum(1 for v in state.values() if "result" in v)
+    print(json.dumps({"ran": done, "skipped": skipped, "failed": failed,
+                      "measured_total": measured,
+                      "configs_total": len(configs()),
+                      "state_file": STATE}))
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -130,10 +130,10 @@ class MiniCluster:
         m.start()
         return m
 
-    def add_osd(self, osd_id: int) -> OSDDaemon:
+    def add_osd(self, osd_id: int, store=None) -> OSDDaemon:
         host = f"host{osd_id}" if self._hosts_per_osd else "host0"
         osd = OSDDaemon(osd_id, self.network, cfg=self.cfg, host=host,
-                        mons=self.mon_names)
+                        mons=self.mon_names, store=store)
         self.osds[osd_id] = osd
         osd.start()
         if self._admin_dir:
@@ -244,12 +244,17 @@ class MiniCluster:
         raise TimeoutError(
             f"epoch {self._best_epoch_map().epoch} < {epoch}")
 
-    def kill_osd(self, osd_id: int, mark_down: bool = True) -> None:
+    def kill_osd(self, osd_id: int, mark_down: bool = True):
         """Hard-kill a daemon (kill_daemon in ceph-helpers).  With
-        mark_down=False the cluster must notice via heartbeats."""
+        mark_down=False the cluster must notice via heartbeats.
+        Returns the dead daemon's object store: pass it to revive_osd
+        to model a crash-RESTART (durable state survives) instead of a
+        device swap (fresh store, recovery rebuilds everything)."""
         osd = self.osds.pop(osd_id, None)
+        store = None
         if osd:
             osd.stop()
+            store = osd.store
             self._drop_admin_socket(osd.name)
         proc = self.procs.pop(osd_id, None)
         if proc is not None:
@@ -258,9 +263,10 @@ class MiniCluster:
         if mark_down and self.clients:
             self.clients[0].mon_command({"prefix": "osd down",
                                          "id": osd_id})
+        return store
 
-    def revive_osd(self, osd_id: int) -> OSDDaemon:
-        return self.add_osd(osd_id)
+    def revive_osd(self, osd_id: int, store=None) -> OSDDaemon:
+        return self.add_osd(osd_id, store=store)
 
     def settle(self, seconds: float = 0.2) -> None:
         """Let in-flight dispatch/recovery drain (tests only)."""

@@ -144,6 +144,64 @@ def test_divergent_head_discarded_by_authoritative_log():
         c.stop()
 
 
+def test_primary_killed_mid_write_divergent_entry_durably_discarded():
+    """Thrash variant of the divergent-head scenario: the isolated
+    primary is hard-KILLED after applying the torn write (its store —
+    with the divergent log entry — survives, as a crashed daemon's disk
+    would), an interim primary commits different content at the same
+    version, and the revived daemon's durable divergent entry must be
+    discarded during peering, never served."""
+    c = MiniCluster(n_osds=3, cfg=make_cfg(osd_op_timeout=0.6)).start()
+    try:
+        client = c.client()
+        client.create_pool("p", size=2, pg_num=1)
+        client.write_full("p", "obj", b"committed-v1")
+        pool_id = client._pool_id("p")
+        up = c.mon.osdmap.pg_to_up_osds(pool_id, 0)
+        a = up[0]
+        osd_a = c.osds[a]
+        for other in list(c.osds):
+            if other != a:
+                c.network.partition(f"osd.{a}", f"osd.{other}")
+        c.network.partition(f"osd.{a}", c.mon.name)
+        epoch = c.mon.osdmap.epoch
+        with pytest.raises(RadosError):
+            client.write_full("p", "obj", b"torn-write-on-A")
+        pg = PgId(pool_id, 0)
+        head_a = osd_a._pglog(pg).last_epoch_version()
+        assert head_a[1] >= 2, "A did not apply the torn write locally"
+        # hard-kill A mid-2PC; its store (holding the torn entry) is the
+        # crashed daemon's surviving disk
+        c.network.heal()
+        store_a = c.kill_osd(a, mark_down=True)
+        _wait(lambda: c.mon.osdmap.epoch > epoch and
+              c.mon.osdmap.pg_to_up_osds(pool_id, 0)[0] != a,
+              msg="B never promoted")
+        client.write_full("p", "obj", b"committed-v2-by-B")
+        # crash-RESTART: same store, divergent entry still on disk
+        c.revive_osd(a, store=store_a)
+        _wait(lambda: a in [u for u in c.mon.osdmap.pg_to_up_osds(
+            pool_id, 0) if u is not None], 20, "A never rejoined")
+        c.settle(1.0)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                if client.read("p", "obj") == b"committed-v2-by-B":
+                    break
+            except RadosError:
+                pass
+            time.sleep(0.1)
+        assert client.read("p", "obj") == b"committed-v2-by-B"
+        div_ev = (head_a[0], head_a[1])
+        _wait(lambda: all(
+            (e.epoch, e.version) != div_ev
+            for osd in c.osds.values()
+            for e in osd._pglog(pg).entries()), 20,
+            "the torn-interval entry survived the crash-restart")
+    finally:
+        c.stop()
+
+
 def test_intervals_recorded_and_les_advances_under_churn():
     """Membership churn closes intervals durably and peering completion
     advances the last-epoch-started fence."""
