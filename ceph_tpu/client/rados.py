@@ -93,6 +93,9 @@ class RadosClient(Dispatcher):
         self._cookies = itertools.count(1)
         self._watch_renewer = None
         self._closed = False
+        from ..utils.tracer import Tracer
+        self.tracer = Tracer(name)
+        self.tracing = False  # per-client switch: ops carry spans
         self._aio_exec = None
         self._aio_init_lock = threading.Lock()
         self._aio_outstanding: set = set()
@@ -252,12 +255,25 @@ class RadosClient(Dispatcher):
     def _op(self, pool_name: str, oid: str, op: str, data: bytes = b"",
             offset: int = 0, length: int = 0, snapid: int = 0):
         pool_id = self._pool_id(pool_name)
+        root = (self.tracer.start(f"client-op {op}", oid=oid,
+                                  pool=pool_name)
+                if self.tracing else None)
+        try:
+            return self._op_attempts(pool_id, pool_name, oid, op, data,
+                                     offset, length, snapid, root)
+        finally:
+            if root is not None:
+                root.finish()
+
+    def _op_attempts(self, pool_id, pool_name, oid, op, data,
+                     offset, length, snapid, root):
         last_error: RadosError | None = None
         for attempt in range(12):
             target = self._primary_for(pool_id, oid)
             tid = next(self._tids)
             m = MOSDOp(tid, self.name, pool_id, oid, op, offset, length,
-                       data, self.osdmap.epoch, snapid=snapid)
+                       data, self.osdmap.epoch, snapid=snapid,
+                       trace=root.ctx if root is not None else ())
             if op in self._WRITE_OPS:
                 seq, snaps = self._snapc.get(pool_id, (0, []))
                 m.snap_seq, m.snaps = seq, list(snaps)

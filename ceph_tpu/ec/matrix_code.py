@@ -73,6 +73,48 @@ class MatrixErasureCode(ErasureCode):
                 f"expected {self.k} data chunks, got {data_chunks.shape[0]}")
         return self._matmul(self.matrix, data_chunks)
 
+    def encode_chunks_with_csums(
+            self, data_chunks: np.ndarray
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """(parity, per-chunk CRC32C over data+parity rows) — on the jax
+        backend both come out of ONE fused device pass (the Checksummer
+        north star, src/common/Checksummer.h:13: the csum rides the
+        encode batch instead of a second CPU sweep); other backends
+        compute the same csums CPU-side so callers share one API."""
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        nbytes = int(data_chunks.shape[-1])
+        plain = type(self).encode_chunks is MatrixErasureCode.encode_chunks
+        if not plain:
+            # a subclass (CLAY's coupled layers, SHEC's local groups)
+            # owns the parity math: fuse nothing, delegate — csums ride
+            # a CPU sweep over whatever it produced
+            parity = self.encode_chunks(data_chunks)
+            stack = np.concatenate([data_chunks, parity], axis=0)
+            return parity, np.array([native.crc32c(row.tobytes())
+                                     for row in stack], dtype=np.uint32)
+        if self._backend == "jax" and nbytes % 4 == 0 and nbytes >= 4:
+            key = b"csum" + self.matrix.tobytes() + nbytes.to_bytes(8,
+                                                                    "little")
+            op = self._jax_ops.get(key)
+            if op is None:
+                import jax
+
+                from ..models.stripe_codec import StripeCodec
+                codec = StripeCodec.__new__(StripeCodec)
+                codec.k, codec.m = self.k, self.m
+                codec.matrix = self.matrix
+                op = jax.jit(codec.encode_csum_graph(nbytes))
+                if len(self._jax_ops) > 64:
+                    self._jax_ops.pop(next(iter(self._jax_ops)))
+                self._jax_ops[key] = op
+            parity, csums = op(data_chunks)
+            return np.asarray(parity), np.asarray(csums)[:, 0]
+        parity = self._matmul(self.matrix, data_chunks)
+        stack = np.concatenate([data_chunks, parity], axis=0)
+        csums = np.array([native.crc32c(row.tobytes())
+                          for row in stack], dtype=np.uint32)
+        return parity, csums
+
     def _get_decode_matrix(self, available: Sequence[int]) -> np.ndarray:
         key = tuple(available[: self.k])
         hit = self._decode_cache.get(key)

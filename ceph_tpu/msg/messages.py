@@ -46,8 +46,11 @@ class MOSDOp(Encodable):
     snapid: int = 0
     snap_seq: int = 0
     snaps: list = field(default_factory=list)  # newest-first snap ids
+    # v3 tail: trace context (trace_id, span_id) — the tracer.h span
+    # propagation role; empty = tracing off for this op
+    trace: tuple = ()
 
-    VERSION, COMPAT = 2, 1
+    VERSION, COMPAT = 3, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
@@ -56,6 +59,7 @@ class MOSDOp(Encodable):
             e.u64(self.length); e.blob(self.data); e.u64(self.epoch)
             e.u64(self.snapid); e.u64(self.snap_seq)   # v2 tail
             e.seq(self.snaps, Encoder.u64)
+            e.seq(list(self.trace), Encoder.u64)       # v3 tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -67,6 +71,8 @@ class MOSDOp(Encodable):
                 m.snapid = d.u64()
                 m.snap_seq = d.u64()
                 m.snaps = d.seq(Decoder.u64)
+            if v >= 3:
+                m.trace = tuple(d.seq(Decoder.u64))
             return m
         return dec.versioned(cls.VERSION, body)
 
@@ -108,6 +114,7 @@ class MSubWrite:
     data: bytes = b""
     attrs: dict = field(default_factory=dict)
     offset: int = 0     # write_partial only
+    trace: tuple = ()   # (trace_id, span_id) — ZTracer sub-op span parent
     # map epoch the primary minted this write's version under: the
     # replica stamps its log entry with it so both sides agree on the
     # entry's interval (the eversion epoch, src/osd/osd_types.h)
@@ -140,6 +147,7 @@ class MSubPartialWrite:
     # head object to the generation variant and stores the shipped
     # SnapSet before applying the extents.  Empty = no snap work.
     snap: dict = field(default_factory=dict)
+    trace: tuple = ()  # (trace_id, span_id) — ZTracer sub-op span parent
 
 
 @dataclass
@@ -158,6 +166,7 @@ class MSubDelta:
     prev_version: int = -1  # conditional apply (see MSubPartialWrite)
     epoch: int = 0  # primary's minting epoch (see MSubWrite.epoch)
     snap: dict = field(default_factory=dict)  # see MSubPartialWrite.snap
+    trace: tuple = ()  # see MSubPartialWrite.trace
 
 
 @dataclass

@@ -53,6 +53,7 @@ from ..utils.config import Config, default_config
 from ..utils.log import dout
 from ..utils.perf import CounterType, global_perf
 from ..utils.tracked_op import OpTracker
+from ..utils.tracer import Tracer
 from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
@@ -76,6 +77,7 @@ class _PendingWrite:
     failed: int = 0
     retry: int = 0  # version-conflict sub-op refusals (client retries)
     lock_key: tuple | None = None  # per-object write lock to release
+    span: object = None  # op span closed when the client reply leaves
     stamp: float = field(default_factory=time.time)
 
 
@@ -100,6 +102,21 @@ class _PendingRead:
     # recovery reads carry a completion callback instead of a client
     on_done: object = None
     stamp: float = field(default_factory=time.time)
+
+
+class _SpanConn:
+    """Send-handle that closes the op's span when the client reply
+    goes out (whatever async path produced it)."""
+
+    def __init__(self, conn, span):
+        self._conn = conn
+        self._span = span
+
+    def send(self, msg) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            self._span.tag("result", msg.result)
+            self._span.finish()
+        return self._conn.send(msg)
 
 
 class _ClientConn:
@@ -203,6 +220,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._recovery_pg_ops: dict[PgId, int] = {}
         self.inject = FaultInjection()
         self.op_tracker = OpTracker()
+        self.tracer = Tracer(self.name)
         self._init_objops()
         self._init_snaps()
         self._handlers = {
@@ -299,6 +317,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return self.perf.dump()
         if cmd == "dump_ops_in_flight":
             return self.op_tracker.dump_ops_in_flight()
+        if cmd == "dump_tracing":
+            tid = kw.get("trace_id")
+            return self.tracer.dump(int(tid) if tid is not None
+                                    else None)
         if cmd == "dump_historic_ops":
             return self.op_tracker.dump_historic_ops()
         if cmd == "dump_slow_ops":
@@ -516,6 +538,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 m.oid in self._stale_objects.get(pgid, ())):
             conn.send(MOSDOpReply(m.tid, EAGAIN, epoch=self.osdmap.epoch))
             return
+        if m.trace:
+            # distributed span (tracer.h role): the op's span on THIS
+            # daemon; closed when the client reply leaves, however many
+            # async stages the op spans.  Sub-ops fan out under its ctx.
+            span = self.tracer.start(f"osd-op {m.op}", parent=m.trace,
+                                     oid=m.oid, pg=str(pgid))
+            m._span = span
+            conn = _SpanConn(conn, span)
         self.perf.inc("op_rw_bytes", len(m.data))
         with self.op_tracker.create(f"{m.op} {m.oid}") as op:
             if pool.kind == "ec":
@@ -665,6 +695,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, len(peers), version)
+        self._pending_writes[tid].span = getattr(m, '_span', None)
         sub_attrs = dict(extra_attrs)
         if rider is not None:
             sub_attrs["_snap"] = rider
@@ -673,7 +704,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, -1, version, op, payload,
                           attrs=dict(sub_attrs), offset=off,
-                          epoch=self._entry_epoch()))
+                          epoch=self._entry_epoch(),
+                          trace=self._tctx(m)))
 
     def _rep_read(self, conn, m: MOSDOp, pgid: PgId) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -727,12 +759,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, len(peers), version)
+        self._pending_writes[tid].span = getattr(m, '_span', None)
         for peer in peers:
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, -1, version, sub_op,
                           attrs=dict(sub_attrs),
-                          epoch=self._entry_epoch()))
+                          epoch=self._entry_epoch(),
+                          trace=self._tctx(m)))
 
     def _stat(self, conn, m: MOSDOp, pgid: PgId, shard: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -819,6 +853,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         {"_lc": lc.to_bytes(8, "little")})
         if own:
             self.store.queue_transaction(tx)
+
+    @staticmethod
+    def _tctx(m) -> tuple:
+        """Trace context for sub-ops of this client op (the ZTracer
+        child-span propagation, ECCommon.cc:1046-1051)."""
+        span = getattr(m, "_span", None)
+        return span.ctx if span is not None else ()
 
     def _entry_epoch(self) -> int:
         """Epoch to stamp a fresh log entry with: the minting primary's
@@ -1006,10 +1047,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         version = self._next_version(pgid)
         # whole-object (re)write: scatter the buffer into the RAID-0
         # shard streams and encode ALL rows in ONE kernel launch (the
-        # batching seam of ECUtil::shard_extent_map_t::encode)
+        # batching seam of ECUtil::shard_extent_map_t::encode).  The
+        # per-shard CRC32C rides the same pass (Checksummer.h:13 role):
+        # on the jax backend both leave the device together, and every
+        # shard holder stores the pre-computed digest instead of
+        # re-sweeping the bytes on CPU.
         self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(m.data)
-        parity = codec.encode_chunks(streams)
+        enc_csum = getattr(codec, "encode_chunks_with_csums", None)
+        if enc_csum is not None:
+            parity, csums = enc_csum(streams)
+        else:
+            parity, csums = codec.encode_chunks(streams), None
         attrs = {"v": version, "len": len(m.data)}
         if self._ec_whiteout(pgid, m.oid):
             attrs["wh"] = 0  # write resurrects a whiteout'd head
@@ -1024,19 +1073,33 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             chunk = streams[shard] if shard < codec.k \
                 else parity[shard - codec.k]
             data = chunk.tobytes()
+            if csums is not None:
+                attrs = dict(attrs, dcsum=int(csums[shard]))
+                sub_attrs = dict(sub_attrs, dcsum=int(csums[shard]))
             if osd == self.osd_id:
                 pre = (self._snap_apply_rider(pgid, m.oid, rider,
                                               shard=shard)
                        if rider is not None else None)
-                self._apply_write(pgid, m.oid, shard, data, attrs,
-                                  pre_tx=pre)
+                tctx = self._tctx(m)
+                if tctx:
+                    with self.tracer.start("sub-write write",
+                                           parent=tctx, shard=shard,
+                                           oid=m.oid) as sp, \
+                            self.tracer.start("store-commit",
+                                              parent=sp.ctx):
+                        self._apply_write(pgid, m.oid, shard, data,
+                                          attrs, pre_tx=pre)
+                else:
+                    self._apply_write(pgid, m.oid, shard, data, attrs,
+                                      pre_tx=pre)
             else:
                 remote += 1
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MSubWrite(tid, pgid, m.oid, shard, version, "write",
                               data, dict(sub_attrs),
-                              epoch=self._entry_epoch()))
+                              epoch=self._entry_epoch(),
+                              trace=self._tctx(m)))
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
@@ -1044,6 +1107,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, remote, version, lock_key=lock_key)
+        self._pending_writes[tid].span = getattr(m, '_span', None)
 
     # -- EC partial writes (parity delta / rmw; ECTransaction WritePlan) ---
     def _ec_object_version(self, pgid: PgId, oid: str) -> int:
@@ -1105,7 +1169,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                      total_len=new_len, create=create,
                                      prev_version=prev_version,
                                      epoch=self._entry_epoch(),
-                                     snap=rider or {}))
+                                     snap=rider or {},
+                                     trace=self._tctx(m)))
         if remote == 0:
             result = EIO if local_failed else (EAGAIN if local_retry else 0)
             conn.send(MOSDOpReply(m.tid, result,
@@ -1115,6 +1180,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._pending_writes[tid] = _PendingWrite(
                 m.client, m.tid, remote, version, failed=local_failed,
                 retry=local_retry, lock_key=lock_key)
+            self._pending_writes[tid].span = getattr(m, '_span', None)
 
     def _ec_partial_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
                           codec, si: StripeInfo, object_size: int,
@@ -1200,7 +1266,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                          ext, total_len=new_len,
                                          prev_version=prev,
                                          epoch=self._entry_epoch(),
-                                         snap=rider or {}))
+                                         snap=rider or {},
+                                         trace=self._tctx(m)))
             # parity shards: one delta message covering all data deltas
             flat = [(ds, soff, dbytes) for ds, lst in deltas.items()
                     for soff, dbytes in lst]
@@ -1224,7 +1291,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                   list(flat), total_len=new_len,
                                   prev_version=prev,
                                   epoch=self._entry_epoch(),
-                                  snap=rider or {}))
+                                  snap=rider or {},
+                                  trace=self._tctx(m)))
             # refill the extent cache with the bytes just written (the
             # next overlapping overwrite skips the read fan); failure
             # paths invalidate
@@ -1246,6 +1314,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 self._pending_writes[wtid] = _PendingWrite(
                     m.client, m.tid, remote, version, failed=local_failed,
                     retry=local_retry, lock_key=lock_key)
+                self._pending_writes[wtid].span = getattr(m, '_span', None)
 
         # extent-cache fast path (ECExtentCache role): if EVERY touched
         # segment is cached at a known version, skip the read fan-out
@@ -1791,7 +1860,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     MSubWrite(tid, pgid, m.oid, shard, version,
                               "whiteout" if whiteout else "remove",
                               attrs=dict(sub_attrs),
-                              epoch=self._entry_epoch()))
+                              epoch=self._entry_epoch(),
+                              trace=self._tctx(m)))
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
@@ -1799,6 +1869,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         else:
             self._pending_writes[tid] = _PendingWrite(
                 m.client, m.tid, remote, version, lock_key=lock_key)
+            self._pending_writes[tid].span = getattr(m, '_span', None)
 
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
@@ -1807,8 +1878,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = to_oid(oid, shard)
         oid = vname_of(obj)  # canonical: log/tombstones use the vname
-        # stored digest for deep scrub (per-blob csum, BlueStore role)
-        attrs = dict(attrs, d=native_crc32c(data))
+        # stored digest for deep scrub (per-blob csum, BlueStore role);
+        # a device-computed csum from the fused encode pass arrives as
+        # "dcsum" and skips the CPU re-sweep (scrub still re-verifies)
+        dc = attrs.get("dcsum")
+        attrs = dict(attrs, d=int(dc) if dc is not None
+                     else native_crc32c(data))
+        attrs.pop("dcsum", None)
         # entry epoch: a recovery push carries the authority's stamp in
         # "ev" (it must survive verbatim or the re-pushed entry forks
         # again); otherwise the minting/sub-op epoch
@@ -1856,7 +1932,20 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return
         self._sub_epoch.v = m.epoch
         try:
-            self._do_sub_write(conn, m)
+            if m.trace:
+                # per-sub-op child span + the store-commit grandchild
+                # (the ZTracer spans through EC sub-ops,
+                # ECCommon.cc:1046-1051; the tree a collector merges:
+                # client-op -> osd-op -> sub-write -> store-commit)
+                with self.tracer.start(f"sub-write {m.op}",
+                                       parent=m.trace,
+                                       shard=m.shard,
+                                       oid=m.oid) as sp:
+                    with self.tracer.start("store-commit",
+                                           parent=sp.ctx):
+                        self._do_sub_write(conn, m)
+            else:
+                self._do_sub_write(conn, m)
         finally:
             self._sub_epoch.v = 0
 
@@ -1954,6 +2043,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if result != 0 and pw.lock_key is not None:
             # a failed/torn write leaves cached extents untrustworthy
             self._ec_cache.invalidate(*pw.lock_key)
+        if pw.span is not None:
+            pw.span.tag("result", result)
+            pw.span.finish()
         self.messenger.send_message(
             pw.client,
             MOSDOpReply(pw.client_tid, result,

@@ -143,6 +143,7 @@ class ObjOpsMixin:
         from .daemon import _PendingWrite
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, len(fanout), version)
+        self._pending_writes[tid].span = getattr(m, '_span', None)
         for peer, shard in fanout:
             self.messenger.send_message(
                 f"osd.{peer}",
@@ -305,6 +306,7 @@ class ObjOpsMixin:
         tid = next(self._tids)
         from .daemon import _PendingWrite
         pw = _PendingWrite(m.client, m.tid, len(fanout), version)
+        pw.span = getattr(m, '_span', None)
         pw.reply_data = _pack(out)
         self._pending_writes[tid] = pw
         for peer, shard in fanout:
@@ -614,6 +616,7 @@ class ObjOpsMixin:
         tid = next(self._tids)
         from .daemon import _PendingWrite
         pw = _PendingWrite(m.client, m.tid, len(fanout), version)
+        pw.span = getattr(m, '_span', None)
         pw.lock_key = key
         self._pending_writes[tid] = pw
         payload = _pack(eff)

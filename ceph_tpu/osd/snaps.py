@@ -400,6 +400,7 @@ class SnapMixin:
         tid = next(self._tids)
         from .daemon import _PendingWrite
         pw = _PendingWrite(m.client, m.tid, len(peers), version)
+        pw.span = getattr(m, '_span', None)
         pw.lock_key = lock_key
         self._pending_writes[tid] = pw
         payload = _pack({"cloneid": cloneid, "ss": ss_b,
@@ -491,6 +492,7 @@ class SnapMixin:
             return
         from .daemon import _PendingWrite
         pw = _PendingWrite(m.client, m.tid, remote, version)
+        pw.span = getattr(m, '_span', None)
         pw.lock_key = lock_key
         self._pending_writes[tid] = pw
 

@@ -77,6 +77,13 @@ def main() -> int:
                         "once per shape EVER — survives tunnel wedges "
                         "across processes); empty = default under the "
                         "repo's .jax_cache")
+    p.add_argument("--csum", action="store_true",
+                   help="fuse per-chunk CRC32C into the encode pass "
+                        "(Checksummer.h:13 north star) and time "
+                        "encode+csum; the digest gate then also proves "
+                        "the csums (std-crc is raw-linear over XOR, so "
+                        "with an even batch the per-iteration constant "
+                        "contributions cancel in the XOR accumulator)")
     p.add_argument("--force-cpu", action="store_true",
                    help="hermetic CPU run: drop the axon PJRT factory "
                         "before backend init (the sitecustomize-injected "
@@ -154,18 +161,54 @@ def main() -> int:
 
     def with_loop(core, iters: int):
         """ITERS encodes per dispatch (see module docstring); returns
-        only the 4-byte XOR-digest accumulator."""
+        only the 4-byte XOR-digest accumulator.  Fused (parity, csums)
+        cores fold BOTH outputs — the identity holds because std crc is
+        raw-linear over XOR and the even batch cancels the constant
+        per-iteration contributions pairwise."""
         def fn(x32):
             def body(i, acc):
-                y32 = core(jnp.bitwise_xor(x32, jnp.uint32(i)))
-                return jnp.bitwise_xor(acc, xordig(y32))
+                out = core(jnp.bitwise_xor(x32, jnp.uint32(i)))
+                if isinstance(out, tuple):
+                    y32, cs = out
+                    return jnp.bitwise_xor(
+                        acc, jnp.bitwise_xor(xordig(y32), xordig(cs)))
+                return jnp.bitwise_xor(acc, xordig(out))
             return lax.fori_loop(0, iters, body, jnp.uint32(0))
         return jax.jit(fn)
 
     candidates: dict[str, object] = {}
     candidates_core: dict[str, object] = {}
 
+    crcfn = None
+    if args.csum:
+        if args.batch % 2:
+            p.error("--csum needs an even --batch (digest identity)")
+        if (n4 * 4) % chunk:
+            p.error("--csum: kernel tile rounding broke the chunk "
+                    "boundary; pick a power-of-two stripe size")
+        from ceph_tpu.ops.checksum import CrcPlan
+        chunk_words = chunk // 4
+        crcfn = CrcPlan(chunk).device_fn()
+
+        def with_csums(core):
+            def fused(x32):
+                y32 = core(x32)
+                stack = jnp.concatenate([x32, y32], axis=0)
+                words = stack.reshape(stack.shape[0], -1, chunk_words)
+                return y32, crcfn(words)  # (rows, batch) uint32
+            return fused
+
     def register(name, core):
+        if crcfn is not None:
+            fused = with_csums(core)
+            candidates_core[name] = fused
+
+            def fn(x32, _f=fused):
+                y32, cs = _f(x32)
+                return y32, (jnp.sum(y32, dtype=jnp.uint32)
+                             + jnp.sum(cs, dtype=jnp.uint32))
+            candidates[name] = jax.jit(fn)
+            return
         candidates_core[name] = core
         candidates[name] = with_digest(core)
 
@@ -239,16 +282,32 @@ def main() -> int:
                 if native.available()
                 else gf256.encode_region(W, h.view(np.uint8)))
 
-    def sum_digest(par) -> int:
-        return int(np.sum(par.view(np.uint32), dtype=np.uint32))
+    def oracle_csums(h, par) -> np.ndarray:
+        stack = np.concatenate([h.view(np.uint8), par], axis=0)
+        blocks = stack.reshape(stack.shape[0], -1, chunk)
+        return np.array(
+            [[native.crc32c(blocks[r, b].tobytes())
+              for b in range(blocks.shape[1])]
+             for r in range(blocks.shape[0])], dtype=np.uint32)
 
-    def xor_digest(par) -> int:
-        return int(np.bitwise_xor.reduce(par.view(np.uint32), axis=None))
+    def sum_digest(par, cs=None) -> int:
+        s = int(np.sum(par.view(np.uint32), dtype=np.uint32))
+        if cs is not None:
+            s = (s + int(np.sum(cs, dtype=np.uint32))) & 0xFFFFFFFF
+        return s
+
+    def xor_digest(par, cs=None) -> int:
+        x = int(np.bitwise_xor.reduce(par.view(np.uint32), axis=None))
+        if cs is not None:
+            x ^= int(np.bitwise_xor.reduce(cs, axis=None))
+        return x
 
     progress(f"staged ({staging_gbps} GB/s); computing oracle digests")
     parities = [oracle_parity(h) for h in hosts[:-1]]
-    wants_sum = [sum_digest(p) for p in parities]
-    wants_xor = [xor_digest(p) for p in parities]
+    csums_l = ([oracle_csums(h, p) for h, p in zip(hosts[:-1], parities)]
+               if args.csum else [None] * len(parities))
+    wants_sum = [sum_digest(p, c) for p, c in zip(parities, csums_l)]
+    wants_xor = [xor_digest(p, c) for p, c in zip(parities, csums_l)]
     # odd ITERS + even lane count make the loop accumulator equal the
     # base buffer's parity XOR-digest (module docstring)
     assert n4 % 2 == 0, "xor-digest identity needs an even lane count"
@@ -359,7 +418,7 @@ def main() -> int:
     print(json.dumps({
         "backend": backend,
         "kernel": best,
-        "workload": args.workload,
+        "workload": args.workload + ("+csum" if args.csum else ""),
         "k": k, "m": r, "stripe_bytes": args.stripe_bytes,
         "batch": args.batch, "reps": args.reps,
         "bytes_per_rep": nbytes,

@@ -268,6 +268,17 @@ class MiniCluster:
     def revive_osd(self, osd_id: int, store=None) -> OSDDaemon:
         return self.add_osd(osd_id, store=store)
 
+    def collect_trace(self, trace_id: int) -> list[dict]:
+        """Collector role: merge every daemon's + client's local span
+        ring for one trace id (what jaeger assembles from per-service
+        reports)."""
+        spans = []
+        for osd in self.osds.values():
+            spans += osd.tracer.spans_for(trace_id)
+        for cl in self.clients:
+            spans += cl.tracer.spans_for(trace_id)
+        return spans
+
     def settle(self, seconds: float = 0.2) -> None:
         """Let in-flight dispatch/recovery drain (tests only)."""
         time.sleep(seconds)

@@ -1,0 +1,134 @@
+"""Distributed tracing: span trees across the client -> primary ->
+shard fan-out.
+
+The capability of the reference's tracer (src/common/tracer.h:10-35 —
+jaeger spans started per op, child spans per pipeline stage; ZTracer
+child spans per EC sub-op, src/osd/ECCommon.cc:1046-1051), re-shaped
+for this runtime: every entity (client, osd, mon) owns a Tracer that
+records finished spans into a bounded ring; a trace CONTEXT — the
+(trace_id, span_id) pair — rides message fields, so a child span on
+the receiving daemon links to its remote parent without any shared
+state.  Aggregation is collector-style: each daemon dumps its local
+spans for a trace id (admin socket verb), and the operator (or
+MiniCluster.collect_trace) merges the rings into one tree — the same
+shape jaeger assembles from per-service reports.
+
+Tracing is off unless the op carries a context (zero overhead on the
+hot path: one falsy check per handler).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Span:
+    trace_id: int
+    span_id: int
+    parent_id: int          # 0 = root
+    name: str
+    service: str            # entity that produced it (client.x / osd.N)
+    start: float = field(default_factory=time.time)
+    end: float = 0.0
+    tags: dict = field(default_factory=dict)
+    _tracer: "Tracer | None" = None
+
+    @property
+    def ctx(self) -> tuple[int, int]:
+        """The propagation context a child on another daemon parents
+        itself under (trace.h's trace context role)."""
+        return (self.trace_id, self.span_id)
+
+    def tag(self, key: str, value) -> "Span":
+        self.tags[key] = value
+        return self
+
+    def finish(self) -> None:
+        if self.end:
+            return  # idempotent: async completions can race teardown
+        self.end = time.time()
+        if self._tracer is not None:
+            self._tracer._record(self)
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+class Tracer:
+    """Per-entity span factory + bounded finished-span ring."""
+
+    KEEP = 2048  # finished spans retained (ring; ops tooling window)
+
+    def __init__(self, service: str):
+        self.service = service
+        self._ids = itertools.count(1)
+        self._seed = (hash(service) & 0xFFFF) << 32
+        self._lock = threading.Lock()
+        self._done: deque[Span] = deque(maxlen=self.KEEP)
+
+    def _next_id(self) -> int:
+        return self._seed | next(self._ids)
+
+    def start(self, name: str, parent: tuple | None = None,
+              **tags) -> Span:
+        """Start a span.  parent = a (trace_id, span_id) context from a
+        message (remote parent) or a local Span.ctx; None starts a new
+        root trace."""
+        if parent:
+            trace_id, parent_id = int(parent[0]), int(parent[1])
+        else:
+            trace_id, parent_id = self._next_id(), 0
+        return Span(trace_id, self._next_id(), parent_id, name,
+                    self.service, tags=dict(tags), _tracer=self)
+
+    def _record(self, span: Span) -> None:
+        with self._lock:
+            self._done.append(span)
+
+    def spans_for(self, trace_id: int) -> list[dict]:
+        with self._lock:
+            return [
+                {"trace_id": s.trace_id, "span_id": s.span_id,
+                 "parent_id": s.parent_id, "name": s.name,
+                 "service": s.service, "start": s.start, "end": s.end,
+                 "dur_ms": round((s.end - s.start) * 1000, 3),
+                 "tags": dict(s.tags)}
+                for s in self._done if s.trace_id == trace_id]
+
+    def dump(self, trace_id: int | None = None) -> list[dict]:
+        if trace_id is not None:
+            return self.spans_for(trace_id)
+        with self._lock:
+            return [{"trace_id": s.trace_id, "span_id": s.span_id,
+                     "parent_id": s.parent_id, "name": s.name,
+                     "service": s.service, "dur_ms":
+                     round((s.end - s.start) * 1000, 3),
+                     "tags": dict(s.tags)} for s in self._done]
+
+
+def build_tree(spans: list[dict]) -> list[dict]:
+    """Assemble collector-merged span dicts into parent->children trees
+    (roots returned; orphans whose parent span is missing from the
+    window become roots too, tagged so)."""
+    by_id = {s["span_id"]: dict(s, children=[]) for s in spans}
+    roots = []
+    for s in by_id.values():
+        parent = by_id.get(s["parent_id"])
+        if parent is not None:
+            parent["children"].append(s)
+        else:
+            if s["parent_id"]:
+                s["orphan"] = True
+            roots.append(s)
+    for s in by_id.values():
+        s["children"].sort(key=lambda c: c["start"])
+    roots.sort(key=lambda c: c["start"])
+    return roots
