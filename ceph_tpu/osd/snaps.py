@@ -380,6 +380,15 @@ class SnapMixin:
             {"seq": 0, "clones": [], "sz": {}, "ov": {}}
         covering = [c for c in ss["clones"] if c >= m.snapid]
         if not covering:
+            if self._snap_resolve(cid, name, m.snapid) is None and \
+                    self.store.exists(cid, ObjectId(name)):
+                # the object did NOT exist at snapid (born later, or
+                # whiteout window): rolling back means it ceases to
+                # exist — a replicated remove (find_object_context
+                # pre-birth + PrimaryLogPG _rollback_to ENOENT path)
+                self._rep_remove(conn, m, pgid, up)
+                self._obj_unlock(lock_key)
+                return
             # head already IS the state at snapid (or nothing exists)
             code = 0 if (self.store.exists(cid, ObjectId(name))
                          and not self._head_whiteout(cid, name)) else ENOENT
@@ -453,8 +462,13 @@ class SnapMixin:
             {"seq": 0, "clones": [], "sz": {}, "ov": {}}
         covering = [c for c in ss["clones"] if c >= m.snapid]
         if not covering:
-            code = 0 if (self._ec_object_len(pgid, name) is not None
-                         and not self._ec_whiteout(pgid, name)) \
+            exists = self._ec_object_len(pgid, name) is not None
+            if exists and \
+                    self._ec_snap_resolve(pgid, name, m.snapid) is None:
+                # born after the snap: rollback removes it (pre-birth)
+                self._ec_remove(conn, m, pgid, up, lock_key=lock_key)
+                return
+            code = 0 if (exists and not self._ec_whiteout(pgid, name)) \
                 else ENOENT
             conn.send(MOSDOpReply(m.tid, code, epoch=self.osdmap.epoch))
             self._obj_unlock(lock_key)

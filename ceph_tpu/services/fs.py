@@ -96,6 +96,7 @@ class FsFile:
     def _flush_locked(self) -> None:
         if not self._buffered:
             return
+        self._fs._snapc_sync()  # buffered data lands under the live snapc
         so = self._fs._data(self.ino)
         for offset, data in self._buffered:
             so.write(offset, data)
@@ -196,11 +197,148 @@ class FsClient:
             self._handles.setdefault(path, []).append(h)
         return h
 
+    # ----------------------------------------------------- .snap routing
+    def _split_snap(self, path: str):
+        """CephFS-style snapshot paths: <realm>/.snap/<name>[/<rest>].
+        Returns (snapid, snap_root, resolved_path) or None for live
+        paths; resolution walks up to the covering realm (snaprealm
+        semantics)."""
+        parts = _norm(path).split("/")
+        if ".snap" not in parts:
+            return None
+        i = parts.index(".snap")
+        realm = _norm("/".join(parts[:i])) or "/"
+        if i + 1 >= len(parts):
+            raise FsError(-21, ".snap itself is not a file")
+        name = parts[i + 1]
+        rest = "/".join(parts[i + 2:])
+        root, sid = self.mds.snap_covering(realm, name)
+        resolved = _norm(posixpath.join(realm, rest)) if rest \
+            else _norm(realm)
+        return sid, root, resolved
+
+    def _snapc_sync(self) -> None:
+        """Attach the filesystem's live SnapContext before data writes
+        so the OSDs clone-on-first-write-after-snap (the snaprealm
+        get_snap_context -> ioctx write-ctx path)."""
+        seq, snaps = self.mds.snap_context()
+        self.client.set_snap_context(self.pool, seq, snaps)
+
+    def _read_snap_data(self, ent: dict, snapid: int, offset: int,
+                        length: int) -> bytes:
+        out = bytearray(length)
+        pos = 0
+        prefix = _DATA_PREFIX.format(ino=ent["ino"])
+        for objno, obj_off, take in self.layout.file_to_extents(
+                offset, length):
+            try:
+                piece = self.client.read(
+                    self.pool, f"{prefix}.{objno:016x}",
+                    offset=obj_off, length=take, snapid=snapid)
+            except RadosError:
+                piece = b""
+            out[pos:pos + len(piece)] = piece
+            pos += take
+        return bytes(out)
+
+    # ---------------------------------------------------- snapshot verbs
+    def snap_create(self, path: str, name: str) -> int:
+        # flush THIS mount's buffers under the realm before freezing
+        for p, hs in list(self._handles.items()):
+            if p == _norm(path) or p.startswith(_norm(path) + "/"):
+                for h in list(hs):
+                    if not h.closed:
+                        h.flush()
+        return self.mds.snap_create(path, name)
+
+    def snap_list(self, path: str) -> dict:
+        return self.mds.snaps_of(path)
+
+    def snap_remove(self, path: str, name: str) -> None:
+        self.mds.snap_remove(path, name)
+
+    def snap_rollback(self, path: str, name: str) -> None:
+        """Restore the subtree to the snapshot: journaled metadata
+        rollback at the MDS, then per-piece data rollback driven here
+        (idempotent — re-run after any crash)."""
+        path = _norm(path)
+        sid = self.mds.snaps_of(path).get(name)
+        if sid is None:
+            raise FsError(-2, f"no snapshot {name!r} on {path!r}")
+        # capture the LIVE tree before metadata rollback: files that
+        # grew after the snapshot need their beyond-snap pieces rolled
+        # (the OSD removes pre-birth pieces), and files born after the
+        # snapshot lose their dentries — their data is purged here
+        live = {}
+        self._collect_files(path, live)
+        self.mds.snap_rollback(path, name)
+        survivors: dict[str, int] = {}
+        self._rollback_data(path, sid, live, survivors)
+        for ino, size in live.items():
+            if ino not in survivors:
+                # born after the snapshot: dentry gone, purge the data
+                prefix = _DATA_PREFIX.format(ino=ino)
+                for objno in ({o for o, _x, _t in
+                               self.layout.file_to_extents(0, size)}
+                              if size else set()):
+                    try:
+                        self.client.remove(self.pool,
+                                           f"{prefix}.{objno:016x}")
+                    except RadosError:
+                        pass
+
+    def _collect_files(self, dirpath: str, out: dict) -> None:
+        try:
+            ents = self.mds.entries(dirpath)
+        except FsError:
+            return
+        for nm, ent in ents.items():
+            sub = posixpath.join(dirpath, nm)
+            if ent["type"] == "dir":
+                self._collect_files(sub, out)
+            else:
+                out[ent["ino"]] = int(ent.get("size", 0))
+
+    def _rollback_data(self, dirpath: str, snapid: int,
+                       live: dict, survivors: dict) -> None:
+        for nm, ent in self.mds.entries(dirpath).items():
+            sub = posixpath.join(dirpath, nm)
+            if ent["type"] == "dir":
+                self._rollback_data(sub, snapid, live, survivors)
+                continue
+            size = int(ent.get("size", 0))
+            survivors[ent["ino"]] = size
+            # roll the pieces covering the LARGER of snap-time and live
+            # size: the OSD restores in-snap pieces and REMOVES pieces
+            # born after the snap (pre-birth rollback semantics)
+            span = max(size, live.get(ent["ino"], 0))
+            prefix = _DATA_PREFIX.format(ino=ent["ino"])
+            pieces = {objno for objno, _o, _t
+                      in self.layout.file_to_extents(0, span)} if span \
+                else set()
+            for objno in pieces:
+                try:
+                    self.client.snap_rollback(
+                        self.pool, f"{prefix}.{objno:016x}", snapid)
+                except RadosError:
+                    pass  # piece did not exist at snap time
+
     # ---------------------------------------------------------- directory
     def mkdir(self, path: str) -> None:
+        if self._split_snap(path) is not None:
+            raise FsError(-30, "snapshots are read-only")
         self.mds.mkdir(path)
 
     def listdir(self, path: str) -> list[str]:
+        parts = _norm(path).split("/")
+        if parts[-1] == ".snap":
+            realm = _norm("/".join(parts[:-1])) or "/"
+            self._assert_dir(realm)
+            return sorted(self.mds.snaps_of(realm))
+        snap = self._split_snap(path)
+        if snap is not None:
+            sid, _root, resolved = snap
+            return sorted(self.mds.snap_entries(sid, resolved))
         self._assert_dir(path)
         return sorted(self.mds.entries(_norm(path)))
 
@@ -214,9 +352,14 @@ class FsClient:
 
     # --------------------------------------------------------------- files
     def create(self, path: str) -> None:
+        if self._split_snap(path) is not None:
+            raise FsError(-30, "snapshots are read-only")
         self.mds.create(path)
 
     def write_file(self, path: str, data: bytes, offset: int = 0) -> None:
+        if self._split_snap(path) is not None:
+            raise FsError(-30, "snapshots are read-only")
+        self._snapc_sync()
         ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory")
@@ -230,6 +373,17 @@ class FsClient:
 
     def read_file(self, path: str, offset: int = 0,
                   length: int | None = None) -> bytes:
+        snap = self._split_snap(path)
+        if snap is not None:
+            sid, root, resolved = snap
+            ent = self.mds.snap_lookup(sid, root, resolved)
+            if ent["type"] != "file":
+                raise FsError(-21, f"{path!r} is a directory")
+            size = int(ent.get("size", 0))
+            if length is None:
+                length = max(0, size - offset)
+            length = max(0, min(length, size - offset))
+            return self._read_snap_data(ent, sid, offset, length)
         ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory")
@@ -265,6 +419,12 @@ class FsClient:
         self.mds.rm_entry(path)
 
     def stat(self, path: str) -> dict:
+        snap = self._split_snap(path)
+        if snap is not None:
+            sid, root, resolved = snap
+            ent = dict(self.mds.snap_lookup(sid, root, resolved))
+            ent.setdefault("size", 0)
+            return ent
         ent = dict(self.mds.lookup(path))
         ent.setdefault("size", 0)
         return ent

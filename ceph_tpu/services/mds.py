@@ -46,6 +46,8 @@ from ..client.rados import RadosClient, RadosError
 from ..msg.wire import pack_value, unpack_value
 
 _DIR_OID = "fs_dir.{path}"
+_SNAPDIR_OID = "fs_snapdir.{snapid:x}.{path}"
+_SNAPTABLE_OID = "fs_snaptable"
 _JOURNAL_OID = "mds_journal.{rank}"
 _APPLIED_KEY = "_applied"          # journal omap: high-water of applied seqs
 _TRIM_EVERY = 64                   # expire applied entries in batches
@@ -182,8 +184,205 @@ class MdsDaemon:
             self.client.omap_rm(self.pool, self._dir_oid(parent), [name])
         elif kind == "rename":
             self._apply_rename(op["src"], op["dst"], op["ent"])
+        elif kind == "mksnap":
+            self._apply_mksnap(op["path"], op["name"], op["snapid"])
+        elif kind == "rmsnap":
+            self._apply_rmsnap(op["path"], op["name"], op["snapid"])
+        elif kind == "rollback_snap":
+            self._apply_rollback_snap(op["path"], op["snapid"])
         else:  # pragma: no cover - forward-compat guard
             raise FsError(-22, f"unknown journal op {kind!r}")
+
+    # ------------------------------------------------- snapshots (realm)
+    # The SnapServer + SnapRealm capability (src/mds/SnapServer.h:32,
+    # src/mds/SnapRealm.h) re-shaped: snapids come from the mon's
+    # self-managed pool snaps (data-object COW happens in the OSDs via
+    # the SnapContext clients attach), the snap TABLE is a journaled
+    # omap object shared by every rank, and the directory-tree METADATA
+    # at snap time is frozen by copying the subtree's dentry omaps to
+    # per-snapid objects.  Simplification vs the reference: the write
+    # SnapContext is filesystem-global, not per-realm — a post-snapshot
+    # write anywhere clones the touched objects (extra clones, same
+    # correctness; trim removes them).
+    def _snapdir_oid(self, snapid: int, path: str) -> str:
+        return _SNAPDIR_OID.format(snapid=snapid, path=_norm(path))
+
+    def snap_table(self) -> dict[tuple[str, str], int]:
+        """{(dirpath, snapname): snapid} — the live snap table."""
+        try:
+            omap = self.client.omap_get(self.pool, _SNAPTABLE_OID)
+        except RadosError:
+            return {}
+        out = {}
+        for k, v in omap.items():
+            rec = unpack_value(bytes(v))
+            out[(rec["path"], rec["name"])] = int(rec["snapid"])
+        return out
+
+    def snap_context(self) -> tuple[int, list]:
+        """(seq, snapids newest-first) for the data-pool write
+        SnapContext — what clients attach so the OSDs clone-on-write
+        (the snaprealm get_snap_context role)."""
+        ids = sorted(self.snap_table().values(), reverse=True)
+        return (ids[0] if ids else 0, ids)
+
+    def snaps_of(self, dirpath: str) -> dict[str, int]:
+        dirpath = _norm(dirpath)
+        return {name: sid for (p, name), sid in
+                self.snap_table().items() if p == dirpath}
+
+    def snap_covering(self, path: str, name: str) -> tuple[str, int]:
+        """Closest ancestor dir with a snapshot `name` (snaprealm
+        resolution: a realm covers its whole subtree)."""
+        path = _norm(path)
+        table = self.snap_table()
+        probe = path
+        while True:
+            sid = table.get((probe, name))
+            if sid is not None:
+                return probe, sid
+            if probe == "/":
+                raise FsError(-2, f"no snapshot {name!r} over {path!r}")
+            probe = posixpath.split(probe)[0]
+
+    def snap_create(self, dirpath: str, name: str) -> int:
+        dirpath = _norm(dirpath)
+        if self.lookup(dirpath)["type"] != "dir":
+            raise FsError(-20, f"{dirpath!r} is not a directory")
+        if name in self.snaps_of(dirpath):
+            raise FsError(-17, f"snapshot {name!r} exists")
+        # flush every client's buffered data under the realm first: the
+        # pool snapshot freezes what the OSDs HOLD, not client caches
+        self._revoke_subtree(dirpath, exclude=None)
+        self.invalidate(dirpath)
+        snapid = self.client.selfmanaged_snap_create(self.pool)
+        self.submit({"op": "mksnap", "path": dirpath, "name": name,
+                     "snapid": snapid})
+        return snapid
+
+    def _apply_mksnap(self, dirpath, name, snapid) -> None:
+        self._freeze_tree(dirpath, snapid)
+        self.client.omap_set(self.pool, _SNAPTABLE_OID, {
+            f"{snapid:016x}": pack_value({"path": _norm(dirpath),
+                                          "name": name,
+                                          "snapid": snapid})})
+
+    def _freeze_tree(self, dirpath: str, snapid: int) -> None:
+        """Copy the subtree's dentry omaps to the snapshot objects
+        (idempotent; replay-safe)."""
+        ents = self._raw_entries(dirpath)
+        if ents is None:
+            return
+        self.client.omap_set(self.pool,
+                             self._snapdir_oid(snapid, dirpath),
+                             dict(ents) or {"_": b""})
+        for nm, raw in ents.items():
+            if unpack_value(raw)["type"] == "dir":
+                self._freeze_tree(posixpath.join(_norm(dirpath), nm),
+                                  snapid)
+
+    def snap_remove(self, dirpath: str, name: str) -> None:
+        dirpath = _norm(dirpath)
+        sid = self.snaps_of(dirpath).get(name)
+        if sid is None:
+            raise FsError(-2, f"no snapshot {name!r} on {dirpath!r}")
+        self.submit({"op": "rmsnap", "path": dirpath, "name": name,
+                     "snapid": sid})
+        # retire the pool snap: OSDs trim the data clones
+        self.client.selfmanaged_snap_remove(self.pool, sid)
+
+    def _apply_rmsnap(self, dirpath, name, snapid) -> None:
+        self._thaw_tree(dirpath, snapid)
+        self.client.omap_rm(self.pool, _SNAPTABLE_OID,
+                            [f"{snapid:016x}"])
+
+    def _thaw_tree(self, dirpath: str, snapid: int) -> None:
+        ents = self.snap_entries_raw(snapid, dirpath)
+        for nm, raw in (ents or {}).items():
+            if nm != "_" and unpack_value(raw)["type"] == "dir":
+                self._thaw_tree(posixpath.join(_norm(dirpath), nm),
+                                snapid)
+        try:
+            self.client.remove(self.pool,
+                               self._snapdir_oid(snapid, dirpath))
+        except RadosError:
+            pass
+
+    # -- snapshot READ surface (the .snap view) -------------------------
+    def snap_entries_raw(self, snapid: int, dirpath: str) -> dict | None:
+        try:
+            omap = self.client.omap_get(
+                self.pool, self._snapdir_oid(snapid, dirpath))
+        except RadosError:
+            return None
+        omap.pop("_", None)
+        return omap
+
+    def snap_entries(self, snapid: int, dirpath: str) -> dict:
+        raw = self.snap_entries_raw(snapid, dirpath)
+        if raw is None:
+            raise FsError(-2, f"no such directory in snapshot")
+        return {k: unpack_value(v) for k, v in raw.items()}
+
+    def snap_lookup(self, snapid: int, snap_root: str,
+                    path: str) -> dict:
+        path = _norm(path)
+        if path == _norm(snap_root):
+            return {"type": "dir"}
+        parent, nm = posixpath.split(path)
+        ent = self.snap_entries(snapid, parent).get(nm)
+        if ent is None:
+            raise FsError(-2, f"no entry {path!r} in snapshot")
+        return ent
+
+    # -- rollback --------------------------------------------------------
+    def snap_rollback(self, dirpath: str, name: str) -> None:
+        """Restore the subtree (metadata + file data) to its state at
+        the snapshot; survives failover because the op is journaled and
+        apply is idempotent."""
+        dirpath = _norm(dirpath)
+        sid = self.snaps_of(dirpath).get(name)
+        if sid is None:
+            raise FsError(-2, f"no snapshot {name!r} on {dirpath!r}")
+        self._revoke_subtree(dirpath, exclude=None)
+        self.invalidate(dirpath)
+        self.submit({"op": "rollback_snap", "path": dirpath,
+                     "snapid": sid})
+
+    def _apply_rollback_snap(self, dirpath: str, snapid: int) -> None:
+        self._rollback_tree(dirpath, snapid)
+
+    def _rollback_tree(self, dirpath: str, snapid: int) -> None:
+        dirpath = _norm(dirpath)
+        frozen = self.snap_entries_raw(snapid, dirpath)
+        if frozen is None:
+            return
+        live = self._raw_entries(dirpath) or {}
+        # drop entries born after the snapshot
+        dead = [nm for nm in live if nm not in frozen]
+        if dead:
+            for nm in dead:
+                ent = unpack_value(live[nm])
+                if ent["type"] == "dir":
+                    self._drop_dir_tree(posixpath.join(dirpath, nm))
+            self.client.omap_rm(self.pool, self._dir_oid(dirpath), dead)
+        # restore the frozen entries (sizes/inos) + recurse; file DATA
+        # rolls back via the rados per-object snap_rollback op
+        self.client.omap_set(self.pool, self._dir_oid(dirpath),
+                             dict(frozen))
+        for nm, raw in frozen.items():
+            ent = unpack_value(raw)
+            sub = posixpath.join(dirpath, nm)
+            if ent["type"] == "dir":
+                try:
+                    self.client.omap_get(self.pool, self._dir_oid(sub))
+                except RadosError:
+                    self.client.omap_set(self.pool,
+                                         self._dir_oid(sub), {})
+                self._rollback_tree(sub, snapid)
+        # file DATA rolls back client-side (FsClient.snap_rollback):
+        # the layout lives with the mount, and the rados per-piece
+        # snap_rollback op is idempotent — a crashed roller re-runs
 
     def _apply_set_entry(self, path: str, ent: dict) -> None:
         parent, name = posixpath.split(_norm(path))
@@ -491,6 +690,37 @@ class MdsCluster:
                     continue
                 return {"subtree": top, "from": src, "to": dst}
         return None
+
+    # ------------------------------------------------ snapshot routing
+    def snap_create(self, dirpath: str, name: str) -> int:
+        a = self._entry_auth(dirpath)
+        for r in self.ranks:          # flush EVERY rank's caps under it
+            r._revoke_subtree(_norm(dirpath), exclude=None)
+        return a.snap_create(dirpath, name)
+
+    def snap_remove(self, dirpath: str, name: str) -> None:
+        self._entry_auth(dirpath).snap_remove(dirpath, name)
+
+    def snap_rollback(self, dirpath: str, name: str) -> None:
+        a = self._entry_auth(dirpath)
+        for r in self.ranks:
+            r._revoke_subtree(_norm(dirpath), exclude=None)
+        a.snap_rollback(dirpath, name)
+
+    def snaps_of(self, dirpath: str):
+        return self.ranks[0].snaps_of(dirpath)
+
+    def snap_context(self):
+        return self.ranks[0].snap_context()
+
+    def snap_covering(self, path: str, name: str):
+        return self.ranks[0].snap_covering(path, name)
+
+    def snap_entries(self, snapid: int, dirpath: str):
+        return self.ranks[0].snap_entries(snapid, dirpath)
+
+    def snap_lookup(self, snapid: int, snap_root: str, path: str):
+        return self.ranks[0].snap_lookup(snapid, snap_root, path)
 
     # --------------------------------------- MdsDaemon-compatible surface
     def register_session(self, client_id: str, revoke_cb) -> None:

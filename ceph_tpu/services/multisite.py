@@ -149,6 +149,49 @@ class ZoneSyncAgent:
     def _apply(self, bucket: str, entry: dict) -> str:
         """-> "applied" | "skip" (superseded/duplicate) | "retry"."""
         key = entry["key"]
+        vid = entry.get("version_id") or None
+        op = entry["op"]
+        # versioned ops replicate EXACT generations (the bilog carries
+        # version ids — rgw data-sync versioned-epoch role); duplicates
+        # are detected per-version, not by head mtime
+        if op == "delete_marker":
+            if any(m.get("delete_marker")
+                   and m.get("version_id") == vid
+                   for m in self.dst.versions_of(bucket, key)):
+                return "skip"
+            try:
+                # the marker replicates with the ORIGIN's id, so the
+                # generation graph stays identical across zones (a
+                # later delete_version of the marker then applies)
+                self.dst.delete_object(bucket, key,
+                                       origin=entry["zone"],
+                                       mtime=entry["mtime"],
+                                       marker_version_id=vid)
+            except KeyError:
+                pass
+            return "applied"
+        if op == "delete_version":
+            try:
+                self.dst.delete_object(bucket, key, version_id=vid,
+                                       origin=entry["zone"])
+            except KeyError:
+                pass  # that generation never made it here / gone
+            return "applied"
+        if op == "put" and vid:
+            if any(m.get("version_id") == vid
+                   for m in self.dst.versions_of(bucket, key)):
+                return "skip"  # this exact generation already landed
+            quoted = urllib.parse.quote(key)
+            st, body = self._request(
+                "GET", f"/{bucket}/{quoted}?versionId={vid}")
+            if st == 404:
+                return "skip"  # generation purged at source meanwhile
+            if st != 200:
+                return "retry"
+            self.dst.put_object(bucket, key, body,
+                                origin=entry["zone"],
+                                mtime=entry["mtime"], version_id=vid)
+            return "applied"
         # last-writer-wins by mtime: a newer local change outranks the
         # replicated one (non-versioned-bucket mtime squash)
         try:
@@ -157,7 +200,7 @@ class ZoneSyncAgent:
             local = None
         if local is not None and local["mtime"] > entry["mtime"]:
             return "skip"
-        if entry["op"] == "delete":
+        if op == "delete":
             try:
                 self.dst.delete_object(bucket, key,
                                        origin=entry["zone"])

@@ -20,9 +20,25 @@ Re-shaped for this build:
   might read through them) and purges copies when nothing older
   remains.
 
-Single-writer images (the exclusive-lock feature of the reference is a
-later slice); all ops are synchronous like the rest of the client
-stack.
+Exclusive lock + journaling (the librbd exclusive_lock/journal
+features, ref src/librbd/Journal.h:41, src/journal/, managed-lock
+handoff src/librbd/ManagedLock.cc):
+
+- mutating ops take a cls_lock exclusive lock on the header object
+  (cookie = client name).  A contender NOTIFIES the header
+  (request_lock, the librbd RequestLock notify); the holder's watch
+  releases cooperatively and re-acquires before its own next write —
+  the ping-pong handoff of two librbd clients.  A dead holder's lock
+  is BROKEN after the handoff times out (blocklist-lite), and its
+  journal is replayed before the new holder serves io.
+- with the journaling feature, every mutation appends an event to the
+  image journal (omap of rbd_journal.<name>: seq -> packed event)
+  BEFORE touching data objects, and trims it after apply (commit
+  pointer).  Lock acquisition replays any events past the commit
+  pointer — a crashed writer's half-applied write is completed, never
+  torn (Journal.h's replay-on-open contract).
+
+All ops are synchronous like the rest of the client stack.
 """
 
 from __future__ import annotations
@@ -37,6 +53,10 @@ _HEADER = "rbd_header.{name}"
 _DATA = "rbd_data.{name}.{objno:016x}"
 _SNAP = "rbd_data.{name}.{objno:016x}@{snap}"
 _DIR = "rbd_directory"
+_JOURNAL = "rbd_journal.{name}"
+_LOCK_NAME = "rbd_lock"
+
+FEATURE_JOURNALING = 1
 
 
 class RbdError(Exception):
@@ -75,8 +95,9 @@ class ImageHeader(Encodable):
     stripe_count: int
     snap_seq: int = 0
     snaps: list = field(default_factory=list)  # [SnapRecord]
+    features: int = 0  # FEATURE_* bits (journaling)
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
@@ -86,6 +107,7 @@ class ImageHeader(Encodable):
             e.u64(self.stripe_count)
             e.u64(self.snap_seq)
             e.seq(self.snaps, lambda ee, s: s.encode(ee))
+            e.u64(self.features)  # v2 tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -93,6 +115,8 @@ class ImageHeader(Encodable):
         def body(d, v):
             h = cls(d.u64(), d.u64(), d.u64(), d.u64(), d.u64())
             h.snaps = d.seq(SnapRecord.decode)
+            if v >= 2:
+                h.features = d.u64()
             return h
         return dec.versioned(cls.VERSION, body)
 
@@ -110,7 +134,8 @@ class RBD:
     def create(self, pool: str, name: str, size: int,
                object_size: int = 4 * 1024 * 1024,
                stripe_unit: int | None = None,
-               stripe_count: int = 1) -> "Image":
+               stripe_count: int = 1,
+               features: int = 0) -> "Image":
         if size < 0:
             raise RbdError("negative size")
         header = _HEADER.format(name=name)
@@ -120,7 +145,8 @@ class RBD:
         except RadosError:
             pass
         su = stripe_unit or object_size
-        h = ImageHeader(size, object_size, su, stripe_count)
+        h = ImageHeader(size, object_size, su, stripe_count,
+                        features=features)
         FileLayout(su, stripe_count, object_size)  # validates
         self.client.write_full(pool, header, h.encode_bytes())
         self._dir_update(pool, add=name)
@@ -161,7 +187,235 @@ class Image:
         self.client = client
         self.pool = pool
         self.name = name
+        self._owner = client.name  # cls_lock cookie
+        self._locked = False
+        self._release_asked = False
+        self._watching = False
+        self._in_op = False
+        import threading
+        self._lk = threading.RLock()  # lock state vs the notify thread
+        self._jseq = 0
         self._load()
+
+    # ------------------------------------------------- exclusive lock
+    @property
+    def _hoid(self) -> str:
+        return _HEADER.format(name=self.name)
+
+    @property
+    def _joid(self) -> str:
+        return _JOURNAL.format(name=self.name)
+
+    def _journaling(self) -> bool:
+        return bool(self.header.features & FEATURE_JOURNALING)
+
+    def _on_header_notify(self, oid, notifier, payload) -> None:
+        if payload == b"request_lock" and notifier != self._owner:
+            # cooperative handoff (ManagedLock release-on-request): an
+            # idle holder lets the contender in NOW; mid-op, the
+            # release happens when the op finishes.  Either way we
+            # re-acquire before our own next write.
+            with self._lk:
+                idle = self._locked and not self._in_op
+                self._release_asked = not idle
+            if idle:
+                # the callback runs on the client's dispatch thread —
+                # the synchronous unlock RPC must not wait for replies
+                # that same thread would deliver
+                import threading
+                threading.Thread(target=self._release_lock,
+                                 daemon=True).start()
+
+    def _ensure_lock(self, timeout: float = 5.0) -> None:
+        """Hold the exclusive lock before mutating (librbd
+        exclusive_lock).  Contenders ask the holder to release
+        (header notify) and finally BREAK a dead holder's lock,
+        replaying its journal before serving io."""
+        with self._lk:
+            if self._locked and self._release_asked:
+                self._release_lock()
+            if self._locked:
+                # refresh the lock stamp AND verify we still own it (a
+                # contender may have broken a lock we held while stuck
+                # — the no-blocklist analogue of librbd fencing: loss
+                # is detected at the next op boundary)
+                try:
+                    self.client.cls_call(self.pool, self._hoid, "lock",
+                                         "lock", {"name": _LOCK_NAME,
+                                                  "owner": self._owner,
+                                                  "exclusive": True})
+                    self._in_op = True
+                    return
+                except RadosError:
+                    self._locked = False  # usurped: fall through
+        if not self._watching:
+            self.client.watch(self.pool, self._hoid,
+                              self._on_header_notify)
+            self._watching = True
+        import time as _time
+        deadline = _time.time() + timeout
+        asked = False
+        while True:
+            try:
+                self.client.cls_call(self.pool, self._hoid, "lock",
+                                     "lock", {"name": _LOCK_NAME,
+                                              "owner": self._owner,
+                                              "exclusive": True})
+                break
+            except RadosError as e:
+                if _time.time() >= deadline:
+                    # break ONLY a holder whose lock stamp has gone
+                    # stale (live holders refresh it every op): a
+                    # stuck-but-alive writer keeps its lock, a dead
+                    # one is dispossessed (blocklist-lite) — its
+                    # journal replays below
+                    info = self.client.cls_call(
+                        self.pool, self._hoid, "lock", "info",
+                        {"name": _LOCK_NAME}) or {}
+                    stamp = float(info.get("stamp", 0.0))
+                    if _time.time() - stamp >= timeout:
+                        self.client.cls_call(self.pool, self._hoid,
+                                             "lock", "break_lock",
+                                             {"name": _LOCK_NAME})
+                    else:
+                        deadline = stamp + 2 * timeout
+                    continue
+                if not asked:
+                    asked = True
+                self.client.notify(self.pool, self._hoid,
+                                   b"request_lock")
+                _time.sleep(0.02)
+        with self._lk:
+            self._locked = True
+            self._release_asked = False
+            self._in_op = True
+        # the header may have moved while someone else held the lock
+        # (their snapshots/resizes MUST be visible before we mutate, or
+        # a write would skip their snapshot's copy-up)
+        self._load()
+        if self._journaling():
+            self._replay_journal()
+
+    def _release_lock(self) -> None:
+        with self._lk:
+            if not self._locked:
+                return
+            self._locked = False
+            self._release_asked = False
+        try:
+            self.client.cls_call(self.pool, self._hoid, "lock",
+                                 "unlock", {"name": _LOCK_NAME,
+                                            "owner": self._owner})
+        except RadosError:
+            pass  # already broken/taken
+
+    def _end_op(self) -> None:
+        with self._lk:
+            self._in_op = False
+            if self._locked and self._release_asked:
+                self._release_lock()
+
+    def lock_owner(self) -> str | None:
+        info = self.client.cls_call(self.pool, self._hoid, "lock",
+                                    "info", {"name": _LOCK_NAME})
+        owners = (info or {}).get("owners") or []
+        return owners[0] if owners else None
+
+    def close(self) -> None:
+        self._release_lock()
+        if self._watching:
+            try:
+                self.client.unwatch(self.pool, self._hoid)
+            except RadosError:
+                pass
+            self._watching = False
+
+    # ------------------------------------------------------- journal
+    def _journal_entries(self) -> tuple[int, list[tuple[int, dict]]]:
+        """(committed seq, [(seq, event)] past it, seq-ordered)."""
+        from ..msg.wire import unpack_value
+        try:
+            omap = self.client.omap_get(self.pool, self._joid)
+        except RadosError:
+            return 0, []
+        committed = int.from_bytes(bytes(omap.get("_c", b"")) or b"\0",
+                                   "little")
+        ents = sorted((int(k[1:], 16), unpack_value(bytes(v)))
+                      for k, v in omap.items() if k.startswith("e"))
+        return committed, [(s, ev) for s, ev in ents if s > committed]
+
+    def _journal_append(self, event: dict) -> int:
+        from ..msg.wire import pack_value
+        self._jseq += 1
+        self.client.omap_set(self.pool, self._joid,
+                             {f"e{self._jseq:016x}": pack_value(event)})
+        return self._jseq
+
+    def _journal_commit(self, seq: int) -> None:
+        self.client.omap_set(self.pool, self._joid,
+                             {"_c": seq.to_bytes(8, "little")})
+        # trim only what EVERY registered consumer (the local commit
+        # pointer plus mirror peers) has consumed — the journal is the
+        # mirroring feed (src/journal/ commit-position semantics)
+        floor = min([seq] + list(self._mirror_positions().values()))
+        if floor >= seq:
+            self.client.omap_rm(self.pool, self._joid,
+                                [f"e{seq:016x}"])
+
+    # ------------------------------------------------------ mirroring
+    def _mirror_positions(self) -> dict[str, int]:
+        try:
+            omap = self.client.omap_get(self.pool, self._joid)
+        except RadosError:
+            return {}
+        return {k[3:]: int.from_bytes(bytes(v), "little")
+                for k, v in omap.items() if k.startswith("_m.")}
+
+    def mirror_register(self, peer: str) -> None:
+        """Register a mirror peer (rbd mirror pool peer add role):
+        journal events are retained until the peer's replayer consumes
+        them.  Requires the journaling feature."""
+        if not self._journaling():
+            raise RbdError("mirroring needs the journaling feature")
+        if peer not in self._mirror_positions():
+            self.client.omap_set(
+                self.pool, self._joid,
+                {f"_m.{peer}": (0).to_bytes(8, "little")})
+
+    def mirror_unregister(self, peer: str) -> None:
+        self.client.omap_rm(self.pool, self._joid, [f"_m.{peer}"])
+        self._mirror_trim()
+
+    def _mirror_trim(self) -> None:
+        """Drop journal events every consumer has passed."""
+        from ..msg.wire import unpack_value  # noqa: F401 - parity import
+        try:
+            omap = self.client.omap_get(self.pool, self._joid)
+        except RadosError:
+            return
+        committed = int.from_bytes(bytes(omap.get("_c", b"")) or b"\0",
+                                   "little")
+        floor = min([committed]
+                    + list(self._mirror_positions().values()))
+        drop = [k for k in omap
+                if k.startswith("e") and int(k[1:], 16) <= floor]
+        if drop:
+            self.client.omap_rm(self.pool, self._joid, drop)
+
+    def _replay_journal(self) -> None:
+        """Journal.h replay-on-open: complete events a crashed holder
+        journaled but may not have fully applied (apply is idempotent
+        — same bytes to the same extents)."""
+        committed, pending = self._journal_entries()
+        self._jseq = max([committed] + [s for s, _ in pending])
+        for seq, ev in pending:
+            if ev.get("op") == "write":
+                self._apply_write(int(ev["off"]), bytes(ev["data"]))
+            elif ev.get("op") == "resize":
+                self._load()
+                if self.header.size != int(ev["size"]):
+                    self._apply_resize(int(ev["size"]))
+            self._journal_commit(seq)
 
     # ------------------------------------------------------------- header
     def _load(self) -> None:
@@ -228,6 +482,22 @@ class Image:
             raise RbdError("write past end of image (resize first)")
         if not data:
             return
+        self._ensure_lock()
+        try:
+            if self._journaling():
+                # journal FIRST (Journal.h write-ahead contract): a
+                # crash after this point replays the event; before it,
+                # the write never happened — no torn middle survives
+                seq = self._journal_append({"op": "write", "off": off,
+                                            "data": data})
+                self._apply_write(off, data)
+                self._journal_commit(seq)
+            else:
+                self._apply_write(off, data)
+        finally:
+            self._end_op()
+
+    def _apply_write(self, off: int, data: bytes) -> None:
         layout = self.header.layout()
         newest = self._newest_snap()
         per_obj: dict[int, list] = {}
@@ -291,6 +561,19 @@ class Image:
     def resize(self, new_size: int) -> None:
         if new_size < 0:
             raise RbdError("negative size")
+        self._ensure_lock()
+        try:
+            if self._journaling():
+                seq = self._journal_append({"op": "resize",
+                                            "size": new_size})
+                self._apply_resize(new_size)
+                self._journal_commit(seq)
+            else:
+                self._apply_resize(new_size)
+        finally:
+            self._end_op()
+
+    def _apply_resize(self, new_size: int) -> None:
         old = self.header.size
         if new_size < old:
             # trim: COW whole objects into the newest snapshot (a live
@@ -320,6 +603,13 @@ class Image:
         raise RbdError(f"no snapshot {name!r}")
 
     def snap_create(self, name: str) -> int:
+        self._ensure_lock()
+        try:
+            return self._snap_create(name)
+        finally:
+            self._end_op()
+
+    def _snap_create(self, name: str) -> int:
         if any(r.name == name for r in self.header.snaps):
             raise RbdError(f"snapshot {name!r} exists")
         self.header.snap_seq += 1
@@ -333,6 +623,13 @@ class Image:
                 for r in self.header.snaps if r.name]
 
     def snap_remove(self, name: str) -> None:
+        self._ensure_lock()
+        try:
+            self._snap_remove_locked(name)
+        finally:
+            self._end_op()
+
+    def _snap_remove_locked(self, name: str) -> None:
         rec = self._snap_record(name)
         older_live = any(r.name and r.snap_id < rec.snap_id
                         for r in self.header.snaps)
@@ -366,6 +663,13 @@ class Image:
         """head := the image content at the snapshot (librbd rollback).
         Rollback is itself a mutation: objects copy-up to snapshots
         NEWER than the target first, so those snapshots stay frozen."""
+        self._ensure_lock()
+        try:
+            self._snap_rollback_locked(name)
+        finally:
+            self._end_op()
+
+    def _snap_rollback_locked(self, name: str) -> None:
         rec = self._snap_record(name)
         cur = self.header.size
         newest = self._newest_snap()
@@ -428,3 +732,41 @@ class Image:
                                _HEADER.format(name=self.name))
         except RadosError:
             pass
+
+
+# --------------------------------------------------------------- mirroring
+def mirror_replay(src: Image, dst: Image, peer: str) -> int:
+    """One rbd-mirror replayer pass (src/tools/rbd_mirror/ image
+    replayer role): apply the src image's journal events past this
+    peer's commit position onto dst, advance the position, trim.
+    Returns how many events were applied.  Event application is
+    idempotent (same bytes to the same extents), so a crashed replayer
+    simply re-runs."""
+    positions = src._mirror_positions()
+    if peer not in positions:
+        raise RbdError(f"peer {peer!r} not registered")
+    pos = positions[peer]
+    try:
+        omap = src.client.omap_get(src.pool, src._joid)
+    except RadosError:
+        return 0
+    from ..msg.wire import unpack_value
+    events = sorted((int(k[1:], 16), unpack_value(bytes(v)))
+                    for k, v in omap.items() if k.startswith("e"))
+    applied = 0
+    for seq, ev in events:
+        if seq <= pos:
+            continue
+        if ev.get("op") == "write":
+            off, data = int(ev["off"]), bytes(ev["data"])
+            if off + len(data) > dst.header.size:
+                dst._apply_resize(off + len(data))
+            dst._apply_write(off, data)
+        elif ev.get("op") == "resize":
+            dst._apply_resize(int(ev["size"]))
+        pos = seq
+        applied += 1
+    src.client.omap_set(src.pool, src._joid,
+                        {f"_m.{peer}": pos.to_bytes(8, "little")})
+    src._mirror_trim()
+    return applied

@@ -29,7 +29,14 @@ cls_rgw omap on index objects, object data striped over RADOS):
   active-active safe (entries originated by the applying zone are
   skipped, so changes never ping-pong).
 
-Versioning is planned.
+- versioning (rgw_op.cc versioned paths): per-bucket flag; versioned
+  PUTs retain every generation under minted version ids, unversioned
+  DELETE leaves a delete marker, versionId= addresses reads/deletes of
+  specific generations, GET ?versions lists them — and the bilog
+  carries version ids so multisite sync replicates exact generations;
+- lifecycle (rgw_lc.h role): per-bucket rules (prefix + expiration
+  days, noncurrent-version expiration); lc_process() is the LC worker
+  pass the reference schedules as a daemon.
 """
 
 from __future__ import annotations
@@ -54,6 +61,45 @@ _DATA_PREFIX = "rgw_data.{bucket}.{key}"
 _UPLOADS_OID = "rgw_uploads.{bucket}"
 _PART_PREFIX = "rgw_mp.{bucket}.{upload}.{part:05d}"
 _BILOG_OID = "rgw_bilog.{bucket}"
+_VERIDX_OID = "rgw_verindex.{bucket}"
+_VSEP = "\x00v"
+
+
+def _localname(tag: str) -> str:
+    return tag.rsplit("}", 1)[-1]
+
+
+def _child_text(elem, *path: str) -> str | None:
+    """findtext by LOCAL names — AWS SDK bodies carry the s3 xmlns,
+    and namespaced children silently miss unqualified findtext."""
+    cur = elem
+    for name in path:
+        cur = next((c for c in cur if _localname(c.tag) == name), None)
+        if cur is None:
+            return None
+    return cur.text
+
+
+def _parse_lifecycle(body: bytes) -> list[dict]:
+    rules = []
+    root = ElementTree.fromstring(body)
+    for r in root.iter():
+        if _localname(r.tag) != "Rule":
+            continue
+        prefix = _child_text(r, "Prefix")
+        if prefix is None:
+            prefix = _child_text(r, "Filter", "Prefix")
+        rule = {"id": _child_text(r, "ID") or "",
+                "prefix": prefix or ""}
+        d = _child_text(r, "Expiration", "Days")
+        if d:
+            rule["days"] = float(d)
+        nd = _child_text(r, "NoncurrentVersionExpiration",
+                         "NoncurrentDays")
+        if nd:
+            rule["noncurrent_days"] = float(nd)
+        rules.append(rule)
+    return rules
 
 
 class RgwGateway:
@@ -151,6 +197,30 @@ class RgwGateway:
                         self._send(200, gw.list_buckets_xml())
                     elif key is None and "uploads" in qs:
                         self._send(200, gw.list_uploads_xml(bucket))
+                    elif key is None and "versions" in qs:
+                        prefix = urllib.parse.unquote(
+                            qs.get("prefix", ""))
+                        self._send(200, gw.list_versions_xml(bucket,
+                                                             prefix))
+                    elif key is None and "versioning" in qs:
+                        status = ("Enabled"
+                                  if gw.versioning_enabled(bucket)
+                                  else "Suspended")
+                        self._send(200, (
+                            '<?xml version="1.0"?>'
+                            "<VersioningConfiguration><Status>"
+                            f"{status}</Status>"
+                            "</VersioningConfiguration>").encode())
+                    elif key is None and "lifecycle" in qs:
+                        rules = gw.get_lifecycle(bucket)
+                        items = "".join(
+                            f"<Rule><ID>{escape(str(r.get('id', '')))}"
+                            f"</ID><Prefix>{escape(r.get('prefix', ''))}"
+                            f"</Prefix></Rule>" for r in rules)
+                        self._send(200, (
+                            '<?xml version="1.0"?>'
+                            f"<LifecycleConfiguration>{items}"
+                            "</LifecycleConfiguration>").encode())
                     elif key is None:
                         prefix = urllib.parse.unquote(
                             qs.get("prefix", ""))
@@ -161,11 +231,16 @@ class RgwGateway:
                             bucket, key, qs["uploadId"]))
                     else:
                         rng = self.headers.get("Range")
-                        data, meta, status = gw.get_object(bucket, key,
-                                                           rng)
+                        data, meta, status = gw.get_object(
+                            bucket, key, rng,
+                            version_id=qs.get("versionId"))
+                        hdrs = {"ETag": f'"{meta["etag"]}"'}
+                        if meta.get("version_id"):
+                            hdrs["x-amz-version-id"] = \
+                                meta["version_id"]
                         self._send(status, data,
                                    ctype="application/octet-stream",
-                                   headers={"ETag": f'"{meta["etag"]}"'})
+                                   headers=hdrs)
                 except KeyError:
                     self._error(404, "NoSuchKey")
 
@@ -233,7 +308,17 @@ class RgwGateway:
                 if not self._auth(body):
                     return
                 try:
-                    if key is None:
+                    if key is None and "versioning" in qs:
+                        enabled = b"<Status>Enabled</Status>" in body
+                        gw.check_bucket(bucket)
+                        gw.set_versioning(bucket, enabled)
+                        self._send(200)
+                    elif key is None and "lifecycle" in qs:
+                        gw.check_bucket(bucket)
+                        gw.set_lifecycle(bucket,
+                                         _parse_lifecycle(body))
+                        self._send(200)
+                    elif key is None:
                         gw.create_bucket(bucket)
                         self._send(200)
                     elif "partNumber" in qs and "uploadId" in qs:
@@ -254,11 +339,21 @@ class RgwGateway:
                 try:
                     if key is not None and "uploadId" in qs:
                         gw.abort_multipart(bucket, key, qs["uploadId"])
+                        self._send(204)
                     elif key is None:
                         gw.delete_bucket(bucket)
+                        self._send(204)
                     else:
-                        gw.delete_object(bucket, key)
-                    self._send(204)
+                        res = gw.delete_object(
+                            bucket, key,
+                            version_id=qs.get("versionId"))
+                        hdrs = {}
+                        if res.get("delete_marker"):
+                            hdrs["x-amz-delete-marker"] = "true"
+                        if res.get("version_id"):
+                            hdrs["x-amz-version-id"] = \
+                                res["version_id"]
+                        self._send(204, headers=hdrs)
                 except KeyError:
                     self._error(404, "NoSuchKey")
                 except ValueError:
@@ -282,13 +377,39 @@ class RgwGateway:
         except RadosError:
             return {}
 
-    def create_bucket(self, bucket: str) -> None:
+    def _bucket_rec(self, bucket: str) -> dict:
+        raw = self._buckets().get(bucket)
+        if raw is None:
+            raise KeyError(bucket)
+        rec = unpack_value(raw)
+        if not isinstance(rec, dict):  # pre-versioning stamp: a float
+            rec = {"created": float(rec)}
+        return rec
+
+    def _bucket_rec_set(self, bucket: str, rec: dict) -> None:
         self.client.omap_set(self.pool, _BUCKETS_OID,
-                             {bucket: pack_value(time.time())})
+                             {bucket: pack_value(rec)})
+
+    def create_bucket(self, bucket: str) -> None:
+        self.client.omap_set(
+            self.pool, _BUCKETS_OID,
+            {bucket: pack_value({"created": time.time()})})
 
     def check_bucket(self, bucket: str) -> None:
         if bucket not in self._buckets():
             raise KeyError(bucket)
+
+    # ---------------------------------------------------- versioning flag
+    def set_versioning(self, bucket: str, enabled: bool) -> None:
+        rec = self._bucket_rec(bucket)
+        rec["versioning"] = bool(enabled)
+        self._bucket_rec_set(bucket, rec)
+
+    def versioning_enabled(self, bucket: str) -> bool:
+        try:
+            return bool(self._bucket_rec(bucket).get("versioning"))
+        except KeyError:
+            return False
 
     def delete_bucket(self, bucket: str) -> None:
         self.check_bucket(bucket)
@@ -329,6 +450,8 @@ class RgwGateway:
             if prefix and not key.startswith(prefix):
                 continue
             meta = idx[key]
+            if meta.get("delete_marker"):
+                continue  # a marker head hides the key (S3 list)
             items.append(
                 f"<Contents><Key>{escape(key)}</Key>"
                 f"<Size>{meta['size']}</Size>"
@@ -338,9 +461,47 @@ class RgwGateway:
                 f"<Prefix>{escape(prefix)}</Prefix>"
                 f"{''.join(items)}</ListBucketResult>").encode()
 
+    # -------------------------------------------------- version index
+    def _verindex(self, bucket: str) -> dict:
+        try:
+            raw = self.client.omap_get(
+                self.pool, _VERIDX_OID.format(bucket=bucket))
+        except RadosError:
+            return {}
+        return {k: unpack_value(v) for k, v in raw.items()}
+
+    def _verindex_set(self, bucket: str, key: str, vid: str,
+                      meta: dict) -> None:
+        self.client.omap_set(self.pool,
+                             _VERIDX_OID.format(bucket=bucket),
+                             {f"{key}{_VSEP}{vid}": pack_value(meta)})
+
+    def _verindex_rm(self, bucket: str, key: str, vid: str) -> None:
+        try:
+            self.client.omap_rm(self.pool,
+                                _VERIDX_OID.format(bucket=bucket),
+                                [f"{key}{_VSEP}{vid}"])
+        except RadosError:
+            pass  # no version index object / no such generation
+
+    def versions_of(self, bucket: str, key: str) -> list[dict]:
+        """Every generation of `key`, newest first (head included)."""
+        out = []
+        head = self._index(bucket).get(key)
+        if head is not None:
+            out.append(dict(head, is_latest=True))
+        prefix = f"{key}{_VSEP}"
+        for k, meta in self._verindex(bucket).items():
+            if k.startswith(prefix):
+                out.append(dict(meta, is_latest=False))
+        out.sort(key=lambda m: -float(m.get("mtime", 0)))
+        return out
+
     # ------------------------------------------------------------ objects
-    def _striped(self, bucket: str, key: str) -> StripedObject:
-        safe = hashlib.sha256(key.encode()).hexdigest()[:24]
+    def _striped(self, bucket: str, key: str,
+                 vid: str | None = None) -> StripedObject:
+        tag = key if vid in (None, "", "null") else f"{key}{_VSEP}{vid}"
+        safe = hashlib.sha256(tag.encode()).hexdigest()[:24]
         return StripedObject(
             self.client, self.pool,
             _DATA_PREFIX.format(bucket=bucket, key=safe),
@@ -349,25 +510,137 @@ class RgwGateway:
 
     def put_object(self, bucket: str, key: str, body: bytes,
                    origin: str | None = None,
-                   mtime: float | None = None) -> str:
+                   mtime: float | None = None,
+                   version_id: str | None = None) -> str:
         """origin: the zone whose client caused this change (multisite
         sync applies peer changes with the PEER's zone so they are not
         replicated back — the no-ping-pong rule).  mtime: preserve the
         ORIGIN's timestamp on replicated applies, or LWW comparisons
-        against later origin entries would judge them stale."""
+        against later origin entries would judge them stale.
+        version_id: multisite replays a peer's exact generation id; a
+        fresh id is minted otherwise when the bucket is versioned."""
         self.check_bucket(bucket)
-        self._drop_object_data(bucket, key)  # replace semantics
-        so = self._striped(bucket, key)
+        versioned = self.versioning_enabled(bucket)
+        old_head = self._index(bucket).get(key) if versioned else None
+        if versioned:
+            # versioned PUT keeps every generation (rgw_op.cc
+            # versioning-enabled write path): the old head retires
+            # into the version index, nothing is dropped
+            if old_head is not None:
+                self._verindex_set(bucket, key,
+                                   old_head.get("version_id", "null"),
+                                   old_head)
+            vid = version_id or uuid.uuid4().hex[:16]
+        else:
+            # versioning OFF or SUSPENDED.  Suspended S3 semantics: the
+            # new object REPLACES the null generation only — non-null
+            # generations (from when versioning was enabled) and their
+            # data must survive, so only null-addressed data may drop.
+            head = self._index(bucket).get(key)
+            if head is not None and head.get("version_id"):
+                # non-null head retires untouched into the index
+                self._verindex_set(bucket, key, head["version_id"],
+                                   head)
+            else:
+                self._drop_object_data(bucket, key)  # replaces null
+            # the retained-null record (if any) is being replaced
+            self._verindex_rm(bucket, key, "null")
+            vid = None
+        so = self._striped(bucket, key, vid)
         if body:
             so.write(0, body)
         etag = hashlib.md5(body).hexdigest()
         mtime = time.time() if mtime is None else float(mtime)
-        self._index_set(bucket, key, {"size": len(body), "etag": etag,
-                                      "mtime": mtime})
+        meta = {"size": len(body), "etag": etag, "mtime": mtime}
+        if vid is not None:
+            meta["version_id"] = vid
+        self._index_set(bucket, key, meta)
         self._bilog_append(bucket, {"op": "put", "key": key,
                                     "etag": etag, "mtime": mtime,
+                                    "version_id": vid or "",
                                     "zone": origin or self.zone})
         return etag
+
+    def list_versions_xml(self, bucket: str, prefix: str = "") -> bytes:
+        """GET /bucket?versions (ListVersionsResult)."""
+        self.check_bucket(bucket)
+        keys = sorted({k for k in self._index(bucket)} |
+                      {k.split(_VSEP)[0] for k in self._verindex(bucket)})
+        items = []
+        for key in keys:
+            if prefix and not key.startswith(prefix):
+                continue
+            for meta in self.versions_of(bucket, key):
+                vid = meta.get("version_id", "null")
+                latest = "true" if meta.get("is_latest") else "false"
+                if meta.get("delete_marker"):
+                    items.append(
+                        f"<DeleteMarker><Key>{escape(key)}</Key>"
+                        f"<VersionId>{vid}</VersionId>"
+                        f"<IsLatest>{latest}</IsLatest></DeleteMarker>")
+                else:
+                    items.append(
+                        f"<Version><Key>{escape(key)}</Key>"
+                        f"<VersionId>{vid}</VersionId>"
+                        f"<IsLatest>{latest}</IsLatest>"
+                        f"<Size>{meta['size']}</Size>"
+                        f"<ETag>&quot;{meta['etag']}&quot;</ETag>"
+                        f"</Version>")
+        return (f'<?xml version="1.0"?><ListVersionsResult>'
+                f"<Name>{escape(bucket)}</Name>"
+                f"{''.join(items)}</ListVersionsResult>").encode()
+
+    # ---------------------------------------------------------- lifecycle
+    def set_lifecycle(self, bucket: str, rules: list[dict]) -> None:
+        """rules: [{id, prefix, days, noncurrent_days}] — the
+        expiration slice of the reference's LC config (rgw_lc.h:579
+        rule model)."""
+        rec = self._bucket_rec(bucket)
+        rec["lifecycle"] = list(rules)
+        self._bucket_rec_set(bucket, rec)
+
+    def get_lifecycle(self, bucket: str) -> list[dict]:
+        return list(self._bucket_rec(bucket).get("lifecycle", []))
+
+    def lc_process(self, now: float | None = None) -> dict:
+        """One LC worker pass over every bucket (the RGWLC::process
+        scheduled-daemon role): expire current objects past their rule
+        age (versioned buckets get a delete marker, plain buckets a
+        real delete) and permanently remove NONCURRENT generations past
+        noncurrent_days.  Returns counters for observability."""
+        now = time.time() if now is None else now
+        expired = noncurrent = 0
+        for bucket in list(self._buckets()):
+            try:
+                rules = self.get_lifecycle(bucket)
+            except KeyError:
+                continue
+            for rule in rules:
+                pfx = rule.get("prefix", "")
+                days = rule.get("days")
+                nc_days = rule.get("noncurrent_days")
+                if days is not None:
+                    cutoff = now - float(days) * 86400
+                    for key, meta in list(self._index(bucket).items()):
+                        if not key.startswith(pfx) \
+                                or meta.get("delete_marker"):
+                            continue
+                        if float(meta.get("mtime", now)) < cutoff:
+                            self.delete_object(bucket, key)
+                            expired += 1
+                if nc_days is not None:
+                    cutoff = now - float(nc_days) * 86400
+                    for k, meta in list(self._verindex(bucket).items()):
+                        key, _, vid = k.partition(_VSEP)
+                        if not key.startswith(pfx):
+                            continue
+                        if float(meta.get("mtime", now)) < cutoff:
+                            self.delete_object(
+                                bucket, key,
+                                version_id=meta.get("version_id",
+                                                    "null"))
+                            noncurrent += 1
+        return {"expired": expired, "noncurrent_removed": noncurrent}
 
     # ----------------------------------------------------- multisite bilog
     _BILOG_KEEP = 10_000
@@ -488,14 +761,28 @@ class RgwGateway:
             total += meta["size"]
         # S3 multipart etag convention: md5 of the part digests, -N
         etag = f"{hashlib.md5(digests).hexdigest()}-{len(manifest)}"
-        self._drop_object_data(bucket, key)  # replace any old head
+        vid = None
+        if self.versioning_enabled(bucket):
+            # versioned completion retires the old head like any PUT
+            # (generation retained, nothing dropped)
+            old_head = self._index(bucket).get(key)
+            if old_head is not None:
+                self._verindex_set(bucket, key,
+                                   old_head.get("version_id", "null"),
+                                   old_head)
+            vid = uuid.uuid4().hex[:16]
+        else:
+            self._drop_object_data(bucket, key)  # replace any old head
         mtime = time.time()
-        self._index_set(bucket, key,
-                        {"size": total, "etag": etag,
-                         "mtime": mtime, "parts": manifest,
-                         "upload": upload_id})
+        meta = {"size": total, "etag": etag,
+                "mtime": mtime, "parts": manifest,
+                "upload": upload_id}
+        if vid is not None:
+            meta["version_id"] = vid
+        self._index_set(bucket, key, meta)
         self._bilog_append(bucket, {"op": "put", "key": key,
                                     "etag": etag, "mtime": mtime,
+                                    "version_id": vid or "",
                                     "zone": self.zone})
         # retire the session; uploaded-but-unlisted parts are garbage
         for n in stored:
@@ -553,10 +840,18 @@ class RgwGateway:
                 f"{''.join(items)}"
                 f"</ListMultipartUploadsResult>").encode()
 
-    def head_object(self, bucket: str, key: str) -> dict:
+    def head_object(self, bucket: str, key: str,
+                    version_id: str | None = None) -> dict:
         self.check_bucket(bucket)
+        if version_id:
+            for meta in self.versions_of(bucket, key):
+                if meta.get("version_id", "null") == version_id:
+                    if meta.get("delete_marker"):
+                        raise KeyError(key)
+                    return meta
+            raise KeyError(key)
         meta = self._index(bucket).get(key)
-        if meta is None:
+        if meta is None or meta.get("delete_marker"):
             raise KeyError(key)
         return meta
 
@@ -568,7 +863,9 @@ class RgwGateway:
         if length <= 0:
             return b""
         if not meta.get("parts"):
-            return self._striped(bucket, key).read(start, length)
+            return self._striped(bucket, key,
+                                 meta.get("version_id")).read(start,
+                                                              length)
         out, pos = [], 0
         end = start + length
         for n, size in meta["parts"]:
@@ -585,8 +882,9 @@ class RgwGateway:
         return b"".join(out)
 
     def get_object(self, bucket: str, key: str,
-                   range_header: str | None = None):
-        meta = self.head_object(bucket, key)
+                   range_header: str | None = None,
+                   version_id: str | None = None):
+        meta = self.head_object(bucket, key, version_id=version_id)
         if range_header and range_header.startswith("bytes="):
             spec = range_header[len("bytes="):]
             start_s, _, end_s = spec.partition("-")
@@ -605,10 +903,74 @@ class RgwGateway:
                                  meta["size"]), meta, 200
 
     def delete_object(self, bucket: str, key: str,
-                      origin: str | None = None) -> None:
-        self.head_object(bucket, key)
+                      origin: str | None = None,
+                      version_id: str | None = None,
+                      mtime: float | None = None,
+                      marker_version_id: str | None = None) -> dict:
+        """S3 delete semantics (rgw_op.cc RGWDeleteObj versioned
+        paths): on a versioned bucket an unqualified DELETE leaves a
+        delete MARKER (data retained); versionId= permanently removes
+        that one generation, promoting the next-newest to head when it
+        was current.  Returns {delete_marker, version_id}."""
+        self.check_bucket(bucket)
+        mtime = time.time() if mtime is None else float(mtime)
+        versioned = self.versioning_enabled(bucket)
+        head = self._index(bucket).get(key)
+        if versioned and not version_id:
+            if head is None and not self.versions_of(bucket, key):
+                raise KeyError(key)
+            if head is not None:
+                self._verindex_set(bucket, key,
+                                   head.get("version_id", "null"),
+                                   head)
+            # multisite replays a peer's marker with the PEER's id so
+            # generations stay identical across zones
+            vid = marker_version_id or uuid.uuid4().hex[:16]
+            self._index_set(bucket, key,
+                            {"size": 0, "etag": "", "mtime": mtime,
+                             "version_id": vid, "delete_marker": True})
+            self._bilog_append(bucket, {"op": "delete_marker",
+                                        "key": key, "etag": "",
+                                        "mtime": mtime,
+                                        "version_id": vid,
+                                        "zone": origin or self.zone})
+            return {"delete_marker": True, "version_id": vid}
+        if version_id:
+            # permanent removal of ONE generation
+            target = next((m for m in self.versions_of(bucket, key)
+                           if m.get("version_id", "null") == version_id),
+                          None)
+            if target is None:
+                raise KeyError(key)
+            if not target.get("delete_marker") \
+                    and not target.get("parts"):
+                self._striped(bucket, key,
+                              target.get("version_id")).remove()
+            if head is not None and \
+                    head.get("version_id", "null") == version_id:
+                self._index_rm(bucket, key)
+                rest = [m for m in self.versions_of(bucket, key)
+                        if m.get("version_id", "null") != version_id]
+                if rest:  # promote the next-newest generation
+                    new_head = rest[0]
+                    self._verindex_rm(bucket, key,
+                                      new_head.get("version_id",
+                                                   "null"))
+                    self._index_set(bucket, key, new_head)
+            else:
+                self._verindex_rm(bucket, key, version_id)
+            self._bilog_append(bucket, {"op": "delete_version",
+                                        "key": key, "etag": "",
+                                        "mtime": mtime,
+                                        "version_id": version_id,
+                                        "zone": origin or self.zone})
+            return {"delete_marker": False, "version_id": version_id}
+        if head is None:
+            raise KeyError(key)
         self._drop_object_data(bucket, key)
         self._index_rm(bucket, key)
         self._bilog_append(bucket, {"op": "delete", "key": key,
-                                    "etag": "", "mtime": time.time(),
+                                    "etag": "", "mtime": mtime,
+                                    "version_id": "",
                                     "zone": origin or self.zone})
+        return {"delete_marker": False, "version_id": ""}
