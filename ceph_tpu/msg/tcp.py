@@ -223,7 +223,8 @@ class TcpNetwork(Network):
     def __init__(self, host: str = "127.0.0.1", seed: int = 0,
                  compress: str = "none", compress_min: int = 4096,
                  auth_secret: bytes | None = None,
-                 secure: bool = False, resume: bool = True):
+                 secure: bool = False, resume: bool = True,
+                 auth_rotation: float = 0.0, clock=None):
         super().__init__(seed)
         self._host = host
         # msgr2 secure mode (crypto_onwire role): ChaCha20 per-direction
@@ -248,6 +249,18 @@ class TcpNetwork(Network):
         # key; every frame carries a truncated HMAC tag under it.  A
         # peer without the secret can neither connect nor forge frames.
         self._auth_secret = auth_secret
+        # rotating service keys (CephxKeyServer.h:165 role): the wire
+        # secret is a per-GENERATION key derived from the base secret,
+        # generations advance every auth_rotation seconds, and only the
+        # current one +- one grace generation authenticates — so a
+        # captured per-epoch key (or a ticket minted under it) ages out
+        # instead of working forever.  Deployment difference vs the
+        # reference, stated plainly: real cephx distributes fresh RANDOM
+        # rotating keys from the monitor; with one pre-shared secret the
+        # epochs are HKDF-derived from it, which bounds key/ticket
+        # lifetime but cannot survive base-secret compromise.
+        self._auth_rotation = float(auth_rotation or 0.0)
+        self._auth_clock = clock or time.time
         # on-wire compression (ProtocolV2 compression_onwire role):
         # config-driven algorithm, applied to frames past the threshold;
         # both endpoints of a deployment share the setting
@@ -317,23 +330,42 @@ class TcpNetwork(Network):
             c.close()
 
     # -- cephx-lite handshake ---------------------------------------------
+    def _auth_generation(self) -> int:
+        if self._auth_rotation <= 0:
+            return 0
+        return int(self._auth_clock() // self._auth_rotation)
+
+    def _epoch_secret(self, gen: int) -> bytes:
+        """The per-generation service key (rotating-key derivation)."""
+        if self._auth_rotation <= 0:
+            return self._auth_secret
+        return _mac(self._auth_secret, b"rot",
+                    gen.to_bytes(8, "little"))
+
     def _auth_server(self, sock: socket.socket) -> bytes | None:
         """Server leg of the challenge/response; returns the session key
-        or None on failure."""
+        or None on failure.  The client names its key GENERATION in the
+        hello; only the current generation +- one authenticates (expired
+        tickets are refused, the rotating-secrets window)."""
         sock.settimeout(5)
         try:
-            hello = _recv_exact(sock, len(_AUTH_MAGIC) + 16)
+            hello = _recv_exact(sock, len(_AUTH_MAGIC) + 8 + 16)
             if hello is None or not hello.startswith(_AUTH_MAGIC):
                 return None
-            nonce_c = hello[len(_AUTH_MAGIC):]
+            gen = int.from_bytes(
+                hello[len(_AUTH_MAGIC):len(_AUTH_MAGIC) + 8], "little")
+            if self._auth_rotation > 0 and \
+                    abs(gen - self._auth_generation()) > 1:
+                return None  # expired (or far-future) generation
+            key = self._epoch_secret(gen)
+            nonce_c = hello[len(_AUTH_MAGIC) + 8:]
             nonce_s = _secrets.token_bytes(16)
-            sock.sendall(nonce_s + _mac(self._auth_secret, b"srv",
-                                        nonce_c, nonce_s))
+            sock.sendall(nonce_s + _mac(key, b"srv", nonce_c, nonce_s))
             proof = _recv_exact(sock, 32)
-            want = _mac(self._auth_secret, b"cli", nonce_s, nonce_c)
+            want = _mac(key, b"cli", nonce_s, nonce_c)
             if proof is None or not hmac.compare_digest(proof, want):
                 return None
-            return _mac(self._auth_secret, b"ses", nonce_c, nonce_s)
+            return _mac(key, b"ses", nonce_c, nonce_s)
         except OSError:
             return None
         finally:
@@ -342,18 +374,20 @@ class TcpNetwork(Network):
     def _auth_client(self, sock: socket.socket) -> bytes | None:
         sock.settimeout(5)
         try:
+            gen = self._auth_generation()
+            key = self._epoch_secret(gen)
             nonce_c = _secrets.token_bytes(16)
-            sock.sendall(_AUTH_MAGIC + nonce_c)
+            sock.sendall(_AUTH_MAGIC + gen.to_bytes(8, "little")
+                         + nonce_c)
             reply = _recv_exact(sock, 16 + 32)
             if reply is None:
                 return None
             nonce_s, proof = reply[:16], reply[16:]
-            want = _mac(self._auth_secret, b"srv", nonce_c, nonce_s)
+            want = _mac(key, b"srv", nonce_c, nonce_s)
             if not hmac.compare_digest(proof, want):
                 return None
-            sock.sendall(_mac(self._auth_secret, b"cli", nonce_s,
-                              nonce_c))
-            return _mac(self._auth_secret, b"ses", nonce_c, nonce_s)
+            sock.sendall(_mac(key, b"cli", nonce_s, nonce_c))
+            return _mac(key, b"ses", nonce_c, nonce_s)
         except OSError:
             return None
         finally:

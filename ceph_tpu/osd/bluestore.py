@@ -26,7 +26,14 @@ codebase rather than translated:
 - **clone** is O(pages): the page map is copied and per-page refcounts
   bump — writes always allocate, so sharing is copy-on-write for free;
 - **reads** verify each page's crc32c against the onode every time
-  (BlueStore _verify_csum role) and raise StoreError on rot.
+  (BlueStore _verify_csum role) and raise StoreError on rot;
+- **inline compression** (the Compression.cc / per-blob csum+compress
+  role): large aligned writes compress into BLOBS — zlib over a run of
+  logical pages stored in fewer physical pages — recorded in the onode
+  and transparently decompressed on read; a blob is only kept when it
+  saves real pages (required_ratio), and any overwrite of a compressed
+  range first materialises it back to plain pages (BlueStore's
+  compressed-blob GC-on-overwrite).
 
 Transactions are atomic: ops stage against shadow onodes and commit in
 one KV batch; validation failures roll back staged allocations and leave
@@ -55,6 +62,11 @@ DEFER_LIMIT = 16 * 1024     # writes at or below take the deferred path
 DEFER_FLUSH_N = 64          # flush+trim "D" records past this many
 
 HOLE = -1                   # page map entry for an unwritten page
+BLOB_BASE = -2              # entries <= BLOB_BASE reference a blob:
+#                             phys = BLOB_BASE - blob_id, crc = index of
+#                             this logical page inside the blob's span
+COMPRESS_MIN_PAGES = 8      # blob threshold: 32 KiB of aligned pages
+COMPRESS_RATIO = 0.875      # keep a blob only if it saves >= 1/8th
 
 _P_SUPER, _P_COLL, _P_ONODE, _P_OMAP, _P_DEFER = "S", "C", "O", "M", "D"
 
@@ -62,13 +74,16 @@ _P_SUPER, _P_COLL, _P_ONODE, _P_OMAP, _P_DEFER = "S", "C", "O", "M", "D"
 class Onode:
     """In-RAM onode: the decoded image of one "O" record (+ its omap)."""
 
-    __slots__ = ("size", "attrs", "omap", "pages")
+    __slots__ = ("size", "attrs", "omap", "pages", "blobs")
 
     def __init__(self):
         self.size = 0
         self.attrs: dict[str, object] = {}
         self.omap: dict[str, object] = {}
         self.pages: list[tuple[int, int]] = []  # (phys page, crc32c)
+        # compressed blobs: id -> {"pages": [(phys, crc)...] of the
+        # compressed stream, "clen": compressed bytes, "raw": raw bytes}
+        self.blobs: dict[int, dict] = {}
 
     def copy(self) -> "Onode":
         o = Onode()
@@ -76,7 +91,18 @@ class Onode:
         o.attrs = dict(self.attrs)
         o.omap = dict(self.omap)
         o.pages = list(self.pages)
+        o.blobs = {i: {"pages": list(b["pages"]), "clen": b["clen"],
+                       "raw": b["raw"]} for i, b in self.blobs.items()}
         return o
+
+    def phys_pages(self):
+        """Every physical page this onode references (plain + blob)."""
+        for phys, _crc in self.pages:
+            if phys >= 0:
+                yield phys
+        for b in self.blobs.values():
+            for phys, _crc in b["pages"]:
+                yield phys
 
 
 def _onode_key(cid: CollectionId, oid: ObjectId) -> str:
@@ -100,7 +126,15 @@ def _encode_onode(oid: ObjectId, o: Onode) -> bytes:
         se.u32(len(o.pages))
         for phys, crc in o.pages:
             se.i64(phys); se.u32(crc)
-    e.versioned(1, 1, body)
+        se.u32(len(o.blobs))                      # v2 tail
+        for bid, b in sorted(o.blobs.items()):
+            se.u64(bid)
+            se.u64(b["clen"])
+            se.u64(b["raw"])
+            se.u32(len(b["pages"]))
+            for phys, crc in b["pages"]:
+                se.i64(phys); se.u32(crc)
+    e.versioned(2, 1, body)
     return e.tobytes()
 
 
@@ -114,8 +148,17 @@ def _decode_onode(raw: bytes) -> tuple[ObjectId, Onode]:
         for _ in range(sd.u32()):
             k = sd.string(); o.attrs[k] = _dec_value(sd)
         o.pages = [(sd.i64(), sd.u32()) for _ in range(sd.u32())]
+        if version >= 2:
+            for _ in range(sd.u32()):
+                bid = sd.u64()
+                clen = sd.u64()
+                raw = sd.u64()
+                pages = [(sd.i64(), sd.u32())
+                         for _ in range(sd.u32())]
+                o.blobs[bid] = {"pages": pages, "clen": clen,
+                                "raw": raw}
         return oid, o
-    return d.versioned(1, body)
+    return d.versioned(2, body)
 
 
 class _Staging:
@@ -139,10 +182,14 @@ class BlueStore(ObjectStore):
     docstring for the layout and crash-ordering rules)."""
 
     def __init__(self, path: str, defer_limit: int = DEFER_LIMIT,
-                 kv_backend: str = "wal"):
+                 kv_backend: str = "wal", compression: str = "zlib"):
         self.path = path
         self.defer_limit = defer_limit
         self.kv_backend = kv_backend  # "wal" or "sst" (RocksDB-tier LSM)
+        # inline blob compression mode ("zlib" | "none") —
+        # bluestore_compression_{mode,algorithm} role
+        self.compression = None if compression in ("none", "", None) \
+            else compression
         self._lock = threading.RLock()
         self._mounted = False
         self._dev = None
@@ -204,9 +251,8 @@ class BlueStore(ObjectStore):
             oid, onode = _decode_onode(raw)
             self._colls.setdefault(cid, {})[oid] = onode
             key_to_obj[okey] = (cid, oid)
-            for phys, _crc in onode.pages:
-                if phys != HOLE:
-                    self._refs[phys] = self._refs.get(phys, 0) + 1
+            for phys in onode.phys_pages():
+                self._refs[phys] = self._refs.get(phys, 0) + 1
         for mkey, val in self._kv.iterate(_P_OMAP):
             okey, _, user = mkey.partition("\x00")
             ref = key_to_obj.get(okey)
@@ -329,8 +375,45 @@ class BlueStore(ObjectStore):
         return o
 
     def _free_page(self, st: _Staging, phys: int) -> None:
-        if phys != HOLE:
+        if phys >= 0:  # HOLE and blob sentinels are not device pages
             st.frees.append(phys)
+
+    def _blob_raw(self, st: _Staging | None, o: Onode,
+                  bid: int) -> bytes:
+        """Decompress one blob (crc-verified per compressed page)."""
+        import zlib
+        b = o.blobs[bid]
+        parts = [self._read_page(st, phys, crc)
+                 for phys, crc in b["pages"]]
+        comp = b"".join(parts)[: b["clen"]]
+        return zlib.decompress(comp)
+
+    def _unblob_range(self, st: _Staging, o: Onode, first: int,
+                      last: int) -> None:
+        """Materialise any blob overlapping logical pages
+        [first, last] back to plain pages (the compressed-blob
+        rewrite-on-overwrite, Compression.cc GC role)."""
+        hit = set()
+        for idx in range(min(first, len(o.pages)),
+                         min(last + 1, len(o.pages))):
+            phys = o.pages[idx][0]
+            if phys <= BLOB_BASE:
+                hit.add(BLOB_BASE - phys)
+        for bid in hit:
+            raw = self._blob_raw(st, o, bid)
+            span = [i for i, (p, _c) in enumerate(o.pages)
+                    if p <= BLOB_BASE and BLOB_BASE - p == bid]
+            for i in span:
+                off = o.pages[i][1] * PAGE
+                content = raw[off: off + PAGE]
+                content += b"\0" * (PAGE - len(content))
+                phys = self._alloc(st)
+                o.pages[i] = (phys, crc32c(content))
+                st.page_data[phys] = content
+                st.large.append((phys, content))
+            for phys, _crc in o.blobs[bid]["pages"]:
+                self._free_page(st, phys)
+            del o.blobs[bid]
 
     def _page_content(self, st: _Staging, o: Onode, idx: int) -> bytes:
         """Current (staged-aware) content of logical page idx, zero-padded
@@ -338,6 +421,11 @@ class BlueStore(ObjectStore):
         if idx >= len(o.pages) or o.pages[idx][0] == HOLE:
             return b"\0" * PAGE
         phys, crc = o.pages[idx]
+        if phys <= BLOB_BASE:
+            raw = self._blob_raw(st, o, BLOB_BASE - phys)
+            off = crc * PAGE
+            content = raw[off: off + PAGE]
+            return content + b"\0" * (PAGE - len(content))
         return self._read_page(st, phys, crc)
 
     def _put_page(self, st: _Staging, o: Onode, idx: int, content: bytes,
@@ -371,6 +459,12 @@ class BlueStore(ObjectStore):
         deferred = len(data) <= self.defer_limit
         end = offset + len(data)
         first, last = offset // PAGE, (end - 1) // PAGE
+        # a write over a compressed range first materialises the blob
+        # (partial overwrite of compressed data is read-modify-write)
+        self._unblob_range(st, o, first, last)
+        if self.compression and not deferred and \
+                self._try_compress(st, o, offset, data):
+            return
         for idx in range(first, last + 1):
             pstart = idx * PAGE
             lo = max(offset, pstart) - pstart
@@ -384,6 +478,43 @@ class BlueStore(ObjectStore):
             self._put_page(st, o, idx, content, deferred)
         o.size = max(o.size, end)
 
+    def _try_compress(self, st: _Staging, o: Onode, offset: int,
+                      data: bytes) -> bool:
+        """Blob-compress the aligned whole-page run of this write when
+        it spans enough pages AND actually saves space (required_ratio)
+        — the Compression.cc inline path.  Unaligned head/tail bytes
+        fall through to the plain path.  Returns True when the WHOLE
+        write was consumed."""
+        import zlib
+        if offset % PAGE or len(data) % PAGE:
+            return False  # keep it simple: only fully aligned writes
+        n = len(data) // PAGE
+        if n < COMPRESS_MIN_PAGES:
+            return False
+        comp = zlib.compress(data, 1)
+        cpages = (len(comp) + PAGE - 1) // PAGE
+        if cpages > n * COMPRESS_RATIO:
+            return False  # not compressible enough to bother
+        first = offset // PAGE
+        bid = (max(o.blobs) + 1) if o.blobs else 0
+        blob_pages = []
+        for i in range(cpages):
+            chunk = comp[i * PAGE: (i + 1) * PAGE]
+            chunk += b"\0" * (PAGE - len(chunk))
+            phys = self._alloc(st)
+            blob_pages.append((phys, crc32c(chunk)))
+            st.page_data[phys] = chunk
+            st.large.append((phys, chunk))
+        o.blobs[bid] = {"pages": blob_pages, "clen": len(comp),
+                        "raw": len(data)}
+        while len(o.pages) < first + n:
+            o.pages.append((HOLE, 0))
+        for i in range(n):
+            self._free_page(st, o.pages[first + i][0])
+            o.pages[first + i] = (BLOB_BASE - bid, i)
+        o.size = max(o.size, offset + len(data))
+        return True
+
     def _zero_range(self, st: _Staging, o: Onode, offset: int,
                     length: int) -> None:
         if length <= 0:
@@ -391,6 +522,7 @@ class BlueStore(ObjectStore):
             return
         end = offset + length
         first, last = offset // PAGE, (end - 1) // PAGE
+        self._unblob_range(st, o, first, last)
         for idx in range(first, last + 1):
             pstart = idx * PAGE
             lo = max(offset, pstart) - pstart
@@ -409,6 +541,8 @@ class BlueStore(ObjectStore):
     def _truncate(self, st: _Staging, o: Onode, size: int) -> None:
         if size < o.size:
             keep = (size + PAGE - 1) // PAGE
+            self._unblob_range(st, o, max(0, keep - 1),
+                               len(o.pages) - 1)
             for phys, _crc in o.pages[keep:]:
                 self._free_page(st, phys)
             del o.pages[keep:]
@@ -424,7 +558,7 @@ class BlueStore(ObjectStore):
 
     def _remove_onode(self, st: _Staging, cid, oid) -> None:
         o = self._get_onode(st, cid, oid, create=False)
-        for phys, _crc in o.pages:
+        for phys in o.phys_pages():
             self._free_page(st, phys)
         # drop the omap rows here, while the (possibly staged) key set is
         # known — a later re-create in the same tx must not inherit them
@@ -494,15 +628,17 @@ class BlueStore(ObjectStore):
             src = self._get_onode(st, cid, op[2], create=False)
             dst_oid = op[3]
             dst = self._get_onode(st, cid, dst_oid, create=True)
-            for phys, _crc in dst.pages:   # clone fully replaces dst
+            for phys in dst.phys_pages():  # clone fully replaces dst
                 self._free_page(st, phys)
             dst.size = src.size
             dst.attrs = dict(src.attrs)
             dst.pages = list(src.pages)
-            for phys, _crc in src.pages:   # share pages, bump refs
-                if phys != HOLE:
-                    self._refs[phys] = self._refs.get(phys, 0) + 1
-                    st.allocs.append(phys)  # rollback undoes the bump
+            dst.blobs = {i: {"pages": list(b["pages"]),
+                             "clen": b["clen"], "raw": b["raw"]}
+                         for i, b in src.blobs.items()}
+            for phys in src.phys_pages():  # share pages, bump refs
+                self._refs[phys] = self._refs.get(phys, 0) + 1
+                st.allocs.append(phys)  # rollback undoes the bump
             dst_key = _onode_key(cid, dst_oid)
             for k in dst.omap:
                 st.kv.rm(_P_OMAP, f"{dst_key}\x00{k}")
@@ -603,11 +739,22 @@ class BlueStore(ObjectStore):
                 return BufferList(b"")
             first, last = offset // PAGE, (end - 1) // PAGE
             parts = []
+            blob_cache: dict[int, bytes] = {}
             for idx in range(first, last + 1):
                 if idx < len(o.pages) and o.pages[idx][0] != HOLE:
                     phys, crc = o.pages[idx]
                     try:
-                        parts.append(self._read_page(None, phys, crc))
+                        if phys <= BLOB_BASE:
+                            bid = BLOB_BASE - phys
+                            raw = blob_cache.get(bid)
+                            if raw is None:
+                                raw = self._blob_raw(None, o, bid)
+                                blob_cache[bid] = raw
+                            seg = raw[crc * PAGE: crc * PAGE + PAGE]
+                            parts.append(seg + b"\0" * (PAGE - len(seg)))
+                        else:
+                            parts.append(self._read_page(None, phys,
+                                                         crc))
                     except StoreError:
                         raise StoreError(
                             f"checksum mismatch on {cid}/{oid}")
@@ -625,9 +772,10 @@ class BlueStore(ObjectStore):
                 o = self._onode(cid, oid)
             except (NoSuchCollection, NoSuchObject):
                 return True
-            for phys, crc in o.pages:
-                if phys == HOLE:
-                    continue
+            entries = [(p, c) for p, c in o.pages if p >= 0]
+            for b in o.blobs.values():
+                entries.extend(b["pages"])
+            for phys, crc in entries:
                 data = self._deferred.get(phys)
                 if data is None:
                     data = self._dev_read(phys)
@@ -672,9 +820,8 @@ class BlueStore(ObjectStore):
             referenced: dict[int, int] = {}
             for coll in self._colls.values():
                 for o in coll.values():
-                    for phys, _crc in o.pages:
-                        if phys != HOLE:
-                            referenced[phys] = referenced.get(phys, 0) + 1
+                    for phys in o.phys_pages():
+                        referenced[phys] = referenced.get(phys, 0) + 1
             free = set(self._free)
             leaked = [p for p in range(self._npages)
                       if p not in referenced and p not in free]

@@ -61,7 +61,7 @@ from .extent_cache import ECExtentCache
 from .intervals import INTERVALS_KEY, LES_KEY, PastIntervals
 from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
-from .scheduler import ClassParams, MClockScheduler
+from .scheduler import ClassParams, ShardedScheduler
 from .scrub import FaultInjection, ScrubMixin
 from .snaps import SnapMixin, split_vname, to_oid, vname, vname_of
 
@@ -269,7 +269,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MScrubMap: "scrub",
         }
         self._use_mclock = self.cfg["osd_op_queue"] == "mclock"
-        self.scheduler = MClockScheduler(
+        self.scheduler = ShardedScheduler(
             self._run_scheduled,
             {
                 "client": ClassParams(self.cfg["osd_mclock_client_res"],
@@ -285,6 +285,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 # system (maps, sub-ops, replies): effectively unthrottled
                 "system": ClassParams(1e9, 1e6, 0.0),
             },
+            shards=self.cfg["osd_op_num_shards"],
             name=f"mclock-{self.name}")
 
     # ------------------------------------------------------------ lifecycle
@@ -329,6 +330,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return self.cfg.dump()
         if cmd == "dump_op_queue":
             return {"mode": "mclock" if self._use_mclock else "fifo",
+                    "shards": len(self.scheduler.shards),
                     "depth": self.scheduler.queue_depth(),
                     "served": dict(self.scheduler.served)}
         if cmd == "config set":
@@ -355,8 +357,28 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             handler(conn, msg)
             return True
         klass = self._op_classes.get(type(msg), "system")
-        self.scheduler.enqueue(klass, (handler, conn, msg))
+        self.scheduler.enqueue(klass, (handler, conn, msg),
+                               key=self._shard_key(msg))
         return True
+
+    def _shard_key(self, msg):
+        """Sharded-OpWQ routing key: EVERYTHING about one PG — client
+        ops, sub-ops, acks, pushes — executes on one shard, so the
+        single-worker ordering every handler was written under still
+        holds per PG while distinct PGs run in parallel.  (Object-level
+        keys are NOT enough: two objects of one PG would race on the
+        unlocked PGLog/lc state, and a sub-op ack could outrun the
+        primary's own local apply.)"""
+        pgid = getattr(msg, "pgid", None)
+        if pgid is not None:
+            return (pgid.pool, pgid.seed)
+        if isinstance(msg, MOSDOp):
+            if self.osdmap is not None and \
+                    msg.pool in self.osdmap.pools:
+                return (msg.pool,
+                        self.osdmap.object_to_pg(msg.pool, msg.oid))
+            return (msg.pool, 0)  # no map yet: handler EAGAINs anyway
+        return None  # maps/boot/admin: the stable default shard
 
     def _run_scheduled(self, klass: str, item) -> None:
         handler, conn, msg = item
@@ -1066,7 +1088,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if rider is not None:
             sub_attrs["_snap"] = rider
         tid = next(self._tids)
-        remote = 0
+        # the pending entry must exist BEFORE any sub-op leaves: with
+        # sharded dispatch a reply can be processed on another shard
+        # worker ahead of this handler's next line (round-4 regression:
+        # late registration dropped the ack and the op timed out)
+        remote = sum(1 for s, o in enumerate(up)
+                     if o is not None and o != self.osd_id)
+        if remote:
+            pw = _PendingWrite(m.client, m.tid, remote, version,
+                               lock_key=lock_key)
+            pw.span = getattr(m, '_span', None)
+            self._pending_writes[tid] = pw
         for shard, osd in enumerate(up):
             if osd is None:
                 continue  # degraded write: hole shard skipped
@@ -1093,7 +1125,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self._apply_write(pgid, m.oid, shard, data, attrs,
                                       pre_tx=pre)
             else:
-                remote += 1
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MSubWrite(tid, pgid, m.oid, shard, version, "write",
@@ -1105,9 +1136,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                   epoch=self.osdmap.epoch))
             self._obj_unlock(lock_key)
             return
-        self._pending_writes[tid] = _PendingWrite(
-            m.client, m.tid, remote, version, lock_key=lock_key)
-        self._pending_writes[tid].span = getattr(m, '_span', None)
 
     # -- EC partial writes (parity delta / rmw; ECTransaction WritePlan) ---
     def _ec_object_version(self, pgid: PgId, oid: str) -> int:
@@ -1140,47 +1168,59 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         parity = codec.encode_chunks(streams)
         base = row0 * si.chunk_size
         tid = next(self._tids)
-        remote = 0
+        remote = sum(1 for o in up
+                     if o is not None and o != self.osd_id)
+        pw = None
+        if remote:
+            # registered BEFORE any send: a reply may run on another
+            # shard worker immediately (sharded-dispatch ordering)
+            pw = _PendingWrite(m.client, m.tid, remote, version,
+                               lock_key=lock_key)
+            pw.span = getattr(m, '_span', None)
+            self._pending_writes[tid] = pw
         local_failed = local_retry = 0
         for shard, osd in enumerate(up):
-            if osd is None:
+            if osd is None or osd != self.osd_id:
                 continue
             chunk = streams[shard] if shard < codec.k \
                 else parity[shard - codec.k]
             ext = [(base, chunk.tobytes())]
-            if osd == self.osd_id:
-                pre = (self._snap_apply_rider(pgid, m.oid, rider,
-                                              shard=shard)
-                       if rider else None)
-                code = self._apply_partial(pgid, m.oid, shard, ext, version,
-                                           create_ok=create,
-                                           total_len=new_len,
-                                           prev_version=prev_version,
-                                           pre_tx=pre)
-                if code == EAGAIN:
-                    local_retry += 1
-                elif code != 0:
-                    local_failed += 1
-            else:
-                remote += 1
-                self.messenger.send_message(
-                    f"osd.{osd}",
-                    MSubPartialWrite(tid, pgid, m.oid, shard, version, ext,
-                                     total_len=new_len, create=create,
-                                     prev_version=prev_version,
-                                     epoch=self._entry_epoch(),
-                                     snap=rider or {},
-                                     trace=self._tctx(m)))
+            pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                          shard=shard)
+                   if rider else None)
+            code = self._apply_partial(pgid, m.oid, shard, ext, version,
+                                       create_ok=create,
+                                       total_len=new_len,
+                                       prev_version=prev_version,
+                                       pre_tx=pre)
+            if code == EAGAIN:
+                local_retry += 1
+            elif code != 0:
+                local_failed += 1
+        if pw is not None:
+            # local tallies land before any send, so a full ack drain
+            # computes the true result
+            pw.failed += local_failed
+            pw.retry += local_retry
+        for shard, osd in enumerate(up):
+            if osd is None or osd == self.osd_id:
+                continue
+            chunk = streams[shard] if shard < codec.k \
+                else parity[shard - codec.k]
+            ext = [(base, chunk.tobytes())]
+            self.messenger.send_message(
+                f"osd.{osd}",
+                MSubPartialWrite(tid, pgid, m.oid, shard, version, ext,
+                                 total_len=new_len, create=create,
+                                 prev_version=prev_version,
+                                 epoch=self._entry_epoch(),
+                                 snap=rider or {},
+                                 trace=self._tctx(m)))
         if remote == 0:
             result = EIO if local_failed else (EAGAIN if local_retry else 0)
             conn.send(MOSDOpReply(m.tid, result,
                                   version=version, epoch=self.osdmap.epoch))
             self._obj_unlock(lock_key)
-        else:
-            self._pending_writes[tid] = _PendingWrite(
-                m.client, m.tid, remote, version, failed=local_failed,
-                retry=local_retry, lock_key=lock_key)
-            self._pending_writes[tid].span = getattr(m, '_span', None)
 
     def _ec_partial_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
                           codec, si: StripeInfo, object_size: int,
@@ -1217,6 +1257,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             prev = vers.pop()
             version = self._next_version(pgid)
             wtid = next(self._tids)
+            remote_n = sum(1 for o in up
+                           if o is not None and o != self.osd_id)
+            pw = None
+            if remote_n:
+                # registered before any send (sharded-dispatch rule)
+                pw = _PendingWrite(m.client, m.tid, remote_n, version,
+                                   lock_key=lock_key)
+                pw.span = getattr(m, '_span', None)
+                self._pending_writes[wtid] = pw
             deltas: dict[int, list[tuple[int, bytes]]] = {}
             news: dict[int, list[tuple[int, bytes]]] = {}
             for shard, exts in per_shard.items():
@@ -1235,7 +1284,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         (soff, delta.tobytes()))
                     news.setdefault(shard, []).append((soff, new.tobytes()))
                     pos += ln
-            remote = 0
             local_failed = local_retry = 0
 
             def tally(code: int) -> None:
@@ -1245,46 +1293,47 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 elif code != 0:
                     local_failed += 1
 
-            # data shards: new bytes (touched) or version bump (untouched)
+            flat = [(ds, soff, dbytes) for ds, lst in deltas.items()
+                    for soff, dbytes in lst]
+            # LOCAL applies first (their tallies must be recorded on the
+            # pending entry before any ack can drain it)
             for shard, osd in enumerate(up):
-                if osd is None or shard >= codec.k:
+                if osd != self.osd_id:
                     continue
-                ext = news.get(shard, [])
-                if osd == self.osd_id:
-                    pre = (self._snap_apply_rider(pgid, m.oid, rider,
-                                                  shard=shard)
-                           if rider else None)
-                    tally(self._apply_partial(pgid, m.oid, shard, ext,
+                pre = (self._snap_apply_rider(pgid, m.oid, rider,
+                                              shard=shard)
+                       if rider else None)
+                if shard < codec.k:
+                    tally(self._apply_partial(pgid, m.oid, shard,
+                                              news.get(shard, []),
                                               version, total_len=new_len,
                                               prev_version=prev,
                                               pre_tx=pre))
                 else:
-                    remote += 1
-                    self.messenger.send_message(
-                        f"osd.{osd}",
-                        MSubPartialWrite(wtid, pgid, m.oid, shard, version,
-                                         ext, total_len=new_len,
-                                         prev_version=prev,
-                                         epoch=self._entry_epoch(),
-                                         snap=rider or {},
-                                         trace=self._tctx(m)))
-            # parity shards: one delta message covering all data deltas
-            flat = [(ds, soff, dbytes) for ds, lst in deltas.items()
-                    for soff, dbytes in lst]
-            for shard, osd in enumerate(up):
-                if osd is None or shard < codec.k:
-                    continue
-                if osd == self.osd_id:
-                    pre = (self._snap_apply_rider(pgid, m.oid, rider,
-                                                  shard=shard)
-                           if rider else None)
                     tally(self._apply_delta_local(pgid, m.oid, shard,
                                                   flat, version,
                                                   total_len=new_len,
                                                   prev_version=prev,
                                                   pre_tx=pre))
+            if pw is not None:
+                pw.failed += local_failed
+                pw.retry += local_retry
+            # data shards: new bytes (touched) or version bump (untouched)
+            for shard, osd in enumerate(up):
+                if osd is None or osd == self.osd_id:
+                    continue
+                if shard < codec.k:
+                    self.messenger.send_message(
+                        f"osd.{osd}",
+                        MSubPartialWrite(wtid, pgid, m.oid, shard, version,
+                                         news.get(shard, []),
+                                         total_len=new_len,
+                                         prev_version=prev,
+                                         epoch=self._entry_epoch(),
+                                         snap=rider or {},
+                                         trace=self._tctx(m)))
                 else:
-                    remote += 1
+                    # parity: one delta message covering all data deltas
                     self.messenger.send_message(
                         f"osd.{osd}",
                         MSubDelta(wtid, pgid, m.oid, shard, version,
@@ -1300,7 +1349,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 for soff, nb in lst:
                     self._ec_cache.write(pgid, m.oid, shard, soff, nb,
                                          version=version)
-            if remote == 0:
+            if remote_n == 0:
                 result = EIO if local_failed \
                     else (EAGAIN if local_retry else 0)
                 if result != 0:
@@ -1310,11 +1359,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     MOSDOpReply(m.tid, result,
                                 version=version, epoch=self.osdmap.epoch))
                 self._obj_unlock(lock_key)
-            else:
-                self._pending_writes[wtid] = _PendingWrite(
-                    m.client, m.tid, remote, version, failed=local_failed,
-                    retry=local_retry, lock_key=lock_key)
-                self._pending_writes[wtid].span = getattr(m, '_span', None)
 
         # extent-cache fast path (ECExtentCache role): if EVERY touched
         # segment is cached at a known version, skip the read fan-out
@@ -1839,7 +1883,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if not whiteout:
             self._record_tombstone(pgid, m.oid, version)
         tid = next(self._tids)
-        remote = 0
+        remote = sum(1 for o in up
+                     if o is not None and o != self.osd_id)
+        if remote:  # registered before any send (sharded dispatch)
+            pw = _PendingWrite(m.client, m.tid, remote, version,
+                               lock_key=lock_key)
+            pw.span = getattr(m, '_span', None)
+            self._pending_writes[tid] = pw
         sub_attrs = {"_snap": rider} if rider is not None else {}
         for shard, osd in enumerate(up):
             if osd is None:
@@ -1854,7 +1904,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 else:
                     self._apply_remove(pgid, m.oid, shard, version)
             else:
-                remote += 1
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MSubWrite(tid, pgid, m.oid, shard, version,
@@ -1866,10 +1915,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
             self._obj_unlock(lock_key)
-        else:
-            self._pending_writes[tid] = _PendingWrite(
-                m.client, m.tid, remote, version, lock_key=lock_key)
-            self._pending_writes[tid].span = getattr(m, '_span', None)
 
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,

@@ -8,10 +8,11 @@ proportional share among classes under their limit — so background
 recovery and scrub cannot starve client IO, yet keep a guaranteed
 floor when the client is idle.
 
-One dequeue worker preserves the daemon's single-threaded handler
-execution (the sharded scheduler's shard count is a scale knob, as in
-the reference); the messenger dispatch thread only classifies and
-enqueues.
+Sharding (the reference's sharded OpWQ, osd_op_num_shards): ops hash
+by PG to one of N independent scheduler shards, each with its own
+dmclock state and dequeue worker — PGs execute in parallel inside one
+OSD while everything touching one object stays ordered on its shard.
+The messenger dispatch thread only classifies and enqueues.
 """
 
 from __future__ import annotations
@@ -173,3 +174,48 @@ class MClockScheduler:
                 import traceback
                 dout("osd", 0)("scheduler handler error: %s",
                                traceback.format_exc())
+
+
+class ShardedScheduler:
+    """N MClockScheduler shards keyed by placement group (the sharded
+    OpWQ of src/osd/scheduler/: per-PG parallelism inside one OSD,
+    per-shard dmclock QoS, per-object ordering preserved because a
+    given key always lands on the same shard)."""
+
+    def __init__(self, handler, classes: dict[str, ClassParams],
+                 shards: int = 2, name: str = "mclock"):
+        self.shards = [MClockScheduler(handler, dict(classes),
+                                       name=f"{name}-s{i}")
+                       for i in range(max(1, shards))]
+
+    def start(self) -> None:
+        for s in self.shards:
+            s.start()
+
+    def shutdown(self) -> None:
+        for s in self.shards:
+            s.shutdown()
+
+    def enqueue(self, klass: str, item, key=None) -> None:
+        shard = self.shards[hash(key) % len(self.shards)] \
+            if key is not None else self.shards[0]
+        shard.enqueue(klass, item)
+
+    def queue_depth(self, klass: str | None = None) -> int:
+        return sum(s.queue_depth(klass) for s in self.shards)
+
+    @property
+    def served(self) -> dict:
+        out: dict[str, int] = {}
+        for s in self.shards:
+            for c, n in s.served.items():
+                out[c] = out.get(c, 0) + n
+        return out
+
+    @property
+    def dropped(self) -> dict:
+        out: dict[str, int] = {}
+        for s in self.shards:
+            for c, n in s.dropped.items():
+                out[c] = out.get(c, 0) + n
+        return out

@@ -480,7 +480,14 @@ class SnapMixin:
         payload = _pack({"cloneid": cloneid, "ss": ss_b,
                          "rider": rider, "total": total})
         tid = next(self._tids)
-        remote = 0
+        remote = sum(1 for o in up
+                     if o is not None and o != self.osd_id)
+        if remote:  # registered before any send (sharded dispatch)
+            from .daemon import _PendingWrite
+            pw = _PendingWrite(m.client, m.tid, remote, version)
+            pw.span = getattr(m, '_span', None)
+            pw.lock_key = lock_key
+            self._pending_writes[tid] = pw
         epoch = self._entry_epoch()
         for shard, osd in enumerate(up):
             if osd is None:
@@ -493,7 +500,6 @@ class SnapMixin:
                                           version, pre_tx=pre,
                                           shard=shard, total_len=total)
             else:
-                remote += 1
                 self.messenger.send_message(
                     f"osd.{osd}",
                     MSubWrite(tid, pgid, name, shard, version,
@@ -504,11 +510,6 @@ class SnapMixin:
                                   epoch=self.osdmap.epoch))
             self._obj_unlock(lock_key)
             return
-        from .daemon import _PendingWrite
-        pw = _PendingWrite(m.client, m.tid, remote, version)
-        pw.span = getattr(m, '_span', None)
-        pw.lock_key = lock_key
-        self._pending_writes[tid] = pw
 
     # ----------------------------------------------------------- whiteout
     def _apply_whiteout(self, pgid: PgId, name: str, version: int,

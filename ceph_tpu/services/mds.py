@@ -222,9 +222,18 @@ class MdsDaemon:
     def snap_context(self) -> tuple[int, list]:
         """(seq, snapids newest-first) for the data-pool write
         SnapContext — what clients attach so the OSDs clone-on-write
-        (the snaprealm get_snap_context role)."""
+        (the snaprealm get_snap_context role).  Cached (the reference
+        caches it with client caps): the per-WRITE cost must not be a
+        snap-table omap scan; mksnap/rmsnap invalidate."""
+        cached = getattr(self, "_snapc_cache", None)
+        if cached is not None:
+            return cached
         ids = sorted(self.snap_table().values(), reverse=True)
-        return (ids[0] if ids else 0, ids)
+        self._snapc_cache = (ids[0] if ids else 0, ids)
+        return self._snapc_cache
+
+    def _snapc_invalidate(self) -> None:
+        self._snapc_cache = None
 
     def snaps_of(self, dirpath: str) -> dict[str, int]:
         dirpath = _norm(dirpath)
@@ -261,6 +270,7 @@ class MdsDaemon:
         return snapid
 
     def _apply_mksnap(self, dirpath, name, snapid) -> None:
+        self._snapc_invalidate()
         self._freeze_tree(dirpath, snapid)
         self.client.omap_set(self.pool, _SNAPTABLE_OID, {
             f"{snapid:016x}": pack_value({"path": _norm(dirpath),
@@ -292,6 +302,7 @@ class MdsDaemon:
         self.client.selfmanaged_snap_remove(self.pool, sid)
 
     def _apply_rmsnap(self, dirpath, name, snapid) -> None:
+        self._snapc_invalidate()
         self._thaw_tree(dirpath, snapid)
         self.client.omap_rm(self.pool, _SNAPTABLE_OID,
                             [f"{snapid:016x}"])
@@ -696,10 +707,15 @@ class MdsCluster:
         a = self._entry_auth(dirpath)
         for r in self.ranks:          # flush EVERY rank's caps under it
             r._revoke_subtree(_norm(dirpath), exclude=None)
-        return a.snap_create(dirpath, name)
+        sid = a.snap_create(dirpath, name)
+        for r in self.ranks:
+            r._snapc_invalidate()
+        return sid
 
     def snap_remove(self, dirpath: str, name: str) -> None:
         self._entry_auth(dirpath).snap_remove(dirpath, name)
+        for r in self.ranks:
+            r._snapc_invalidate()
 
     def snap_rollback(self, dirpath: str, name: str) -> None:
         a = self._entry_auth(dirpath)

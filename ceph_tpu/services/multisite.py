@@ -154,6 +154,13 @@ class ZoneSyncAgent:
         # versioned ops replicate EXACT generations (the bilog carries
         # version ids — rgw data-sync versioned-epoch role); duplicates
         # are detected per-version, not by head mtime
+        if (op in ("delete_marker", "delete_version")
+                or (op == "put" and vid)) and \
+                not self.dst.versioning_enabled(bucket):
+            # versioned entries imply the source bucket is versioned:
+            # mirror the flag before applying, or the unversioned paths
+            # would DROP retained generations instead of keeping them
+            self.dst.set_versioning(bucket, True)
         if op == "delete_marker":
             if any(m.get("delete_marker")
                    and m.get("version_id") == vid

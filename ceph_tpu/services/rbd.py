@@ -192,6 +192,7 @@ class Image:
         self._release_asked = False
         self._watching = False
         self._in_op = False
+        self._releasing = False  # unlock RPC in flight
         import threading
         self._lk = threading.RLock()  # lock state vs the notify thread
         self._jseq = 0
@@ -253,6 +254,8 @@ class Image:
                               self._on_header_notify)
             self._watching = True
         import time as _time
+        while self._releasing:  # an unlock is mid-flight: let it land
+            _time.sleep(0.005)
         deadline = _time.time() + timeout
         asked = False
         while True:
@@ -282,8 +285,8 @@ class Image:
                     continue
                 if not asked:
                     asked = True
-                self.client.notify(self.pool, self._hoid,
-                                   b"request_lock")
+                    self.client.notify(self.pool, self._hoid,
+                                       b"request_lock")
                 _time.sleep(0.02)
         with self._lk:
             self._locked = True
@@ -300,14 +303,22 @@ class Image:
         with self._lk:
             if not self._locked:
                 return
-            self._locked = False
-            self._release_asked = False
+            # latch BEFORE the RPC: our own next op must not re-acquire
+            # in the window where the unlock is in flight (it would
+            # mutate while the contender takes the lock from under it);
+            # _locked clears only after the unlock landed
+            self._releasing = True
         try:
             self.client.cls_call(self.pool, self._hoid, "lock",
                                  "unlock", {"name": _LOCK_NAME,
                                             "owner": self._owner})
         except RadosError:
             pass  # already broken/taken
+        finally:
+            with self._lk:
+                self._locked = False
+                self._release_asked = False
+                self._releasing = False
 
     def _end_op(self) -> None:
         with self._lk:
@@ -478,12 +489,12 @@ class Image:
         return objs
 
     def write(self, off: int, data: bytes) -> None:
-        if off + len(data) > self.header.size:
-            raise RbdError("write past end of image (resize first)")
         if not data:
             return
-        self._ensure_lock()
+        self._ensure_lock()  # also reloads the header on acquisition
         try:
+            if off + len(data) > self.header.size:
+                raise RbdError("write past end of image (resize first)")
             if self._journaling():
                 # journal FIRST (Journal.h write-ahead contract): a
                 # crash after this point replays the event; before it,

@@ -309,9 +309,12 @@ class RgwGateway:
                     return
                 try:
                     if key is None and "versioning" in qs:
-                        enabled = b"<Status>Enabled</Status>" in body
                         gw.check_bucket(bucket)
-                        gw.set_versioning(bucket, enabled)
+                        root = ElementTree.fromstring(body)
+                        status = (_child_text(root, "Status")
+                                  or "").strip()
+                        gw.set_versioning(bucket,
+                                          status == "Enabled")
                         self._send(200)
                     elif key is None and "lifecycle" in qs:
                         gw.check_bucket(bucket)

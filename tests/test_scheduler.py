@@ -150,3 +150,43 @@ def test_recovery_throttled_under_client_load():
         assert served > 0
     finally:
         c.stop()
+
+
+def test_sharded_scheduler_ordering_and_parallelism():
+    """Sharded OpWQ semantics: one key's ops stay ordered (same shard);
+    distinct keys spread across shard workers."""
+    import collections
+    import threading
+    import time as _time
+
+    from ceph_tpu.osd.scheduler import ClassParams, ShardedScheduler
+
+    seen = collections.defaultdict(list)
+    lock = threading.Lock()
+    threads = set()
+
+    def handler(klass, item):
+        key, seq = item
+        with lock:
+            threads.add(threading.current_thread().name)
+            seen[key].append(seq)
+        _time.sleep(0.001)
+
+    s = ShardedScheduler(handler, {"client": ClassParams(0, 100, 0)},
+                         shards=4, name="t")
+    s.start()
+    try:
+        for seq in range(50):
+            for key in ("a", "b", "c", "d", "e", "f"):
+                s.enqueue("client", (key, seq), key=key)
+        deadline = _time.time() + 10
+        while _time.time() < deadline and \
+                sum(len(v) for v in seen.values()) < 300:
+            _time.sleep(0.01)
+        assert sum(len(v) for v in seen.values()) == 300
+        for key, seqs in seen.items():
+            assert seqs == sorted(seqs), f"{key} reordered: {seqs[:10]}"
+        assert len(threads) > 1, "ops never spread across shard workers"
+        assert sum(s.served.values()) == 300
+    finally:
+        s.shutdown()

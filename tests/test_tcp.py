@@ -430,3 +430,67 @@ def test_resume_ring_never_evicts_newest():
     huge = b"y" * (tcpmod._RING_MAX_BYTES + 1)
     st.ring_append(1, 0, huge)
     assert len(st.ring) == 1 and st.ring[0][0] == 1
+
+
+def test_auth_rotating_generations():
+    """Rotating service keys (CephxKeyServer.h:165 role): peers inside
+    the generation window authenticate; a peer presenting an EXPIRED
+    generation is refused — captured epoch keys age out."""
+    import time as _time
+
+    from ceph_tpu.msg.messenger import Messenger, Policy
+    from ceph_tpu.msg.tcp import TcpNetwork
+
+    secret = b"rotating-secret"
+    now = [1000.0]
+    net = TcpNetwork(auth_secret=secret, auth_rotation=100.0,
+                     clock=lambda: now[0])
+    got = []
+
+    class Sink:
+        def ms_dispatch(self, conn, msg):
+            got.append(msg)
+            return True
+
+    a = Messenger(net, "a", Policy.lossless_peer())
+    b = Messenger(net, "b", Policy.lossless_peer())
+    b.add_dispatcher(Sink())
+    a.start(); b.start()
+    try:
+        from ceph_tpu.msg.messages import MOSDPing
+        a.send_message("b", MOSDPing(1, 1, 1.0))
+        deadline = _time.time() + 5
+        while _time.time() < deadline and not got:
+            _time.sleep(0.02)
+        assert got, "same-generation peers failed to authenticate"
+
+        # one generation of drift still authenticates (grace window)
+        drift = TcpNetwork(auth_secret=secret, auth_rotation=100.0,
+                           clock=lambda: now[0] + 100.0)
+        drift._addrs.update(net._addrs)
+        c = Messenger(drift, "c", Policy.lossless_peer())
+        c.start()
+        try:
+            c.send_message("b", MOSDPing(2, 1, 1.0))
+            deadline = _time.time() + 5
+            while _time.time() < deadline and len(got) < 2:
+                _time.sleep(0.02)
+            assert len(got) >= 2, "grace-window generation refused"
+        finally:
+            c.shutdown()
+
+        # three generations stale: refused
+        stale = TcpNetwork(auth_secret=secret, auth_rotation=100.0,
+                           clock=lambda: now[0] - 300.0)
+        stale._addrs.update(net._addrs)
+        d = Messenger(stale, "d", Policy.lossless_peer())
+        d.start()
+        try:
+            d.send_message("b", MOSDPing(3, 1, 1.0))
+            _time.sleep(0.5)
+            assert all(getattr(m, "sender", 0) != 3 for m in got), \
+                "an expired generation authenticated"
+        finally:
+            d.shutdown()
+    finally:
+        a.shutdown(); b.shutdown(); net.stop()
