@@ -150,6 +150,14 @@ class RadosClient(Dispatcher):
                                       or m.epoch > self.osdmap.epoch):
                     self.osdmap = m
                     changed = True
+                    # the OSDMap is the address book (as in the
+                    # reference): a STANDALONE client on a fresh wire
+                    # transport learns daemon endpoints from it (no-op
+                    # on the in-proc network / shared addr books)
+                    net = self.messenger.network
+                    for peer, info in m.osds.items():
+                        if getattr(info, "addr", ""):
+                            net.set_addr(f"osd.{peer}", info.addr)
                 self._map_cond.notify_all()
             if changed and self._watches:
                 # linger-op role: watches are primary-local soft state,

@@ -4,10 +4,13 @@ The capability of jerasure's packed-word bit-matrix techniques
 (/root/reference/src/erasure-code/jerasure/ErasureCodeJerasure.h:135-336:
 liberation, blaum_roth, liber8tion — RAID-6 codes whose schedules are
 pure XOR over w sub-stripes per chunk).  The reference's actual
-matrices live in the absent jerasure submodule; here each technique is
-an OWN construction with the same parameter envelope and the same
-execution shape: a (w·m, w·k) GF(2) matrix applied as XORs of packet
-rows — which is also exactly the formulation the MXU bitmatrix kernel
+matrices live in the absent jerasure submodule.  blaum_roth here IS
+the published construction (ring R_p companion-matrix powers — see
+blaum_roth_bitmatrix); liberation/liber8tion remain OWN MDS
+constructions with the published parameter envelopes (the exact
+Plank FAST'08 extra-bit placements need the paper, absent here).  All
+share the execution shape: a (w·m, w·k) GF(2) matrix applied as XORs
+of packet rows — exactly the formulation the MXU bitmatrix kernel
 executes (ops/ec_kernels.py:88).
 
 Packetization is GRANULE-LOCAL: the byte stream is processed in
@@ -52,6 +55,36 @@ def element_bitmatrix(e: int, w: int) -> np.ndarray:
         for i in range(w):
             M[i, j] = (v >> i) & 1
     return M
+
+
+def blaum_roth_bitmatrix(k: int, w: int) -> np.ndarray:
+    """The PUBLISHED Blaum-Roth RAID-6 construction (Blaum & Roth,
+    lowest-density MDS codes over the ring R_p = GF(2)[x]/M_p(x) with
+    M_p = 1 + x + ... + x^(p-1), p = w+1 prime — the same matrix
+    jerasure's blaum_roth technique builds): symbols are polynomials of
+    degree < w; P = sum(d_i), Q = sum(x^i * d_i).  Multiply-by-x in the
+    quotient basis {1..x^(w-1)} is the companion matrix whose last
+    column is ALL-ONES (x^w = x^(p-1) == sum of all lower powers mod
+    M_p); block i of Q is its i-th power.  MDS for k <= w because x has
+    order p and x^i + x^j is a unit in R_p for i != j (mod p)."""
+    p = w + 1
+    if any(p % d == 0 for d in range(2, p)) or p < 3:
+        raise ErasureCodeError(f"blaum_roth needs w+1 prime (w={w})")
+    if k > w:
+        raise ErasureCodeError(f"blaum_roth: k={k} > w={w}")
+    # companion matrix of multiply-by-x in R_p
+    C = np.zeros((w, w), dtype=np.uint8)
+    for j in range(w - 1):
+        C[j + 1, j] = 1
+    C[:, w - 1] = 1  # x^w reduces to 1 + x + ... + x^(w-1)
+    B = np.zeros((2 * w, k * w), dtype=np.uint8)
+    ident = np.eye(w, dtype=np.uint8)
+    Ci = ident
+    for i in range(k):
+        B[:w, i * w:(i + 1) * w] = ident
+        B[w:, i * w:(i + 1) * w] = Ci
+        Ci = (C @ Ci) % 2
+    return B
 
 
 def raid6_bitmatrix(k: int, w: int) -> np.ndarray:

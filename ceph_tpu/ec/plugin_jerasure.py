@@ -25,7 +25,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import gf256
-from .bitmatrix_code import BitMatrixErasureCode, raid6_bitmatrix
+from .bitmatrix_code import (BitMatrixErasureCode,
+                             blaum_roth_bitmatrix, raid6_bitmatrix)
 from .interface import ErasureCodeError, profile_int
 from .matrix_code import MatrixErasureCode
 from .registry import register
@@ -86,7 +87,11 @@ class JerasureBitCode(BitMatrixErasureCode):
                                    "(4 or 6)")
         if self.technique == "liber8tion" and self.w != 8:
             raise ErasureCodeError("liber8tion is defined for w=8")
-        self.bitmatrix = raid6_bitmatrix(self.k, self.w)
+        if self.technique == "blaum_roth":
+            # the published ring construction (see bitmatrix_code)
+            self.bitmatrix = blaum_roth_bitmatrix(self.k, self.w)
+        else:
+            self.bitmatrix = raid6_bitmatrix(self.k, self.w)
         self._init_bitmatrix()
 
 

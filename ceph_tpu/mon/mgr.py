@@ -1,0 +1,343 @@
+"""Manager daemon with a module ecosystem (mgr-lite).
+
+The capability of the reference's ceph-mgr (src/mgr/ hosting
+src/pybind/mgr/ modules: a MgrModule base with cluster-state accessors,
+an enable/disable registry, per-module threads, and the module command
+surface — `ceph mgr module ls/enable/disable`): a MgrDaemon attached to
+the monitor hosts pluggable modules, each seeing the same state the
+reference modules read (osdmap, per-osd stats, health) and able to act
+through monitor commands.
+
+Built-in modules (the reference's always-on + most-used set):
+- status:     health/df digests as JSON (the `ceph status` feeder)
+- prometheus: /metrics HTTP endpoint (wraps mon/exporter.py)
+- dashboard:  HTTP overview — an HTML cluster page + /api/* JSON (the
+              dashboard module's monitoring slice; no auth/SSL frame)
+- balancer:   periodic upmap optimization when active (automatic mode)
+
+Third-party modules register with @register_module and are enabled per
+MgrDaemon — the loadable-module ecosystem seam.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_MODULES: dict[str, type] = {}
+
+
+def register_module(name: str):
+    def deco(cls):
+        cls.NAME = name
+        _MODULES[name] = cls
+        return cls
+    return deco
+
+
+def registered_modules() -> list[str]:
+    return sorted(_MODULES)
+
+
+class MgrModule:
+    """Base class (src/mgr/MgrModule shape): cluster-state accessors +
+    lifecycle hooks.  Modules run their own threads in serve() or do
+    periodic work in tick()."""
+
+    NAME = "base"
+    TICK_EVERY = 5.0
+
+    def __init__(self, mgr: "MgrDaemon"):
+        self.mgr = mgr
+
+    # -- state accessors (the MgrModule.get("...") surface) -----------
+    def get_osdmap(self):
+        return self.mgr.mon.osdmap
+
+    def osd_states(self) -> list[tuple]:
+        """[(id, up, in, host)] snapshotted under the mon lock — the
+        dispatch thread inserts into osdmap.osds concurrently, and
+        iterating it bare can blow up mid-scrape (same invariant the
+        exporter documents)."""
+        mon = self.mgr.mon
+        with mon._lock:
+            return [(i, o.up, o.in_cluster, getattr(o, "host", ""))
+                    for i, o in sorted(mon.osdmap.osds.items())]
+
+    def pool_states(self) -> list[tuple]:
+        mon = self.mgr.mon
+        with mon._lock:
+            return [(pid, p.name, p.kind, p.pg_num, p.size)
+                    for pid, p in sorted(mon.osdmap.pools.items())]
+
+    def get_osd_stats(self) -> dict:
+        with self.mgr.mon._lock:
+            return {i: dict(s)
+                    for i, s in self.mgr.mon._osd_stats.items()}
+
+    def mon_command(self, cmd: dict):
+        with self.mgr.mon._lock:
+            result, data = self.mgr.mon._run_command(cmd)
+        if result != 0:
+            raise RuntimeError(f"mon command {cmd.get('prefix')!r} "
+                               f"failed: {result} {data}")
+        return data
+
+    # -- lifecycle -----------------------------------------------------
+    def serve(self) -> None:  # long-running setup (threads etc.)
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def tick(self) -> None:  # periodic work on the mgr tick thread
+        pass
+
+    def command(self, cmd: str, **kw):
+        raise KeyError(f"module {self.NAME}: unknown command {cmd!r}")
+
+
+@register_module("status")
+class StatusModule(MgrModule):
+    def command(self, cmd: str, **kw):
+        if cmd == "status":
+            return self.digest()
+        raise KeyError(cmd)
+
+    def digest(self) -> dict:
+        osds = self.osd_states()
+        stats = self.get_osd_stats()
+        used = sum(int(s.get("bytes_used", 0)) for s in stats.values())
+        with self.mgr.mon._lock:
+            epoch = self.mgr.mon.osdmap.epoch
+            pools = len(self.mgr.mon.osdmap.pools)
+        return {
+            "epoch": epoch,
+            "osds": {"total": len(osds),
+                     "up": sum(1 for _i, up, _in, _h in osds if up),
+                     "in": sum(1 for _i, _up, in_, _h in osds
+                               if in_)},
+            "pools": pools,
+            "bytes_used": used,
+            "health": ("HEALTH_OK"
+                       if all(up for _i, up, _in, _h in osds)
+                       else "HEALTH_WARN"),
+        }
+
+
+@register_module("prometheus")
+class PrometheusModule(MgrModule):
+    """Wraps the exporter: the mgr owns the /metrics endpoint like the
+    reference's prometheus module does."""
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._exporter = None
+
+    def serve(self) -> None:
+        from .exporter import MetricsExporter
+        self._exporter = MetricsExporter(mon=self.mgr.mon, port=0)
+        self.port = self._exporter.port
+
+    def shutdown(self) -> None:
+        if self._exporter is not None:
+            self._exporter.stop()
+
+
+@register_module("balancer")
+class BalancerModule(MgrModule):
+    """Automatic upmap balancing (pybind/mgr/balancer role): when
+    active, each tick runs one bounded optimize pass through the
+    monitor's balancer verb."""
+
+    TICK_EVERY = 10.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.active = False
+        self.last: dict | None = None
+
+    def command(self, cmd: str, **kw):
+        if cmd == "on":
+            self.active = True
+            return {"active": True}
+        if cmd == "off":
+            self.active = False
+            return {"active": False}
+        if cmd == "status":
+            return {"active": self.active, "last": self.last}
+        if cmd == "optimize":
+            return self._optimize(int(kw.get("max_moves", 10)))
+        raise KeyError(cmd)
+
+    def _optimize(self, max_moves: int = 10):
+        with self.mgr.mon._lock:
+            result, data = self.mgr.mon._run_command(
+                {"prefix": "balancer optimize",
+                 "max_moves": max_moves})
+        self.last = data if result == 0 else {"error": data}
+        return self.last
+
+    def tick(self) -> None:
+        if self.active:
+            self._optimize()
+
+
+@register_module("dashboard")
+class DashboardModule(MgrModule):
+    """HTTP overview (pybind/mgr/dashboard monitoring slice): an HTML
+    cluster page plus /api/status, /api/osds, /api/pools JSON."""
+
+    def serve(self) -> None:
+        mgr = self.mgr  # noqa: F841 - closure for future handlers
+        module = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                try:
+                    if self.path == "/api/status":
+                        self._json(StatusModule.digest(module))
+                    elif self.path == "/api/osds":
+                        stats = module.get_osd_stats()
+                        self._json([
+                            {"id": i, "up": up, "in": in_,
+                             "host": host,
+                             **{k: v for k, v in
+                                stats.get(i, {}).items()}}
+                            for i, up, in_, host
+                            in module.osd_states()])
+                    elif self.path == "/api/pools":
+                        self._json([
+                            {"id": pid, "name": name,
+                             "kind": kind, "pg_num": pg_num,
+                             "size": size}
+                            for pid, name, kind, pg_num, size
+                            in module.pool_states()])
+                    elif self.path in ("/", "/index.html"):
+                        d = StatusModule.digest(module)
+                        rows = "".join(
+                            f"<tr><td>osd.{i}</td>"
+                            f"<td>{'up' if up else 'down'}</td>"
+                            f"<td>{'in' if in_ else 'out'}"
+                            f"</td></tr>"
+                            for i, up, in_, _h in module.osd_states())
+                        html = (
+                            "<html><head><title>ceph_tpu dashboard"
+                            "</title></head><body>"
+                            f"<h1>{d['health']}</h1>"
+                            f"<p>epoch {d['epoch']} — "
+                            f"{d['osds']['up']}/{d['osds']['total']} "
+                            f"osds up, {d['pools']} pools, "
+                            f"{d['bytes_used']} bytes used</p>"
+                            f"<table border=1><tr><th>osd</th>"
+                            f"<th>state</th><th>membership</th></tr>"
+                            f"{rows}</table>"
+                            "<p><a href=/api/status>/api/status</a> "
+                            "<a href=/api/osds>/api/osds</a> "
+                            "<a href=/api/pools>/api/pools</a></p>"
+                            "</body></html>").encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/html")
+                        self.send_header("Content-Length",
+                                         str(len(html)))
+                        self.end_headers()
+                        self.wfile.write(html)
+                    else:
+                        self._json({"error": "not found"}, 404)
+                except Exception as e:  # noqa: BLE001
+                    self._json({"error": repr(e)}, 500)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="mgr-dashboard", daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        if getattr(self, "_server", None) is not None:
+            self._server.shutdown()
+            self._server.server_close()
+
+
+class MgrDaemon:
+    """Hosts enabled modules against a monitor (ceph-mgr role)."""
+
+    def __init__(self, mon, modules=("status", "balancer"),
+                 tick: float = 1.0):
+        self.mon = mon
+        self._modules: dict[str, MgrModule] = {}
+        self._tick = tick
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._last_tick: dict[str, float] = {}
+        for name in modules:
+            self.enable(name)
+
+    # -- module registry (mgr module ls/enable/disable) ---------------
+    def module(self, name: str) -> MgrModule:
+        return self._modules[name]
+
+    def enabled(self) -> list[str]:
+        return sorted(self._modules)
+
+    def enable(self, name: str) -> MgrModule:
+        if name in self._modules:
+            return self._modules[name]
+        cls = _MODULES.get(name)
+        if cls is None:
+            raise KeyError(f"no such mgr module {name!r} "
+                           f"(have {registered_modules()})")
+        mod = cls(self)
+        mod.serve()
+        self._modules[name] = mod
+        return mod
+
+    def disable(self, name: str) -> None:
+        mod = self._modules.pop(name, None)
+        if mod is not None:
+            mod.shutdown()
+
+    def command(self, module: str, cmd: str, **kw):
+        """`ceph mgr <module> <cmd>` dispatch."""
+        if module == "mgr" and cmd == "module ls":
+            return {"enabled": self.enabled(),
+                    "available": registered_modules()}
+        return self.module(module).command(cmd, **kw)
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "MgrDaemon":
+        self._thread = threading.Thread(target=self._run,
+                                        name="mgr-tick", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._tick):
+            now = time.time()
+            for name, mod in list(self._modules.items()):
+                if now - self._last_tick.get(name, 0) >= mod.TICK_EVERY:
+                    self._last_tick[name] = now
+                    try:
+                        mod.tick()
+                    except Exception:  # noqa: BLE001 - module isolation
+                        from ..utils.log import dout
+                        dout("mgr", 0)("module %s tick failed", name)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for name in list(self._modules):
+            self.disable(name)

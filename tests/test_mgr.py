@@ -1,0 +1,119 @@
+"""Manager module ecosystem (src/mgr + pybind/mgr role): module
+registry/enable/disable, status digests, the dashboard HTTP overview,
+prometheus endpoint ownership, and automatic balancing."""
+
+import http.client
+import json
+
+import pytest
+
+from ceph_tpu.mon.mgr import MgrDaemon, MgrModule, register_module, \
+    registered_modules
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=4, cfg=make_cfg()).start()
+    yield c
+    c.stop()
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    conn.request("GET", path)
+    r = conn.getresponse()
+    body = r.read()
+    conn.close()
+    return r.status, body
+
+
+def test_module_registry_and_status(cluster):
+    mgr = MgrDaemon(cluster.mon).start()
+    try:
+        ls = mgr.command("mgr", "module ls")
+        assert "status" in ls["enabled"]
+        assert set(ls["enabled"]) <= set(ls["available"])
+        d = mgr.command("status", "status")
+        assert d["osds"]["total"] == 4 and d["health"] == "HEALTH_OK"
+        with pytest.raises(KeyError):
+            mgr.enable("no-such-module")
+    finally:
+        mgr.stop()
+
+
+def test_dashboard_http(cluster):
+    client = cluster.client()
+    client.create_pool("p", size=2, pg_num=2)
+    client.write_full("p", "o", b"x" * 1000)
+    mgr = MgrDaemon(cluster.mon, modules=("status", "dashboard")).start()
+    try:
+        port = mgr.module("dashboard").port
+        st, body = _get(port, "/")
+        assert st == 200 and b"HEALTH_OK" in body and b"osd.0" in body
+        st, body = _get(port, "/api/status")
+        assert st == 200 and json.loads(body)["pools"] == 1
+        st, body = _get(port, "/api/osds")
+        osds = json.loads(body)
+        assert len(osds) == 4 and all(o["up"] for o in osds)
+        st, body = _get(port, "/api/pools")
+        assert json.loads(body)[0]["name"] == "p"
+        assert _get(port, "/nope")[0] == 404
+    finally:
+        mgr.stop()
+
+
+def test_prometheus_module(cluster):
+    mgr = MgrDaemon(cluster.mon, modules=("prometheus",)).start()
+    try:
+        port = mgr.module("prometheus").port
+        st, body = _get(port, "/metrics")
+        assert st == 200 and b"ceph_tpu_" in body
+    finally:
+        mgr.stop()
+
+
+def test_balancer_module(cluster):
+    client = cluster.client()
+    client.create_pool("p", size=2, pg_num=4)
+    mgr = MgrDaemon(cluster.mon, modules=("balancer",)).start()
+    try:
+        out = mgr.command("balancer", "optimize")
+        assert "moves" in out or isinstance(out, dict)
+        st = mgr.command("balancer", "on")
+        assert st["active"] is True
+        assert mgr.command("balancer", "status")["active"] is True
+        mgr.command("balancer", "off")
+    finally:
+        mgr.stop()
+
+
+def test_third_party_module_seam(cluster):
+    calls = []
+
+    @register_module("testmod")
+    class TestMod(MgrModule):
+        TICK_EVERY = 0.0
+
+        def tick(self):
+            calls.append(self.get_osdmap().epoch)
+
+        def command(self, cmd, **kw):
+            if cmd == "hello":
+                return {"osds": len(self.get_osdmap().osds)}
+            raise KeyError(cmd)
+
+    assert "testmod" in registered_modules()
+    mgr = MgrDaemon(cluster.mon, modules=("testmod",), tick=0.05).start()
+    try:
+        assert mgr.command("testmod", "hello")["osds"] == 4
+        import time
+        deadline = time.time() + 5
+        while time.time() < deadline and not calls:
+            time.sleep(0.05)
+        assert calls, "module tick never ran"
+        mgr.disable("testmod")
+        assert "testmod" not in mgr.enabled()
+    finally:
+        mgr.stop()
