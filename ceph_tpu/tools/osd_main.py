@@ -40,6 +40,10 @@ def main(argv=None) -> int:
                     help="on-wire compression algorithm")
     ap.add_argument("--secure", action="store_true",
                     help="msgr2-secure-mode on-wire encryption")
+    ap.add_argument("--bind-ip", default="127.0.0.1",
+                    help="address this daemon's messengers bind — a "
+                         "distinct loopback per host models the "
+                         "multi-host deployment (public_addr role)")
     args = ap.parse_args(argv)
 
     from ..msg.tcp import TcpNetwork
@@ -51,8 +55,8 @@ def main(argv=None) -> int:
     cfg.apply_dict(json.loads(args.cfg))
     secret = bytes.fromhex(args.auth_secret_hex) \
         if args.auth_secret_hex is not None else None
-    net = TcpNetwork(auth_secret=secret, compress=args.compress,
-                     secure=args.secure)
+    net = TcpNetwork(host=args.bind_ip, auth_secret=secret,
+                     compress=args.compress, secure=args.secure)
     net.set_addr(args.mon_name, args.mon_addr)
     store_kw = {"path": args.store_path} if args.store_path else {}
     store = ObjectStore.create(args.store, **store_kw)

@@ -145,7 +145,8 @@ class MiniCluster:
 
     def spawn_osd_process(self, osd_id: int, store: str = "memstore",
                           store_path: str | None = None,
-                          cfg_overrides: dict | None = None):
+                          cfg_overrides: dict | None = None,
+                          bind_ip: str | None = None):
         """Boot an OSD as a REAL child process over TCP (the multi-daemon
         vstart.sh mode).  Requires transport='tcp'.  Returns the Popen;
         kill it with .terminate()/.kill() like a thrasher would."""
@@ -166,6 +167,8 @@ class MiniCluster:
                 "--cfg", _json.dumps(cfg_overrides or {})]
         if store_path:
             argv += ["--store-path", store_path]
+        if bind_ip:
+            argv += ["--bind-ip", bind_ip]
         if self._admin_dir:
             argv += ["--admin-socket",
                      os.path.join(self._admin_dir,

@@ -494,3 +494,73 @@ def test_auth_rotating_generations():
             d.shutdown()
     finally:
         a.shutdown(); b.shutdown(); net.stop()
+
+
+def test_multihost_daemons_distinct_addresses():
+    """Multi-host deployment stand-in (SURVEY §2.3 DCN row, host side):
+    OSD processes bound to DIFFERENT loopback addresses — distinct
+    network identities per 'host' — form one cluster over TCP, serve
+    EC io, and survive a remote-host daemon death."""
+    import socket
+
+    # loopback aliases beyond 127.0.0.1 are a Linux-ism; fail fast and
+    # portably where the alias can't bind
+    try:
+        probe = socket.socket()
+        probe.bind(("127.0.0.2", 0))
+        probe.close()
+    except OSError:
+        pytest.skip("127.0.0.0/8 loopback aliases unavailable")
+    hb = {"osd_heartbeat_interval": 0.1, "osd_heartbeat_grace": 1.0}
+    cfg = make_cfg(**hb)
+    c = MiniCluster(n_osds=0, cfg=cfg, transport="tcp",
+                    hosts_per_osd=True).start()
+    procs = []
+    try:
+        for osd_id, ip in ((0, "127.0.0.2"), (1, "127.0.0.3"),
+                           (2, "127.0.0.4"), (3, "127.0.0.2"),
+                           (4, "127.0.0.3")):
+            procs.append(c.spawn_osd_process(osd_id, bind_ip=ip,
+                                             cfg_overrides=hb))
+        deadline = time.time() + 20
+        while time.time() < deadline and \
+                len(c.mon.osdmap.up_osds()) < 5:
+            time.sleep(0.2)
+        assert len(c.mon.osdmap.up_osds()) == 5
+        # the map's address book carries the per-host IPs
+        addrs = {i: o.addr for i, o in c.mon.osdmap.osds.items()}
+        assert addrs[0].startswith("127.0.0.2:")
+        assert addrs[1].startswith("127.0.0.3:")
+        client = c.client()
+        client.create_pool("ec", kind="ec", pg_num=2,
+                           ec_profile={"plugin": "jerasure", "k": "3",
+                                       "m": "2", "backend": "numpy"})
+        data = b"multi-host!" * 3000
+        client.write_full("ec", "obj", data)
+        assert client.read("ec", "obj") == data
+        # a daemon on a remote "host" dies; the stripe still serves
+        procs[1].kill()
+        procs[1].wait()
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                len(c.mon.osdmap.up_osds()) == 5:
+            time.sleep(0.2)
+        got = None
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                got = client.read("ec", "obj")
+                break
+            except Exception:  # noqa: BLE001 - peering window
+                time.sleep(0.2)
+        assert got == data
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                p.kill()
+        c.stop()
