@@ -21,6 +21,16 @@ reported alongside in the metric string and the JSON detail.
 The TPU leg runs in a subprocess with a hard timeout: the axon TPU tunnel
 can wedge, and the driver must never hang here.  On TPU failure the line
 reports the CPU number with the metric labelled accordingly.
+
+Round-4 engineering around the wedge (it has held the tunnel closed for
+entire sessions): a persistent XLA compilation cache (.jax_cache/ —
+compile once per shape EVER, so a brief tunnel revival suffices for a
+measurement), a resumable full-BASELINE sweep driver
+(ceph_tpu.tools.bench_sweep: per-config subprocess + timeout + retries
++ atomic state, CPU and device legs in separate tables), a decode
+workload and a fused encode+csum mode (--csum) in the worker, and a
+probe-every-10-min watcher pattern that fires the sweep the moment the
+tunnel answers.  BENCH_SWEEP_CPU.json carries the measured CPU leg.
 """
 
 from __future__ import annotations

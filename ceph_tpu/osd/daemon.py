@@ -203,6 +203,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # lock / ECExtentCache ordering role): queued thunks per key
         self._obj_locks: dict[tuple, object] = {}
         self._requery_at: dict[tuple, float] = {}
+        self._requery_timers: dict[tuple, object] = {}
         self._pending_scrubs: dict = {}
         # recovery reservations + initiation throttle (AsyncReserver /
         # osd_max_backfills / osd_recovery_max_active roles): bulk
@@ -305,6 +306,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def stop(self) -> None:
         self._stop.set()
+        with self._pending_lock:
+            timers = list(self._requery_timers.values())
+            self._requery_timers.clear()
+        for t in timers:
+            t.cancel()  # a dead daemon must not keep querying peers
         self.messenger.shutdown()
         self.hb_messenger.shutdown()
         if self._use_mclock:
@@ -3410,7 +3416,26 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # before it cannot swallow the full-inventory demand
         key = (pgid, force_full)
         if now - self._requery_at.get(key, 0.0) < 0.2:
+            # DEFER, never drop: a swallowed kick that happens to be the
+            # last event in a recovery chain leaves a permanent fixed
+            # point (the thrash missing_shard hole) — re-fire once the
+            # window passes instead
+            def fire(key=key, pgid=pgid, force_full=force_full):
+                with self._pending_lock:
+                    self._requery_timers.pop(key, None)
+                if not self._stop.is_set():
+                    self._requery_pg(pgid, force_full)
+            with self._pending_lock:
+                if key not in self._requery_timers:
+                    t = threading.Timer(0.25, fire)
+                    t.daemon = True
+                    self._requery_timers[key] = t
+                    t.start()
             return
+        with self._pending_lock:
+            stale = self._requery_timers.pop(key, None)
+        if stale is not None:
+            stale.cancel()  # superseded: must not re-fire redundantly
         up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
         if self._primary_of(up) != self.osd_id:
             return

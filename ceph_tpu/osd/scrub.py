@@ -134,6 +134,18 @@ class ScrubMixin:
             self.perf.inc("scrub_errors", len(issues))
             dout("osd", 1)("%s: scrub %s found %d inconsistencies",
                            self.name, ps.pgid, len(issues))
+            if not ps.repair and any(
+                    i["kind"] in ("missing_shard", "stale_version",
+                                  "missing_copy")
+                    for i in issues):
+                # close the detect->repair->converge loop: a scrub that
+                # SEES recoverable damage re-arms recovery even without
+                # the explicit repair verb — a rebuild lost to a racing
+                # map change or swept read must not leave a permanent
+                # hole that only an operator command would fix (the
+                # round-3 thrash fixed point: 4/5 shards healthy,
+                # recovery idle, nothing ever retried)
+                self._requery_pg(ps.pgid, force_full=True)
         self.messenger.send_message(
             ps.client, MScrubResult(ps.client_tid, ps.pgid, 0, issues,
                                     repaired))

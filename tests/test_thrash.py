@@ -118,6 +118,39 @@ def test_thrash_osds_under_load(pool_kind, profile):
         while issues and time.time() < deadline:
             c.settle(1.5)
             issues = client.scrub_pool("p", deep=True)
+        if issues:
+            # diagnostic dump: the convergence bug this test guards
+            # against is timing-dependent — on failure, capture the
+            # cluster state the assert message can't carry
+            from ceph_tpu.msg.messages import PgId
+            pool_id = client._pool_id("p")
+            print(f"\nPERSISTENT ISSUES: {issues}")
+            for name in {i["object"] for i in issues}:
+                seed = c.mon.osdmap.object_to_pg(
+                    pool_id, name.split("\x00")[0])
+                pg = PgId(pool_id, seed)
+                up = c.mon.osdmap.pg_to_up_osds(pool_id, seed)
+                print(f"== {name} pg={pg} up={up} "
+                      f"epoch={c.mon.osdmap.epoch}")
+                for oid, osd in sorted(c.osds.items()):
+                    inv = {k: v for k, v in osd._inventory(pg).items()
+                           if k[0] == name}
+                    print(f" osd.{oid}: inv={inv} "
+                          f"peering={pg in osd._peering} "
+                          f"stale={osd._stale_objects.get(pg, {}).get(name)} "
+                          f"lc={osd._lc(pg)} les={osd._les(pg)}")
+                prim = next((u for u in up if u is not None), None)
+                if prim is None or prim not in c.osds:
+                    print(f" (no live primary for {pg})")
+                    continue
+                p_osd = c.osds[prim]
+                print(f" primary osd.{prim}: "
+                      f"rq={len(p_osd._recovery_q)} "
+                      f"inflight={p_osd._recovery_inflight} "
+                      f"pg_ops={dict(p_osd._recovery_pg_ops)} "
+                      f"lwait={ {str(k): len(v) for k, v in p_osd._local_waiting.items()} } "
+                      f"rwait={ {str(k): len(v) for k, v in p_osd._remote_waiting.items()} } "
+                      f"rpend={ {str(k): round(time.time()-v, 1) for k, v in p_osd._remote_pending_at.items()} }")
         assert issues == [], issues
         assert errors <= ops // 2, f"{errors}/{ops} ops failed"
     finally:
