@@ -273,6 +273,100 @@ class DashboardModule(MgrModule):
             self._server.server_close()
 
 
+@register_module("nfs")
+class NfsModule(MgrModule):
+    """NFS export management (the pybind/mgr/nfs role): the reference's
+    NFS support is ORCHESTRATION — it stores ganesha-format export
+    configurations in RADOS for the ganesha daemons to consume and
+    reload (src/pybind/mgr/nfs/export.py), it does not speak the NFS
+    protocol itself.  Same here: exports live in the omap of a
+    conf-<cluster> object in the named pool; create/delete/list mirror
+    the `ceph nfs export ...` verbs; apply() renders the ganesha
+    EXPORT block a gateway would ingest."""
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.client = None  # bound via bind(); needs a rados client
+        self.pool = None
+        self.cluster_id = "a"
+
+    def bind(self, client, pool: str,
+             cluster_id: str = "a") -> "NfsModule":
+        self.client = client
+        self.pool = pool
+        self.cluster_id = cluster_id
+        return self
+
+    @property
+    def _oid(self) -> str:
+        return f"conf-nfs.{self.cluster_id}"
+
+    def _exports(self) -> dict:
+        from ..msg.wire import unpack_value
+        try:
+            omap = self.client.omap_get(self.pool, self._oid)
+        except Exception:  # noqa: BLE001 - no exports yet
+            return {}
+        return {k: unpack_value(bytes(v)) for k, v in omap.items()}
+
+    def command(self, cmd: str, **kw):
+        if cmd == "export create":
+            return self.export_create(**kw)
+        if cmd == "export rm":
+            return self.export_rm(kw["pseudo"])
+        if cmd == "export ls":
+            return sorted(self._exports())
+        if cmd == "export get":
+            return self._exports()[kw["pseudo"]]
+        if cmd == "conf":
+            return self.render_conf()
+        raise KeyError(cmd)
+
+    def export_create(self, pseudo: str, path: str = "/",
+                      fs_pool: str | None = None,
+                      access: str = "RW", squash: str = "none",
+                      **_kw) -> dict:
+        from ..msg.wire import pack_value
+        if not pseudo.startswith("/"):
+            raise ValueError("pseudo path must be absolute")
+        exports = self._exports()
+        export_id = 1 + max((e["export_id"]
+                             for e in exports.values()), default=0)
+        rec = {"export_id": export_id, "pseudo": pseudo,
+               "path": path, "pool": fs_pool or self.pool,
+               "access_type": access, "squash": squash,
+               "protocols": [4], "transports": ["TCP"]}
+        self.client.omap_set(self.pool, self._oid,
+                             {pseudo: pack_value(rec)})
+        return rec
+
+    def export_rm(self, pseudo: str) -> None:
+        if pseudo not in self._exports():
+            raise KeyError(pseudo)
+        self.client.omap_rm(self.pool, self._oid, [pseudo])
+
+    def render_conf(self) -> str:
+        """The ganesha config body a gateway ingests (EXPORT blocks —
+        export.py's GaneshaConfParser format, the consumable
+        artifact)."""
+        blocks = []
+        for pseudo, e in sorted(self._exports().items()):
+            blocks.append(
+                "EXPORT {\n"
+                f"    Export_Id = {e['export_id']};\n"
+                f"    Path = \"{e['path']}\";\n"
+                f"    Pseudo = \"{pseudo}\";\n"
+                f"    Access_Type = {e['access_type']};\n"
+                f"    Squash = {e['squash']};\n"
+                f"    Protocols = "
+                f"{', '.join(map(str, e['protocols']))};\n"
+                f"    Transports = {', '.join(e['transports'])};\n"
+                "    FSAL { Name = CEPH; "
+                f"Filesystem = \"{e['pool']}\"; }}\n"
+                "}")
+        return "\n".join(blocks)
+
+
 class MgrDaemon:
     """Hosts enabled modules against a monitor (ceph-mgr role)."""
 

@@ -117,3 +117,33 @@ def test_third_party_module_seam(cluster):
         assert "testmod" not in mgr.enabled()
     finally:
         mgr.stop()
+
+
+def test_nfs_export_module(cluster):
+    """mgr/nfs role: export configs managed in RADOS omap, ganesha
+    EXPORT blocks rendered for a gateway to ingest."""
+    client = cluster.client()
+    client.create_pool("nfs-meta", size=2, pg_num=1)
+    mgr = MgrDaemon(cluster.mon, modules=("nfs",)).start()
+    try:
+        nfs = mgr.module("nfs").bind(client, "nfs-meta")
+        rec = mgr.command("nfs", "export create", pseudo="/data",
+                          path="/", fs_pool="fsdata")
+        assert rec["export_id"] == 1
+        mgr.command("nfs", "export create", pseudo="/backup",
+                    access="RO")
+        assert mgr.command("nfs", "export ls") == ["/backup", "/data"]
+        got = mgr.command("nfs", "export get", pseudo="/data")
+        assert got["pool"] == "fsdata" and got["protocols"] == [4]
+        conf = mgr.command("nfs", "conf")
+        assert 'Pseudo = "/data"' in conf and "FSAL" in conf
+        assert "Access_Type = RO" in conf
+        # exports survive a fresh module instance (RADOS-durable)
+        nfs2 = type(nfs)(mgr).bind(client, "nfs-meta")
+        assert sorted(nfs2._exports()) == ["/backup", "/data"]
+        mgr.command("nfs", "export rm", pseudo="/backup")
+        assert mgr.command("nfs", "export ls") == ["/data"]
+        with pytest.raises(KeyError):
+            mgr.command("nfs", "export rm", pseudo="/backup")
+    finally:
+        mgr.stop()
