@@ -475,6 +475,13 @@ class MonitorLite(Dispatcher):
         # be rolled back by a leader change
         self._reply_on_commit: dict[int, list] = {}
         self._peer_seen: dict[str, float] = {}
+        # connectivity scores (the ConnectionTracker role,
+        # src/mon/ConnectionTracker.h): EWMA of each peer link's
+        # liveness, sampled every quorum tick; my own candidacy
+        # advertises the MEAN — a flapping or half-partitioned mon
+        # scores low and defers to better-connected candidates under
+        # the "connectivity" election strategy
+        self._conn_scores: dict[str, float] = {}
         self._became_leader = 0.0
         self._stop = threading.Event()
         # per-destination sender lanes: a blocking connect to one dead
@@ -599,8 +606,34 @@ class MonitorLite(Dispatcher):
         accepted on at least one member of every majority, and term-
         before-length stops a long divergent stale-term tail from
         beating newer committed history."""
-        return (self.store.last_term, self.store.accepted_version,
-                -self._rank)
+        return self._make_score(self.store.last_term,
+                                self.store.accepted_version,
+                                self._connectivity_bucket(),
+                                self._rank)
+
+    def _make_score(self, lterm: int, version: int, connectivity: int,
+                    rank: int) -> tuple:
+        """The vote comparator, ONE shape for self-score and candidate
+        alike.  Connectivity ranks BELOW log completeness: the Raft
+        §5.4.1 safety argument (a majority-committed entry lives on
+        some member of every majority, so the most complete log must
+        win) cannot be traded for link quality — the score only breaks
+        ties between equally complete candidates, which is where a
+        flapping mon loses."""
+        if self.cfg["mon_election_strategy"] == "connectivity":
+            return (lterm, version, connectivity, -rank)
+        return (lterm, version, -rank)
+
+    def _connectivity(self) -> float:
+        if not self.peers:
+            return 1.0
+        return sum(self._conn_scores.get(p, 0.0)
+                   for p in self.peers) / len(self.peers)
+
+    def _connectivity_bucket(self) -> int:
+        """Quantized (tenths) so hair-width score differences don't
+        destabilize elections (the strategy's half-epsilon rule)."""
+        return int(round(self._connectivity() * 10))
 
     def _majority(self) -> int:
         return (len(self.peers) + 1) // 2 + 1
@@ -611,6 +644,15 @@ class MonitorLite(Dispatcher):
         while not self._stop.wait(interval):
             now = time.monotonic()
             with self._lock:
+                for p in self.peers:
+                    seen = self._peer_seen.get(p)
+                    alive = 1.0 if (seen is not None
+                                    and now - seen < lease) else 0.0
+                    # unknown links start PESSIMISTIC: a freshly booted
+                    # or rejoining mon must not outrank incumbents on
+                    # optimism — it earns its score by observing pings
+                    cur = self._conn_scores.get(p, 0.0)
+                    self._conn_scores[p] = 0.9 * cur + 0.1 * alive
                 role = self._role
                 if role == "leader" and self.peers:
                     # a partitioned minority leader must stop serving:
@@ -630,6 +672,17 @@ class MonitorLite(Dispatcher):
                 for p in self.peers:
                     self._post(p, ping)
             elif role == "follower":
+                # status ping to EVERY peer: the leader consumes the
+                # accept-ack, everyone samples the link for the
+                # connectivity tracker
+                acc = self.store.accepted
+                ping = MMonPing(self.name, self._term, "follower",
+                                self.store.accepted_version,
+                                time.time(),
+                                lterm=(acc[-1][1] if acc
+                                       else self.store.last_term))
+                for p in self.peers:
+                    self._post(p, ping)
                 if now - self._leader_seen > lease:
                     dout("mon", 1)("%s: leader lease expired", self.name)
                     self._start_election()
@@ -680,9 +733,10 @@ class MonitorLite(Dispatcher):
             lterm = self.store.last_term
         dout("mon", 3)("%s: election term %d (v%d)", self.name, term,
                        version)
+        conn_b = self._connectivity_bucket()
         for p in self.peers:
             self._post(p, MMonElect(term, version, self._rank, self.name,
-                                    lterm=lterm))
+                                    lterm=lterm, connectivity=conn_b))
 
     def _handle_elect(self, conn, m: MMonElect) -> None:
         with self._lock:
@@ -694,7 +748,9 @@ class MonitorLite(Dispatcher):
                 self.store.set_term(m.term, "")  # durable term adoption
                 if self._role == "leader":
                     self._demote(to_role="electing")
-            if (m.lterm, m.version, -m.rank) >= self._score():
+            cand = self._make_score(m.lterm, m.version,
+                                    m.connectivity, m.rank)
+            if cand >= self._score():
                 # at most ONE vote per term (the Raft votedFor rule —
                 # without it two candidates can both reach majority in
                 # the same term and split-brain)
@@ -800,6 +856,12 @@ class MonitorLite(Dispatcher):
                 acks.add(name)
 
     def _handle_mon_ping(self, conn, m: MMonPing) -> None:
+        with self._lock:
+            # liveness observation feeds the connectivity tracker on
+            # EVERY mon regardless of role — followers must score their
+            # links too, or the strategy is inert exactly when a
+            # leader-death election needs it
+            self._peer_seen[m.name] = time.monotonic()
         if m.role == "follower":
             # follower status ping: liveness + cumulative accept-ack
             # (version = its accepted_version), so a lost MMonPropAck

@@ -408,6 +408,13 @@ class MMonElect:
     rank: int
     name: str
     lterm: int = 0  # pterm of the candidate's newest log entry
+    # quantized connectivity score (ConnectionTracker role): under the
+    # "connectivity" election strategy, voters prefer candidates that
+    # can actually SEE the cluster — a half-partitioned or flapping
+    # mon defers to a better-connected one.  Default 0 = pessimistic:
+    # a sender that never scored (older version, fresh boot) must not
+    # outrank honest candidates on optimism
+    connectivity: int = 0
 
 
 @dataclass

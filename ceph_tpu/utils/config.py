@@ -174,6 +174,12 @@ OPTIONS: list[Option] = [
            "base grace before reporting a peer down", min=0.1, max=600.0),
     Option("mon_osd_min_down_reporters", int, 2, OptionLevel.ADVANCED,
            "distinct reporters required to mark an osd down", min=1),
+    Option("mon_election_strategy", str, "connectivity",
+           OptionLevel.ADVANCED,
+           "elector strategy: classic (log/rank only) or connectivity "
+           "(prefer candidates that can see the cluster — the "
+           "ConnectionTracker scoring, src/mon/ElectionLogic)",
+           enum_values=("classic", "connectivity")),
     Option("osd_op_num_shards", int, 4, OptionLevel.ADVANCED,
            "op scheduler shard queues per osd", min=1, max=64),
     Option("osd_client_message_cap", int, 256, OptionLevel.ADVANCED,

@@ -185,3 +185,81 @@ def test_killed_leader_rejoins_as_follower(quorum_cluster):
     assert m0.store.version >= new_leader.store.version
     assert any(p.name == "while-away" for p in m0.osdmap.pools.values())
     assert not m0.is_leader
+
+
+def test_connectivity_scores_accumulate_from_real_pings():
+    """The tracker's production path: follower links are observed via
+    the all-to-all status pings, so every mon's bucket RISES from the
+    pessimistic start — the strategy is live for leader-death
+    elections, not just leader-held state."""
+    import time as _time
+
+    from ceph_tpu.tools.vstart import MiniCluster
+    from tests.test_cluster import make_cfg
+
+    c = MiniCluster(n_osds=1, n_mons=3,
+                    cfg=make_cfg(osd_heartbeat_interval=0.05)).start()
+    try:
+        deadline = _time.time() + 15
+        mons = list(c.mons.values()) if hasattr(c, "mons") else [c.mon]
+        while _time.time() < deadline:
+            buckets = [m._connectivity_bucket() for m in mons]
+            followers = [m for m in mons if not m.is_leader]
+            if followers and all(m._connectivity_bucket() >= 5
+                                 for m in followers):
+                break
+            _time.sleep(0.2)
+        for m in mons:
+            assert m._connectivity_bucket() >= 5, \
+                (m.name, m.is_leader, m._conn_scores)
+    finally:
+        c.stop()
+
+
+def test_connectivity_strategy_breaks_ties_against_flappers():
+    """The connectivity election strategy (ConnectionTracker role):
+    between equally log-complete candidates, voters defer to the one
+    that can actually SEE the cluster — but link quality can NEVER
+    outrank log completeness (commit safety)."""
+    from ceph_tpu.mon.monitor import MonitorLite
+    from ceph_tpu.msg.messages import MMonElect
+    from ceph_tpu.msg.messenger import LocalNetwork
+    from tests.test_cluster import make_cfg
+
+    net = LocalNetwork()
+    m = MonitorLite(net, "mon.1", cfg=make_cfg(),
+                    peers=["mon.0", "mon.1", "mon.2"])
+    try:
+        m._term = 4
+        # my view of the cluster is healthy
+        m._conn_scores = {"mon.0": 1.0, "mon.2": 1.0}
+        granted = []
+        m._post = lambda dst, msg: granted.append((dst, msg))
+        # equally complete candidate with TERRIBLE connectivity
+        # (bucket 2) and a better rank: the tie breaks AGAINST it
+        m.ms_dispatch(type("C", (), {"peer": "mon.0"})(),
+                      MMonElect(5, 0, 0, "mon.0", lterm=0,
+                                connectivity=2))
+        assert not any(type(x).__name__ == "MMonVote"
+                       for _d, x in granted), \
+            "a flapping candidate won an even tie"
+        # same candidacy with healthy connectivity gets the vote
+        granted.clear()
+        m._voted = None
+        m.ms_dispatch(type("C", (), {"peer": "mon.0"})(),
+                      MMonElect(6, 0, 0, "mon.0", lterm=0,
+                                connectivity=10))
+        assert any(type(x).__name__ == "MMonVote"
+                   for _d, x in granted)
+        # a MORE COMPLETE log beats any connectivity deficit
+        granted.clear()
+        m._voted = None
+        m.store.accept_at(1, 4, "k", b"v", "d")  # my log grows
+        m.ms_dispatch(type("C", (), {"peer": "mon.0"})(),
+                      MMonElect(7, 0, 0, "mon.0", lterm=0,
+                                connectivity=10))
+        assert not any(type(x).__name__ == "MMonVote"
+                       for _d, x in granted), \
+            "connectivity outranked log completeness"
+    finally:
+        m.stop()
