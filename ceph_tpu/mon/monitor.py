@@ -482,6 +482,7 @@ class MonitorLite(Dispatcher):
         # scores low and defers to better-connected candidates under
         # the "connectivity" election strategy
         self._conn_scores: dict[str, float] = {}
+        self._link_seen: dict[str, float] = {}  # tracker input (any term)
         self._became_leader = 0.0
         self._stop = threading.Event()
         # per-destination sender lanes: a blocking connect to one dead
@@ -645,7 +646,7 @@ class MonitorLite(Dispatcher):
             now = time.monotonic()
             with self._lock:
                 for p in self.peers:
-                    seen = self._peer_seen.get(p)
+                    seen = self._link_seen.get(p)
                     alive = 1.0 if (seen is not None
                                     and now - seen < lease) else 0.0
                     # unknown links start PESSIMISTIC: a freshly booted
@@ -857,11 +858,13 @@ class MonitorLite(Dispatcher):
 
     def _handle_mon_ping(self, conn, m: MMonPing) -> None:
         with self._lock:
-            # liveness observation feeds the connectivity tracker on
-            # EVERY mon regardless of role — followers must score their
-            # links too, or the strategy is inert exactly when a
-            # leader-death election needs it
-            self._peer_seen[m.name] = time.monotonic()
+            # link-quality observation feeds the connectivity tracker
+            # on EVERY mon regardless of role or TERM — but in its own
+            # map: _peer_seen is QUORUM accounting (term-guarded), and
+            # counting a term-mismatched ping there would let a stale
+            # minority leader believe it still has quorum contact and
+            # never step down
+            self._link_seen[m.name] = time.monotonic()
         if m.role == "follower":
             # follower status ping: liveness + cumulative accept-ack
             # (version = its accepted_version), so a lost MMonPropAck

@@ -160,37 +160,79 @@ class RgwGateway:
                         urllib.parse.parse_qs(
                             query, keep_blank_values=True).items()}
 
-            def _auth(self, body: bytes = b"") -> bool:
+            def _auth(self, body: bytes = b""):
                 """SigV4 gate on every verb when a user registry is
-                configured; replies the S3 error shape on failure."""
+                configured; replies the S3 error shape on failure.
+                Returns the authenticated principal ("" when the
+                gateway is anonymous), or None after replying 4xx."""
                 if gw.users is None:
-                    return True
+                    return ""
                 path = self.path.split("?", 1)[0]
                 query = self.path.split("?", 1)[1] \
                     if "?" in self.path else ""
                 try:
-                    s3auth.verify(self.command, path, query,
-                                  {k: v for k, v in self.headers.items()},
-                                  body, gw.users.get)
-                    return True
+                    return s3auth.verify(
+                        self.command, path, query,
+                        {k: v for k, v in self.headers.items()},
+                        body, gw.users.get)
                 except s3auth.AuthError as e:
                     self._error(e.http, e.s3code)
+                    return None
+
+            def _allow(self, who, bucket, action) -> bool:
+                try:
+                    gw.authorize(who, bucket, action)
+                    return True
+                except PermissionError:
+                    self._error(403, "AccessDenied")
                     return False
+
+            def _owner_gate(self, who, bucket) -> bool:
+                """Bucket-config surface (policy/versioning/
+                lifecycle/delete/re-create): strictly owner-scoped.
+                Replies 403 itself on refusal."""
+                try:
+                    owner = gw.bucket_owner(bucket)
+                except KeyError:
+                    owner = ""
+                if gw.users is not None and owner and who != owner:
+                    self._error(403, "AccessDenied")
+                    return False
+                return True
 
             # ----------------------------------------------------- verbs
             def do_GET(self):  # noqa: N802
-                if not self._auth():
+                who = self._auth()
+                if who is None:
                     return
                 bucket, key, query = self._route()
                 qs = self._qs(query)
+                if bucket is not None and bucket != "admin":
+                    if key is None and any(
+                            q in qs for q in ("policy", "versioning",
+                                              "lifecycle")):
+                        # config reads expose grants/denies and rule
+                        # sets: owner-only, like the config writes
+                        if not self._owner_gate(who, bucket):
+                            return
+                    else:
+                        action = "s3:GetObject" if key is not None \
+                            else "s3:ListBucket"
+                        if not self._allow(who, bucket, action):
+                            return
                 try:
                     if bucket == "admin" and key == "bilog":
                         # multisite: the bucket-index log tail (the
-                        # radosgw-admin bilog list / datalog role)
+                        # radosgw-admin bilog list / datalog role).
+                        # The log leaks the TARGET bucket's key listing
+                        # — same authorization as listing it
+                        target = qs.get("bucket", "")
+                        if not self._allow(who, target,
+                                           "s3:ListBucket"):
+                            return
                         import json as _json
                         entries = gw.bilog_since(
-                            qs.get("bucket", ""),
-                            int(qs.get("marker", 0)))
+                            target, int(qs.get("marker", 0)))
                         self._send(200, _json.dumps(entries).encode(),
                                    ctype="application/json")
                     elif bucket is None:
@@ -211,6 +253,14 @@ class RgwGateway:
                             "<VersioningConfiguration><Status>"
                             f"{status}</Status>"
                             "</VersioningConfiguration>").encode())
+                    elif key is None and "policy" in qs:
+                        import json as _json
+                        pol = gw.get_bucket_policy(bucket)
+                        if pol is None:
+                            self._error(404, "NoSuchBucketPolicy")
+                        else:
+                            self._send(200, _json.dumps(pol).encode(),
+                                       ctype="application/json")
                     elif key is None and "lifecycle" in qs:
                         rules = gw.get_lifecycle(bucket)
                         items = "".join(
@@ -247,10 +297,14 @@ class RgwGateway:
             def do_POST(self):  # noqa: N802
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
-                if not self._auth(body):
+                who = self._auth(body)
+                if who is None:
                     return
                 bucket, key, query = self._route()
                 qs = self._qs(query)
+                if bucket is not None and \
+                        not self._allow(who, bucket, "s3:PutObject"):
+                    return
                 try:
                     if key is not None and "uploads" in qs:
                         upload_id = gw.initiate_multipart(bucket, key)
@@ -285,9 +339,13 @@ class RgwGateway:
                     self._error(400, "InvalidPart")
 
             def do_HEAD(self):  # noqa: N802
-                if not self._auth():
+                who = self._auth()
+                if who is None:
                     return
                 bucket, key, _ = self._route()
+                if bucket is not None and \
+                        not self._allow(who, bucket, "s3:GetObject"):
+                    return
                 try:
                     if key is None:
                         gw.check_bucket(bucket)
@@ -305,9 +363,28 @@ class RgwGateway:
                 qs = self._qs(query)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
-                if not self._auth(body):
+                who = self._auth(body)
+                if who is None:
                     return
+                # bucket-config verbs (versioning/lifecycle/policy) and
+                # bucket creation are owner-scoped; object writes go
+                # through the policy
+                if key is not None and \
+                        not self._allow(who, bucket, "s3:PutObject"):
+                    return
+                if key is None and any(q in qs for q in
+                                       ("versioning", "lifecycle",
+                                        "policy")):
+                    if not self._owner_gate(who, bucket):
+                        return
                 try:
+                    if key is None and "policy" in qs:
+                        import json as _json
+                        gw.check_bucket(bucket)
+                        gw.set_bucket_policy(bucket,
+                                             _json.loads(body))
+                        self._send(200)
+                        return
                     if key is None and "versioning" in qs:
                         gw.check_bucket(bucket)
                         root = ElementTree.fromstring(body)
@@ -322,8 +399,24 @@ class RgwGateway:
                                          _parse_lifecycle(body))
                         self._send(200)
                     elif key is None:
-                        gw.create_bucket(bucket)
-                        self._send(200)
+                        try:
+                            gw.check_bucket(bucket)
+                            exists = True
+                        except KeyError:
+                            exists = False
+                        if exists:
+                            # re-PUT must neither clobber the record
+                            # (owner/policy/versioning) nor transfer
+                            # ownership — S3: your own bucket is a
+                            # no-op 200, someone else's refuses
+                            if not self._owner_gate(who, bucket):
+                                return
+                            self._send(200)
+                        else:
+                            gw.create_bucket(bucket)
+                            if who:
+                                gw.set_bucket_owner(bucket, who)
+                            self._send(200)
                     elif "partNumber" in qs and "uploadId" in qs:
                         etag = gw.put_part(bucket, key, qs["uploadId"],
                                            int(qs["partNumber"]), body)
@@ -335,11 +428,22 @@ class RgwGateway:
                     self._error(404, "NoSuchBucket")
 
             def do_DELETE(self):  # noqa: N802
-                if not self._auth():
+                who = self._auth()
+                if who is None:
                     return
                 bucket, key, query = self._route()
                 qs = self._qs(query)
+                if key is not None and \
+                        not self._allow(who, bucket,
+                                        "s3:DeleteObject"):
+                    return
+                if key is None and not self._owner_gate(who, bucket):
+                    return
                 try:
+                    if key is None and "policy" in qs:
+                        gw.delete_bucket_policy(bucket)
+                        self._send(204)
+                        return
                     if key is not None and "uploadId" in qs:
                         gw.abort_multipart(bucket, key, qs["uploadId"])
                         self._send(204)
@@ -401,6 +505,76 @@ class RgwGateway:
     def check_bucket(self, bucket: str) -> None:
         if bucket not in self._buckets():
             raise KeyError(bucket)
+
+    # ----------------------------------------------------------- IAM
+    # (the rgw IAM/bucket-policy slice, src/rgw/rgw_iam_policy.{h,cc}:
+    # buckets have OWNERS; non-owners are admitted only by an attached
+    # AWS-shaped bucket policy; explicit Deny outranks Allow; anything
+    # unmatched is denied.  Anonymous gateways — no user registry —
+    # skip enforcement entirely, as before.)
+    def set_bucket_owner(self, bucket: str, owner: str) -> None:
+        rec = self._bucket_rec(bucket)
+        rec["owner"] = owner
+        self._bucket_rec_set(bucket, rec)
+
+    def bucket_owner(self, bucket: str) -> str:
+        return str(self._bucket_rec(bucket).get("owner", ""))
+
+    def set_bucket_policy(self, bucket: str, policy: dict) -> None:
+        stmts = policy.get("Statement")
+        if not isinstance(stmts, list):
+            raise ValueError("policy needs a Statement list")
+        rec = self._bucket_rec(bucket)
+        rec["policy"] = policy
+        self._bucket_rec_set(bucket, rec)
+
+    def get_bucket_policy(self, bucket: str) -> dict | None:
+        return self._bucket_rec(bucket).get("policy")
+
+    def delete_bucket_policy(self, bucket: str) -> None:
+        rec = self._bucket_rec(bucket)
+        rec.pop("policy", None)
+        self._bucket_rec_set(bucket, rec)
+
+    @staticmethod
+    def _stmt_matches(stmt: dict, principal: str, action: str) -> bool:
+        pr = stmt.get("Principal", {})
+        if pr != "*":
+            aws = pr.get("AWS", []) if isinstance(pr, dict) else []
+            if isinstance(aws, str):
+                aws = [aws]
+            if "*" not in aws and principal not in aws:
+                return False
+        acts = stmt.get("Action", [])
+        if isinstance(acts, str):
+            acts = [acts]
+        return any(a == "s3:*" or a == action for a in acts)
+
+    def authorize(self, principal: str, bucket: str,
+                  action: str) -> None:
+        """Raise PermissionError unless `principal` may perform
+        `action` on `bucket` (owner always may; then the bucket
+        policy decides: explicit Deny wins, unmatched denies)."""
+        if self.users is None:
+            return  # anonymous gateway: no enforcement
+        try:
+            rec = self._bucket_rec(bucket)
+        except KeyError:
+            return  # bucket existence errors surface as 404 later
+        owner = rec.get("owner", "")
+        if not owner or principal == owner:
+            return  # unowned (legacy) buckets stay open to auth'd users
+        policy = rec.get("policy") or {}
+        allowed = False
+        for stmt in policy.get("Statement", []):
+            if not self._stmt_matches(stmt, principal, action):
+                continue
+            if stmt.get("Effect") == "Deny":
+                raise PermissionError(action)
+            if stmt.get("Effect") == "Allow":
+                allowed = True
+        if not allowed:
+            raise PermissionError(action)
 
     # ---------------------------------------------------- versioning flag
     def set_versioning(self, bucket: str, enabled: bool) -> None:

@@ -416,3 +416,88 @@ def test_versioning_multisite_sync(gateway):
             agent.stop()
     finally:
         gw2.stop()
+
+
+@pytest.fixture
+def iam_gateway():
+    from ceph_tpu.services import s3auth
+    c = MiniCluster(n_osds=4, cfg=make_cfg()).start()
+    client = c.client()
+    client.create_pool("rgw", size=3, pg_num=2)
+    gw = RgwGateway(client, "rgw", users={"ALICE": "s1", "BOB": "s2",
+                                          "EVE": "s3"})
+    yield gw, s3auth
+    gw.stop()
+    c.stop()
+
+
+def test_iam_bucket_ownership_and_policy(iam_gateway):
+    """The rgw IAM/bucket-policy slice (rgw_iam_policy role): buckets
+    are owned; non-owners need a policy grant; Deny beats Allow;
+    config verbs stay owner-only."""
+    import json as _json
+    gw, s3auth = iam_gateway
+
+    def alice(method, path, body=b""):
+        return _signed(gw, s3auth, method, path, body,
+                       access="ALICE", secret="s1")
+
+    def bob(method, path, body=b""):
+        return _signed(gw, s3auth, method, path, body,
+                       access="BOB", secret="s2")
+
+    def eve(method, path, body=b""):
+        return _signed(gw, s3auth, method, path, body,
+                       access="EVE", secret="s3")
+
+    assert alice("PUT", "/priv")[0] == 200
+    assert gw.bucket_owner("priv") == "ALICE"
+    assert alice("PUT", "/priv/doc", b"owner-data")[0] == 200
+    # a non-owner is denied everything by default
+    assert bob("GET", "/priv/doc")[0] == 403
+    assert bob("PUT", "/priv/x", b"nope")[0] == 403
+    assert bob("DELETE", "/priv/doc")[0] == 403
+    assert bob("GET", "/priv")[0] == 403
+    # the owner attaches a policy granting BOB read, EVE denied all
+    policy = {"Statement": [
+        {"Effect": "Allow", "Principal": {"AWS": ["BOB"]},
+         "Action": ["s3:GetObject", "s3:ListBucket"]},
+        {"Effect": "Deny", "Principal": {"AWS": ["EVE"]},
+         "Action": ["s3:*"]},
+    ]}
+    assert alice("PUT", "/priv?policy",
+                 _json.dumps(policy).encode())[0] == 200
+    st, body, _ = alice("GET", "/priv?policy")
+    assert st == 200 and _json.loads(body) == policy
+    # BOB reads but cannot write; EVE is denied even reads
+    assert bob("GET", "/priv/doc")[1] == b"owner-data"
+    assert bob("GET", "/priv")[0] == 200
+    assert bob("PUT", "/priv/x", b"still-nope")[0] == 403
+    assert eve("GET", "/priv/doc")[0] == 403
+    # non-owners cannot touch bucket config or the policy itself
+    assert bob("PUT", "/priv?policy", b"{}")[0] == 403
+    assert bob("PUT", "/priv?versioning",
+               b"<VersioningConfiguration><Status>Enabled</Status>"
+               b"</VersioningConfiguration>")[0] == 403
+    assert bob("DELETE", "/priv")[0] == 403
+    # wildcard principal opens reads to every authenticated user
+    policy["Statement"][0]["Principal"] = "*"
+    assert alice("PUT", "/priv?policy",
+                 _json.dumps(policy).encode())[0] == 200
+    assert bob("GET", "/priv/doc")[0] == 200
+    assert eve("GET", "/priv/doc")[0] == 403  # Deny still wins
+    # owner removes the policy: back to owner-only
+    assert alice("DELETE", "/priv?policy")[0] == 204
+    assert bob("GET", "/priv/doc")[0] == 403
+    assert alice("GET", "/priv/doc")[1] == b"owner-data"
+    # bucket re-PUT by a non-owner must neither hijack ownership nor
+    # clobber config (round-4 review finding)
+    assert bob("PUT", "/priv")[0] == 403
+    assert gw.bucket_owner("priv") == "ALICE"
+    assert alice("PUT", "/priv")[0] == 200  # own re-PUT: no-op
+    assert gw.bucket_owner("priv") == "ALICE"
+    # config READS are owner-only; the admin bilog needs list rights
+    assert bob("GET", "/priv?policy")[0] == 403
+    assert bob("GET", "/priv?lifecycle")[0] == 403
+    assert bob("GET", "/admin/bilog?bucket=priv")[0] == 403
+    assert alice("GET", "/admin/bilog?bucket=priv")[0] == 200
