@@ -107,3 +107,111 @@ class IntervalSet:
     def __repr__(self) -> str:
         ivs = ", ".join(f"[{s},{e})" for s, e in self)
         return f"IntervalSet({ivs})"
+
+
+class IntervalMap:
+    """Offset-ranged VALUES (the interval_map<K, V> role,
+    src/include/interval_map.h — BlueStore and the EC read pipeline use
+    it with bufferlist values): non-overlapping [start, start+len)
+    ranges each carrying a value; inserts SPLICE over existing ranges
+    (later writes win, like overlapping buffer extents), adjacent
+    ranges with splice-compatible values merge via the value's
+    concatenation when it supports it (bytes), and lookups return the
+    covering segments of any query range."""
+
+    def __init__(self):
+        self._segs: list[list] = []  # [start, length, value], sorted
+
+    # -- mutation ----------------------------------------------------------
+    def insert(self, start: int, length: int, value) -> None:
+        if length <= 0:
+            return
+        if isinstance(value, (bytes, bytearray)) \
+                and len(value) != length:
+            # every byte-value slice below relies on ln == len(v) —
+            # the C++ interval_map asserts this invariant at insert
+            raise ValueError(
+                f"value length {len(value)} != interval {length}")
+        self.erase(start, length)
+        idx = bisect.bisect_left(self._segs, start,
+                                 key=lambda seg: seg[0])
+        self._segs.insert(idx, [start, length, value])
+        self._coalesce(idx)
+
+    def erase(self, start: int, length: int) -> None:
+        """Remove [start, start+length): overlapping segments are cut,
+        byte-valued segments keep their surviving slices."""
+        if length <= 0:
+            return
+        end = start + length
+        out = []
+        for s, ln, v in self._segs:
+            e = s + ln
+            if e <= start or s >= end:
+                out.append([s, ln, v])
+                continue
+            if s < start:  # left remainder
+                keep = start - s
+                out.append([s, keep,
+                            v[:keep] if isinstance(v, (bytes, bytearray))
+                            else v])
+            if e > end:    # right remainder
+                keep = e - end
+                off = end - s
+                out.append([end, keep,
+                            v[off:off + keep]
+                            if isinstance(v, (bytes, bytearray))
+                            else v])
+        self._segs = out
+
+    def _coalesce(self, idx: int) -> None:
+        """Merge byte-valued neighbours that abut exactly."""
+        segs = self._segs
+        # try merging idx with its right neighbour, then left
+        for i in (idx, idx - 1):
+            if 0 <= i < len(segs) - 1:
+                s, ln, v = segs[i]
+                s2, ln2, v2 = segs[i + 1]
+                if s + ln == s2 and isinstance(v, (bytes, bytearray)) \
+                        and isinstance(v2, (bytes, bytearray)):
+                    segs[i] = [s, ln + ln2, bytes(v) + bytes(v2)]
+                    del segs[i + 1]
+
+    # -- queries -----------------------------------------------------------
+    def get(self, start: int, length: int) -> list[tuple[int, int, object]]:
+        """Covering segments of [start, start+length) clipped to it:
+        [(seg_start, seg_len, value_slice_or_value)]."""
+        end = start + length
+        out = []
+        for s, ln, v in self._segs:
+            e = s + ln
+            if e <= start or s >= end:
+                continue
+            lo, hi = max(s, start), min(e, end)
+            if isinstance(v, (bytes, bytearray)):
+                out.append((lo, hi - lo, bytes(v[lo - s: hi - s])))
+            else:
+                out.append((lo, hi - lo, v))
+        return out
+
+    def covers(self, start: int, length: int) -> bool:
+        """True when every byte of the range carries a value."""
+        need = start
+        end = start + length
+        for s, ln, _v in self._segs:
+            if s > need:
+                return False
+            if s + ln > need:
+                need = s + ln
+                if need >= end:
+                    return True
+        return need >= end
+
+    def __len__(self) -> int:
+        return len(self._segs)
+
+    def __iter__(self):
+        return iter((s, ln, v) for s, ln, v in self._segs)
+
+    def empty(self) -> bool:
+        return not self._segs

@@ -272,3 +272,44 @@ def test_op_tracker():
     assert [e["event"] for e in hist[0]["events"]][:2] == [
         "initiated", "queued"]
     assert tr.slow_op_count == 1
+
+
+def test_interval_map_buffer_values():
+    """interval_map<K, bufferlist> role: value-carrying ranges with
+    splice-on-overwrite, slice-preserving erase, byte coalescing, and
+    covering queries."""
+    from ceph_tpu.utils.interval import IntervalMap
+
+    m = IntervalMap()
+    assert m.empty() and not m.covers(0, 1)
+    m.insert(0, 4, b"AAAA")
+    m.insert(4, 4, b"BBBB")
+    # byte neighbours coalesce
+    assert len(m) == 1
+    assert m.get(0, 8) == [(0, 8, b"AAAABBBB")]
+    # overwrite splices: later writes win, survivors keep their slices
+    m.insert(2, 4, b"XXXX")
+    assert m.get(0, 8) == [(0, 8, b"AAXXXXBB")]
+    # ranged query clips values
+    assert m.get(3, 2) == [(3, 2, b"XX")]
+    # erase keeps the remainders
+    m.erase(1, 6)
+    assert m.get(0, 8) == [(0, 1, b"A"), (7, 1, b"B")]
+    assert not m.covers(0, 8) and m.covers(7, 1)
+    # non-byte values: kept whole, no coalescing, no slicing
+    m2 = IntervalMap()
+    m2.insert(0, 10, {"v": 1})
+    m2.insert(10, 5, {"v": 2})
+    assert len(m2) == 2
+    assert m2.get(8, 4) == [(8, 2, {"v": 1}), (10, 2, {"v": 2})]
+    m2.erase(5, 7)
+    assert m2.get(0, 20) == [(0, 5, {"v": 1}), (12, 3, {"v": 2})]
+    assert m2.covers(12, 3) and not m2.covers(4, 2)
+    # invariants: byte length must match; degenerate erase is a no-op
+    m3 = IntervalMap()
+    with pytest.raises(ValueError):
+        m3.insert(0, 4, b"too-long!")
+    m3.insert(0, 4, b"GOOD")
+    m3.erase(2, 0)
+    m3.erase(2, -5)
+    assert m3.get(0, 4) == [(0, 4, b"GOOD")]
