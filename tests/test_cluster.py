@@ -19,7 +19,13 @@ def make_cfg(**over):
     cfg = default_config()
     cfg.apply_dict({"osd_heartbeat_interval": 0.05,
                     "osd_heartbeat_grace": 0.5,
-                    "ec_backend": "native", **over})
+                    "ec_backend": "native",
+                    # sharded dispatch stays exercised (2 shards per
+                    # OSD) without the full default-4 thread pressure —
+                    # an 8-daemon test cluster already runs ~50 threads
+                    # and CI-box contention was flaking timing-tight
+                    # tests at 4
+                    "osd_op_num_shards": 2, **over})
     return cfg
 
 
