@@ -216,3 +216,76 @@ class NbdServer:
                data: bytes = b"") -> None:
         sock.sendall(struct.pack(">IIQ", REPLY_MAGIC, error, handle)
                      + data)
+
+
+class NbdClient:
+    """Minimal fixed-newstyle NBD client (the kernel's wire dialect) —
+    the other half of the gateway, used by smoke/tests and usable as a
+    library client."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port),
+                                             timeout=10)
+        magic, opt, flags = struct.unpack(
+            ">QQH", _recv_exact(self.sock, 18))
+        assert magic == NBDMAGIC and opt == IHAVEOPT
+        self.sock.sendall(struct.pack(">I", 1))  # fixed-newstyle
+        self._handle = 0
+
+    def list_exports(self):
+        self.sock.sendall(struct.pack(">QII", IHAVEOPT, OPT_LIST, 0))
+        names = []
+        while True:
+            magic, opt, rep, ln = struct.unpack(
+                ">QIII", _recv_exact(self.sock, 20))
+            payload = _recv_exact(self.sock, ln) if ln else b""
+            if rep == REP_ACK:
+                return names
+            assert rep == REP_SERVER
+            (nlen,) = struct.unpack(">I", payload[:4])
+            names.append(payload[4:4 + nlen].decode())
+
+    def go(self, name):
+        data = name.encode()
+        self.sock.sendall(struct.pack(">QII", IHAVEOPT,
+                                      OPT_EXPORT_NAME, len(data))
+                          + data)
+        size, tflags = struct.unpack(">QH",
+                                     _recv_exact(self.sock, 10))
+        _recv_exact(self.sock, 124)
+        return size, tflags
+
+    def _cmd(self, cmd, offset=0, length=0, data=b""):
+        self._handle += 1
+        self.sock.sendall(struct.pack(
+            ">IHHQQI", REQ_MAGIC, 0, cmd, self._handle, offset,
+            length) + data)
+        if cmd == CMD_DISC:
+            return 0, b""
+        magic, err, handle = struct.unpack(
+            ">IIQ", _recv_exact(self.sock, 16))
+        assert magic == REPLY_MAGIC and handle == self._handle
+        body = _recv_exact(self.sock, length) \
+            if cmd == CMD_READ and err == 0 else b""
+        return err, body
+
+    def read(self, offset, length):
+        err, data = self._cmd(CMD_READ, offset, length)
+        assert err == 0, err
+        return data
+
+    def write(self, offset, data):
+        err, _ = self._cmd(CMD_WRITE, offset, len(data), data)
+        return err
+
+    def flush(self):
+        return self._cmd(CMD_FLUSH)[0]
+
+    def trim(self, offset, length):
+        return self._cmd(CMD_TRIM, offset, length)[0]
+
+    def close(self):
+        try:
+            self._cmd(CMD_DISC)
+        finally:
+            self.sock.close()
