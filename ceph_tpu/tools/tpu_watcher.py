@@ -1,0 +1,253 @@
+"""TPU tunnel watcher: probe the axon device link continuously and
+harvest any revival into the full BASELINE device sweep.
+
+The axon tunnel (the only device link on this box) wedges for hours at
+a time; a live measurement has succeeded exactly once across rounds 1-4
+(round 2, recorded in BENCH_TPU_RECORDED.json).  This tool exists so a
+revival at 3 a.m. is harvested without anyone watching:
+
+  loop forever:
+    probe jax.devices() in a process group with a HARD timeout
+    log the probe (JSONL, one line per event -> proves coverage)
+    on success:
+      1. headline harvest ladder: bench_tpu at batch 4 -> 16 -> 64
+         (round 2 showed the tunnel wedges during LARGE staging
+         transfers, so small batches land a recorded number first);
+         each digest-verified success refreshes BENCH_TPU_RECORDED.json
+         with fresh provenance so bench.py reports THIS round's number
+      2. the resumable BASELINE sweep (bench_sweep, device leg) —
+         fired on every probe-up even if the 1 MiB headline harvest
+         wedged: the 4K sweep configs transfer far less and may land
+    sleep the remainder of the interval
+
+Reference analogue: qa/workunits/erasure-code/bench.sh:38-62 (the sweep
+being harvested) and ceph_erasure_code_benchmark.cc:165-195 (protocol).
+
+Usage (round start, detached):
+    nohup python -m ceph_tpu.tools.tpu_watcher >/dev/null 2>&1 &
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LOG = os.path.join(REPO, "TPU_WATCHER_LOG.jsonl")
+RECORDED = os.path.join(REPO, "BENCH_TPU_RECORDED.json")
+
+PROBE_SRC = (
+    "import jax; d = jax.devices(); "
+    "print(__import__('json').dumps("
+    "{'platform': d[0].platform, 'n': len(d), "
+    "'kind': getattr(d[0], 'device_kind', '?')}))"
+)
+
+
+def log_event(event: str, **fields) -> None:
+    line = {"utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "event": event, **fields}
+    with open(LOG, "a") as f:
+        f.write(json.dumps(line) + "\n")
+    print(f"tpu_watcher: {event} {fields}", file=sys.stderr, flush=True)
+
+
+def run_bounded(cmd: list[str], timeout: float):
+    """Run cmd with a timeout that is actually hard: the child gets its
+    own session/process group, and on expiry the WHOLE group is
+    SIGKILLed and the pipes are abandoned rather than drained —
+    subprocess.run's TimeoutExpired path blocks in communicate() until
+    every inherited pipe writer exits, which over a wedged tunnel (or a
+    jax helper process holding the fds) can hang the watcher for hours.
+
+    Returns (rc, stdout, stderr) or None on timeout."""
+    with open(os.devnull) as devnull:
+        proc = subprocess.Popen(
+            cmd, stdin=devnull, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, cwd=REPO,
+            start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout)
+        return proc.returncode, out, err
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:  # group is dead: pipes close promptly; bound it anyway
+            proc.communicate(timeout=10)
+        except (subprocess.TimeoutExpired, ValueError):
+            for pipe in (proc.stdout, proc.stderr):
+                if pipe is not None:
+                    pipe.close()
+        return None
+
+
+def probe(timeout: float) -> dict | None:
+    """One tunnel probe.  Returns the device info dict iff a real
+    non-CPU backend answered."""
+    res = run_bounded([sys.executable, "-c", PROBE_SRC], timeout)
+    if res is None:
+        return None
+    rc, out, err = res
+    if rc != 0:
+        log_event("probe_error", stderr=err.strip()[-300:])
+        return None
+    try:
+        info = json.loads(out.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        log_event("probe_bad_output", stdout=out[-200:])
+        return None
+    if info.get("platform") in (None, "cpu"):
+        # sitecustomize fell back to the host platform: tunnel is down
+        return None
+    return info
+
+
+_CPU_BASELINE: float | None = None
+
+
+def cpu_baseline_gbps() -> float:
+    """The headline single-thread CPU number, measured once per watcher
+    lifetime via bench.py's own probe (one protocol, no drift)."""
+    global _CPU_BASELINE
+    if _CPU_BASELINE is None:
+        if REPO not in sys.path:
+            sys.path.insert(0, REPO)
+        import bench
+        _CPU_BASELINE = bench.cpu_baseline_gbps()
+    return _CPU_BASELINE
+
+
+def harvest_headline(device: dict, timeout: float) -> bool:
+    """Climb the batch ladder at the headline config; refresh
+    BENCH_TPU_RECORDED.json after every digest-verified success so even
+    a tunnel that re-wedges mid-ladder leaves a fresh number behind."""
+    harvested = False
+    for batch in (4, 16, 64):
+        cmd = [sys.executable, "-m", "ceph_tpu.tools.bench_tpu",
+               "--k", "8", "--m", "3", "--stripe-bytes", str(1024 * 1024),
+               "--batch", str(batch), "--reps", "3"]
+        log_event("harvest_start", batch=batch)
+        res = run_bounded(cmd, timeout)
+        if res is None:
+            log_event("harvest_timeout", batch=batch)
+            return harvested  # tunnel re-wedged; keep what we have
+        rc, out, err = res
+        if rc != 0:
+            log_event("harvest_failed", batch=batch,
+                      stderr=err.strip()[-400:])
+            return harvested
+        try:
+            result = json.loads(out.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            log_event("harvest_bad_output", batch=batch)
+            return harvested
+        if not result.get("digest_verified"):
+            log_event("harvest_unverified", batch=batch)
+            return harvested
+        cpu = round(cpu_baseline_gbps(), 3)
+        rec = {
+            "provenance": {
+                "recorded_utc": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "command": " ".join(cmd[1:]),
+                "device": f"{device.get('kind', '?')} "
+                          f"({device.get('platform')}, "
+                          f"{device.get('n')} chip)",
+                "methodology": "harvested live by tools/tpu_watcher.py "
+                               "on tunnel revival; rolled-loop XOR-digest "
+                               "timing per bench_tpu docstring",
+            },
+            "result": result,
+            "cpu_baseline_gbps": cpu,
+            "vs_cpu_baseline": round(result["kernel_gbps"] / cpu, 1)
+            if result.get("kernel_gbps") else None,
+        }
+        tmp = RECORDED + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(rec, f, indent=2)
+        os.replace(tmp, RECORDED)
+        log_event("harvest_recorded", batch=batch,
+                  kernel_gbps=result.get("kernel_gbps"),
+                  e2e_gbps=result.get("e2e_gbps"),
+                  staging_gbps=result.get("staging_gbps"),
+                  kernel=result.get("kernel"))
+        harvested = True
+    return harvested
+
+
+def run_sweep(timeout_per_config: float, total_budget: float) -> None:
+    """Fire the resumable device sweep; its own per-config subprocess
+    timeouts bound each config, this outer timeout bounds the lot."""
+    cmd = [sys.executable, "-m", "ceph_tpu.tools.bench_sweep",
+           "--timeout", str(timeout_per_config)]
+    log_event("sweep_start", budget_s=round(total_budget))
+    res = run_bounded(cmd, min(timeout_per_config * 40, total_budget))
+    if res is None:
+        log_event("sweep_timeout")
+        return
+    rc, out, err = res
+    tail = out.strip().splitlines()
+    fields = {"rc": rc, "summary": tail[-1] if tail else ""}
+    if rc != 0:
+        fields["stderr"] = err.strip()[-400:]
+    log_event("sweep_done", **fields)
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=float, default=600.0,
+                   help="seconds between probe starts")
+    p.add_argument("--probe-timeout", type=float, default=300.0)
+    p.add_argument("--harvest-timeout", type=float, default=900.0,
+                   help="per-bench_tpu-invocation hard timeout")
+    p.add_argument("--max-hours", type=float, default=14.0,
+                   help="stop after this long (round-length bound)")
+    p.add_argument("--once", action="store_true",
+                   help="one probe (+ harvest if up), then exit")
+    args = p.parse_args()
+
+    t_end = time.time() + args.max_hours * 3600
+    log_event("watcher_start", interval=args.interval,
+              probe_timeout=args.probe_timeout,
+              max_hours=args.max_hours, pid=os.getpid())
+    n = 0
+    while True:
+        n += 1
+        t0 = time.time()
+        info = probe(args.probe_timeout)
+        if info is None:
+            log_event("probe_down", n=n,
+                      waited_s=round(time.time() - t0, 1))
+        else:
+            log_event("probe_up", probe=n, **info)
+            # every step below is capped by the time left before
+            # --max-hours: a revival in the final interval must not run
+            # hours past the deadline into the next round's watcher
+            remaining = t_end - time.time()
+            harvest_headline(
+                info, min(args.harvest_timeout, max(60.0, remaining)))
+            remaining = t_end - time.time()
+            if remaining > 60:
+                # the sweep's smallest configs move ~100x less data than
+                # the 1 MiB headline — fire it even after a wedged harvest
+                run_sweep(timeout_per_config=600.0,
+                          total_budget=remaining)
+        if args.once:
+            break
+        if time.time() >= t_end:
+            log_event("watcher_end", probes=n)
+            break
+        time.sleep(max(0.0, args.interval - (time.time() - t0)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
