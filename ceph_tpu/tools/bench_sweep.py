@@ -48,7 +48,7 @@ def configs() -> list[dict]:
                      "--stripe-bytes", str(stripe),
                      "--batch", str(batch), "--reps", str(reps),
                      "--technique", technique,
-                     "--workload", workload, "--skip-e2e"]})
+                     "--workload", workload]})
 
     def plugin(cid, name, params, workload="encode", size=8 * MiB,
                iterations=5, erasures=1):
