@@ -16,7 +16,9 @@ import threading
 import time
 
 from ..mon.maps import OSDMap
-from ..msg.messages import (MMapPush, MMonCommand, MMonCommandReply,
+from ..auth.cephx import AuthContext, canonical_command, op_proof
+from ..msg.messages import (MAuth, MAuthReply, MMapPush, MMonCommand,
+                            MMonCommandReply,
                             MMonSubscribe, MOSDOp, MOSDOpReply, MScrubRequest,
                             MScrubResult, PgId, MNotifyAck, MWatchNotify)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
@@ -73,8 +75,19 @@ class Completion:
 class RadosClient(Dispatcher):
     def __init__(self, network: Network, name: str = "client.0",
                  mon: str = "mon.0", timeout: float = 10.0,
-                 mons: list | None = None):
+                 mons: list | None = None,
+                 auth_entity: str | None = None,
+                 auth_key: bytes | None = None):
         self.name = name
+        # cephx identity (CephXTicketManager role): with a key, every
+        # op carries a mon-issued ticket + proof; tickets renew
+        # automatically as they approach expiry
+        self.auth = (AuthContext(auth_entity or name, auth_key)
+                     if auth_key is not None else None)
+        self._auth_ttl = 0.0
+        self._auth_refreshed_at = float("-inf")
+        self._auth_no_caps: set = set()
+        self._auth_lock = threading.Lock()
         self.mons = list(mons) if mons else [mon]
         self.mon = self.mons[0]
         self._mon_idx = 0
@@ -172,7 +185,8 @@ class RadosClient(Dispatcher):
             finally:
                 conn.send(MNotifyAck(msg.notify_id, self.name))
             return True
-        if isinstance(msg, (MOSDOpReply, MMonCommandReply, MScrubResult)):
+        if isinstance(msg, (MOSDOpReply, MMonCommandReply, MScrubResult,
+                            MAuthReply)):
             ev = self._waiters.get(msg.tid)
             if ev is not None:
                 self._replies[msg.tid] = msg
@@ -200,14 +214,90 @@ class RadosClient(Dispatcher):
                 and self.osdmap.epoch > epoch, timeout=timeout)
 
     # ----------------------------------------------------------- mon admin
+    # ------------------------------------------------------------- cephx
+    AUTH_SERVICES = ("mon", "osd", "mds")
+
+    def _auth_refresh(self) -> None:
+        """Fetch fresh service tickets, hunting across monitors: the
+        current mon being dead must not strand a data-only client whose
+        ticket is expiring (any mon serves MAuth)."""
+        with self._auth_lock:
+            last: Exception | None = None
+            for _attempt in range(max(2, len(self.mons))):
+                tid = next(self._tids)
+                nonce, ts_ms, proof = self.auth.build_request(
+                    list(self.AUTH_SERVICES))
+                try:
+                    reply = self._rpc(
+                        self.mon,
+                        MAuth(tid, self.auth.entity,
+                              list(self.AUTH_SERVICES),
+                              nonce, ts_ms, proof),
+                        tid, timeout=min(self.timeout, 3.0))
+                except TimeoutError_ as e:
+                    last = e
+                    self._rotate_mon()
+                    continue
+                if reply.result != 0:
+                    raise RadosError(
+                        reply.result,
+                        f"auth refused for {self.auth.entity}")
+                self._auth_ttl = reply.ttl or 0.0
+                granted = set()
+                for svc, blob, sealed, tnonce in reply.tickets:
+                    self.auth.accept(svc, blob, sealed, tnonce)
+                    granted.add(svc)
+                # services the mon did NOT grant (no caps there, or an
+                # auth-free cluster): remembered so they cost one round
+                # trip per window, not one per op
+                self._auth_no_caps = set(self.AUTH_SERVICES) - granted
+                self._auth_refreshed_at = time.monotonic()
+                return
+            raise last or TimeoutError_("auth refresh")
+
+    def _ticket(self, service: str) -> tuple:
+        """(ticket_blob, session_key); renews through the mon when the
+        cached ticket is missing or nearing expiry.  A (b"", None)
+        return means the entity holds no caps for the service (or the
+        cluster runs auth-free with a keyed client) — the op goes out
+        unticketed and the daemon decides.  A refresh that yields no
+        ticket for the service is remembered briefly so a capless
+        service costs one mon round trip per window, not one per op."""
+        if self.auth.needs_renewal(service, self._auth_ttl or 1.0):
+            if service in self._auth_no_caps and \
+                    time.monotonic() - self._auth_refreshed_at < 30.0:
+                return b"", None  # negative-cached: mon said no caps
+            try:
+                self._auth_refresh()
+            except TimeoutError_:
+                pass  # every mon down; a still-valid ticket may serve
+        return self.auth.ticket_for(service) or (b"", None)
+
+    def service_ticket(self, service: str) -> bytes:
+        """Current ticket blob for a service (renewed through the mon
+        as needed); empty on an auth-free cluster or when the entity
+        holds no caps for the service — the daemon then refuses."""
+        if self.auth is None:
+            return b""
+        blob, _session = self._ticket(service)
+        return blob
+
     def mon_command(self, cmd: dict) -> dict:
         """Send a command; rotate monitors on timeout and retry on a
         no-quorum answer (the MonClient hunt-for-mon behavior)."""
         last: RadosError | None = None
+        auth_retried = False
         for _attempt in range(max(3, 3 * len(self.mons))):
             tid = next(self._tids)
+            msg = MMonCommand(tid, cmd)
+            if self.auth is not None:
+                blob, session = self._ticket("mon")
+                if session is not None:
+                    msg.ticket = blob
+                    msg.proof = op_proof(session, tid,
+                                         canonical_command(cmd))
             try:
-                reply = self._rpc(self.mon, MMonCommand(tid, cmd), tid,
+                reply = self._rpc(self.mon, msg, tid,
                                   timeout=min(self.timeout, 3.0))
             except TimeoutError_ as e:
                 last = e
@@ -217,6 +307,14 @@ class RadosClient(Dispatcher):
                 last = RadosError(-11, str(reply.data))
                 time.sleep(0.2)
                 self._rotate_mon()
+                continue
+            if reply.result == -13 and self.auth is not None \
+                    and not auth_retried:
+                # ticket may have expired mid-flight (or rotation edge):
+                # force one renewal, then retry once
+                auth_retried = True
+                self.auth.tickets.pop("mon", None)
+                last = RadosError(-13, str(reply.data))
                 continue
             if reply.result != 0:
                 raise RadosError(reply.result, str(reply.data))
@@ -276,6 +374,7 @@ class RadosClient(Dispatcher):
     def _op_attempts(self, pool_id, pool_name, oid, op, data,
                      offset, length, snapid, root):
         last_error: RadosError | None = None
+        auth_retried = False
         for attempt in range(12):
             target = self._primary_for(pool_id, oid)
             tid = next(self._tids)
@@ -285,6 +384,12 @@ class RadosClient(Dispatcher):
             if op in self._WRITE_OPS:
                 seq, snaps = self._snapc.get(pool_id, (0, []))
                 m.snap_seq, m.snaps = seq, list(snaps)
+            if self.auth is not None:
+                blob, session = self._ticket("osd")
+                if session is not None:
+                    m.ticket = blob
+                    m.proof = op_proof(session, m.tid, m.pool, m.oid,
+                                       m.op, m.offset, m.length, m.data)
             try:
                 reply = self._rpc(target, m, tid)
             except TimeoutError_ as e:
@@ -306,6 +411,14 @@ class RadosClient(Dispatcher):
                     # the OSD is the stale one; give its map time to arrive
                     time.sleep(0.05 * (attempt + 1))
                 last_error = RadosError(-116, "stale map")
+                continue
+            if reply.result == -13 and self.auth is not None \
+                    and not auth_retried:
+                # expiry/rotation edge: drop the cached ticket, renew
+                # via _ticket on the retry, refuse again -> EACCES out
+                auth_retried = True
+                self.auth.tickets.pop("osd", None)
+                last_error = RadosError(-13, f"{op} {pool_name}/{oid}")
                 continue
             if reply.result < 0:
                 raise RadosError(reply.result, f"{op} {pool_name}/{oid}")

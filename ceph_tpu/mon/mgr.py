@@ -185,6 +185,79 @@ class BalancerModule(MgrModule):
             self._optimize()
 
 
+@register_module("pg_autoscaler")
+class PgAutoscalerModule(MgrModule):
+    """pg_autoscaler role (src/pybind/mgr/pg_autoscaler/): watch
+    per-pool object counts from the OSD stats reports and grow a
+    pool's pg_num when it outgrows its placement granularity.  The
+    proposal is the smallest power-of-two multiple of the current
+    pg_num that brings logical objects-per-PG back under the target;
+    `status` lists proposals, `on` applies them each tick through the
+    `osd pool set-pg-num` split verb."""
+
+    TICK_EVERY = 5.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self.active = False
+        cfg = mgr.mon.cfg
+        self.target = int(cfg["mgr_autoscaler_objects_per_pg"])
+        self.max_pg_num = int(cfg["mgr_autoscaler_max_pg_num"])
+        self.last: list | None = None
+
+    def _proposals(self) -> list[dict]:
+        per_pool: dict[int, int] = {}
+        for s in self.get_osd_stats().values():
+            for pid, n in (s.get("pool_objects") or {}).items():
+                pid = int(pid)
+                per_pool[pid] = per_pool.get(pid, 0) + int(n)
+        out = []
+        for pool_id, pool in sorted(self.get_osdmap().pools.items()):
+            # raw counts tally every replica/EC shard/clone: normalize
+            # by pool width for a logical-object estimate
+            logical = per_pool.get(pool_id, 0) / max(pool.size, 1)
+            per_pg = logical / max(pool.pg_num, 1)
+            if per_pg <= self.target:
+                continue
+            new = pool.pg_num
+            # the cap is checked on the NEXT doubling, so a proposal
+            # can never exceed max_pg_num
+            while new * 2 <= self.max_pg_num \
+                    and logical / new > self.target:
+                new *= 2
+            if new == pool.pg_num:
+                continue  # already at (or doubling would pass) the cap
+            out.append({"pool": pool.name, "pg_num": pool.pg_num,
+                        "proposed": new,
+                        "objects_per_pg": round(per_pg, 1),
+                        "target": self.target})
+        return out
+
+    def command(self, cmd: str, **kw):
+        if cmd == "on":
+            self.active = True
+            return {"active": True}
+        if cmd == "off":
+            self.active = False
+            return {"active": False}
+        if cmd == "status":
+            return {"active": self.active,
+                    "proposals": self._proposals(), "last": self.last}
+        raise KeyError(cmd)
+
+    def tick(self) -> None:
+        if not self.active:
+            return
+        applied = []
+        for p in self._proposals():
+            reply = self.mon_command({"prefix": "osd pool set-pg-num",
+                                      "pool": p["pool"],
+                                      "pg_num": p["proposed"]})
+            applied.append({**p, "result": reply})
+        if applied:
+            self.last = applied
+
+
 @register_module("dashboard")
 class DashboardModule(MgrModule):
     """HTTP overview (pybind/mgr/dashboard monitoring slice): an HTML

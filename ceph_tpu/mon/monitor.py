@@ -29,6 +29,7 @@ replication Paxos.cc, Elector.cc leader election, forwarded requests):
 
 from __future__ import annotations
 
+import hmac as _hmac
 import os
 import queue
 import struct
@@ -36,9 +37,12 @@ import threading
 import time
 
 from .. import ec
-from ..msg.messages import (MFailureReport, MMapPush, MMonClaim,
-                            MMonCommand, MMonCommandReply, MMonElect,
-                            MMonForward, MMonFwdReply, MMonPing,
+from ..auth.caps import CapsError
+from ..auth.cephx import (ServiceVerifier, canonical_command as
+                          _canonical_cmd, op_proof)
+from ..msg.messages import (MAuth, MAuthReply, MFailureReport, MMapPush,
+                            MMonClaim, MMonCommand, MMonCommandReply,
+                            MMonElect, MMonForward, MMonFwdReply, MMonPing,
                             MMonPropAck, MMonPropose, MMonSubscribe,
                             MMonSyncEntries, MMonSyncReq, MMonVote,
                             MOSDBoot, MOSDPGTemp, MStatsReport)
@@ -429,7 +433,8 @@ class _RelayConn:
 class MonitorLite(Dispatcher):
     def __init__(self, network: Network, name: str = "mon.0",
                  cfg: Config | None = None,
-                 peers: tuple | list = (), path: str | None = None):
+                 peers: tuple | list = (), path: str | None = None,
+                 key_server=None):
         self.name = name
         self.cfg = cfg or default_config()
         self.peers = [p for p in peers if p != name]
@@ -440,6 +445,18 @@ class MonitorLite(Dispatcher):
         self.osdmap = OSDMap()
         if self.store.kv.get("osdmap"):
             self.osdmap = OSDMap.decode_bytes(self.store.kv["osdmap"])
+        # AuthMonitor role: per-entity keys + caps, replicated through
+        # the paxos store under "authdb"; None = authorization off.
+        # The durable kv wins over the constructor seed — entities
+        # added by `auth` commands must survive a mon restart.
+        self.key_server = key_server
+        self._mon_verifier = None
+        if key_server is not None:
+            if self.store.kv.get("authdb"):
+                key_server.load_db(self.store.kv["authdb"])
+            self._mon_verifier = ServiceVerifier(
+                "mon", key_server.service_secrets["mon"],
+                key_server.rotation, key_server.clock)
         self._subscribers: set[str] = set()
         # incremental distribution: snapshot of the map as of the last
         # commit (diff base) + a ring of recent incrementals keyed by
@@ -507,6 +524,7 @@ class MonitorLite(Dispatcher):
             MMonSyncEntries: self._handle_sync_entries,
             MMonForward: self._handle_forward,
             MMonFwdReply: self._handle_fwd_reply,
+            MAuth: self._handle_auth,
         }
 
     # ------------------------------------------------------------ lifecycle
@@ -985,6 +1003,8 @@ class MonitorLite(Dispatcher):
                 push = MMapPush(self.osdmap.epoch, value)
                 for sub in list(self._subscribers):
                     self._post(sub, push)
+            elif key == "authdb" and self.key_server is not None:
+                self.key_server.load_db(value)
 
     def _handle_sync_req(self, conn, m: MMonSyncReq) -> None:
         if not self.is_leader:
@@ -1019,6 +1039,9 @@ class MonitorLite(Dispatcher):
                 # subscriber's map permanently
                 self._inc_ring.clear()
                 self.store.reset_to(m.snap_version, m.snap_kv)
+                if self.key_server is not None and \
+                        self.store.kv.get("authdb"):
+                    self.key_server.load_db(self.store.kv["authdb"])
                 if self.store.kv.get("osdmap"):
                     self.osdmap = OSDMap.decode_bytes(
                         self.store.kv["osdmap"])
@@ -1052,6 +1075,8 @@ class MonitorLite(Dispatcher):
             push = MMapPush(self.osdmap.epoch, value)
             for sub in list(self._subscribers):
                 self._post(sub, push)
+        elif key == "authdb" and self.key_server is not None:
+            self.key_server.load_db(value)
 
     # ------------------------------------------------------------ map flow
     INC_RING_KEEP = 128
@@ -1277,11 +1302,85 @@ class MonitorLite(Dispatcher):
                     f"osd.{m.target} down ({distinct} reporters)")
 
     # ------------------------------------------------------------- commands
+    # mon cap classification: read-only verbs need r, auth-database
+    # verbs need full caps (MonCap "allow *" semantics), every other
+    # mutation needs w
+    _READONLY_CMDS = frozenset({"status", "osd dump", "osd stats",
+                                "auth list"})
+
+    def _mon_cmd_denied(self, m: MMonCommand):
+        """(errno, detail) if the command must be refused, else None.
+        Verifies the mon-service ticket, the per-command proof, and the
+        entity's mon caps (MonCap::is_capable role)."""
+        vt = self._mon_verifier.verify(m.ticket)
+        if vt is None:
+            return -13, {"error": "access denied: no/invalid/expired "
+                                  "mon ticket"}
+        want = op_proof(vt.session_key, m.tid, _canonical_cmd(m.cmd))
+        if not _hmac.compare_digest(want, m.proof):
+            return -13, {"error": "access denied: bad command proof"}
+        prefix = str(m.cmd.get("prefix", ""))
+        if prefix in self._READONLY_CMDS:
+            need = "r"
+        elif prefix.startswith("auth"):
+            need = "rwx"
+        else:
+            need = "w"
+        if not vt.caps.allows(need):
+            return -13, {"error": f"access denied: {vt.entity} lacks "
+                                  f"mon caps {need!r}"}
+        return None
+
+    def _handle_auth(self, conn, m: MAuth) -> None:
+        """Ticket mint (AuthMonitor::prep_auth role).  Any mon serves —
+        issuance reads the replicated entity table and mutates
+        nothing."""
+        if self.key_server is None:
+            conn.send(MAuthReply(m.tid, 0))
+            return
+        ks = self.key_server
+        with self._lock:
+            ok = ks.verify_request(m.entity, m.nonce, m.ts_ms,
+                                   list(m.services), m.proof)
+            tickets = []
+            if ok:
+                for svc in m.services:
+                    out = ks.issue(m.entity, svc)
+                    if out is not None:
+                        blob, sealed, nonce = out
+                        tickets.append((svc, blob, sealed, nonce))
+        if not ok:
+            dout("mon", 2)("%s: auth request for %r REFUSED", self.name,
+                           m.entity)
+            conn.send(MAuthReply(m.tid, -13))
+            return
+        conn.send(MAuthReply(m.tid, 0, tickets, ks.ttl))
+
+    def _commit_auth(self, desc: str) -> None:
+        """Stage the entity table under the same accept/commit quorum
+        as the osdmap (caller holds _lock; leader only)."""
+        raw = self.key_server.encode_db()
+        if not self.peers:
+            self.store.commit("authdb", raw, desc)
+            return
+        v = self.store.accepted_version + 1
+        self.store.accept_at(v, self._term, "authdb", raw, desc)
+        self._pending_acks[v] = {self.name}
+        prop = MMonPropose(self._term, v, "authdb", raw, desc,
+                           pterm=self._term, commit=self.store.version)
+        for p in self.peers:
+            self._post(p, prop)
+
     def _handle_command(self, conn, m: MMonCommand) -> None:
         if not self.is_leader:
             # reachable on a mid-election mon addressed directly
             conn.send(MMonCommandReply(m.tid, -11, {"error": "not leader"}))
             return
+        if self._mon_verifier is not None:
+            denied = self._mon_cmd_denied(m)
+            if denied is not None:
+                conn.send(MMonCommandReply(m.tid, denied[0], denied[1]))
+                return
         with self._lock:
             pre = self.store.accepted_version
             try:
@@ -1388,6 +1487,31 @@ class MonitorLite(Dispatcher):
                 info.primary_affinity = aff
                 self._commit_map(f"osd.{target} primary-affinity {aff}")
             return 0, {}
+        if prefix == "osd pool set-pg-num":
+            # live PG split (pg_num scaling — OSD::split_pgs role, ref
+            # src/osd/OSD.h:1999 + pg-split math in src/osd/OSDMap.cc).
+            # Growth only, and only to a multiple of the current pg_num:
+            # with modulo placement that makes every object's new seed a
+            # deterministic child of its old one (the stable-mod split),
+            # so holders split locally and recovery moves the rest.
+            with self._lock:
+                pool = self._pool_by_name(cmd["pool"])
+                if pool is None:
+                    return -2, {"error": f"no pool {cmd['pool']!r}"}
+                new = int(cmd["pg_num"])
+                if new == pool.pg_num:
+                    return 0, {"pg_num": new}
+                if new < pool.pg_num:
+                    return -22, {"error": "pg_num can only grow "
+                                          "(merge unsupported)"}
+                if new % pool.pg_num:
+                    return -22, {"error": f"pg_num {new} must be a "
+                                          f"multiple of {pool.pg_num}"}
+                old_num = pool.pg_num
+                pool.pg_num = new
+                self._commit_map(
+                    f"pool {pool.name} pg_num {old_num} -> {new}")
+            return 0, {"pg_num": new}
         if prefix == "osd pool selfmanaged-snap-create":
             # mint a pool-unique snap id (pg_pool_t::snap_seq role)
             with self._lock:
@@ -1437,6 +1561,54 @@ class MonitorLite(Dispatcher):
         if prefix == "osd stats":
             return 0, {f"osd.{i}": dict(s)
                        for i, s in sorted(self._osd_stats.items())}
+        if prefix.startswith("auth"):
+            return self._auth_command(prefix, cmd)
+        return -22, {"error": f"unknown command {prefix!r}"}
+
+    def _auth_command(self, prefix: str, cmd: dict):
+        """The `ceph auth ...` verb family (AuthMonitor command role).
+        Mutations replicate the whole entity table under "authdb"."""
+        ks = self.key_server
+        if ks is None:
+            return -95, {"error": "authorization disabled on this "
+                                  "cluster"}
+        if prefix == "auth list":
+            with self._lock:
+                return 0, {"entities": ks.list_entities()}
+        if prefix == "auth get-or-create":
+            name = str(cmd["entity"])
+            caps = {str(k): str(v)
+                    for k, v in (cmd.get("caps") or {}).items()}
+            with self._lock:
+                existed = name in ks.entities
+                try:
+                    key = ks.get_or_create(name, caps or None)
+                except CapsError as e:
+                    return -22, {"error": str(e)}
+                if caps or not existed:
+                    self._commit_auth(f"auth get-or-create {name}")
+                return 0, {"entity": name, "key": key.hex(),
+                           "caps": dict(ks.entities[name]["caps"])}
+        if prefix == "auth caps":
+            name = str(cmd["entity"])
+            caps = {str(k): str(v)
+                    for k, v in (cmd.get("caps") or {}).items()}
+            with self._lock:
+                if name not in ks.entities:
+                    return -2, {"error": f"no entity {name!r}"}
+                try:
+                    ks.add(name, caps)
+                except CapsError as e:
+                    return -22, {"error": str(e)}
+                self._commit_auth(f"auth caps {name}")
+                return 0, {"entity": name, "caps": caps}
+        if prefix == "auth del":
+            name = str(cmd["entity"])
+            with self._lock:
+                if not ks.remove(name):
+                    return -2, {"error": f"no entity {name!r}"}
+                self._commit_auth(f"auth del {name}")
+                return 0, {}
         return -22, {"error": f"unknown command {prefix!r}"}
 
     def _balancer_optimize(self, max_moves: int = 10):

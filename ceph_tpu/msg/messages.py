@@ -49,8 +49,12 @@ class MOSDOp(Encodable):
     # v3 tail: trace context (trace_id, span_id) — the tracer.h span
     # propagation role; empty = tracing off for this op
     trace: tuple = ()
+    # v4 tail: cephx ticket + per-op proof (MOSDOp session auth role);
+    # empty = cluster runs without authorization
+    ticket: bytes = b""
+    proof: bytes = b""
 
-    VERSION, COMPAT = 3, 1
+    VERSION, COMPAT = 4, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
@@ -60,6 +64,7 @@ class MOSDOp(Encodable):
             e.u64(self.snapid); e.u64(self.snap_seq)   # v2 tail
             e.seq(self.snaps, Encoder.u64)
             e.seq(list(self.trace), Encoder.u64)       # v3 tail
+            e.blob(self.ticket); e.blob(self.proof)    # v4 tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -73,6 +78,9 @@ class MOSDOp(Encodable):
                 m.snaps = d.seq(Decoder.u64)
             if v >= 3:
                 m.trace = tuple(d.seq(Decoder.u64))
+            if v >= 4:
+                m.ticket = d.blob()
+                m.proof = d.blob()
             return m
         return dec.versioned(cls.VERSION, body)
 
@@ -270,6 +278,10 @@ class MOSDBoot:
 class MMonCommand:
     tid: int
     cmd: dict
+    # cephx mon-service ticket + proof over (tid, canonical cmd);
+    # empty = cluster runs without authorization
+    ticket: bytes = b""
+    proof: bytes = b""
 
 
 @dataclass
@@ -277,6 +289,32 @@ class MMonCommandReply:
     tid: int
     result: int
     data: dict = field(default_factory=dict)
+
+
+# ------------------------------------------------------------------- cephx
+@dataclass
+class MAuth:
+    """Client -> mon: prove knowledge of the entity key, get service
+    tickets (the CEPHX_GET_AUTH_SESSION_KEY request role).  One round
+    trip: `proof` is an HMAC under the entity key over (entity, nonce,
+    ts_ms, services); replay is harmless because the reply's session
+    keys are sealed under the entity key."""
+
+    tid: int
+    entity: str
+    services: list
+    nonce: bytes
+    ts_ms: int
+    proof: bytes
+
+
+@dataclass
+class MAuthReply:
+    tid: int
+    result: int  # 0 ok, -13 EACCES
+    # list of (service, ticket_blob, sealed_session_key, nonce)
+    tickets: list = field(default_factory=list)
+    ttl: float = 0.0
 
 
 # --------------------------------------------------------- peering/recovery

@@ -120,6 +120,7 @@ MESSAGE_TYPES: list[type] = [
     M.MWatchNotify, M.MNotifyAck,                                 # 37-38
     M.MOSDPGTemp,                                                 # 39
     M.MRecoveryReserve,                                           # 40
+    M.MAuth, M.MAuthReply,                                        # 41-42
 ]
 _TYPE_IDS = {t: i + 1 for i, t in enumerate(MESSAGE_TYPES)}
 _ID_TYPES = {i: t for t, i in _TYPE_IDS.items()}

@@ -58,14 +58,14 @@ from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
 from .extent_cache import ECExtentCache
-from .intervals import INTERVALS_KEY, LES_KEY, PastIntervals
+from .intervals import INTERVALS_KEY, Interval, LES_KEY, PastIntervals
 from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scheduler import ClassParams, ShardedScheduler
 from .scrub import FaultInjection, ScrubMixin
 from .snaps import SnapMixin, split_vname, to_oid, vname, vname_of
 
-EIO, ENOENT, ESTALE, EAGAIN, EINVAL = -5, -2, -116, -11, -22
+EIO, ENOENT, ESTALE, EAGAIN, EINVAL, EACCES = -5, -2, -116, -11, -22, -13
 
 
 @dataclass
@@ -134,8 +134,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def __init__(self, osd_id: int, network: Network,
                  mon: str = "mon.0", store: ObjectStore | None = None,
                  cfg: Config | None = None, host: str | None = None,
-                 mons: list | None = None):
+                 mons: list | None = None, auth=None):
         self.osd_id = osd_id
+        # cephx gate (OSD::ms_verify_authorizer + OSDCap enforcement
+        # role): a ServiceVerifier for the "osd" service, or None for
+        # an authorization-free cluster
+        self.auth = auth
         self.name = f"osd.{osd_id}"
         self.host = host or f"host{osd_id}"
         self._mons = list(mons) if mons else [mon]
@@ -199,6 +203,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # the primary knows it is behind on stay blocked until pulled
         self._peering: dict[PgId, set[int]] = {}
         self._stale_objects: dict[PgId, dict[str, int]] = {}
+        # freshly-split PGs (parents and children): their members share
+        # the parent's last-complete, so the LEAN peering path would
+        # skip the inventory exchange that redistributes shards — force
+        # full inventories until one round closes clean
+        self._split_fresh: set[PgId] = set()
         # per-object write serialization for multi-phase EC ops (the obc
         # lock / ECExtentCache ordering role): queued thunks per key
         self._obj_locks: dict[tuple, object] = {}
@@ -462,6 +471,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._ensure_collections()
         self._reservation_map_change(newmap)
         if old is None or newmap.epoch > old.epoch:
+            self._split_pgs(old, newmap)
             self._note_intervals()
             self._start_recovery()
             self._notify_demoted(old)
@@ -499,7 +509,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             up = self.osdmap.pg_to_up_osds(cid.pool, cid.pg_seed)
             if self.osd_id in [u for u in up if u is not None]:
                 continue
-            if old is not None and cid.pool in old.pools:
+            if old is not None and cid.pool in old.pools \
+                    and cid.pg_seed < old.pools[cid.pool].pg_num:
+                # (a just-split child seed did not EXIST in the old map:
+                # its up set is computable but meaningless — fall
+                # through and notify the child primary of our shards)
                 old_up = old.pg_to_up_osds(cid.pool, cid.pg_seed)
                 if self.osd_id not in [u for u in old_up if u is not None]:
                     continue  # was not a member before either: no change
@@ -544,6 +558,37 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         return None
 
     # ----------------------------------------------------------- client ops
+    # op -> required cap bits (OSDCap semantics: r read, w mutate,
+    # x object-class execution)
+    _READ_OPS = frozenset({"read", "stat", "omap_get", "list_snaps",
+                           "multi_read", "getxattrs"})
+    _EXEC_OPS = frozenset({"call"})
+
+    def _auth_denied(self, m: MOSDOp, pool_name: str) -> str | None:
+        """Why this op must be refused (None = authorized).  Ticket
+        signature/expiry, per-op proof under the ticket's session key,
+        then the entity's caps against the pool."""
+        import hmac as _hmac
+
+        from ..auth.cephx import op_proof
+        vt = self.auth.verify(m.ticket)
+        if vt is None:
+            return "no/invalid/expired osd ticket"
+        want = op_proof(vt.session_key, m.tid, m.pool, m.oid, m.op,
+                        m.offset, m.length, m.data)
+        if not _hmac.compare_digest(want, m.proof):
+            return "bad op proof"
+        if m.op in self._READ_OPS:
+            need = "r"
+        elif m.op in self._EXEC_OPS:
+            need = "x"
+        else:
+            need = "w"
+        if not vt.caps.allows(need, pool=pool_name):
+            return (f"entity {vt.entity} lacks caps {need!r} on pool "
+                    f"{pool_name}")
+        return None
+
     def _handle_client_op(self, conn, m: MOSDOp) -> None:
         if self.osdmap is None or m.pool not in self.osdmap.pools:
             # the client's map may be AHEAD of ours (pool just created,
@@ -554,6 +599,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             conn.send(MOSDOpReply(m.tid, err, epoch=my_epoch))
             return
         pool = self.osdmap.pools[m.pool]
+        if self.auth is not None:
+            why = self._auth_denied(m, pool.name)
+            if why is not None:
+                dout("osd", 2)("osd.%d: op %s from %s DENIED: %s",
+                               self.osd_id, m.op, m.client, why)
+                conn.send(MOSDOpReply(m.tid, EACCES,
+                                      epoch=self.osdmap.epoch))
+                return
         seed = self.osdmap.object_to_pg(m.pool, m.oid)
         up = self.osdmap.pg_to_up_osds(m.pool, seed)
         if self._primary_of(up) != self.osd_id:
@@ -973,6 +1026,158 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         pi = self._pi(pgid)
         pi.trim_to(les)
         self._save_pi(pgid)
+
+    # ------------------------------------------------------------ pg split
+    def _split_pgs(self, old: OSDMap | None, new: OSDMap) -> None:
+        """A pool's pg_num grew: rehash every LOCAL parent collection
+        into its children (OSD::split_pgs role, ref src/osd/OSD.h:1999
+        + the OSDMap stable-mod split math in src/osd/OSDMap.cc).
+
+        With modulo placement and new_pg_num a multiple of old_pg_num,
+        an object at parent seed s moves to exactly one child seed in
+        {s + k*old_pg_num} — so each holder of the parent can split
+        LOCALLY, with no cross-daemon traffic.  Children inherit the
+        parent's PGLog subsequence (entries for their objects), its
+        last-complete point, its les fence and its PastIntervals
+        (PGLog::split_into semantics): the child primaries then peer
+        against the parent's membership history and the normal
+        recovery/notify machinery moves shards to their CRUSH homes."""
+        if old is None:
+            return
+        for pool_id, pool in new.pools.items():
+            oldp = old.pools.get(pool_id)
+            if oldp is None or pool.pg_num <= oldp.pg_num:
+                continue
+            oldn = oldp.pg_num
+            for cid in list(self.store.list_collections()):
+                if cid.pool != pool_id or cid.pg_seed >= oldn:
+                    continue
+                self._split_collection(pool_id, cid.pg_seed, oldn,
+                                       pool.pg_num)
+            # Force the next peering round of every PG of the grown
+            # pool I lead to exchange FULL inventories: split members
+            # inherit the parent's last-complete, so the lean path
+            # would hide the shard redistribution entirely.
+            for seed in range(pool.pg_num):
+                up_s = new.pg_to_up_osds(pool_id, seed)
+                if self._primary_of(up_s) == self.osd_id:
+                    self._split_fresh.add(PgId(pool_id, seed))
+            # Seed every NEW child PG I am an up member of with the
+            # parent's membership as a maybe-active closed interval —
+            # even when I never held the parent.  Without this a child
+            # primary landing on a fresh OSD has an empty prior set,
+            # peers trivially against nothing, and serves ENOENT while
+            # the parent's holders still carry the objects.
+            for child_seed in range(oldn, pool.pg_num):
+                child_up = new.pg_to_up_osds(pool_id, child_seed)
+                if self.osd_id not in [u for u in child_up
+                                       if u is not None]:
+                    continue
+                parent_seed = child_seed % oldn
+                parent_up = old.pg_to_up_osds(pool_id, parent_seed)
+                child_pg = PgId(pool_id, child_seed)
+                pi = self._pi(child_pg)
+                first = min(old.epoch, new.epoch - 1)
+                if not any(i.first == first and i.up == list(parent_up)
+                           for i in pi.intervals):
+                    pi.intervals.insert(0, Interval(
+                        first, new.epoch - 1, list(parent_up),
+                        self._primary_of(parent_up)))
+                    self._save_pi(child_pg)
+
+    def _split_collection(self, pool_id: int, parent_seed: int,
+                          oldn: int, newn: int) -> None:
+        from ..parallel.placement import pg_of_object
+        from .snaps import split_vname
+        parent_pg = PgId(pool_id, parent_seed)
+        parent_cid = CollectionId(pool_id, parent_seed)
+        # objects that re-hash away from the parent, grouped by child
+        moves: dict[int, list[ObjectId]] = {}
+        try:
+            oids = list(self.store.list_objects(parent_cid))
+        except Exception:  # noqa: BLE001 - collection vanished
+            return
+        for oid in oids:
+            if oid.shard <= -2:
+                continue  # PG metadata (pglog/snapmapper) stays put
+            seed = pg_of_object(oid.name, newn)
+            if seed != parent_seed:
+                moves.setdefault(seed, []).append(oid)
+        if not moves:
+            return
+        dout("osd", 2)("osd.%d: splitting pg %s: %d objects -> %s",
+                       self.osd_id,
+                       parent_pg,
+                       sum(len(v) for v in moves.values()),
+                       sorted(moves))
+        parent_log = self._pglog(parent_pg).entries()
+        parent_lc = self._lc(parent_pg)
+        parent_les = self._les(parent_pg)
+        parent_pi_raw = self._pi(parent_pg).encode_bytes()
+        parent_tomb = self._tombstones.get(parent_pg, {})
+        have = set(self.store.list_collections())
+        moved_versions = []
+        for child_seed, oids in sorted(moves.items()):
+            child_pg = PgId(pool_id, child_seed)
+            child_cid = CollectionId(pool_id, child_seed)
+            tx = Transaction()
+            if child_cid not in have:
+                tx.create_collection(child_cid)
+                have.add(child_cid)
+            for oid in oids:
+                data = self.store.read(parent_cid, oid)
+                tx.touch(child_cid, oid)
+                if data:
+                    tx.write(child_cid, oid, 0, data)
+                attrs = self.store.getattrs(parent_cid, oid)
+                if attrs:
+                    tx.setattrs(child_cid, oid, dict(attrs))
+                omap = self.store.omap_get(parent_cid, oid)
+                if omap:
+                    tx.omap_setkeys(child_cid, oid, dict(omap))
+                tx.remove(parent_cid, oid)
+            # the child's slice of the parent's log (split_into): the
+            # version numbering keeps the parent's sequence (gaps are
+            # fine — peering falls back to inventories across gaps)
+            child_log = self._pglog(child_pg)
+            for e in parent_log:
+                if pg_of_object(split_vname(e.oid)[0], newn) \
+                        == child_seed:
+                    child_log.append_to(tx, e)
+                    moved_versions.append(e.version)
+            meta = {"_lc": parent_lc.to_bytes(8, "little"),
+                    LES_KEY: parent_les.to_bytes(8, "little"),
+                    INTERVALS_KEY: parent_pi_raw}
+            if not self.store.exists(child_cid, PGLOG_OID):
+                tx.touch(child_cid, PGLOG_OID)
+            tx.omap_setkeys(child_cid, PGLOG_OID, meta)
+            self.store.queue_transaction(tx)
+            # in-memory state for the child: fresh decodes + filtered
+            # tombstones (cheap; loaded lazily elsewhere anyway)
+            self._pglogs.pop(child_pg, None)
+            self._pg_lc[child_pg] = parent_lc
+            self._pg_les[child_pg] = parent_les
+            self._past_intervals.pop(child_pg, None)
+            tomb = {k: v for k, v in parent_tomb.items()
+                    if pg_of_object(split_vname(k[0])[0], newn)
+                    == child_seed}
+            if tomb:
+                self._tombstones[child_pg] = tomb
+        # rewrite the parent: drop moved log entries + tombstones so a
+        # later delta-replay cannot resurrect moved objects here
+        if moved_versions:
+            tx = Transaction()
+            from .pglog import _key as _log_key
+            tx.omap_rmkeys(parent_cid, PGLOG_OID,
+                           [_log_key(v) for v in moved_versions])
+            self.store.queue_transaction(tx)
+            self._pglogs.pop(parent_pg, None)
+        if parent_tomb:
+            keep = {k: v for k, v in parent_tomb.items()
+                    if pg_of_object(split_vname(k[0])[0], newn)
+                    == parent_seed}
+            self._tombstones[parent_pg] = keep
+        self._ec_cache.invalidate(parent_pg)
 
     def _note_intervals(self) -> None:
         """Record membership changes for every PG I host or hold data
@@ -2206,6 +2411,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         The store walk is time-budgeted; a partial walk reports what it
         covered with partial=True rather than stalling heartbeats."""
         objects = nbytes = pgs = 0
+        pool_objects: dict[int, int] = {}  # autoscaler input (per pool)
         partial = False
         t0 = time.monotonic()
         for cid in self.store.list_collections():
@@ -2214,6 +2420,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 try:
                     nbytes += self.store.stat(cid, oid)["size"]
                     objects += 1
+                    if oid.shard > -2:  # user data, not PG meta
+                        pool_objects[cid.pool] = \
+                            pool_objects.get(cid.pool, 0) + 1
                 except Exception:  # noqa: BLE001 - deleted under our feet
                     continue
             if time.monotonic() - t0 > budget:
@@ -2224,6 +2433,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MStatsReport(self.osd_id,
                          self.osdmap.epoch if self.osdmap else 0,
                          {"pgs": pgs, "objects": objects, "bytes": nbytes,
+                          "pool_objects": pool_objects,
                           "partial": partial,
                           "op_w": self.perf.get("op_w"),
                           "op_r": self.perf.get("op_r"),
@@ -2471,7 +2681,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     f"osd.{osd}",
                     MPGQuery(pgid, self.osdmap.epoch,
                              primary_last=last,
-                             primary_floor=floor_v))
+                             primary_floor=floor_v,
+                             force_full=pgid in self._split_fresh))
             # also reconcile my own shard inventory immediately
             self._handle_pg_info(None, self._my_pg_info(pgid))
 
@@ -2500,6 +2711,30 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         return out
 
     def _handle_pg_query(self, conn, m: MPGQuery) -> None:
+        if self.osdmap is not None and m.epoch > self.osdmap.epoch \
+                and not self._stop.is_set() \
+                and getattr(m, "_defers", 0) < 40:
+            # The primary peers at an epoch I have not applied yet — my
+            # inventory may be PRE-SPLIT (the child collection does not
+            # exist until the map lands), and an empty answer would
+            # close the primary's round as "peer holds nothing".  Ask
+            # the mon for the map (once) and defer the answer until it
+            # lands.  Bounded: after ~4s of deferral answer with what I
+            # have — the primary's requery machinery reconciles later,
+            # and an unreachable mon must not spin timers forever.
+            if not getattr(m, "_defers", 0):
+                self.messenger.send_message(
+                    self.mon, MMonSubscribe("osdmap",
+                                            have_epoch=self.osdmap.epoch))
+            m._defers = getattr(m, "_defers", 0) + 1
+
+            def retry(conn=conn, m=m):
+                if not self._stop.is_set():
+                    self._handle_pg_query(conn, m)
+            t = threading.Timer(0.1, retry)
+            t.daemon = True
+            t.start()
+            return
         # ONE log decode feeds head/floor/evs (the peering hot path —
         # every query/info otherwise re-reads the whole omap window)
         ents = self._pglog(m.pgid).entries()
@@ -2755,6 +2990,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         for (name, _s), v in peer_inv.items():
             if v > my_best.get(name, -1) and dead.get(name, -1) < v:
                 stale[name] = max(stale.get(name, 0), v)
+        if done_peering:
+            # one full post-split round has closed: lean peering is
+            # trustworthy again
+            self._split_fresh.discard(m.pgid)
         if (done_peering or fence_done) and not stale:
             # every member (incl. prior-interval holders) answered a
             # round that closed with no fork and nothing known-missing:
@@ -3302,13 +3541,30 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                 omap, extra)},
                         force=force))
 
+        # shard -> source OSD: the position holder when it (plausibly)
+        # has the shard, else ANY holder the collected inventories
+        # revealed — after a PG split the shards sit on strays and
+        # wrong positions until recovery completes, and a purely
+        # positional fan-out would never gather k survivors.
+        invs = dict(self._peer_invs.get(pgid, {}))
+        invs[self.osd_id] = self._inventory(pgid)
+        fan = []
+        for s, u in enumerate(up):
+            src = None
+            if u is not None and u != peer and \
+                    (u not in invs or (name, s) in invs[u]):
+                src = u
+            if src is None:
+                src = next((osd_id for osd_id, inv in invs.items()
+                            if osd_id != peer and (name, s) in inv),
+                           None)
+            fan.append(src)
         pr = _PendingRead(None, 0, pgid.pool, name,
-                          total_shards=sum(1 for u in up
-                                           if u is not None and u != peer),
+                          total_shards=sum(1 for u in fan
+                                           if u is not None),
                           on_done=on_done)
         self._pending_reads[tid] = pr
-        fan_up = [None if u == peer else u for u in up]
-        self._fan_shard_reads(tid, pgid, name, fan_up)
+        self._fan_shard_reads(tid, pgid, name, fan)
 
     def _ec_meta_for(self, pgid: PgId, name: str):
         """(omap, user attrs) from MY shard copy of an EC object —

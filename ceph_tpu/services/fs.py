@@ -105,7 +105,8 @@ class FsFile:
         if self._size != ent.get("size"):
             ent["size"] = max(int(ent.get("size", 0)), self._size)
             ent["mtime"] = time.time()
-            self._fs.mds.set_entry(self.path, ent)
+            self._fs.mds.set_entry(self.path, ent,
+                                   client_id=self._fs.client_id)
         self._size = ent["size"]
 
     def close(self) -> None:
@@ -146,7 +147,10 @@ class FsClient:
         self.client_id = client_id or f"fsclient-{uuid.uuid4().hex[:8]}"
         self._handles: dict[str, list[FsFile]] = {}
         self._hlock = threading.Lock()
-        self.mds.register_session(self.client_id, self._on_revoke)
+        self.mds.register_session(
+            self.client_id, self._on_revoke,
+            ticket=client.service_ticket("mds"),
+            ticket_provider=lambda: client.service_ticket("mds"))
 
     def unmount(self) -> None:
         with self._hlock:
@@ -249,13 +253,13 @@ class FsClient:
                 for h in list(hs):
                     if not h.closed:
                         h.flush()
-        return self.mds.snap_create(path, name)
+        return self.mds.snap_create(path, name, client_id=self.client_id)
 
     def snap_list(self, path: str) -> dict:
         return self.mds.snaps_of(path)
 
     def snap_remove(self, path: str, name: str) -> None:
-        self.mds.snap_remove(path, name)
+        self.mds.snap_remove(path, name, client_id=self.client_id)
 
     def snap_rollback(self, path: str, name: str) -> None:
         """Restore the subtree to the snapshot: journaled metadata
@@ -271,7 +275,7 @@ class FsClient:
         # snapshot lose their dentries — their data is purged here
         live = {}
         self._collect_files(path, live)
-        self.mds.snap_rollback(path, name)
+        self.mds.snap_rollback(path, name, client_id=self.client_id)
         survivors: dict[str, int] = {}
         self._rollback_data(path, sid, live, survivors)
         for ino, size in live.items():
@@ -327,7 +331,7 @@ class FsClient:
     def mkdir(self, path: str) -> None:
         if self._split_snap(path) is not None:
             raise FsError(-30, "snapshots are read-only")
-        self.mds.mkdir(path)
+        self.mds.mkdir(path, client_id=self.client_id)
 
     def listdir(self, path: str) -> list[str]:
         parts = _norm(path).split("/")
@@ -343,7 +347,7 @@ class FsClient:
         return sorted(self.mds.entries(_norm(path)))
 
     def rmdir(self, path: str) -> None:
-        self.mds.rmdir(path)
+        self.mds.rmdir(path, client_id=self.client_id)
 
     def _assert_dir(self, path: str) -> None:
         ent = self.mds.lookup(path)
@@ -354,11 +358,14 @@ class FsClient:
     def create(self, path: str) -> None:
         if self._split_snap(path) is not None:
             raise FsError(-30, "snapshots are read-only")
-        self.mds.create(path)
+        self.mds.create(path, client_id=self.client_id)
 
     def write_file(self, path: str, data: bytes, offset: int = 0) -> None:
         if self._split_snap(path) is not None:
             raise FsError(-30, "snapshots are read-only")
+        # caps BEFORE the data mutation: a path-denied caller must not
+        # touch the file bytes and only then fail on the dentry update
+        self.mds.check_caps(self.client_id, "w", path)
         self._snapc_sync()
         ent = self.mds.lookup(path)
         if ent["type"] != "file":
@@ -369,7 +376,7 @@ class FsClient:
         self._data(ent["ino"]).write(offset, data)
         ent["size"] = max(ent["size"], offset + len(data))
         ent["mtime"] = time.time()
-        self.mds.set_entry(path, ent)
+        self.mds.set_entry(path, ent, client_id=self.client_id)
 
     def read_file(self, path: str, offset: int = 0,
                   length: int | None = None) -> bytes:
@@ -393,6 +400,7 @@ class FsClient:
         return self._data(ent["ino"]).read(offset, length)
 
     def truncate(self, path: str, size: int) -> None:
+        self.mds.check_caps(self.client_id, "w", path)
         ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory")
@@ -408,15 +416,18 @@ class FsClient:
                 size, b"\0" * (ent["size"] - size))
         ent["size"] = size
         ent["mtime"] = time.time()
-        self.mds.set_entry(path, ent)
+        self.mds.set_entry(path, ent, client_id=self.client_id)
 
     def unlink(self, path: str) -> None:
+        # caps BEFORE the data purge: rm_entry's own gate fires only
+        # after the object data would already be gone
+        self.mds.check_caps(self.client_id, "w", path)
         ent = self.mds.lookup(path)
         if ent["type"] != "file":
             raise FsError(-21, f"{path!r} is a directory (use rmdir)")
         self.mds.invalidate(path)
         self._data(ent["ino"]).remove()
-        self.mds.rm_entry(path)
+        self.mds.rm_entry(path, client_id=self.client_id)
 
     def stat(self, path: str) -> dict:
         snap = self._split_snap(path)
@@ -432,4 +443,4 @@ class FsClient:
     def rename(self, src: str, dst: str) -> None:
         """Same-type rename; directory renames move the SUBTREE (the
         single-rank slice of the MDS rename machinery)."""
-        self.mds.rename(src, dst)
+        self.mds.rename(src, dst, client_id=self.client_id)

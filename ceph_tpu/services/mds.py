@@ -64,18 +64,30 @@ def _norm(path: str) -> str:
 
 
 class _Session:
-    def __init__(self, client_id: str, revoke_cb):
+    def __init__(self, client_id: str, revoke_cb, vt=None,
+                 ticket_provider=None):
         self.client_id = client_id
         self.revoke_cb = revoke_cb   # revoke_cb(path) -> None (flush+drop)
+        self.vt = vt                 # VerifiedTicket (auth clusters)
+        # () -> fresh ticket blob; lets a long-lived mount re-present a
+        # renewed mds ticket instead of bricking at TTL expiry
+        self.ticket_provider = ticket_provider
 
 
 class MdsDaemon:
     LEASE_TTL = 30.0  # seconds; mirrors mds_session_cap lease behavior
 
-    def __init__(self, client: RadosClient, pool: str, rank: int = 0):
+    def __init__(self, client: RadosClient, pool: str, rank: int = 0,
+                 auth=None):
         self.client = client
         self.pool = pool
         self.rank = rank
+        # cephx gate (Server::handle_client_session + MDSAuthCaps
+        # enforcement role): sessions must present an "mds" service
+        # ticket; namespace mutations and file opens check the
+        # session's caps with path restrictions.  The data plane is
+        # additionally bound by the entity's pool caps at the OSDs.
+        self.auth = auth
         # per-top-level-prefix op accounting (MDBalancer pop counters)
         self.dir_ops: dict[str, int] = {}
         self._lock = threading.RLock()
@@ -254,7 +266,9 @@ class MdsDaemon:
                 raise FsError(-2, f"no snapshot {name!r} over {path!r}")
             probe = posixpath.split(probe)[0]
 
-    def snap_create(self, dirpath: str, name: str) -> int:
+    def snap_create(self, dirpath: str, name: str,
+                    client_id=None) -> int:
+        self._check(client_id, "w", dirpath)
         dirpath = _norm(dirpath)
         if self.lookup(dirpath)["type"] != "dir":
             raise FsError(-20, f"{dirpath!r} is not a directory")
@@ -291,7 +305,9 @@ class MdsDaemon:
                 self._freeze_tree(posixpath.join(_norm(dirpath), nm),
                                   snapid)
 
-    def snap_remove(self, dirpath: str, name: str) -> None:
+    def snap_remove(self, dirpath: str, name: str,
+                    client_id=None) -> None:
+        self._check(client_id, "w", dirpath)
         dirpath = _norm(dirpath)
         sid = self.snaps_of(dirpath).get(name)
         if sid is None:
@@ -347,7 +363,9 @@ class MdsDaemon:
         return ent
 
     # -- rollback --------------------------------------------------------
-    def snap_rollback(self, dirpath: str, name: str) -> None:
+    def snap_rollback(self, dirpath: str, name: str,
+                      client_id=None) -> None:
+        self._check(client_id, "w", dirpath)
         """Restore the subtree (metadata + file data) to its state at
         the snapshot; survives failover because the op is journaled and
         apply is idempotent."""
@@ -446,7 +464,8 @@ class MdsDaemon:
             raise FsError(-2, f"no such entry {path!r}")
         return ent
 
-    def mkdir(self, path: str) -> None:
+    def mkdir(self, path: str, client_id=None) -> None:
+        self._check(client_id, "w", path)
         with self._lock:
             path = _norm(path)
             parent, name = posixpath.split(path)
@@ -455,7 +474,8 @@ class MdsDaemon:
             self.submit({"op": "mkdir", "path": path,
                          "ent": {"type": "dir", "mtime": time.time()}})
 
-    def rmdir(self, path: str) -> None:
+    def rmdir(self, path: str, client_id=None) -> None:
+        self._check(client_id, "w", path)
         with self._lock:
             path = _norm(path)
             if path == "/":
@@ -467,7 +487,8 @@ class MdsDaemon:
                 raise FsError(-39, f"{path!r} not empty")
             self.submit({"op": "rmdir", "path": path})
 
-    def create(self, path: str) -> dict:
+    def create(self, path: str, client_id=None) -> dict:
+        self._check(client_id, "w", path)
         with self._lock:
             path = _norm(path)
             parent, name = posixpath.split(path)
@@ -478,16 +499,20 @@ class MdsDaemon:
             self.submit({"op": "set_entry", "path": path, "ent": ent})
             return ent
 
-    def set_entry(self, path: str, ent: dict) -> None:
+    def set_entry(self, path: str, ent: dict, client_id=None) -> None:
+        self._check(client_id, "w", path)
         with self._lock:
             self.submit({"op": "set_entry", "path": _norm(path),
                          "ent": ent})
 
-    def rm_entry(self, path: str) -> None:
+    def rm_entry(self, path: str, client_id=None) -> None:
+        self._check(client_id, "w", path)
         with self._lock:
             self.submit({"op": "rm_entry", "path": _norm(path)})
 
-    def rename(self, src: str, dst: str) -> None:
+    def rename(self, src: str, dst: str, client_id=None) -> None:
+        self._check(client_id, "w", src)
+        self._check(client_id, "w", dst)
         with self._lock:
             src, dst = _norm(src), _norm(dst)
             if dst == src or dst.startswith(src + "/"):
@@ -503,9 +528,46 @@ class MdsDaemon:
                          "ent": ent})
 
     # ------------------------------------------------------- capabilities
-    def register_session(self, client_id: str, revoke_cb) -> None:
+    def _check(self, client_id, need: str, path: str) -> None:
+        """Session caps gate (MDSAuthCaps::is_capable role): verify the
+        caller's session ticket is live and its caps cover `need` at
+        `path`.  An expired ticket renews through the session's
+        provider (the client re-presents a fresh mon-issued ticket)
+        before failing.  No-op on auth-free clusters."""
+        if self.auth is None:
+            return
+        sess = self._sessions.get(client_id)
+        if sess is None or sess.vt is None:
+            raise FsError(-13, f"no authenticated session {client_id!r}")
+        if time.time() > sess.vt.valid_until:
+            vt = (self.auth.verify(sess.ticket_provider())
+                  if sess.ticket_provider is not None else None)
+            if vt is None:
+                raise FsError(-13,
+                              f"mds ticket expired for {client_id!r}")
+            sess.vt = vt
+        if not sess.vt.caps.allows(need, path=_norm(path)):
+            raise FsError(-13, f"{sess.vt.entity}: mds caps deny "
+                               f"{need!r} at {path!r}")
+
+    def check_caps(self, client_id, need: str, path: str) -> None:
+        """Public pre-flight gate: callers that mutate DATA before
+        metadata (unlink, write) must check caps first, or a denied
+        caller destroys file contents the path restriction protects."""
+        self._check(client_id, need, path)
+
+    def register_session(self, client_id: str, revoke_cb,
+                         ticket: bytes = b"",
+                         ticket_provider=None) -> None:
+        vt = None
+        if self.auth is not None:
+            vt = self.auth.verify(ticket)
+            if vt is None:
+                raise FsError(-13, "mount refused: no/invalid/expired "
+                                   "mds ticket")
         with self._lock:
-            self._sessions[client_id] = _Session(client_id, revoke_cb)
+            self._sessions[client_id] = _Session(client_id, revoke_cb,
+                                                 vt, ticket_provider)
 
     def unregister_session(self, client_id: str) -> None:
         with self._lock:
@@ -520,6 +582,7 @@ class MdsDaemon:
         plus the granted caps + lease expiry."""
         path = _norm(path)
         want_w = "w" in mode
+        self._check(client_id, "rw" if want_w else "r", path)
         with self._lock:
             ent = self.lookup(path)
             if ent["type"] != "file":
@@ -609,10 +672,11 @@ class MdsCluster:
     """N active ranks with subtree authority partitioning (see module
     docstring).  Drop-in for MdsDaemon in FsClient."""
 
-    def __init__(self, client: RadosClient, pool: str, n_ranks: int = 2):
+    def __init__(self, client: RadosClient, pool: str, n_ranks: int = 2,
+                 auth=None):
         self.client = client
         self.pool = pool
-        self.ranks = [MdsDaemon(client, pool, rank=i)
+        self.ranks = [MdsDaemon(client, pool, rank=i, auth=auth)
                       for i in range(n_ranks)]
         self._maplock = threading.RLock()
         try:
@@ -703,25 +767,29 @@ class MdsCluster:
         return None
 
     # ------------------------------------------------ snapshot routing
-    def snap_create(self, dirpath: str, name: str) -> int:
+    def snap_create(self, dirpath: str, name: str,
+                    client_id=None) -> int:
         a = self._entry_auth(dirpath)
         for r in self.ranks:          # flush EVERY rank's caps under it
             r._revoke_subtree(_norm(dirpath), exclude=None)
-        sid = a.snap_create(dirpath, name)
+        sid = a.snap_create(dirpath, name, client_id=client_id)
         for r in self.ranks:
             r._snapc_invalidate()
         return sid
 
-    def snap_remove(self, dirpath: str, name: str) -> None:
-        self._entry_auth(dirpath).snap_remove(dirpath, name)
+    def snap_remove(self, dirpath: str, name: str,
+                    client_id=None) -> None:
+        self._entry_auth(dirpath).snap_remove(dirpath, name,
+                                              client_id=client_id)
         for r in self.ranks:
             r._snapc_invalidate()
 
-    def snap_rollback(self, dirpath: str, name: str) -> None:
+    def snap_rollback(self, dirpath: str, name: str,
+                      client_id=None) -> None:
         a = self._entry_auth(dirpath)
         for r in self.ranks:
             r._revoke_subtree(_norm(dirpath), exclude=None)
-        a.snap_rollback(dirpath, name)
+        a.snap_rollback(dirpath, name, client_id=client_id)
 
     def snaps_of(self, dirpath: str):
         return self.ranks[0].snaps_of(dirpath)
@@ -739,9 +807,17 @@ class MdsCluster:
         return self.ranks[0].snap_lookup(snapid, snap_root, path)
 
     # --------------------------------------- MdsDaemon-compatible surface
-    def register_session(self, client_id: str, revoke_cb) -> None:
+    def register_session(self, client_id: str, revoke_cb,
+                         ticket: bytes = b"",
+                         ticket_provider=None) -> None:
         for r in self.ranks:
-            r.register_session(client_id, revoke_cb)
+            r.register_session(client_id, revoke_cb, ticket,
+                               ticket_provider)
+
+    def check_caps(self, client_id, need: str, path: str) -> None:
+        # sessions are registered on every rank: rank 0 sees the same
+        # ticket state as the authoritative rank
+        self.ranks[0].check_caps(client_id, need, path)
 
     def unregister_session(self, client_id: str) -> None:
         for r in self.ranks:
@@ -753,20 +829,20 @@ class MdsCluster:
     def entries(self, dirpath: str) -> dict:
         return self._dir_auth(dirpath).entries(dirpath)
 
-    def mkdir(self, path: str) -> None:
-        self._entry_auth(path).mkdir(path)
+    def mkdir(self, path: str, client_id=None) -> None:
+        self._entry_auth(path).mkdir(path, client_id=client_id)
 
-    def rmdir(self, path: str) -> None:
-        self._entry_auth(path).rmdir(path)
+    def rmdir(self, path: str, client_id=None) -> None:
+        self._entry_auth(path).rmdir(path, client_id=client_id)
 
-    def create(self, path: str) -> dict:
-        return self._entry_auth(path).create(path)
+    def create(self, path: str, client_id=None) -> dict:
+        return self._entry_auth(path).create(path, client_id=client_id)
 
-    def set_entry(self, path: str, ent: dict) -> None:
-        self._entry_auth(path).set_entry(path, ent)
+    def set_entry(self, path: str, ent: dict, client_id=None) -> None:
+        self._entry_auth(path).set_entry(path, ent, client_id=client_id)
 
-    def rm_entry(self, path: str) -> None:
-        self._entry_auth(path).rm_entry(path)
+    def rm_entry(self, path: str, client_id=None) -> None:
+        self._entry_auth(path).rm_entry(path, client_id=client_id)
 
     def open(self, client_id: str, path: str, mode: str) -> dict:
         auth = self._entry_auth(path)
@@ -812,7 +888,7 @@ class MdsCluster:
                 self._save_map()
                 self.client.omap_rm(self.pool, _SUBTREE_OID, old_keys)
 
-    def rename(self, src: str, dst: str) -> None:
+    def rename(self, src: str, dst: str, client_id=None) -> None:
         """Renames take ALL rank locks in RANK ORDER (no ABBA between
         two renames) because the moved subtree may contain interior
         subtree roots whose caps live at ranks other than the two
@@ -822,6 +898,10 @@ class MdsCluster:
         at both parents' ranks — apply is idempotent, so each rank's
         replay converges (the slave-request rename role)."""
         src, dst = _norm(src), _norm(dst)
+        # sessions are registered on every rank; rank 0 carries the
+        # same ticket state as the authoritative ranks
+        self.ranks[0]._check(client_id, "w", src)
+        self.ranks[0]._check(client_id, "w", dst)
         if dst == src or dst.startswith(src + "/"):
             raise FsError(-22,
                           f"cannot move {src!r} into itself ({dst!r})")

@@ -26,8 +26,30 @@ class MiniCluster:
                  metrics_port: int | None = None,
                  tcp_auth_secret: bytes | None = None,
                  tcp_compress: str = "none",
-                 tcp_secure: bool = False):
+                 tcp_secure: bool = False,
+                 auth: bool = False,
+                 auth_rotation: float = 0.0,
+                 auth_ttl: float = 3600.0):
         self.cfg = cfg or default_config()
+        # cephx (AuthMonitor + OSDCap roles): service base secrets +
+        # the bootstrap admin entity, provisioned to every daemon at
+        # construction (the keyring-file deployment role).  Each mon
+        # gets its OWN KeyServer seeded identically; later `auth`
+        # commands replicate through the paxos "authdb" key.
+        self._auth_rotation = auth_rotation
+        self._auth_ttl = auth_ttl
+        self._svc_secrets = None
+        self._seed_entities: dict = {}
+        self.admin_key = None
+        if auth:
+            import secrets as _secrets
+            self._svc_secrets = {s: _secrets.token_bytes(32)
+                                 for s in ("mon", "osd", "mds")}
+            self.admin_key = _secrets.token_bytes(32)
+            self._seed_entities = {"client.admin": {
+                "key": self.admin_key,
+                "caps": {"mon": "allow *", "osd": "allow *",
+                         "mds": "allow *"}}}
         if transport == "tcp":
             from ..msg.tcp import TcpNetwork
             self.network = TcpNetwork(auth_secret=tcp_auth_secret,
@@ -80,6 +102,17 @@ class MiniCluster:
         if old is not None:
             old.stop()
 
+    def _make_key_server(self):
+        if self._svc_secrets is None:
+            return None
+        from ..auth.cephx import KeyServer
+        ks = KeyServer(dict(self._svc_secrets),
+                       rotation=self._auth_rotation, ttl=self._auth_ttl)
+        ks.entities = {name: {"key": ent["key"],
+                              "caps": dict(ent["caps"])}
+                       for name, ent in self._seed_entities.items()}
+        return ks
+
     def _make_mon(self, rank: int) -> MonitorLite:
         import os
         path = None
@@ -87,7 +120,8 @@ class MiniCluster:
             path = os.path.join(self._mon_path, f"mon{rank}")
         return MonitorLite(self.network, f"mon.{rank}", cfg=self.cfg,
                            peers=self.mon_names if len(self.mon_names) > 1
-                           else (), path=path)
+                           else (), path=path,
+                           key_server=self._make_key_server())
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> "MiniCluster":
@@ -132,8 +166,13 @@ class MiniCluster:
 
     def add_osd(self, osd_id: int, store=None) -> OSDDaemon:
         host = f"host{osd_id}" if self._hosts_per_osd else "host0"
+        verifier = None
+        if self._svc_secrets is not None:
+            from ..auth.cephx import ServiceVerifier
+            verifier = ServiceVerifier("osd", self._svc_secrets["osd"],
+                                       rotation=self._auth_rotation)
         osd = OSDDaemon(osd_id, self.network, cfg=self.cfg, host=host,
-                        mons=self.mon_names, store=store)
+                        mons=self.mon_names, store=store, auth=verifier)
         self.osds[osd_id] = osd
         osd.start()
         if self._admin_dir:
@@ -189,10 +228,26 @@ class MiniCluster:
         self.procs[osd_id] = proc
         return proc
 
-    def client(self, idx: int | None = None) -> RadosClient:
+    def mds_verifier(self):
+        """ServiceVerifier for an in-process MDS on this cluster (None
+        on an auth-free cluster)."""
+        if self._svc_secrets is None:
+            return None
+        from ..auth.cephx import ServiceVerifier
+        return ServiceVerifier("mds", self._svc_secrets["mds"],
+                               rotation=self._auth_rotation)
+
+    def client(self, idx: int | None = None,
+               entity: str | None = None,
+               key: bytes | None = None) -> RadosClient:
+        """A connected client.  On an auth cluster the default identity
+        is client.admin; pass entity+key for a restricted identity."""
         idx = len(self.clients) if idx is None else idx
+        if key is None and self.admin_key is not None:
+            entity, key = "client.admin", self.admin_key
         c = RadosClient(self.network, f"client.{idx}",
-                        mons=self.mon_names).connect()
+                        mons=self.mon_names, auth_entity=entity,
+                        auth_key=key).connect()
         self.clients.append(c)
         return c
 

@@ -238,6 +238,12 @@ OPTIONS: list[Option] = [
            OptionLevel.ADVANCED,
            "seconds to wait for a remote reservation grant before "
            "failing open (target presumed dead)", min=0.5),
+    Option("mgr_autoscaler_objects_per_pg", int, 100, OptionLevel.BASIC,
+           "pg_autoscaler: grow a pool's pg_num once its logical "
+           "objects-per-PG estimate exceeds this target", min=1),
+    Option("mgr_autoscaler_max_pg_num", int, 256, OptionLevel.ADVANCED,
+           "pg_autoscaler: never propose pg_num beyond this cap",
+           min=1),
 ]
 
 

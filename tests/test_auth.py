@@ -1,0 +1,386 @@
+"""cephx authorization: caps grammar, tickets, and cluster enforcement.
+
+The reference's model (src/mon/AuthMonitor.h:35,
+src/auth/cephx/CephxKeyServer.h:165, OSDCap checks in src/osd/OSD.cc):
+per-entity keys live at the mon, clients obtain time-limited service
+tickets carrying their capability string, and daemons enforce those
+caps at op ingress with no mon round-trip.
+"""
+
+import time
+
+import pytest
+
+from ceph_tpu.auth.caps import Caps, CapsError
+from ceph_tpu.auth.cephx import (AuthContext, KeyServer, ServiceVerifier,
+                                 op_proof)
+from ceph_tpu.client.rados import RadosClient, RadosError
+from ceph_tpu.tools.vstart import MiniCluster
+from ceph_tpu.utils.config import default_config
+
+
+def make_cfg(**over):
+    cfg = default_config()
+    cfg.apply_dict({"osd_heartbeat_interval": 0.05,
+                    "osd_heartbeat_grace": 0.5,
+                    "ec_backend": "native",
+                    "osd_op_num_shards": 2, **over})
+    return cfg
+
+
+# ---------------------------------------------------------------- caps unit
+def test_caps_parse_and_match():
+    c = Caps.parse("allow rw pool=alpha, allow r")
+    assert c.allows("r")                      # bare grant, any pool
+    assert c.allows("rw", pool="alpha")
+    assert not c.allows("w", pool="beta")     # rw grant is alpha-only
+    assert c.allows("r", pool="beta")
+    assert not c.allows("x", pool="alpha")
+
+
+def test_caps_star_and_union():
+    assert Caps.parse("allow *").allows("rwx", pool="anything")
+    # bits accumulate across matching grants (OSDCap::is_capable)
+    c = Caps.parse("allow r pool=p, allow w pool=p")
+    assert c.allows("rw", pool="p")
+    assert not c.allows("rw", pool="q")
+
+
+def test_caps_path_prefix():
+    c = Caps.parse("allow rw path=/home/a")
+    assert c.allows("rw", path="/home/a")
+    assert c.allows("rw", path="/home/a/deep/file")
+    assert not c.allows("rw", path="/home/ab")   # component boundary
+    assert not c.allows("r", path="/home")
+
+
+@pytest.mark.parametrize("bad", [
+    "deny r", "allow", "allow q", "allow rw pool=", "allow rw disk=x",
+    "", "allow rw,", "allow *x"])
+def test_caps_rejects_malformed(bad):
+    with pytest.raises(CapsError):
+        Caps.parse(bad)
+
+
+# ------------------------------------------------------------- tickets unit
+def _ks(clock, rotation=0.0, ttl=60.0):
+    return KeyServer({"mon": b"M" * 32, "osd": b"O" * 32},
+                     rotation=rotation, ttl=ttl, clock=clock)
+
+
+def test_ticket_issue_verify_roundtrip():
+    now = [1000.0]
+    ks = _ks(lambda: now[0])
+    key = ks.add("client.a", {"osd": "allow rw pool=p"})
+    blob, sealed, nonce = ks.issue("client.a", "osd")
+    ver = ServiceVerifier("osd", b"O" * 32, clock=lambda: now[0])
+    vt = ver.verify(blob)
+    assert vt is not None and vt.entity == "client.a"
+    assert vt.caps.allows("rw", pool="p")
+    # the client unseals the same session key the daemon derives
+    ctx = AuthContext("client.a", key)
+    ctx.accept("osd", blob, sealed, nonce)
+    _, session = ctx.ticket_for("osd", clock=lambda: now[0])
+    assert session == vt.session_key
+    # per-op proof binds the op fields
+    proof = op_proof(session, 7, 1, "oid", "write", 0, 3, b"abc")
+    assert proof == op_proof(vt.session_key, 7, 1, "oid", "write",
+                             0, 3, b"abc")
+    assert proof != op_proof(vt.session_key, 7, 1, "oid", "write",
+                             0, 3, b"abd")
+
+
+def test_ticket_expiry_and_tamper():
+    now = [1000.0]
+    ks = _ks(lambda: now[0], ttl=10.0)
+    ks.add("client.a", {"osd": "allow *"})
+    blob, _, _ = ks.issue("client.a", "osd")
+    ver = ServiceVerifier("osd", b"O" * 32, clock=lambda: now[0])
+    assert ver.verify(blob) is not None
+    now[0] += 11.0
+    assert ver.verify(blob) is None          # expired, even if cached
+    now[0] -= 11.0
+    assert ver.verify(bytes([blob[0]]) + blob[1:-1] +
+                      bytes([blob[-1] ^ 1])) is None  # bit-flipped sig
+    assert ver.verify(b"junk") is None
+    # a ticket for another service never verifies here
+    mon_blob, _, _ = ks.issue("client.a", "osd")
+    assert ServiceVerifier("mon", b"M" * 32).verify(mon_blob) is None
+
+
+def test_ticket_rotation_window():
+    now = [10_000.0]
+    ks = _ks(lambda: now[0], rotation=100.0, ttl=1000.0)
+    ks.add("client.a", {"osd": "allow *"})
+    blob, _, _ = ks.issue("client.a", "osd")
+    ver = ServiceVerifier("osd", b"O" * 32, rotation=100.0,
+                          clock=lambda: now[0])
+    assert ver.verify(blob) is not None
+    now[0] += 100.0          # one generation later: grace window holds
+    assert ver.verify(blob) is not None
+    now[0] += 200.0          # beyond current+-1: refused despite ttl
+    assert ver.verify(blob) is None
+
+
+def test_entity_table_replication_bytes():
+    ks = _ks(time.time)
+    ks.add("client.a", {"osd": "allow rw pool=p"}, key=b"k" * 32)
+    ks.add("osd.0", {"mon": "allow r"})
+    raw = ks.encode_db()
+    ks2 = _ks(time.time)
+    ks2.load_db(raw)
+    assert ks2.entities == ks.entities
+
+
+# ------------------------------------------------------------ cluster tests
+@pytest.fixture
+def auth_cluster():
+    c = MiniCluster(n_osds=3, cfg=make_cfg(), auth=True).start()
+    yield c
+    c.stop()
+
+
+def test_admin_full_access(auth_cluster):
+    client = auth_cluster.client()
+    client.create_pool("poolx", size=2, pg_num=4)
+    client.write_full("poolx", "obj", b"payload")
+    assert client.read("poolx", "obj") == b"payload"
+    assert client.status()["health"] == "HEALTH_OK"
+
+
+def test_pool_scoped_caps_enforced(auth_cluster):
+    admin = auth_cluster.client()
+    admin.create_pool("poolx", size=2, pg_num=4)
+    admin.create_pool("pooly", size=2, pg_num=4)
+    out = admin.mon_command({
+        "prefix": "auth get-or-create", "entity": "client.alice",
+        "caps": {"mon": "allow r", "osd": "allow rw pool=poolx"}})
+    alice = auth_cluster.client(entity="client.alice",
+                                key=bytes.fromhex(out["key"]))
+    alice.write_full("poolx", "mine", b"alice data")
+    assert alice.read("poolx", "mine") == b"alice data"
+    # THE acceptance test: pool-x-only caps refused on pool y
+    with pytest.raises(RadosError) as ei:
+        alice.write_full("pooly", "theirs", b"nope")
+    assert ei.value.code == -13
+    with pytest.raises(RadosError) as ei:
+        alice.read("pooly", "whatever")
+    assert ei.value.code == -13
+    # mon caps: r lets status through, refuses mutations
+    assert alice.status()["num_up"] == 3
+    with pytest.raises(RadosError) as ei:
+        alice.create_pool("newpool", size=2, pg_num=1)
+    assert ei.value.code == -13
+    with pytest.raises(RadosError) as ei:
+        alice.mon_command({"prefix": "auth get-or-create",
+                           "entity": "client.evil",
+                           "caps": {"osd": "allow *"}})
+    assert ei.value.code == -13
+
+
+def test_read_only_entity(auth_cluster):
+    admin = auth_cluster.client()
+    admin.create_pool("poolx", size=2, pg_num=4)
+    admin.write_full("poolx", "obj", b"data")
+    out = admin.mon_command({
+        "prefix": "auth get-or-create", "entity": "client.reader",
+        "caps": {"mon": "allow r", "osd": "allow r pool=poolx"}})
+    reader = auth_cluster.client(entity="client.reader",
+                                 key=bytes.fromhex(out["key"]))
+    assert reader.read("poolx", "obj") == b"data"
+    with pytest.raises(RadosError) as ei:
+        reader.write_full("poolx", "obj2", b"x")
+    assert ei.value.code == -13
+    with pytest.raises(RadosError) as ei:
+        reader.remove("poolx", "obj")
+    assert ei.value.code == -13
+
+
+def test_unauthenticated_client_refused(auth_cluster):
+    admin = auth_cluster.client()
+    admin.create_pool("poolx", size=2, pg_num=4)
+    # a client with NO key: ops go out unticketed and are refused
+    anon = RadosClient(auth_cluster.network, "client.99",
+                       mons=auth_cluster.mon_names).connect()
+    try:
+        with pytest.raises(RadosError) as ei:
+            anon.write_full("poolx", "obj", b"sneak")
+        assert ei.value.code == -13
+        with pytest.raises(RadosError) as ei:
+            anon.mon_command({"prefix": "osd pool create",
+                              "name": "anonpool", "kind": "replicated",
+                              "size": 2, "pg_num": 1})
+        assert ei.value.code == -13
+    finally:
+        anon.close()
+
+
+def test_wrong_key_refused(auth_cluster):
+    auth_cluster.client().create_pool("poolx", size=2, pg_num=4)
+    imposter = auth_cluster.client(entity="client.admin",
+                                   key=b"\x00" * 32)
+    with pytest.raises(RadosError) as ei:
+        imposter.write_full("poolx", "obj", b"sneak")
+    assert ei.value.code == -13
+
+
+def test_ticket_expiry_forces_renewal():
+    c = MiniCluster(n_osds=3, cfg=make_cfg(), auth=True,
+                    auth_ttl=1.0).start()
+    try:
+        client = c.client()
+        client.create_pool("poolx", size=2, pg_num=4)
+        client.write_full("poolx", "obj", b"v1")
+        blob1 = client.auth.tickets["osd"][0]
+        time.sleep(1.2)  # past the 1s ttl: cached ticket is dead
+        client.write_full("poolx", "obj", b"v2")  # renews transparently
+        assert client.read("poolx", "obj") == b"v2"
+        assert client.auth.tickets["osd"][0] != blob1
+    finally:
+        c.stop()
+
+
+def test_caps_change_applies_on_renewal(auth_cluster):
+    admin = auth_cluster.client()
+    admin.create_pool("poolx", size=2, pg_num=4)
+    out = admin.mon_command({
+        "prefix": "auth get-or-create", "entity": "client.bob",
+        "caps": {"mon": "allow r", "osd": "allow rw pool=poolx"}})
+    bob = auth_cluster.client(entity="client.bob",
+                              key=bytes.fromhex(out["key"]))
+    bob.write_full("poolx", "obj", b"allowed")
+    # demote bob to read-only; caps live in the ticket, so the change
+    # lands when the ticket renews (cephx semantics)
+    admin.mon_command({"prefix": "auth caps", "entity": "client.bob",
+                       "caps": {"mon": "allow r",
+                                "osd": "allow r pool=poolx"}})
+    bob.auth.tickets.clear()  # force renewal now
+    assert bob.read("poolx", "obj") == b"allowed"
+    with pytest.raises(RadosError) as ei:
+        bob.write_full("poolx", "obj", b"denied")
+    assert ei.value.code == -13
+
+
+def test_auth_del_revokes_at_renewal(auth_cluster):
+    admin = auth_cluster.client()
+    admin.create_pool("poolx", size=2, pg_num=4)
+    out = admin.mon_command({
+        "prefix": "auth get-or-create", "entity": "client.gone",
+        "caps": {"osd": "allow rw pool=poolx"}})
+    gone = auth_cluster.client(entity="client.gone",
+                               key=bytes.fromhex(out["key"]))
+    gone.write_full("poolx", "obj", b"while alive")
+    admin.mon_command({"prefix": "auth del", "entity": "client.gone"})
+    gone.auth.tickets.clear()
+    with pytest.raises(RadosError) as ei:
+        gone.write_full("poolx", "obj", b"after del")
+    assert ei.value.code == -13
+
+
+def test_auth_list_and_commands(auth_cluster):
+    admin = auth_cluster.client()
+    admin.mon_command({"prefix": "auth get-or-create",
+                       "entity": "client.l",
+                       "caps": {"osd": "allow r"}})
+    ents = admin.mon_command({"prefix": "auth list"})["entities"]
+    assert "client.admin" in ents and "client.l" in ents
+    assert ents["client.l"]["caps"] == {"osd": "allow r"}
+    # malformed caps fail closed at creation time
+    with pytest.raises(RadosError) as ei:
+        admin.mon_command({"prefix": "auth get-or-create",
+                           "entity": "client.bad",
+                           "caps": {"osd": "permit everything"}})
+    assert ei.value.code == -22
+
+
+def test_mds_path_caps(auth_cluster):
+    """MDSAuthCaps role: `allow rw path=/app` confines an fs mount to
+    one subtree; the namespace outside it refuses mutations."""
+    from ceph_tpu.services.fs import FsClient
+    from ceph_tpu.services.mds import FsError, MdsDaemon
+
+    admin = auth_cluster.client()
+    admin.create_pool("fsp", size=2, pg_num=4)
+    out = admin.mon_command({
+        "prefix": "auth get-or-create", "entity": "client.fsuser",
+        "caps": {"mon": "allow r", "osd": "allow rw pool=fsp",
+                 "mds": "allow rw path=/app"}})
+    user = auth_cluster.client(entity="client.fsuser",
+                               key=bytes.fromhex(out["key"]))
+    mds = MdsDaemon(admin, "fsp", auth=auth_cluster.mds_verifier())
+    fs = FsClient(user, "fsp", mds=mds)
+    try:
+        fs.mkdir("/app")
+        fs.create("/app/file")
+        fs.write_file("/app/file", b"hello subtree")
+        assert fs.read_file("/app/file") == b"hello subtree"
+        with pytest.raises(FsError) as ei:
+            fs.mkdir("/other")
+        assert ei.value.code == -13
+        with pytest.raises(FsError) as ei:
+            fs.create("/stray")
+        assert ei.value.code == -13
+        with pytest.raises(FsError) as ei:
+            fs.rename("/app/file", "/escaped")
+        assert ei.value.code == -13
+    finally:
+        fs.unmount()
+
+
+def test_mds_mount_refused_without_caps(auth_cluster):
+    from ceph_tpu.services.fs import FsClient
+    from ceph_tpu.services.mds import FsError, MdsDaemon
+
+    admin = auth_cluster.client()
+    admin.create_pool("fsp", size=2, pg_num=4)
+    out = admin.mon_command({
+        "prefix": "auth get-or-create", "entity": "client.nofs",
+        "caps": {"mon": "allow r", "osd": "allow rw pool=fsp"}})
+    nofs = auth_cluster.client(entity="client.nofs",
+                               key=bytes.fromhex(out["key"]))
+    mds = MdsDaemon(admin, "fsp", auth=auth_cluster.mds_verifier())
+    with pytest.raises(FsError) as ei:
+        FsClient(nofs, "fsp", mds=mds)
+    assert ei.value.code == -13
+
+
+def test_authdb_survives_mon_restart(tmp_path):
+    c = MiniCluster(n_osds=3, cfg=make_cfg(), auth=True,
+                    mon_path=str(tmp_path)).start()
+    try:
+        admin = c.client()
+        admin.create_pool("poolx", size=2, pg_num=4)
+        out = admin.mon_command({
+            "prefix": "auth get-or-create", "entity": "client.dur",
+            "caps": {"mon": "allow r", "osd": "allow rw pool=poolx"}})
+        key = bytes.fromhex(out["key"])
+        c.kill_mon(0)
+        c.revive_mon(0)
+        c.wait_for_up(3)
+        dur = c.client(entity="client.dur", key=key)
+        dur.write_full("poolx", "obj", b"still me")
+        assert dur.read("poolx", "obj") == b"still me"
+    finally:
+        c.stop()
+
+
+def test_authdb_replicates_across_mons():
+    c = MiniCluster(n_osds=3, cfg=make_cfg(), n_mons=3,
+                    auth=True).start()
+    try:
+        admin = c.client()
+        admin.create_pool("poolx", size=2, pg_num=4)
+        out = admin.mon_command({
+            "prefix": "auth get-or-create", "entity": "client.rep",
+            "caps": {"mon": "allow r", "osd": "allow rw pool=poolx"}})
+        key = bytes.fromhex(out["key"])
+        c.settle(0.3)  # let the authdb commit reach the followers
+        leader = next(r for r, m in c.mons.items() if m.is_leader)
+        c.kill_mon(leader)
+        # a fresh client must authenticate against a surviving mon
+        # (proves the entity replicated, not just leader-local state)
+        rep = c.client(entity="client.rep", key=key)
+        rep.write_full("poolx", "obj", b"replicated")
+        assert rep.read("poolx", "obj") == b"replicated"
+    finally:
+        c.stop()
