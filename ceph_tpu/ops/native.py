@@ -92,6 +92,11 @@ def _load() -> ctypes.CDLL:
         L.ct_encode_ptrs.argtypes = [
             u8p, ctypes.c_int, ctypes.c_int, ctypes.POINTER(u8p),
             ctypes.POINTER(u8p), ctypes.c_size_t]
+        L.ct_lincomb_rows.restype = None
+        L.ct_lincomb_rows.argtypes = [
+            ctypes.POINTER(u8p), ctypes.POINTER(u8p),
+            ctypes.POINTER(u8p), ctypes.c_uint8, ctypes.c_uint8,
+            ctypes.c_int, ctypes.c_size_t]
         L.ct_crc32c.restype = ctypes.c_uint32
         L.ct_crc32c.argtypes = [ctypes.c_uint32, u8p, ctypes.c_size_t]
         L.ct_xxhash32.restype = ctypes.c_uint32
@@ -205,6 +210,27 @@ def encode_region_ptrs(G: np.ndarray, rows: list[np.ndarray],
     out_ptrs = (u8p * m)(*[_u8p(out[i]) for i in range(m)])
     lib().ct_encode_ptrs(_u8p(G), m, k, in_ptrs, out_ptrs, L)
     return out
+
+
+def lincomb_rows_ptrs(dst_ptrs: np.ndarray, a_ptrs: np.ndarray,
+                      b_ptrs: np.ndarray | None,
+                      ca: int, cb: int, L: int) -> None:
+    """Like lincomb_rows, but the rows are given as uint64 ADDRESS
+    arrays (base + offset computed with numpy) — one ctypes cast per
+    call instead of one per row, which is what makes thousands of tiny
+    coupling rows per encode affordable."""
+    n = len(dst_ptrs)
+    if n == 0:
+        return
+    u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+    d = np.ascontiguousarray(dst_ptrs, dtype=np.uint64)
+    a = np.ascontiguousarray(a_ptrs, dtype=np.uint64)
+    bp = None
+    if b_ptrs is not None:
+        b = np.ascontiguousarray(b_ptrs, dtype=np.uint64)
+        bp = b.ctypes.data_as(u8pp)
+    lib().ct_lincomb_rows(d.ctypes.data_as(u8pp),
+                          a.ctypes.data_as(u8pp), bp, ca, cb, n, L)
 
 
 def crc32c(data: bytes | np.ndarray, crc: int = 0) -> int:

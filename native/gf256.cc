@@ -245,3 +245,32 @@ void ct_encode_ptrs(const uint8_t* G, int m, int k,
       ct_region_mac(out_rows[i], data_rows[j], L, G[i * k + j]);
   }
 }
+
+// dst[i] = ca*a[i] ^ cb*b[i] row-wise over gathered row pointers — the
+// pairwise-coupling primitive of the CLAY coupled-layer transform.  One
+// call covers a whole plane group with zero marshalling copies (the
+// caller passes views straight into the chunk/working buffers); dst may
+// alias a.  b may be NULL when cb == 0 (the unpaired-symbol copy case).
+void ct_lincomb_rows(uint8_t* const* dst, const uint8_t* const* a,
+                     const uint8_t* const* b, uint8_t ca, uint8_t cb,
+                     int nrows, size_t L) {
+  for (int i = 0; i < nrows; i++) {
+    if (dst[i] != a[i]) {
+      if (ca == 1) {
+        memcpy(dst[i], a[i], L);
+      } else {
+        memset(dst[i], 0, L);
+        ct_region_mac(dst[i], a[i], L, ca);
+      }
+    } else if (ca != 1) {
+      // in-place scale: dst == a, rescale via tables
+      uint8_t lo[16], hi[16];
+      build_nibble_tables(ca, lo, hi);
+      for (size_t j = 0; j < L; j++) {
+        uint8_t v = dst[i][j];
+        dst[i][j] = (uint8_t)(lo[v & 15] ^ hi[v >> 4]);
+      }
+    }
+    if (cb && b) ct_region_mac(dst[i], b[i], L, cb);
+  }
+}

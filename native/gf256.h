@@ -53,6 +53,11 @@ void ct_encode(const uint8_t* G, int m, int k, const uint8_t* data,
 void ct_encode_ptrs(const uint8_t* G, int m, int k,
                     const uint8_t* const* data_rows, uint8_t* const* out_rows,
                     size_t L);
+// dst[i] = ca*a[i] ^ cb*b[i] over gathered row pointers (CLAY pairwise
+// coupling); dst may alias a; b may be NULL when cb == 0.
+void ct_lincomb_rows(uint8_t* const* dst, const uint8_t* const* a,
+                     const uint8_t* const* b, uint8_t ca, uint8_t cb,
+                     int nrows, size_t L);
 
 // --- checksums ------------------------------------------------------------
 // crc32c (Castagnoli, reflected, as Ceph's Checksummer/bufferlist use);
