@@ -14,10 +14,11 @@ Techniques:
 - cauchy_good    — Cauchy matrix, bit-matrix density optimised
 - liberation / blaum_roth / liber8tion — RAID-6 (m=2) GF(2) bit-matrix
   schedules over w sub-stripe packets (w=7 / w=6 / w=8 respectively, the
-  per-technique word-size envelopes of the reference).  The reference's
-  exact matrices live in the absent jerasure submodule; these are own
-  constructions (companion-matrix P/Q pairs, provably MDS) with the same
-  XOR-schedule execution shape — see ec/bitmatrix_code.py.
+  per-technique word-size envelopes of the reference).  liberation and
+  blaum_roth are the PUBLISHED constructions (Plank FAST'08 minimum-
+  density placement; Blaum-Roth ring powers); liber8tion remains an own
+  MDS companion-matrix stand-in — see ec/bitmatrix_code.py header for
+  why its published search-derived placements cannot be re-derived.
 """
 
 from __future__ import annotations
@@ -25,8 +26,8 @@ from __future__ import annotations
 import numpy as np
 
 from ..ops import gf256
-from .bitmatrix_code import (BitMatrixErasureCode,
-                             blaum_roth_bitmatrix, raid6_bitmatrix)
+from .bitmatrix_code import (BitMatrixErasureCode, blaum_roth_bitmatrix,
+                             liberation_bitmatrix, raid6_bitmatrix)
 from .interface import ErasureCodeError, profile_int
 from .matrix_code import MatrixErasureCode
 from .registry import register
@@ -90,7 +91,11 @@ class JerasureBitCode(BitMatrixErasureCode):
         if self.technique == "blaum_roth":
             # the published ring construction (see bitmatrix_code)
             self.bitmatrix = blaum_roth_bitmatrix(self.k, self.w)
+        elif self.technique == "liberation":
+            # the published Plank FAST'08 minimum-density placement
+            self.bitmatrix = liberation_bitmatrix(self.k, self.w)
         else:
+            # liber8tion: own MDS stand-in (see bitmatrix_code header)
             self.bitmatrix = raid6_bitmatrix(self.k, self.w)
         self._init_bitmatrix()
 
