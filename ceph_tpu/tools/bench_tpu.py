@@ -105,9 +105,8 @@ def main() -> int:
         print(f"bench_tpu: no persistent compile cache: {e}",
               file=sys.stderr)
     if args.force_cpu:
-        jax.config.update("jax_platforms", "cpu")
-        import jax._src.xla_bridge as _xb
-        _xb._backend_factories.pop("axon", None)
+        from ceph_tpu.utils.jaxenv import force_cpu
+        force_cpu()
     import jax.numpy as jnp
 
     backend = jax.default_backend()

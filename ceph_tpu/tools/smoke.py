@@ -33,10 +33,8 @@ def main(argv=None) -> int:
     import os
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     try:
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-        import jax._src.xla_bridge as _xb
-        _xb._backend_factories.pop("axon", None)
+        from ceph_tpu.utils.jaxenv import force_cpu
+        force_cpu()
     except Exception:  # noqa: BLE001 - jax absent: kernel check fails
         pass
 

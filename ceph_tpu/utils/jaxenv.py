@@ -1,0 +1,33 @@
+"""Hermetic JAX backend selection for the axon environment.
+
+sitecustomize (PYTHONPATH-injected) imports jax in EVERY interpreter
+and registers the 'axon' PJRT factory; initialising ANY backend — even
+with JAX_PLATFORMS=cpu in the env — pokes the tunnel and can block for
+hours.  Every CPU-hermetic entry point (tests, benches, graft dryrun,
+multi-process DCN workers) therefore needs the same three steps BEFORE
+first backend init; this is the single copy of that workaround."""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(device_count: int | None = None) -> None:
+    """Pin the live jax config to the CPU platform, drop the axon PJRT
+    factory, and (optionally) force `device_count` virtual CPU devices.
+    Must run before any jax backend initialisation; safe to call more
+    than once.  The device-count flag is appended only when absent so
+    an inherited XLA_FLAGS (e.g. pytest's 8-device setting) wins."""
+    if device_count is not None:
+        flag = "--xla_force_host_platform_device_count"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if flag not in flags:
+            os.environ["XLA_FLAGS"] = \
+                f"{flags} {flag}={device_count}".strip()
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        import jax._src.xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # noqa: BLE001 - jax internals moved; env var holds
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")

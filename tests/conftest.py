@@ -12,28 +12,14 @@ so tests must drop the factory before any jax backend initialisation.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-prev = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (
-        prev + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+from ceph_tpu.utils.jaxenv import force_cpu  # noqa: E402
 
-try:
-    import jax
-
-    # sitecustomize imports jax before this file runs, snapshotting
-    # JAX_PLATFORMS=axon into the live config — the env var alone is
-    # ignored by an already-imported jax.
-    jax.config.update("jax_platforms", "cpu")
-    import jax._src.xla_bridge as _xb
-
-    # deregister the axon PJRT factory: it gets initialised (and opens
-    # the blocking tunnel) even when it is not the selected platform.
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # jax absent or internals moved; env vars still set
-    pass
+force_cpu(device_count=8)
 
 
 def pytest_configure(config):
