@@ -439,7 +439,9 @@ class MonitorLite(Dispatcher):
         self.cfg = cfg or default_config()
         self.peers = [p for p in peers if p != name]
         self._rank = int(name.rsplit(".", 1)[1]) if "." in name else 0
-        self.messenger = Messenger(network, name, Policy.stateless_server())
+        self.messenger = Messenger(network, name,
+                                   Policy.stateless_server(),
+                                   workers=self.cfg["ms_dispatch_workers"])
         self.messenger.add_dispatcher(self)
         self.store: MonStore = DurableMonStore(path) if path else MonStore()
         self.osdmap = OSDMap()

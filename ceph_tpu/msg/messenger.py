@@ -23,6 +23,7 @@ import queue
 import random
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 
 from ..utils.log import dout
@@ -153,21 +154,32 @@ class LocalNetwork(Network):
 
 
 class Messenger:
-    """One entity's endpoint: a dispatch queue + worker thread."""
+    """One entity's endpoint: N sharded dispatch workers.
+
+    The sharded-worker model of AsyncMessenger (src/msg/async/Stack.h:259
+    Worker event loops, ms_async_op_threads of them, connections pinned
+    to one worker): incoming messages shard by SOURCE entity, so one
+    peer's messages stay strictly ordered on one worker while different
+    peers' dispatch runs concurrently.  workers=1 degenerates to the
+    single dispatch thread every endpoint had before."""
 
     _ids = itertools.count(1)
 
     def __init__(self, network: Network, name: str,
-                 policy: Policy | None = None):
+                 policy: Policy | None = None, workers: int = 1):
         self.network = network
         self.name = name
         self.policy = policy or Policy()
+        self.workers = max(1, int(workers))
         self._dispatchers: list[Dispatcher] = []
-        self._queue: queue.Queue = queue.Queue()
+        self._queues = [queue.Queue() for _ in range(self.workers)]
         self._stopped = False
         self._throttle = (Throttle(f"{name}.msgs", self.policy.throttler_cap)
                           if self.policy.throttler_cap else None)
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
+        # per-worker dispatch counters (perf evidence that connections
+        # actually spread across the loops)
+        self.worker_dispatched = [0] * self.workers
         network.register(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -175,17 +187,20 @@ class Messenger:
         self._dispatchers.append(d)
 
     def start(self) -> None:
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._dispatch_loop, name=f"ms-{self.name}",
-                daemon=True)
-            self._thread.start()
+        if not self._threads:
+            for i in range(self.workers):
+                t = threading.Thread(
+                    target=self._dispatch_loop, args=(i,),
+                    name=f"ms-{self.name}-w{i}", daemon=True)
+                t.start()
+                self._threads.append(t)
 
     def shutdown(self) -> None:
         self._stopped = True
-        self._queue.put(None)
-        if self._thread:
-            self._thread.join(timeout=5)
+        for q in self._queues:
+            q.put(None)
+        for t in self._threads:
+            t.join(timeout=5)
         self.network.unregister(self.name)
 
     # -- sending -----------------------------------------------------------
@@ -196,6 +211,14 @@ class Messenger:
         return self.connect(peer).send(msg)
 
     # -- receiving ---------------------------------------------------------
+    def shard_of(self, src: str) -> int:
+        """Worker a peer's messages are pinned to (stable across the
+        process: per-peer FIFO must never depend on hash seeding).  The
+        multiplicative mix decorrelates the near-identical entity names
+        (client.N / osd.N) that raw crc32 mod small clusters badly."""
+        return (zlib.crc32(src.encode()) * 2654435761 % (1 << 32)) \
+            % self.workers
+
     def _enqueue(self, src: str, msg) -> bool:
         if self._stopped:
             return False
@@ -205,12 +228,13 @@ class Messenger:
                 self.network.dropped += 1
                 return True
             self._throttle.get(1, timeout=5)
-        self._queue.put((src, msg))
+        self._queues[self.shard_of(src)].put((src, msg))
         return True
 
-    def _dispatch_loop(self) -> None:
+    def _dispatch_loop(self, worker: int) -> None:
+        q = self._queues[worker]
         while True:
-            item = self._queue.get()
+            item = q.get()
             if item is None:
                 break
             src, msg = item
@@ -226,5 +250,6 @@ class Messenger:
                 dout("msg", 0)("%s: dispatch error on %s from %s: %r",
                                self.name, type(msg).__name__, src, e)
             finally:
+                self.worker_dispatched[worker] += 1
                 if self._throttle:
                     self._throttle.put()

@@ -148,9 +148,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self.cfg = cfg or default_config()
         self.store = store or ObjectStore.create("memstore")
         self.store.mount()
+        # fifo op-queue mode executes client ops INLINE on the dispatch
+        # thread with no per-PG serialization — it is only safe with
+        # exactly one worker (mclock mode re-serializes through the
+        # ShardedScheduler, so it gets the full worker count)
+        n_workers = (1 if self.cfg["osd_op_queue"] == "fifo"
+                     else self.cfg["ms_dispatch_workers"])
         self.messenger = Messenger(
             network, self.name,
-            Policy.stateless_server(self.cfg["osd_client_message_cap"]))
+            Policy.stateless_server(self.cfg["osd_client_message_cap"]),
+            workers=n_workers)
         self.messenger.add_dispatcher(self)
         # dedicated heartbeat endpoint (the hb_front/hb_back messenger
         # role, src/ceph_osd.cc:550-630): liveness probes must never queue

@@ -238,6 +238,11 @@ OPTIONS: list[Option] = [
            OptionLevel.ADVANCED,
            "seconds to wait for a remote reservation grant before "
            "failing open (target presumed dead)", min=0.5),
+    Option("ms_dispatch_workers", int, 3, OptionLevel.ADVANCED,
+           "sharded messenger dispatch workers per daemon endpoint "
+           "(ms_async_op_threads role): peers pin to one worker so "
+           "per-peer ordering holds while different peers dispatch "
+           "concurrently", min=1),
     Option("mgr_autoscaler_objects_per_pg", int, 100, OptionLevel.BASIC,
            "pg_autoscaler: grow a pool's pg_num once its logical "
            "objects-per-PG estimate exceeds this target", min=1),

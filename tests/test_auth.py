@@ -24,7 +24,8 @@ def make_cfg(**over):
     cfg.apply_dict({"osd_heartbeat_interval": 0.05,
                     "osd_heartbeat_grace": 0.5,
                     "ec_backend": "native",
-                    "osd_op_num_shards": 2, **over})
+                    "osd_op_num_shards": 2,
+                    "ms_dispatch_workers": 2, **over})
     return cfg
 
 

@@ -25,7 +25,8 @@ def make_cfg(**over):
                     # an 8-daemon test cluster already runs ~50 threads
                     # and CI-box contention was flaking timing-tight
                     # tests at 4
-                    "osd_op_num_shards": 2, **over})
+                    "osd_op_num_shards": 2,
+                    "ms_dispatch_workers": 2, **over})
     return cfg
 
 

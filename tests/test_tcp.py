@@ -83,6 +83,10 @@ def _sample(cls):
         M.MNotifyAck: M.MNotifyAck(9, "client.2"),
         M.MOSDPGTemp: M.MOSDPGTemp(2, pg, [3, 0, 1]),
         M.MRecoveryReserve: M.MRecoveryReserve(pg, 4, "request", 255),
+        M.MAuth: M.MAuth(3, "client.a", ["mon", "osd"], b"n" * 16,
+                         1234567, b"p" * 32),
+        M.MAuthReply: M.MAuthReply(
+            3, 0, [("osd", b"ticket", b"sealed", b"n" * 16)], 600.0),
     }
     return samples[cls]
 
