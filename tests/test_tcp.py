@@ -23,72 +23,11 @@ RNG = np.random.default_rng(42)
 
 
 def _sample(cls):
-    """A representative instance of each message type, exercising the
-    nested value shapes the generic codec must carry."""
-    pg = M.PgId(3, 7)
-    samples = {
-        M.MOSDOp: M.MOSDOp(1, "client.0", 2, "obj", "write", 4096, 100,
-                           b"\x00\xffdata", 9),
-        M.MOSDOpReply: M.MOSDOpReply(1, -5, b"payload", 12, 9),
-        M.MSubWrite: M.MSubWrite(2, pg, "o", 4, 7, "write", b"chunk",
-                                 {"v": 7, "len": 100}, 512),
-        M.MSubPartialWrite: M.MSubPartialWrite(
-            3, pg, "o", 1, 8, [(0, b"ab"), (4096, b"cd")], 9000, True, 7),
-        M.MSubDelta: M.MSubDelta(4, pg, "o", 5, 8,
-                                 [(0, 128, b"\x01\x02")], 9000, 7),
-        M.MSubWriteReply: M.MSubWriteReply(5, pg, 2, 3, -11),
-        M.MSubRead: M.MSubRead(6, pg, "o", 0, [(4096, 8192)]),
-        M.MSubReadReply: M.MSubReadReply(7, pg, "o", 0, 1, 0, b"bytes",
-                                         {"v": 3, "len": 50}),
-        M.MOSDPing: M.MOSDPing(1, 5, 123.25),
-        M.MOSDPingReply: M.MOSDPingReply(1, 123.25),
-        M.MFailureReport: M.MFailureReport(2, 1, 5, 3.5),
-        M.MMapPush: M.MMapPush(5, b"\x01\x02raw-map"),
-        M.MMonSubscribe: M.MMonSubscribe("osdmap"),
-        M.MOSDBoot: M.MOSDBoot(3, "host3", "127.0.0.1:1234",
-                               "127.0.0.1:1235"),
-        M.MMonCommand: M.MMonCommand(
-            9, {"prefix": "pool create", "name": "p", "kind": "ec",
-                "ec_profile": {"k": "4", "m": "2"}, "pg_num": 8}),
-        M.MMonCommandReply: M.MMonCommandReply(9, 0, {"pool_id": 1}),
-        M.MPGQuery: M.MPGQuery(pg, 5),
-        M.MPGInfo: M.MPGInfo(pg, 2, -2, {("o", 0): 3, ("o", 1): 3},
-                             {"dead": 2}),
-        M.MPGPull: M.MPGPull(pg, ["a", "b"], True),
-        M.MPGPush: M.MPGPush(pg, 1, {"o": (3, b"data", 100)},
-                             {"gone": 4}, False),
-        M.MStatsReport: M.MStatsReport(1, 5, {"pgs": 2, "bytes": 999}),
-        M.MScrubRequest: M.MScrubRequest(1, "client.0", pg, True, False),
-        M.MScrubShard: M.MScrubShard(1, pg, True),
-        M.MScrubMap: M.MScrubMap(1, pg, 2,
-                                 {("o", 0): {"size": 10, "version": 3,
-                                             "digest": 77}}),
-        M.MScrubResult: M.MScrubResult(1, pg, 0,
-                                       [{"osd": 1, "kind": "x"}], 2),
-        M.MMonPing: M.MMonPing("mon.1", 3, "leader", 9, 55.5),
-        M.MMonElect: M.MMonElect(3, 9, 1, "mon.1"),
-        M.MMonVote: M.MMonVote(3, 2, "mon.2", 8),
-        M.MMonClaim: M.MMonClaim(3, 9, "mon.1"),
-        M.MMonPropose: M.MMonPropose(3, 10, "osdmap", b"raw", "boot"),
-        M.MMonPropAck: M.MMonPropAck(3, 10, "mon.2"),
-        M.MMonSyncReq: M.MMonSyncReq(7, "mon.2"),
-        M.MMonSyncEntries: M.MMonSyncEntries(
-            3, [(8, "boot", "osdmap", b"v8"), (9, "down", "osdmap",
-                                               b"v9")]),
-        M.MMonForward: M.MMonForward("client.0", b"\x01\x02frame"),
-        M.MMonFwdReply: M.MMonFwdReply("client.0", b"\x03frame"),
-        M.MPGRollback: M.MPGRollback(pg, "obj", 3, 7),
-        M.MWatchNotify: M.MWatchNotify(9, 2, "obj", "client.1",
-                                       b"payload"),
-        M.MNotifyAck: M.MNotifyAck(9, "client.2"),
-        M.MOSDPGTemp: M.MOSDPGTemp(2, pg, [3, 0, 1]),
-        M.MRecoveryReserve: M.MRecoveryReserve(pg, 4, "request", 255),
-        M.MAuth: M.MAuth(3, "client.a", ["mon", "osd"], b"n" * 16,
-                         1234567, b"p" * 32),
-        M.MAuthReply: M.MAuthReply(
-            3, 0, [("osd", b"ticket", b"sealed", b"n" * 16)], 600.0),
-    }
-    return samples[cls]
+    """The canonical per-type instances live with the dencoder corpus
+    tool — ONE registry feeds both the round-trip test and the
+    wire-format non-regression archive."""
+    from ceph_tpu.tools.dencoder import message_samples
+    return message_samples()[cls]
 
 
 def test_every_message_roundtrips_the_wire():
