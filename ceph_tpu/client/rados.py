@@ -18,7 +18,7 @@ import time
 from ..mon.maps import OSDMap
 from ..auth.cephx import AuthContext, canonical_command, op_proof
 from ..msg.messages import (MAuth, MAuthReply, MMapPush, MMonCommand,
-                            MMonCommandReply,
+                            MMonCommandReply, MPGList, MPGListReply,
                             MMonSubscribe, MOSDOp, MOSDOpReply, MScrubRequest,
                             MScrubResult, PgId, MNotifyAck, MWatchNotify)
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
@@ -186,7 +186,7 @@ class RadosClient(Dispatcher):
                 conn.send(MNotifyAck(msg.notify_id, self.name))
             return True
         if isinstance(msg, (MOSDOpReply, MMonCommandReply, MScrubResult,
-                            MAuthReply)):
+                            MAuthReply, MPGListReply)):
             ev = self._waiters.get(msg.tid)
             if ev is not None:
                 self._replies[msg.tid] = msg
@@ -424,6 +424,53 @@ class RadosClient(Dispatcher):
                 raise RadosError(reply.result, f"{op} {pool_name}/{oid}")
             return reply
         raise last_error or RadosError(-5, "retries exhausted")
+
+    def list_objects(self, pool: str) -> list[str]:
+        """Every live object head in the pool (the librados
+        NObjectIterator / `rados ls` role): one pgls per PG against its
+        primary, retried on stale primaries like any op."""
+        pool_id = self._pool_id(pool)
+        names: set[str] = set()
+        for seed in range(self.osdmap.pools[pool_id].pg_num):
+            pgid = PgId(pool_id, seed)
+            for attempt in range(12):
+                up = self.osdmap.pg_to_up_osds(pool_id, seed)
+                primary = next((u for u in up if u is not None), None)
+                if primary is None:
+                    raise RadosError(-5, f"pg {pgid} has no up osds")
+                tid = next(self._tids)
+                m = MPGList(tid, pgid, self.osdmap.epoch)
+                if self.auth is not None:
+                    blob, session = self._ticket("osd")
+                    if session is not None:
+                        m.ticket = blob
+                        m.proof = op_proof(session, tid, pool_id, seed,
+                                           "pgls")
+                try:
+                    reply = self._rpc(f"osd.{primary}", m, tid)
+                except TimeoutError_:
+                    # dead primary: wait for the map to move, retry
+                    # (the same resend-on-map-change the op path does)
+                    self._wait_epoch_past(self.osdmap.epoch,
+                                          self.timeout)
+                    continue
+                if reply.result == -11:  # peering/catching up
+                    time.sleep(min(0.05 * 2 ** attempt, 1.0))
+                    continue
+                if reply.result == -116:
+                    if reply.epoch > self.osdmap.epoch:
+                        self._wait_epoch_past(reply.epoch - 1,
+                                              self.timeout)
+                    else:
+                        time.sleep(0.05 * (attempt + 1))
+                    continue
+                if reply.result < 0:
+                    raise RadosError(reply.result, f"pgls {pgid}")
+                names.update(reply.names)
+                break
+            else:
+                raise RadosError(-116, f"pgls {pgid}: retries exhausted")
+        return sorted(names)
 
     def scrub_pg(self, pool: str, seed: int, deep: bool = False,
                  repair: bool = False) -> MScrubResult:

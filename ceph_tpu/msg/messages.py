@@ -291,6 +291,28 @@ class MMonCommandReply:
     data: dict = field(default_factory=dict)
 
 
+@dataclass
+class MPGList:
+    """Client -> PG primary: list the object heads of one PG (the
+    librados NObjectIterator / pgls role).  Carries the cephx osd
+    ticket + proof over (tid, pool, seed, "pgls") on auth clusters."""
+
+    tid: int
+    pgid: PgId
+    epoch: int = 0
+    ticket: bytes = b""
+    proof: bytes = b""
+
+
+@dataclass
+class MPGListReply:
+    tid: int
+    pgid: PgId
+    result: int = 0
+    names: list = field(default_factory=list)
+    epoch: int = 0
+
+
 # ------------------------------------------------------------------- cephx
 @dataclass
 class MAuth:

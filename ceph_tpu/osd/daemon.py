@@ -39,7 +39,8 @@ from ..ec.stripe import StripeInfo, plan_write
 from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
                             MNotifyAck, MOSDBoot, MOSDOp, MOSDOpReply,
-                            MOSDPing, MOSDPingReply, MPGInfo, MPGPull,
+                            MOSDPing, MOSDPingReply, MPGInfo, MPGList,
+                            MPGListReply, MPGPull,
                             MOSDPGTemp,
                             MPGPush, MPGQuery, MPGRollback,
                             MRecoveryReserve, MStatsReport,
@@ -252,6 +253,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MSubWriteReply: self._handle_sub_write_reply,
             MSubRead: self._handle_sub_read,
             MSubReadReply: self._handle_sub_read_reply,
+            MPGList: self._handle_pg_list,
             MOSDPing: self._handle_ping,
             MOSDPingReply: self._handle_ping_reply,
             MPGQuery: self._handle_pg_query,
@@ -2716,6 +2718,71 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         except Exception:  # noqa: BLE001 - collection may not exist yet
             pass
         return out
+
+    def _handle_pg_list(self, conn, m: MPGList) -> None:
+        """List this PG's live object heads (librados pgls role).
+        Primary-only, auth-gated like a read."""
+        if self.osdmap is None or m.pgid.pool not in self.osdmap.pools:
+            # the client's map may be AHEAD (pool just created): EAGAIN
+            # retries; only a pool unknown at its own epoch is ENOENT
+            my_epoch = self.osdmap.epoch if self.osdmap else 0
+            err = EAGAIN if m.epoch > my_epoch else ENOENT
+            conn.send(MPGListReply(m.tid, m.pgid, err, epoch=my_epoch))
+            return
+        up = self.osdmap.pg_to_up_osds(m.pgid.pool, m.pgid.seed)
+        if self._primary_of(up) != self.osd_id:
+            conn.send(MPGListReply(m.tid, m.pgid, ESTALE,
+                                   epoch=self.osdmap.epoch))
+            return
+        if m.pgid in self._peering:
+            # a freshly promoted primary's store may still be missing
+            # not-yet-recovered heads: an authoritative listing must
+            # wait for peering, exactly like client IO does
+            conn.send(MPGListReply(m.tid, m.pgid, EAGAIN,
+                                   epoch=self.osdmap.epoch))
+            return
+        if self.auth is not None:
+            import hmac as _hmac
+
+            from ..auth.cephx import op_proof
+            vt = self.auth.verify(m.ticket)
+            pool_name = self.osdmap.pools[m.pgid.pool].name
+            want = (op_proof(vt.session_key, m.tid, m.pgid.pool,
+                             m.pgid.seed, "pgls")
+                    if vt is not None else b"")
+            if vt is None or not _hmac.compare_digest(want, m.proof) \
+                    or not vt.caps.allows("r", pool=pool_name):
+                conn.send(MPGListReply(m.tid, m.pgid, EACCES,
+                                       epoch=self.osdmap.epoch))
+                return
+        cid = CollectionId(m.pgid.pool, m.pgid.seed)
+        dead = self._tombstones.get(m.pgid, {})
+        is_ec = self._is_ec(m.pgid)
+        names: set[str] = set()
+        try:
+            for oid in self.store.list_objects(cid):
+                if oid.shard <= -2 or oid.generation >= 0:
+                    continue  # PG metadata / snapshot clones
+                if oid.name in names:
+                    continue
+                if is_ec and self._ec_whiteout(m.pgid, oid.name):
+                    continue
+                if oid.name in dead:
+                    # deletes win unless the head was re-written SINCE
+                    try:
+                        v = int(self.store.getattrs(cid, oid).get("v", 0))
+                    except Exception:  # noqa: BLE001
+                        v = 0
+                    if dead[oid.name] >= v:
+                        continue
+                names.add(oid.name)
+        except Exception:  # noqa: BLE001 - collection vanished mid-walk
+            # a partial walk must NOT masquerade as a complete listing
+            conn.send(MPGListReply(m.tid, m.pgid, EAGAIN,
+                                   epoch=self.osdmap.epoch))
+            return
+        conn.send(MPGListReply(m.tid, m.pgid, 0, sorted(names),
+                               epoch=self.osdmap.epoch))
 
     def _handle_pg_query(self, conn, m: MPGQuery) -> None:
         if self.osdmap is not None and m.epoch > self.osdmap.epoch \

@@ -102,6 +102,8 @@ def message_samples() -> dict:
                          1234567, b"p" * 32),
         M.MAuthReply: M.MAuthReply(
             3, 0, [("osd", b"ticket", b"sealed", b"n" * 16)], 600.0),
+        M.MPGList: M.MPGList(4, pg, 9, b"t" * 8, b"p" * 16),
+        M.MPGListReply: M.MPGListReply(4, pg, 0, ["a", "b"], 9),
     }
 
 
@@ -161,6 +163,10 @@ def create(base: str) -> int:
     os.makedirs(base, exist_ok=True)
     n = 0
     samples = message_samples()
+    missing = [c.__name__ for c in MESSAGE_TYPES if c not in samples]
+    if missing:
+        raise SystemExit(f"no canonical sample for {missing} — add them "
+                         f"to message_samples() first")
     for cls in MESSAGE_TYPES:
         msg = samples[cls]
         with open(os.path.join(base, f"msg_{cls.__name__}.bin"),
@@ -181,6 +187,10 @@ def check(base: str) -> list[str]:
     problems: list[str] = []
     samples = message_samples()
     for cls in MESSAGE_TYPES:
+        if cls not in samples:
+            problems.append(f"{cls.__name__}: registered wire type has "
+                            f"no canonical sample in message_samples()")
+            continue
         path = os.path.join(base, f"msg_{cls.__name__}.bin")
         if not os.path.exists(path):
             problems.append(f"{cls.__name__}: no archived blob "

@@ -1,0 +1,141 @@
+"""Independent EC audit: client-side shard reads + in-tool re-encode.
+
+The reference tool's defining property (ECReader.h + ECEncoder.h:17):
+it never asks the OSDs to verify themselves, so self-consistent
+OSD-side damage — which deep scrub's presence/version/digest checks
+pass — cannot hide.  Also covers the new pgls object listing.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import native
+from ceph_tpu.osd.objectstore import CollectionId, ObjectId, Transaction
+from ceph_tpu.tools.ec_consistency import run as audit
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(99)
+
+PROFILE = {"plugin": "jerasure", "k": "3", "m": "2",
+           "backend": "native"}
+
+
+@pytest.fixture
+def ec_cluster():
+    c = MiniCluster(n_osds=6, cfg=make_cfg()).start()
+    client = c.client()
+    client.create_pool("ecp", kind="ec", pg_num=4, ec_profile=PROFILE)
+    yield c, client
+    c.stop()
+
+
+def _fill(client, n=8):
+    objs = {}
+    for i in range(n):
+        data = RNG.integers(0, 256, 20_000 + i * 997,
+                            dtype=np.uint8).tobytes()
+        objs[f"obj{i}"] = data
+        client.write_full("ecp", f"obj{i}", data)
+    return objs
+
+
+def test_list_objects(ec_cluster):
+    c, client = ec_cluster
+    objs = _fill(client)
+    assert client.list_objects("ecp") == sorted(objs)
+    client.remove("ecp", "obj0")
+    assert "obj0" not in client.list_objects("ecp")
+
+
+def test_clean_pool_audits_clean(ec_cluster):
+    c, client = ec_cluster
+    _fill(client)
+    assert audit(client, "ecp") == []
+
+
+def _shard_holder(c, client, oid, shard):
+    pool_id = client._pool_id("ecp")
+    seed = client.osdmap.object_to_pg(pool_id, oid)
+    up = client.osdmap.pg_to_up_osds(pool_id, seed)
+    return c.osds[up[shard]], CollectionId(pool_id, seed)
+
+
+def test_catches_self_consistent_parity_corruption(ec_cluster):
+    """THE acceptance scenario: a parity shard's bytes are wrong but
+    its stored checksum was fixed up to match — per-shard digest
+    verification on the OSDs passes, deep scrub reports clean, and
+    ONLY the independent re-encode sees the algebra is broken."""
+    c, client = ec_cluster
+    _fill(client)
+    oid = "obj3"
+    parity_shard = 3  # k=3: shards 3,4 are parity
+    osd, cid = _shard_holder(c, client, oid, parity_shard)
+    sid = ObjectId(oid, shard=parity_shard)
+    raw = bytearray(osd.store.read(cid, sid).to_bytes())
+    raw[7] ^= 0x5A
+    tx = Transaction().write(cid, sid, 0, bytes(raw))
+    # fix the stored per-shard checksums ("d" is what deep scrub
+    # recomputes against, "dcsum" the EC write csum) to match the
+    # corrupt bytes: the damage is now SELF-consistent on that OSD
+    crc = native.crc32c(bytes(raw))
+    tx.setattrs(cid, sid, {"d": crc, "dcsum": crc})
+    osd.store.queue_transaction(tx)
+
+    assert client.scrub_pool("ecp", deep=True) == [], \
+        "premise broken: deep scrub caught what it should miss"
+    issues = audit(client, "ecp")
+    assert any(i["kind"] == "parity_mismatch" and i["object"] == oid
+               and i["shard"] == parity_shard for i in issues), issues
+
+
+def test_catches_systematic_encode_bug(ec_cluster):
+    """An OSD whose ENCODER is wrong writes self-consistent garbage
+    parity; scrub machinery on that OSD would bless it.  The tool's
+    own codec (constructed in-process from the pool profile) disagrees."""
+    c, client = ec_cluster
+    _fill(client, n=2)
+    pool_id = client._pool_id("ecp")
+
+    class _BuggyCodec:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __getattr__(self, name):
+            if name == "encode_chunks_with_csums":
+                # force the plain encode path (a property raising
+                # AttributeError would fall through to THIS __getattr__
+                # and hand back the inner codec's real fused encoder)
+                raise AttributeError(name)
+            return getattr(self._inner, name)
+
+        def encode_chunks(self, data_chunks):
+            parity = np.array(self._inner.encode_chunks(data_chunks))
+            parity[0, ::257] ^= 0x11  # subtly wrong Q everywhere
+            return parity
+
+    for osd in c.osds.values():
+        osd._ec_codecs[pool_id] = _BuggyCodec(
+            osd._pool_codec(pool_id))
+    data = RNG.integers(0, 256, 50_000, dtype=np.uint8).tobytes()
+    client.write_full("ecp", "poisoned", data)
+
+    issues = audit(client, "ecp", oid="poisoned")
+    assert any(i["kind"] == "parity_mismatch" for i in issues), issues
+    # the data itself still reads back (k data shards intact)
+    assert client.read("ecp", "poisoned") == data
+
+
+def test_audit_detects_csum_mismatch(ec_cluster):
+    c, client = ec_cluster
+    _fill(client, n=3)
+    oid = "obj1"
+    osd, cid = _shard_holder(c, client, oid, 1)
+    sid = ObjectId(oid, shard=1)
+    raw = bytearray(osd.store.read(cid, sid).to_bytes())
+    raw[0] ^= 0xFF  # bytes change, stored dcsum does NOT
+    osd.store.queue_transaction(
+        Transaction().write(cid, sid, 0, bytes(raw)))
+    issues = audit(client, "ecp", oid=oid)
+    kinds = {i["kind"] for i in issues}
+    assert "csum_mismatch" in kinds or "parity_mismatch" in kinds
