@@ -78,7 +78,7 @@ class MdsDaemon:
     LEASE_TTL = 30.0  # seconds; mirrors mds_session_cap lease behavior
 
     def __init__(self, client: RadosClient, pool: str, rank: int = 0,
-                 auth=None):
+                 auth=None, standby: bool = False):
         self.client = client
         self.pool = pool
         self.rank = rank
@@ -98,7 +98,13 @@ class MdsDaemon:
         self._seq = 0
         self._applied = 0
         self._ensure_root()
-        self.replay()
+        if not standby:
+            self.replay()
+        # standby-replay construction defers the journal apply to
+        # promotion: a hot spare must never APPLY while the active
+        # serves (re-applying an old entry behind the active's newer
+        # write would clobber shared dentry tables) — it only keeps
+        # its journal view warm (StandbyReplayMds below).
 
     # ------------------------------------------------------------- journal
     def _ensure_root(self) -> None:
@@ -666,6 +672,59 @@ class _OrderedLocks:
     def __exit__(self, *exc):
         for lk in reversed(self._locks):
             lk.release()
+
+
+class StandbyReplayMds:
+    """Hot spare for one rank (the mds standby-replay role,
+    src/mds/MDSRank standby_replay): tails the active's journal
+    continuously so promotion costs only the UNAPPLIED delta, not a
+    cold construction + full journal scan.
+
+    Shape difference from the reference, deliberate: our dentry tables
+    live in shared RADOS omap (not per-MDS memory), so the standby must
+    never APPLY while the active serves — re-applying an old entry
+    behind the active's newer write would clobber shared state.  It
+    polls the journal to keep its view (and the object's read path)
+    warm and tracks the lag; promote() performs one replay() that
+    applies exactly the entries the dead active journaled but never
+    marked applied (the crash window), then the daemon serves."""
+
+    def __init__(self, client: RadosClient, pool: str, rank: int = 0,
+                 auth=None, poll: float = 0.05):
+        self.mds = MdsDaemon(client, pool, rank=rank, auth=auth,
+                             standby=True)
+        self.lag = 0          # journaled-but-unapplied entries seen
+        self._promoted = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._tail, args=(poll,),
+            name=f"mds-standby-{rank}", daemon=True)
+        self._thread.start()
+
+    def _tail(self, poll: float) -> None:
+        while not self._stop.wait(poll):
+            try:
+                raw = self.mds._journal_entries()
+                applied = int(raw.get(_APPLIED_KEY, b"0") or 0)
+                self.lag = sum(1 for k in raw
+                               if k != _APPLIED_KEY
+                               and int(k, 16) > applied)
+            except Exception:  # noqa: BLE001 - cluster hiccup; re-poll
+                pass
+
+    def promote(self) -> tuple[MdsDaemon, int]:
+        """Take over the rank: stop tailing, apply the unapplied tail
+        (the dead active's crash window), return the live daemon and
+        how many entries the takeover had to replay."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+        replayed = self.mds.replay()
+        self._promoted = True
+        return self.mds, replayed
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
 
 
 class MdsCluster:

@@ -1,0 +1,106 @@
+"""MDS standby-replay (src/mds/ standby_replay role): a hot spare
+tails the active rank's journal and takes over by applying only the
+dead active's crash window."""
+
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.msg.wire import pack_value
+from ceph_tpu.services.fs import FsClient
+from ceph_tpu.services.mds import (_JOURNAL_OID, MdsDaemon,
+                                   StandbyReplayMds)
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(61)
+
+
+@pytest.fixture
+def cluster():
+    c = MiniCluster(n_osds=4, cfg=make_cfg()).start()
+    client = c.client()
+    client.create_pool("fsp", size=2, pg_num=4)
+    yield c, client
+    c.stop()
+
+
+def test_standby_promotion_after_clean_active(cluster):
+    c, client = cluster
+    active = MdsDaemon(client, "fsp")
+    fs = FsClient(client, "fsp", mds=active)
+    fs.mkdir("/a")
+    fs.create("/a/f")
+    fs.write_file("/a/f", b"before failover")
+    standby = StandbyReplayMds(c.client(), "fsp")
+    time.sleep(0.2)  # tailing; active is fully applied
+    assert standby.lag == 0
+    fs.unmount()
+    promoted, replayed = standby.promote()
+    # clean shutdown: nothing in the crash window
+    assert replayed == 0
+    fs2 = FsClient(client, "fsp", mds=promoted)
+    assert fs2.read_file("/a/f") == b"before failover"
+    fs2.mkdir("/post")        # the promoted rank serves mutations
+    assert sorted(fs2.listdir("/")) == ["a", "post"]
+    fs2.unmount()
+
+
+def test_standby_applies_only_the_crash_window(cluster):
+    """THE standby-replay property: the active journaled two mutations
+    and died before applying them; the promoted standby replays exactly
+    those two — not the whole journal — and the namespace includes
+    them."""
+    c, client = cluster
+    active = MdsDaemon(client, "fsp")
+    fs = FsClient(client, "fsp", mds=active)
+    for i in range(20):       # a real journal history, all applied
+        fs.mkdir(f"/d{i}")
+    standby = StandbyReplayMds(c.client(), "fsp")
+    time.sleep(0.2)
+    # simulate the crash window: journal two ops WITHOUT applying
+    # (the active died between journal-append and apply)
+    seq = active._seq
+    client.omap_set("fsp", _JOURNAL_OID.format(rank=0), {
+        f"{seq + 1:016x}": pack_value(
+            {"op": "mkdir", "path": "/crashed1",
+             "ent": {"type": "dir", "mtime": 0.0}}),
+        f"{seq + 2:016x}": pack_value(
+            {"op": "set_entry", "path": "/crashed1/file",
+             "ent": {"type": "file", "size": 0, "ino": "deadbeef",
+                     "mtime": 0.0}}),
+    })
+    deadline = time.time() + 5
+    while standby.lag != 2 and time.time() < deadline:
+        time.sleep(0.05)
+    assert standby.lag == 2   # the tail sees the un-applied window
+    fs.unmount()
+    promoted, replayed = standby.promote()
+    assert replayed == 2      # ONLY the crash window, not 20+ entries
+    fs2 = FsClient(client, "fsp", mds=promoted)
+    assert "crashed1" in fs2.listdir("/")
+    assert fs2.listdir("/crashed1") == ["file"]
+    assert sorted(fs2.listdir("/"))[:3] == ["crashed1", "d0", "d1"]
+    fs2.unmount()
+
+
+def test_standby_never_applies_while_active_lives(cluster):
+    """The shared-table safety property: a tailing standby must not
+    write the dentry tables — mutations land exactly once, from the
+    active."""
+    c, client = cluster
+    active = MdsDaemon(client, "fsp")
+    fs = FsClient(client, "fsp", mds=active)
+    standby = StandbyReplayMds(c.client(), "fsp")
+    for i in range(30):
+        fs.mkdir(f"/x{i}")
+        fs.create(f"/x{i}/f")
+    time.sleep(0.3)           # standby tailing through live mutations
+    assert standby.lag == 0   # active keeps itself applied
+    # the standby never advanced its own applied state
+    assert standby.mds._applied == 0
+    fs.rename("/x0/f", "/x1/g")
+    assert fs.listdir("/x1") == ["f", "g"]
+    standby.stop()
+    fs.unmount()
