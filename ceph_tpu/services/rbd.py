@@ -57,6 +57,15 @@ _JOURNAL = "rbd_journal.{name}"
 _LOCK_NAME = "rbd_lock"
 
 FEATURE_JOURNALING = 1
+FEATURE_OBJECT_MAP = 2
+FEATURE_FAST_DIFF = 4
+
+# object-map states (src/librbd/ObjectMap.h / object_map_types.h):
+# nonexistent / exists-dirty (written since the last snapshot) /
+# exists-clean (untouched since the last snapshot)
+OM_NONEXISTENT, OM_EXISTS, OM_EXISTS_CLEAN = 0, 1, 3
+_OMAP = "rbd_object_map.{name}"
+_OMAP_SNAP = "rbd_object_map.{name}@{snap}"
 
 
 class RbdError(Exception):
@@ -196,6 +205,7 @@ class Image:
         import threading
         self._lk = threading.RLock()  # lock state vs the notify thread
         self._jseq = 0
+        self._om_cache = None  # object-map bytes, valid under the lock
         self._load()
 
     # ------------------------------------------------- exclusive lock
@@ -436,6 +446,9 @@ class Image:
         except RadosError as e:
             raise RbdError(f"no image {self.name!r}") from e
         self.header = ImageHeader.decode_bytes(raw)
+        # the cached object map is only valid under the lock epoch the
+        # header was read in — another owner may have advanced it
+        self._om_cache = None
 
     def _save(self) -> None:
         self.client.write_full(self.pool, _HEADER.format(name=self.name),
@@ -524,6 +537,8 @@ class Image:
             for obj_off, p, take in extents:
                 self.client.write(self.pool, self._piece(objno),
                                   data[p:p + take], offset=obj_off)
+        if self._om_enabled():
+            self._om_mark(per_obj.keys(), OM_EXISTS)
         if dirty_header:
             self._save()
 
@@ -538,7 +553,19 @@ class Image:
         out = bytearray(length)
         pos = 0
         snap_id = None if snap is None else self._snap_record(snap).snap_id
+        if snap_id is None and self._om_enabled():
+            if not self._locked:
+                # a non-owner's cached map can be stale (another owner
+                # may have written under the lock): re-read it
+                self._om_cache = None
+            om = self._om()
+        else:
+            om = None
         for objno, obj_off, take in layout.file_to_extents(off, length):
+            if om is not None and (objno >= len(om)
+                                   or om[objno] == OM_NONEXISTENT):
+                pos += take  # object-map says hole: zeros, no round trip
+                continue
             oid = self._piece(objno) if snap_id is None \
                 else self._resolve_snap_object(objno, snap_id)
             out[pos:pos + take] = self._read_piece(oid, obj_off, take)
@@ -552,6 +579,180 @@ class Image:
             if rec.snap_id >= snap_id and objno in rec.copied:
                 return self._snap_piece(objno, rec.snap_id)
         return self._piece(objno)
+
+    # ---------------------------------------------------- object map
+    # (src/librbd/ObjectMap.h + the fast-diff feature): one state byte
+    # per data object, maintained under the exclusive lock.  Reads skip
+    # NONEXISTENT objects with no cluster round trip; snapshots persist
+    # a copy and demote EXISTS -> EXISTS_CLEAN, so "dirty since snap X"
+    # is answered from the maps alone (fast_diff) — no data reads.
+    def _om_enabled(self) -> bool:
+        return bool(self.header.features & FEATURE_OBJECT_MAP)
+
+    def _om_oid(self, snap_id: int | None = None) -> str:
+        if snap_id is None:
+            return _OMAP.format(name=self.name)
+        return _OMAP_SNAP.format(name=self.name, snap=snap_id)
+
+    def _om_len(self) -> int:
+        objs = self._objects_covering(self.header.size)
+        return (max(objs) + 1) if objs else 0
+
+    def _om(self) -> bytearray:
+        m = self._om_cache
+        if m is None:
+            rebuilt = False
+            try:
+                raw = self.client.read(self.pool, self._om_oid())
+                m = bytearray(raw)
+            except RadosError:
+                # missing/never built: rebuild from reality (the
+                # `rbd object-map rebuild` path on feature enable)
+                m = self._om_rebuild_locked()
+                rebuilt = True
+            n = self._om_len()
+            if len(m) < n:
+                m = m + bytearray(n - len(m))
+            self._om_cache = m
+            if rebuilt:
+                # persist NOW: _om_mark's no-change fast path must be
+                # able to trust that the stored object exists
+                self._om_save()
+        return m
+
+    def _om_save(self) -> None:
+        if self._om_cache is not None:
+            self.client.write_full(self.pool, self._om_oid(),
+                                   bytes(self._om_cache))
+
+    def _om_mark(self, objnos, state: int) -> None:
+        if not self._om_enabled():
+            return
+        m = self._om()
+        changed = False
+        for objno in objnos:
+            if objno >= len(m):
+                m.extend(bytearray(objno + 1 - len(m)))
+            if m[objno] != state:
+                m[objno] = state
+                changed = True
+        if changed:
+            # steady-state rewrites of an already-EXISTS object pay no
+            # extra round trip
+            self._om_save()
+
+    def _om_rebuild_locked(self) -> bytearray:
+        m = bytearray(self._om_len())
+        for objno in range(len(m)):
+            try:
+                self.client.stat(self.pool, self._piece(objno))
+                m[objno] = OM_EXISTS
+            except RadosError:
+                m[objno] = OM_NONEXISTENT
+        return m
+
+    def _om_drop_snap(self, rec: SnapRecord) -> None:
+        """Removing a snapshot must MERGE its dirty bits into the next
+        younger map (or the head) before its map goes away — else
+        fast_diff across the removed snapshot under-reports changes
+        (the data path's retire/read-through logic has the same
+        obligation for bytes)."""
+        try:
+            removed = self.client.read(self.pool,
+                                       self._om_oid(rec.snap_id))
+        except RadosError:
+            removed = b""
+        younger = next((r for r in self.header.snaps
+                        if r.snap_id > rec.snap_id), None)
+        if removed:
+            if younger is not None:
+                try:
+                    tgt = bytearray(self.client.read(
+                        self.pool, self._om_oid(younger.snap_id)))
+                except RadosError:
+                    tgt = bytearray()
+                for i, v in enumerate(removed):
+                    if v == OM_EXISTS and i < len(tgt) \
+                            and tgt[i] == OM_EXISTS_CLEAN:
+                        tgt[i] = OM_EXISTS
+                self.client.write_full(
+                    self.pool, self._om_oid(younger.snap_id),
+                    bytes(tgt))
+            else:
+                m = self._om()
+                dirty = [i for i, v in enumerate(removed)
+                         if v == OM_EXISTS and i < len(m)
+                         and m[i] == OM_EXISTS_CLEAN]
+                if dirty:
+                    self._om_mark(dirty, OM_EXISTS)
+        try:
+            self.client.remove(self.pool, self._om_oid(rec.snap_id))
+        except RadosError:
+            pass
+
+    def _om_resync(self) -> None:
+        """Rare geometry-changing ops (resize, rollback) re-derive the
+        map from reality rather than patching it incrementally."""
+        if self._om_enabled():
+            self._om_cache = self._om_rebuild_locked()
+            self._om_save()
+
+    def rebuild_object_map(self) -> int:
+        """`rbd object-map rebuild`: re-derive the map from the actual
+        data objects (feature enable on an existing image, or repair
+        after an invalid-map event).  Returns the object count."""
+        self._ensure_lock()
+        try:
+            self._om_cache = self._om_rebuild_locked()
+            self._om_save()
+            return len(self._om_cache)
+        finally:
+            self._end_op()
+
+    def fast_diff(self, from_snap: str | None = None) -> list[dict]:
+        """Changed object extents since `from_snap` (None = since
+        creation), computed purely from object maps — the fast-diff
+        feature's deltas-without-reading-data contract (object
+        granularity; offsets are objno * object_size).  Dirtiness
+        composes across snapshots: snapshot S's map carries EXISTS
+        (dirty) for exactly the objects written between S-1 and S."""
+        if not (self.header.features & FEATURE_FAST_DIFF) \
+                or not self._om_enabled():
+            raise RbdError("fast-diff requires the object-map + "
+                           "fast-diff features")
+        self._load()  # also invalidates the cached map
+        head = self._om()
+        n = len(head)
+        if from_snap is None:
+            changed = [i for i in range(n) if head[i] != OM_NONEXISTENT]
+        else:
+            rec = self._snap_record(from_snap)
+            try:
+                fmap = self.client.read(self.pool,
+                                        self._om_oid(rec.snap_id))
+            except RadosError:
+                fmap = b""
+            later = []
+            for r in self.header.snaps:
+                if r.snap_id > rec.snap_id:
+                    try:
+                        later.append(self.client.read(
+                            self.pool, self._om_oid(r.snap_id)))
+                    except RadosError:
+                        pass
+            later.append(bytes(head))
+            changed = []
+            for i in range(n):
+                dirty = any(i < len(m) and m[i] == OM_EXISTS
+                            for m in later)
+                was = i < len(fmap) and fmap[i] != OM_NONEXISTENT
+                now = head[i] != OM_NONEXISTENT
+                if dirty or was != now:
+                    changed.append(i)
+        osize = self.header.object_size
+        return [{"objno": i, "offset": i * osize, "length": osize,
+                 "exists": head[i] != OM_NONEXISTENT}
+                for i in changed]
 
     # ------------------------------------------------------------- resize
     def _zero_tail(self, new_size: int, old_size: int) -> None:
@@ -603,6 +804,8 @@ class Image:
             if dirty:
                 self._save()
             self._zero_tail(new_size, old)
+        self.header.size = new_size  # _om_resync sizes off the header
+        self._om_resync()
         self.header.size = new_size
         self._save()
 
@@ -626,6 +829,17 @@ class Image:
         self.header.snap_seq += 1
         rec = SnapRecord(self.header.snap_seq, name, self.header.size)
         self.header.snaps.append(rec)
+        if self._om_enabled():
+            # persist the snapshot's map, then demote dirty -> clean:
+            # the head map's EXISTS bytes now mean "written since THIS
+            # snapshot" (the fast-diff bookkeeping)
+            m = self._om()
+            self.client.write_full(self.pool,
+                                   self._om_oid(rec.snap_id), bytes(m))
+            for i, v in enumerate(m):
+                if v == OM_EXISTS:
+                    m[i] = OM_EXISTS_CLEAN
+            self._om_save()
         self._save()
         return rec.snap_id
 
@@ -642,6 +856,8 @@ class Image:
 
     def _snap_remove_locked(self, name: str) -> None:
         rec = self._snap_record(name)
+        if self._om_enabled():
+            self._om_drop_snap(rec)
         older_live = any(r.name and r.snap_id < rec.snap_id
                         for r in self.header.snaps)
         if older_live:
@@ -715,6 +931,7 @@ class Image:
         self._zero_tail(rec.size, max(cur, rec.size))
         self.header.size = rec.size
         self._save()
+        self._om_resync()
 
     # -------------------------------------------------------------- purge
     def purge(self) -> None:
@@ -738,6 +955,17 @@ class Image:
                             self._snap_piece(objno, rec.snap_id))
                     except RadosError:
                         pass
+        if self._om_enabled():
+            try:
+                self.client.remove(self.pool, self._om_oid())
+            except RadosError:
+                pass
+            for rec in self.header.snaps:
+                try:
+                    self.client.remove(self.pool,
+                                       self._om_oid(rec.snap_id))
+                except RadosError:
+                    pass
         try:
             self.client.remove(self.pool,
                                _HEADER.format(name=self.name))
