@@ -118,6 +118,9 @@ class RgwGateway:
         self.zone = zone
         self._bilog_lock = threading.Lock()
         self._bilog_seq: dict[str, int] = {}
+        self._push_endpoints: dict = {}   # topic -> callable (push)
+        self._notify_lock = threading.Lock()
+        self._nseq = 0                    # notification seq tiebreak
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -506,6 +509,157 @@ class RgwGateway:
         if bucket not in self._buckets():
             raise KeyError(bucket)
 
+    # ------------------------------------------------- notifications
+    # (the rgw pubsub/bucket-notification slice, src/rgw/rgw_notify.h
+    # + rgw_pubsub.h: SNS-shaped TOPICS, per-bucket notification
+    # configurations with event-type and prefix/suffix filters, and
+    # S3-shaped event records delivered to a durable per-topic queue
+    # (pull mode) and/or a push endpoint.  Push here is an in-process
+    # callable — the HTTP/AMQP/Kafka transports of the reference are
+    # deployment plumbing around the same record.)
+    _TOPICS_OID = "rgw_topics"
+    _QUEUE_OID = "rgw_queue.{topic}"
+
+    def create_topic(self, name: str, push_endpoint=None) -> None:
+        """SNS CreateTopic role.  push_endpoint: optional callable
+        invoked per event (best-effort; the durable queue keeps the
+        record either way — the persistent-queue delivery contract)."""
+        self.client.omap_set(
+            self.pool, self._TOPICS_OID,
+            {name: pack_value({"created": time.time()})})
+        if push_endpoint is not None:
+            self._push_endpoints[name] = push_endpoint
+
+    def delete_topic(self, name: str) -> None:
+        """Removes the topic, its durable queue (undelivered records
+        must not leak to a future topic of the same name), and every
+        bucket configuration referencing it (events would otherwise
+        keep accumulating in an orphaned queue forever)."""
+        self.client.omap_rm(self.pool, self._TOPICS_OID, [name])
+        self._push_endpoints.pop(name, None)
+        try:
+            q = self._QUEUE_OID.format(topic=name)
+            keys = list(self.client.omap_get(self.pool, q))
+            if keys:
+                self.client.omap_rm(self.pool, q, keys)
+        except RadosError:
+            pass
+        for bucket in list(self._buckets()):
+            try:
+                rec = self._bucket_rec(bucket)
+            except KeyError:
+                continue
+            cfgs = rec.get("notifications", [])
+            kept = [c for c in cfgs if c.get("topic") != name]
+            if len(kept) != len(cfgs):
+                rec["notifications"] = kept
+                self._bucket_rec_set(bucket, rec)
+
+    def list_topics(self) -> list[str]:
+        return sorted(self._topics())
+
+    def _topics(self) -> dict:
+        try:
+            return {k: unpack_value(v) for k, v in self.client.omap_get(
+                self.pool, self._TOPICS_OID).items()}
+        except RadosError:
+            return {}
+
+    def put_bucket_notification(self, bucket: str,
+                                configs: list[dict]) -> None:
+        """PutBucketNotificationConfiguration role: each config is
+        {"id", "topic", "events": ["s3:ObjectCreated:*", ...],
+        "prefix": "", "suffix": ""}."""
+        topics = self._topics()
+        for cfg in configs:
+            if cfg.get("topic") not in topics:
+                raise KeyError(f"no topic {cfg.get('topic')!r}")
+            for ev in cfg.get("events", []):
+                if not ev.startswith("s3:"):
+                    raise ValueError(f"bad event type {ev!r}")
+        rec = self._bucket_rec(bucket)
+        rec["notifications"] = list(configs)
+        self._bucket_rec_set(bucket, rec)
+
+    def get_bucket_notification(self, bucket: str) -> list[dict]:
+        return list(self._bucket_rec(bucket).get("notifications", []))
+
+    @staticmethod
+    def _event_matches(cfg: dict, event: str, key: str) -> bool:
+        ok = False
+        for want in cfg.get("events", []):
+            if want == event or (want.endswith(":*")
+                                 and event.startswith(want[:-1])):
+                ok = True
+                break
+        if not ok:
+            return False
+        if cfg.get("prefix") and not key.startswith(cfg["prefix"]):
+            return False
+        if cfg.get("suffix") and not key.endswith(cfg["suffix"]):
+            return False
+        return True
+
+    def _notify(self, bucket: str, event: str, key: str,
+                etag: str = "", size: int = 0,
+                version_id: str = "") -> None:
+        try:
+            configs = self._bucket_rec(bucket).get("notifications", [])
+        except KeyError:
+            return
+        if not configs:
+            return
+        record = None
+        for cfg in configs:
+            if not self._event_matches(cfg, event, key):
+                continue
+            if record is None:
+                # the S3 event record shape (Records[0] essentials)
+                record = {"eventVersion": "2.2", "eventSource":
+                          "ceph:tpu:s3", "awsRegion": self.zone,
+                          "eventTime": time.time(), "eventName": event,
+                          "s3": {"configurationId": "",
+                                 "bucket": {"name": bucket},
+                                 "object": {"key": key, "eTag": etag,
+                                            "size": size,
+                                            "versionId": version_id}}}
+            rec = dict(record)
+            rec["s3"] = dict(record["s3"],
+                             configurationId=cfg.get("id", ""))
+            topic = cfg["topic"]
+            # durable queue first (persistent delivery), then the
+            # best-effort push endpoint
+            oid = self._QUEUE_OID.format(topic=topic)
+            with self._notify_lock:
+                # key minting must be atomic: two handler threads
+                # minting the same (time, seq) key would overwrite one
+                # record and break the durable-delivery contract
+                self._nseq += 1
+                qkey = f"{time.time():017.6f}.{self._nseq:08d}"
+            self.client.omap_set(self.pool, oid,
+                                 {qkey: pack_value(rec)})
+            ep = self._push_endpoints.get(topic)
+            if ep is not None:
+                try:
+                    ep(rec)
+                except Exception:  # noqa: BLE001 - push is best-effort
+                    pass
+
+    def pull_events(self, topic: str, max_events: int = 100,
+                    ack: bool = True) -> list[dict]:
+        """Pull-mode consumption of a topic's durable queue; ack
+        removes the delivered records (the pubsub ack contract)."""
+        oid = self._QUEUE_OID.format(topic=topic)
+        try:
+            raw = self.client.omap_get(self.pool, oid)
+        except RadosError:
+            return []
+        keys = sorted(raw)[:max_events]
+        out = [unpack_value(raw[k]) for k in keys]
+        if ack and keys:
+            self.client.omap_rm(self.pool, oid, keys)
+        return out
+
     # ----------------------------------------------------------- IAM
     # (the rgw IAM/bucket-policy slice, src/rgw/rgw_iam_policy.{h,cc}:
     # buckets have OWNERS; non-owners are admitted only by an attached
@@ -736,6 +890,8 @@ class RgwGateway:
                                     "etag": etag, "mtime": mtime,
                                     "version_id": vid or "",
                                     "zone": origin or self.zone})
+        self._notify(bucket, "s3:ObjectCreated:Put", key, etag=etag,
+                     size=len(body), version_id=vid or "")
         return etag
 
     def list_versions_xml(self, bucket: str, prefix: str = "") -> bytes:
@@ -961,6 +1117,9 @@ class RgwGateway:
                                     "etag": etag, "mtime": mtime,
                                     "version_id": vid or "",
                                     "zone": self.zone})
+        self._notify(bucket,
+                     "s3:ObjectCreated:CompleteMultipartUpload", key,
+                     etag=etag, size=total, version_id=vid or "")
         # retire the session; uploaded-but-unlisted parts are garbage
         for n in stored:
             if n not in {p[0] for p in manifest}:
@@ -1111,6 +1270,9 @@ class RgwGateway:
                                         "mtime": mtime,
                                         "version_id": vid,
                                         "zone": origin or self.zone})
+            self._notify(bucket,
+                         "s3:ObjectRemoved:DeleteMarkerCreated", key,
+                         version_id=vid)
             return {"delete_marker": True, "version_id": vid}
         if version_id:
             # permanent removal of ONE generation
@@ -1141,6 +1303,8 @@ class RgwGateway:
                                         "mtime": mtime,
                                         "version_id": version_id,
                                         "zone": origin or self.zone})
+            self._notify(bucket, "s3:ObjectRemoved:Delete", key,
+                         version_id=version_id)
             return {"delete_marker": False, "version_id": version_id}
         if head is None:
             raise KeyError(key)
@@ -1150,4 +1314,6 @@ class RgwGateway:
                                     "etag": "", "mtime": mtime,
                                     "version_id": "",
                                     "zone": origin or self.zone})
+        self._notify(bucket, "s3:ObjectRemoved:Delete", key,
+                     version_id=version_id or "")
         return {"delete_marker": False, "version_id": ""}
