@@ -174,13 +174,29 @@ class RgwGateway:
                 query = self.path.split("?", 1)[1] \
                     if "?" in self.path else ""
                 try:
-                    return s3auth.verify(
+                    who = s3auth.verify(
                         self.command, path, query,
                         {k: v for k, v in self.headers.items()},
-                        body, gw.users.get)
+                        body, gw.auth_lookup)
                 except s3auth.AuthError as e:
                     self._error(e.http, e.s3code)
                     return None
+                if who.startswith("STS") and who not in gw.users:
+                    # temporary credentials (a REGISTERED key that
+                    # happens to start with STS stays a normal user):
+                    # the live session token must ride the request and
+                    # the principal becomes the ROLE
+                    # (rgw_rest_sts.cc session semantics).  One record
+                    # fetch serves both the token gate and the
+                    # underlying-user attribution.
+                    rec = gw._sts_record(who)
+                    token = self.headers.get("x-amz-security-token")
+                    if rec is None or token != rec["token"]:
+                        self._error(403, "AccessDenied")
+                        return None
+                    self._sts_user = rec["principal"]
+                    who = f"sts:{rec['role']}"
+                return who
 
             def _allow(self, who, bucket, action) -> bool:
                 try:
@@ -416,9 +432,24 @@ class RgwGateway:
                                 return
                             self._send(200)
                         else:
+                            if who.startswith("sts:"):
+                                # temporary credentials may only
+                                # create buckets their ROLE policy
+                                # allows, and ownership goes to the
+                                # assuming USER — a role principal as
+                                # owner would hand every session of
+                                # that role owner powers
+                                if not gw._role_policy_allows(
+                                        who.split(":", 1)[1], bucket,
+                                        "s3:CreateBucket"):
+                                    self._error(403, "AccessDenied")
+                                    return
                             gw.create_bucket(bucket)
-                            if who:
-                                gw.set_bucket_owner(bucket, who)
+                            owner = who
+                            if who.startswith("sts:"):
+                                owner = getattr(self, "_sts_user", "")
+                            if owner:
+                                gw.set_bucket_owner(bucket, owner)
                             self._send(200)
                     elif "partNumber" in qs and "uploadId" in qs:
                         etag = gw.put_part(bucket, key, qs["uploadId"],
@@ -508,6 +539,129 @@ class RgwGateway:
     def check_bucket(self, bucket: str) -> None:
         if bucket not in self._buckets():
             raise KeyError(bucket)
+
+    # ----------------------------------------------------------- STS
+    # (the rgw STS slice, src/rgw/rgw_sts.h + rgw_rest_sts.cc
+    # AssumeRole: IAM roles with a TRUST list and a permission policy;
+    # assumption mints time-limited credentials — access key, secret,
+    # session token — that authenticate through the normal SigV4 path
+    # with the token required, and authorize against the ROLE's policy
+    # instead of ownership.)
+    _ROLES_OID = "rgw_roles"
+    _STS_OID = "rgw_sts_tokens"
+
+    def create_role(self, name: str, trust: list[str],
+                    policy: dict) -> None:
+        """IAM CreateRole: `trust` lists the principals permitted to
+        assume the role; `policy` is the AWS-shaped permission policy
+        evaluated for the temporary principal."""
+        if not isinstance(policy.get("Statement"), list):
+            raise ValueError("role policy needs a Statement list")
+        self.client.omap_set(
+            self.pool, self._ROLES_OID,
+            {name: pack_value({"trust": list(trust),
+                               "policy": policy,
+                               "created": time.time()})})
+
+    def list_roles(self) -> list[str]:
+        try:
+            return sorted(self.client.omap_get(self.pool,
+                                               self._ROLES_OID))
+        except RadosError:
+            return []
+
+    def assume_role(self, principal: str, role: str,
+                    duration: float = 3600.0) -> dict:
+        """STS AssumeRole: trust-gated minting of temporary
+        credentials.  The caller authenticates as itself first (the
+        gateway calls this after SigV4, or a test calls it directly
+        with a verified principal)."""
+        try:
+            raw = self.client.omap_get(self.pool,
+                                       self._ROLES_OID).get(role)
+        except RadosError:
+            raw = None
+        if raw is None:
+            raise KeyError(f"no role {role!r}")
+        rec = unpack_value(raw)
+        if principal not in rec.get("trust", []):
+            raise PermissionError(
+                f"{principal} is not trusted by role {role}")
+        import secrets as _secrets
+        access = "STS" + _secrets.token_hex(8).upper()
+        secret = _secrets.token_hex(20)
+        token = _secrets.token_hex(16)
+        expiry = time.time() + float(duration)
+        self.client.omap_set(
+            self.pool, self._STS_OID,
+            {access: pack_value({"secret": secret, "token": token,
+                                 "role": role, "principal": principal,
+                                 "expiry": expiry})})
+        return {"access_key": access, "secret_key": secret,
+                "session_token": token, "expiration": expiry,
+                "role": role}
+
+    def _sts_record(self, access_key: str) -> dict | None:
+        """Live temporary-credential record, purging on expiry (the
+        session-expiry renewal forcing function)."""
+        if not access_key.startswith("STS"):
+            return None
+        try:
+            raw = self.client.omap_get(self.pool,
+                                       self._STS_OID).get(access_key)
+        except RadosError:
+            return None
+        if raw is None:
+            return None
+        rec = unpack_value(raw)
+        if time.time() > float(rec.get("expiry", 0)):
+            try:
+                self.client.omap_rm(self.pool, self._STS_OID,
+                                    [access_key])
+            except RadosError:
+                pass
+            return None
+        return rec
+
+    def auth_lookup(self, access_key: str):
+        """SigV4 secret resolution across BOTH credential classes:
+        long-lived users and live STS sessions."""
+        if self.users and access_key in self.users:
+            return self.users[access_key]
+        rec = self._sts_record(access_key)
+        return rec["secret"] if rec is not None else None
+
+    def sts_principal(self, access_key: str,
+                      session_token: str | None) -> str | None:
+        """After SigV4 passes for an STS access key: require the live
+        session token and map the caller to its role principal
+        ("sts:<role>").  None = reject."""
+        rec = self._sts_record(access_key)
+        if rec is None or session_token != rec["token"]:
+            return None
+        return f"sts:{rec['role']}"
+
+    def _role_policy_allows(self, role: str, bucket: str,
+                            action: str) -> bool:
+        try:
+            raw = self.client.omap_get(self.pool,
+                                       self._ROLES_OID).get(role)
+        except RadosError:
+            raw = None
+        if raw is None:
+            return False
+        allowed = False
+        for stmt in unpack_value(raw).get("policy", {}) \
+                .get("Statement", []):
+            if not (self._action_matches(stmt.get("Action", []), action)
+                    and self._resource_matches(
+                        stmt.get("Resource", ["*"]), bucket)):
+                continue
+            if stmt.get("Effect") == "Deny":
+                return False
+            if stmt.get("Effect") == "Allow":
+                allowed = True
+        return allowed
 
     # ------------------------------------------------- notifications
     # (the rgw pubsub/bucket-notification slice, src/rgw/rgw_notify.h
@@ -691,6 +845,23 @@ class RgwGateway:
         self._bucket_rec_set(bucket, rec)
 
     @staticmethod
+    def _action_matches(actions, action: str) -> bool:
+        """ONE action matcher for bucket and role policies — split
+        evaluators silently diverge on wildcard support."""
+        if isinstance(actions, str):
+            actions = [actions]
+        return any(a in ("*", "s3:*", action) for a in actions)
+
+    @staticmethod
+    def _resource_matches(resources, bucket: str) -> bool:
+        if isinstance(resources, str):
+            resources = [resources]
+        return any(r in ("*", bucket)
+                   or (r.endswith("*") and r.rstrip("*")
+                       and bucket.startswith(r.rstrip("*")))
+                   for r in resources)
+
+    @staticmethod
     def _stmt_matches(stmt: dict, principal: str, action: str) -> bool:
         pr = stmt.get("Principal", {})
         if pr != "*":
@@ -699,10 +870,8 @@ class RgwGateway:
                 aws = [aws]
             if "*" not in aws and principal not in aws:
                 return False
-        acts = stmt.get("Action", [])
-        if isinstance(acts, str):
-            acts = [acts]
-        return any(a == "s3:*" or a == action for a in acts)
+        return RgwGateway._action_matches(stmt.get("Action", []),
+                                          action)
 
     def authorize(self, principal: str, bucket: str,
                   action: str) -> None:
@@ -715,6 +884,18 @@ class RgwGateway:
             rec = self._bucket_rec(bucket)
         except KeyError:
             return  # bucket existence errors surface as 404 later
+        if principal.startswith("sts:"):
+            # temporary credentials: the ROLE's permission policy is
+            # the authority (never ownership); an explicit resource-
+            # policy Deny naming the role principal still wins
+            for stmt in (rec.get("policy") or {}).get("Statement", []):
+                if self._stmt_matches(stmt, principal, action) \
+                        and stmt.get("Effect") == "Deny":
+                    raise PermissionError(action)
+            if not self._role_policy_allows(principal[4:], bucket,
+                                            action):
+                raise PermissionError(action)
+            return
         owner = rec.get("owner", "")
         if not owner or principal == owner:
             return  # unowned (legacy) buckets stay open to auth'd users

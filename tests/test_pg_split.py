@@ -160,9 +160,23 @@ def test_autoscaler_proposes_and_applies(cluster):
     cfg.apply_dict({"mgr_autoscaler_objects_per_pg": 5})
     mgr = MgrDaemon(cluster.mon, modules=("pg_autoscaler",), tick=0.1)
     try:
-        st = mgr.command("pg_autoscaler", "status")
-        props = {p["pool"]: p for p in st["proposals"]}
-        assert "busy" in props
+        # the stats reports travel the messenger asynchronously: poll
+        # until the mon has absorbed them and the proposal appears
+        import time as _time
+        deadline = _time.time() + 10
+        props = {}
+        while _time.time() < deadline:
+            st = mgr.command("pg_autoscaler", "status")
+            props = {p["pool"]: p for p in st["proposals"]}
+            if "busy" in props:
+                break
+            for osd in cluster.osds.values():
+                osd._report_stats(budget=5.0)
+            _time.sleep(0.1)
+        assert "busy" in props, (
+            props, mgr.module("pg_autoscaler").target,
+            {i: s.get("pool_objects")
+             for i, s in cluster.mon._osd_stats.items()})
         assert props["busy"]["proposed"] > props["busy"]["pg_num"]
         # turn it on: the next tick applies the split
         mgr.command("pg_autoscaler", "on")
