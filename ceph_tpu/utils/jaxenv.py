@@ -31,3 +31,14 @@ def force_cpu(device_count: int | None = None) -> None:
         _xb._backend_factories.pop("axon", None)
     except Exception:  # noqa: BLE001 - jax internals moved; env var holds
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def force_cpu_if_selected(device_count: int | None = None) -> bool:
+    """Apply force_cpu() iff the caller's env selects the CPU platform
+    (the JAX_PLATFORMS gate every hermetic entry point shares — one
+    copy, so the detection rule cannot drift per call site).  Returns
+    whether it fired."""
+    if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+        force_cpu(device_count)
+        return True
+    return False
