@@ -121,6 +121,9 @@ class RgwGateway:
         self._push_endpoints: dict = {}   # topic -> callable (push)
         self._notify_lock = threading.Lock()
         self._nseq = 0                    # notification seq tiebreak
+        self.host = host
+        # swift TempAuth sessions: token -> (user, expiry)
+        self._swift_tokens: dict[str, tuple[str, float]] = {}
         gw = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -219,8 +222,130 @@ class RgwGateway:
                     return False
                 return True
 
+            # ----------------------------------------------- swift API
+            # (the rgw Swift dialect, src/rgw/rgw_rest_swift.cc over
+            # the SAME buckets/objects the S3 surface serves — rgw's
+            # dual-protocol contract): TempAuth-style token mint at
+            # /auth/v1.0, then /swift/v1/<container>[/<object>] with
+            # X-Auth-Token.  Listings are text/plain like Swift's.
+            def _swift(self, body: bytes = b"") -> bool:
+                """Handle the request if it is a Swift-dialect path;
+                returns True when fully handled."""
+                path = self.path.split("?", 1)[0]
+                if path == "/auth/v1.0":
+                    user = self.headers.get("X-Auth-User", "")
+                    key = self.headers.get("X-Auth-Key", "")
+                    token = gw.swift_auth(user, key)
+                    if token is None:
+                        self._send(401, b"", ctype="text/plain")
+                        return True
+                    self._send(204, b"", ctype="text/plain", headers={
+                        "X-Auth-Token": token,
+                        "X-Storage-Url":
+                            f"http://{gw.host}:{gw.port}/swift/v1"})
+                    return True
+                if not path.startswith("/swift/v1"):
+                    return False
+                who = gw.swift_principal(
+                    self.headers.get("X-Auth-Token", ""))
+                if who is None:
+                    self._send(401, b"", ctype="text/plain")
+                    return True
+                rest = path[len("/swift/v1"):].strip("/")
+                container, _, obj = rest.partition("/")
+                container = urllib.parse.unquote(container) or None
+                obj = urllib.parse.unquote(obj) or None
+                try:
+                    self._swift_op(who, container, obj, body)
+                except KeyError:
+                    self._send(404, b"", ctype="text/plain")
+                except PermissionError:
+                    self._send(403, b"", ctype="text/plain")
+                return True
+
+            def _swift_op(self, who, container, obj, body) -> None:
+                v = self.command
+                if container is None:
+                    if v == "GET":  # account listing: containers
+                        names = sorted(gw._buckets())
+                        self._send(200, ("\n".join(names) + "\n").encode()
+                                   if names else b"",
+                                   ctype="text/plain")
+                    else:
+                        self._send(405, b"", ctype="text/plain")
+                    return
+                if obj is None:
+                    if v == "PUT":
+                        try:
+                            gw.check_bucket(container)
+                            # re-PUT mirrors the S3 contract: never a
+                            # silent success for a non-owner
+                            owner = gw.bucket_owner(container)
+                            if gw.users is not None and owner \
+                                    and who != owner:
+                                raise PermissionError(container)
+                        except KeyError:
+                            gw.create_bucket(container)
+                            if who:
+                                gw.set_bucket_owner(container, who)
+                        self._send(201, b"", ctype="text/plain")
+                    elif v == "GET":
+                        gw.authorize(who, container, "s3:ListBucket")
+                        gw.check_bucket(container)
+                        # delete-marker heads are not live objects —
+                        # same filter as the S3 listing
+                        names = sorted(
+                            k for k, m in gw._index(container).items()
+                            if not m.get("delete_marker"))
+                        self._send(200, ("\n".join(names) + "\n").encode()
+                                   if names else b"",
+                                   ctype="text/plain")
+                    elif v == "DELETE":
+                        gw.check_bucket(container)
+                        # bucket deletion is OWNER-scoped on the S3
+                        # surface; the Swift surface must not widen it
+                        # through a policy Allow
+                        owner = gw.bucket_owner(container)
+                        if gw.users is not None and owner \
+                                and who != owner:
+                            raise PermissionError(container)
+                        if gw._index(container):
+                            self._send(409, b"", ctype="text/plain")
+                            return
+                        gw.delete_bucket(container)
+                        self._send(204, b"", ctype="text/plain")
+                    else:
+                        self._send(405, b"", ctype="text/plain")
+                    return
+                if v == "PUT":
+                    gw.authorize(who, container, "s3:PutObject")
+                    gw.check_bucket(container)
+                    etag = gw.put_object(container, obj, body)
+                    self._send(201, b"", ctype="text/plain",
+                               headers={"ETag": etag})
+                elif v in ("GET", "HEAD"):
+                    gw.authorize(who, container, "s3:GetObject")
+                    meta = gw.head_object(container, obj)
+                    data = b""
+                    if v == "GET":
+                        data = gw._read_extent(container, obj, meta, 0,
+                                               meta["size"])
+                    hdrs = {"ETag": meta.get("etag", "")}
+                    if v == "HEAD":
+                        hdrs["X-Object-Size"] = str(meta.get("size", 0))
+                    self._send(200, data, ctype="application/"
+                               "octet-stream", headers=hdrs)
+                elif v == "DELETE":
+                    gw.authorize(who, container, "s3:DeleteObject")
+                    gw.delete_object(container, obj)
+                    self._send(204, b"", ctype="text/plain")
+                else:
+                    self._send(405, b"", ctype="text/plain")
+
             # ----------------------------------------------------- verbs
             def do_GET(self):  # noqa: N802
+                if self._swift():
+                    return
                 who = self._auth()
                 if who is None:
                     return
@@ -358,6 +483,8 @@ class RgwGateway:
                     self._error(400, "InvalidPart")
 
             def do_HEAD(self):  # noqa: N802
+                if self._swift():
+                    return
                 who = self._auth()
                 if who is None:
                     return
@@ -382,6 +509,8 @@ class RgwGateway:
                 qs = self._qs(query)
                 length = int(self.headers.get("Content-Length", 0))
                 body = self.rfile.read(length) if length else b""
+                if self._swift(body):
+                    return
                 who = self._auth(body)
                 if who is None:
                     return
@@ -462,6 +591,8 @@ class RgwGateway:
                     self._error(404, "NoSuchBucket")
 
             def do_DELETE(self):  # noqa: N802
+                if self._swift():
+                    return
                 who = self._auth()
                 if who is None:
                     return
@@ -506,6 +637,42 @@ class RgwGateway:
             target=self._server.serve_forever, name="rgw-frontend",
             daemon=True)
         self._thread.start()
+
+    # ---------------------------------------------------- swift auth
+    SWIFT_TOKEN_TTL = 3600.0
+
+    def swift_auth(self, user: str, key: str) -> str | None:
+        """TempAuth mint (GET /auth/v1.0): the SAME user registry the
+        S3 surface authenticates — rgw's one-user-two-protocols shape.
+        None = bad credentials."""
+        if self.users is None:
+            user = ""          # anonymous gateway: unauthenticated ok
+        elif self.users.get(user) != key:
+            return None
+        import secrets as _secrets
+        now = time.time()
+        # sweep on mint: expired sessions must not accumulate for the
+        # gateway's lifetime
+        for t, (_u, exp) in list(self._swift_tokens.items()):
+            if now > exp:
+                self._swift_tokens.pop(t, None)
+        token = "AUTH_tk" + _secrets.token_hex(16)
+        self._swift_tokens[token] = (user, now + self.SWIFT_TOKEN_TTL)
+        return token
+
+    def swift_principal(self, token: str) -> str | None:
+        """Live session lookup; expired/unknown tokens reject (401).
+        Anonymous gateways accept tokenless requests."""
+        if self.users is None:
+            return ""
+        ent = self._swift_tokens.get(token)
+        if ent is None:
+            return None
+        user, expiry = ent
+        if time.time() > expiry:
+            self._swift_tokens.pop(token, None)
+            return None
+        return user
 
     def stop(self) -> None:
         self._server.shutdown()
