@@ -1501,18 +1501,23 @@ class MonitorLite(Dispatcher):
                 if pool is None:
                     return -2, {"error": f"no pool {cmd['pool']!r}"}
                 new = int(cmd["pg_num"])
+                if new <= 0:
+                    return -22, {"error": "pg_num must be positive"}
                 if new == pool.pg_num:
                     return 0, {"pg_num": new}
-                if new < pool.pg_num:
-                    return -22, {"error": "pg_num can only grow "
-                                          "(merge unsupported)"}
-                if new % pool.pg_num:
+                if new > pool.pg_num and new % pool.pg_num:
                     return -22, {"error": f"pg_num {new} must be a "
                                           f"multiple of {pool.pg_num}"}
+                if new < pool.pg_num and pool.pg_num % new:
+                    return -22, {"error": f"pg_num {new} must divide "
+                                          f"{pool.pg_num} (merge folds "
+                                          f"seed s into s mod new)"}
                 old_num = pool.pg_num
                 pool.pg_num = new
+                verb = "split" if new > old_num else "merge"
                 self._commit_map(
-                    f"pool {pool.name} pg_num {old_num} -> {new}")
+                    f"pool {pool.name} pg_num {old_num} -> {new} "
+                    f"({verb})")
             return 0, {"pg_num": new}
         if prefix == "osd pool selfmanaged-snap-create":
             # mint a pool-unique snap id (pg_pool_t::snap_seq role)

@@ -481,6 +481,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._reservation_map_change(newmap)
         if old is None or newmap.epoch > old.epoch:
             self._split_pgs(old, newmap)
+            self._merge_pgs(old, newmap)
             self._note_intervals()
             self._start_recovery()
             self._notify_demoted(old)
@@ -1187,6 +1188,152 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     == parent_seed}
             self._tombstones[parent_pg] = keep
         self._ec_cache.invalidate(parent_pg)
+
+    def _merge_pgs(self, old: OSDMap | None, new: OSDMap) -> None:
+        """A pool's pg_num SHRANK (to a divisor of the old value): every
+        source PG with seed >= new_pg_num folds into seed % new_pg_num
+        (the pg merge of OSDMap.cc; with modulo placement and new | old,
+        h % new == (h % old) % new, so each source merges whole into
+        exactly one surviving PG).  Matching the reference's merge
+        semantics, the combined PG's log is NOT continuable: logs reset,
+        the les fence drops, PastIntervals of both halves concatenate,
+        and the next peering round runs on full inventories (the
+        _split_fresh force).
+
+        Folding is keyed on LOCAL collections out of range of the NEW
+        map — not on observing the shrink epoch — so an OSD that was
+        down across the merge still folds its strays on revival
+        (out-of-range seeds are invisible to _notify_demoted and would
+        otherwise leak forever)."""
+        for pool_id, pool in new.pools.items():
+            newn = pool.pg_num
+            by_target: dict[int, list[int]] = {}
+            for cid in list(self.store.list_collections()):
+                if cid.pool == pool_id and cid.pg_seed >= newn:
+                    by_target.setdefault(cid.pg_seed % newn,
+                                         []).append(cid.pg_seed)
+            for tgt_seed, src_seeds in sorted(by_target.items()):
+                self._merge_sources(pool_id, tgt_seed,
+                                    sorted(src_seeds))
+            oldp = old.pools.get(pool_id) if old is not None else None
+            if oldp is None or newn >= oldp.pg_num:
+                continue
+            oldn = oldp.pg_num
+            for seed in range(newn):
+                up_s = new.pg_to_up_osds(pool_id, seed)
+                if self._primary_of(up_s) == self.osd_id:
+                    self._split_fresh.add(PgId(pool_id, seed))
+                if self.osd_id not in [u for u in up_s
+                                       if u is not None]:
+                    continue
+                # Seed every surviving PG I am a member of with each
+                # folded source's OLD membership as a maybe-active
+                # interval: a target primary that never held a source
+                # collection would otherwise peer with an empty prior
+                # set and serve ENOENT while the source's holders still
+                # carry the objects (the same hole the split fix
+                # closes for children).
+                tgt_pg = PgId(pool_id, seed)
+                pi = self._pi(tgt_pg)
+                changed = False
+                first = min(old.epoch, new.epoch - 1)
+                for src_seed in range(seed + newn, oldn, newn):
+                    src_up = old.pg_to_up_osds(pool_id, src_seed)
+                    if any(i.first == first and i.up == list(src_up)
+                           for i in pi.intervals):
+                        continue
+                    pi.intervals.insert(0, Interval(
+                        first, new.epoch - 1, list(src_up),
+                        self._primary_of(src_up)))
+                    changed = True
+                if changed:
+                    self._save_pi(tgt_pg)
+
+    def _merge_sources(self, pool_id: int, tgt_seed: int,
+                       src_seeds: list[int]) -> None:
+        """Fold every listed source collection into the target in ONE
+        transaction, with one log reset and one PastIntervals rewrite
+        however many sources share the target."""
+        tgt_pg = PgId(pool_id, tgt_seed)
+        tgt_cid = CollectionId(pool_id, tgt_seed)
+        have = set(self.store.list_collections())
+        tx = Transaction()
+        if tgt_cid not in have:
+            tx.create_collection(tgt_cid)
+        tgt_pi = self._pi(tgt_pg)
+        moved = 0
+        with self._pending_lock:
+            vmax = self._pg_versions.get(tgt_pg, 0)
+        for src_seed in src_seeds:
+            src_pg = PgId(pool_id, src_seed)
+            src_cid = CollectionId(pool_id, src_seed)
+            try:
+                oids = list(self.store.list_objects(src_cid))
+            except Exception:  # noqa: BLE001 - collection vanished
+                continue
+            for oid in oids:
+                if oid.shard <= -2:
+                    continue  # PG meta dies with the source
+                data = self.store.read(src_cid, oid)
+                tx.touch(tgt_cid, oid)
+                if data:
+                    tx.write(tgt_cid, oid, 0, data)
+                attrs = self.store.getattrs(src_cid, oid)
+                if attrs:
+                    tx.setattrs(tgt_cid, oid, dict(attrs))
+                omap = self.store.omap_get(src_cid, oid)
+                if omap:
+                    tx.omap_setkeys(tgt_cid, oid, dict(omap))
+                tx.remove(src_cid, oid)
+                moved += 1
+            # concatenate membership history; the source's open
+            # interval closes at the epoch before this map
+            src_pi = self._pi(src_pg)
+            tgt_pi.intervals.extend(src_pi.intervals)
+            if src_pi.cur_up:
+                tgt_pi.intervals.append(Interval(
+                    src_pi.cur_first,
+                    max(src_pi.cur_first, self.osdmap.epoch - 1),
+                    list(src_pi.cur_up), src_pi.cur_primary))
+            tx.remove_collection(src_cid)
+            self._pglogs.pop(src_pg, None)
+            self._past_intervals.pop(src_pg, None)
+            with self._pending_lock:
+                vmax = max(vmax, self._pg_versions.pop(src_pg, 0))
+            src_tomb = self._tombstones.pop(src_pg, {})
+            if src_tomb:
+                tgt = self._tombstones.setdefault(tgt_pg, {})
+                for k, v in src_tomb.items():
+                    tgt[k] = max(tgt.get(k, -1), v)
+            self._ec_cache.invalidate(src_pg)
+        # the merged log is un-continuable: reset the TARGET's entries
+        # and contiguity point; peering rebuilds authority from full
+        # inventories (version floors recover from object "v" attrs)
+        try:
+            logkeys = [k for k in self.store.omap_get(tgt_cid,
+                                                      PGLOG_OID)
+                       if not k.startswith("_")]
+        except Exception:  # noqa: BLE001 - no log object yet
+            logkeys = []
+        if logkeys:
+            tx.omap_rmkeys(tgt_cid, PGLOG_OID, logkeys)
+        if not self.store.exists(tgt_cid, PGLOG_OID):
+            tx.touch(tgt_cid, PGLOG_OID)
+        tx.omap_setkeys(tgt_cid, PGLOG_OID, {
+            "_lc": (0).to_bytes(8, "little"),
+            LES_KEY: (0).to_bytes(8, "little"),
+            INTERVALS_KEY: tgt_pi.encode_bytes()})
+        self.store.queue_transaction(tx)
+        dout("osd", 2)("osd.%d: merged pgs %s into %s (%d objects)",
+                       self.osd_id,
+                       [f"{pool_id}.{s:x}" for s in src_seeds],
+                       tgt_pg, moved)
+        self._pglogs.pop(tgt_pg, None)
+        self._pg_lc[tgt_pg] = 0
+        self._pg_les[tgt_pg] = 0
+        with self._pending_lock:
+            self._pg_versions[tgt_pg] = vmax
+        self._ec_cache.invalidate(tgt_pg)
 
     def _note_intervals(self) -> None:
         """Record membership changes for every PG I host or hold data

@@ -244,8 +244,9 @@ class RgwGateway:
                         "X-Storage-Url":
                             f"http://{gw.host}:{gw.port}/swift/v1"})
                     return True
-                if not path.startswith("/swift/v1"):
-                    return False
+                if path != "/swift/v1" and \
+                        not path.startswith("/swift/v1/"):
+                    return False  # e.g. S3 bucket "swift", key "v1x"
                 who = gw.swift_principal(
                     self.headers.get("X-Auth-Token", ""))
                 if who is None:
@@ -261,6 +262,10 @@ class RgwGateway:
                     self._send(404, b"", ctype="text/plain")
                 except PermissionError:
                     self._send(403, b"", ctype="text/plain")
+                except ValueError:
+                    self._send(409, b"", ctype="text/plain")
+                except Exception:  # noqa: BLE001 - degraded cluster
+                    self._send(503, b"", ctype="text/plain")
                 return True
 
             def _swift_op(self, who, container, obj, body) -> None:

@@ -31,6 +31,24 @@ def _settle_clean(cluster, client, pool, timeout=10.0):
     cluster.settle(0.3)
 
 
+def _poll_reads(client, pool, objs, timeout=25.0):
+    """Recovery after a pg_num change converges on its own schedule:
+    poll every object instead of guessing a settle time."""
+    import time as _time
+    deadline = _time.time() + timeout
+    remaining = dict(objs)
+    while remaining and _time.time() < deadline:
+        for name in list(remaining):
+            try:
+                if client.read(pool, name) == remaining[name]:
+                    del remaining[name]
+            except RadosError:
+                pass
+        if remaining:
+            _time.sleep(0.25)
+    assert not remaining, sorted(remaining)
+
+
 def test_split_preserves_every_object(cluster):
     """THE acceptance test: write through a pg_num doubling under load,
     no lost object, scrub clean."""
@@ -110,9 +128,9 @@ def test_split_ec_pool(cluster):
 def test_split_validation(cluster):
     client = cluster.client()
     client.create_pool("p", size=2, pg_num=4)
-    with pytest.raises(RadosError):  # shrink refused
+    with pytest.raises(RadosError):  # non-divisor shrink refused
         client.mon_command({"prefix": "osd pool set-pg-num",
-                            "pool": "p", "pg_num": 2})
+                            "pool": "p", "pg_num": 3})
     with pytest.raises(RadosError):  # non-multiple refused
         client.mon_command({"prefix": "osd pool set-pg-num",
                             "pool": "p", "pg_num": 6})
@@ -142,9 +160,7 @@ def test_split_survives_osd_restart(cluster):
     store = cluster.kill_osd(victim)
     cluster.settle(0.2)
     cluster.revive_osd(victim, store=store)  # crash-RESTART, same store
-    cluster.settle(0.5)
-    for name, data in objs.items():
-        assert client.read("grow", name) == data, name
+    _poll_reads(client, "grow", objs, timeout=20)
 
 
 def test_autoscaler_proposes_and_applies(cluster):
@@ -188,3 +204,59 @@ def test_autoscaler_proposes_and_applies(cluster):
             assert client.read("busy", f"b{i}") == b"x" * 100
     finally:
         mgr.stop() if hasattr(mgr, "stop") else None
+
+
+def test_merge_preserves_every_object(cluster):
+    """pg merge (the reverse scaling verb): fold pg_num back down with
+    no lost object and a clean deep scrub; writes continue after."""
+    client = cluster.client()
+    client.create_pool("shrink", size=2, pg_num=8)
+    objs = {f"m{i}": RNG.integers(0, 256, 12_000,
+                                  dtype=np.uint8).tobytes()
+            for i in range(40)}
+    for name, data in objs.items():
+        client.write_full("shrink", name, data)
+    out = client.mon_command({"prefix": "osd pool set-pg-num",
+                              "pool": "shrink", "pg_num": 2})
+    assert out["pg_num"] == 2
+    _poll_reads(client, "shrink", objs)
+    # the merged PGs serve writes (fresh version floor holds: a new
+    # write must supersede, not collide with, pre-merge versions)
+    client.write_full("shrink", "m0", b"post-merge rewrite")
+    assert client.read("shrink", "m0") == b"post-merge rewrite"
+    for i in range(40, 50):
+        client.write_full("shrink", f"m{i}", bytes([i]) * 500)
+        assert client.read("shrink", f"m{i}") == bytes([i]) * 500
+    assert client.scrub_pool("shrink", deep=True) == []
+    # source collections are gone everywhere
+    pool_id = client._pool_id("shrink")
+    for osd in cluster.osds.values():
+        for cid in osd.store.list_collections():
+            if cid.pool == pool_id:
+                assert cid.pg_seed < 2, (osd.osd_id, cid)
+
+
+def test_merge_validation(cluster):
+    client = cluster.client()
+    client.create_pool("mv", size=2, pg_num=4)
+    with pytest.raises(RadosError):  # non-divisor shrink refused
+        client.mon_command({"prefix": "osd pool set-pg-num",
+                            "pool": "mv", "pg_num": 3})
+    out = client.mon_command({"prefix": "osd pool set-pg-num",
+                              "pool": "mv", "pg_num": 2})
+    assert out["pg_num"] == 2
+
+
+def test_split_then_merge_roundtrip(cluster):
+    client = cluster.client()
+    client.create_pool("rt", size=2, pg_num=2)
+    objs = {f"r{i}": bytes([i]) * 3000 for i in range(24)}
+    for name, data in objs.items():
+        client.write_full("rt", name, data)
+    client.mon_command({"prefix": "osd pool set-pg-num",
+                        "pool": "rt", "pg_num": 8})
+    cluster.settle(0.5)
+    client.mon_command({"prefix": "osd pool set-pg-num",
+                        "pool": "rt", "pg_num": 2})
+    _poll_reads(client, "rt", objs)
+    assert client.scrub_pool("rt", deep=True) == []
