@@ -211,6 +211,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # the primary knows it is behind on stay blocked until pulled
         self._peering: dict[PgId, set[int]] = {}
         self._stale_objects: dict[PgId, dict[str, int]] = {}
+        # epoch whose FULL application (collections ensured, PGs
+        # split/merged) has completed — self.osdmap.epoch moves at the
+        # START of _handle_map, and peering answers must not race the
+        # split/merge window in between
+        self._applied_epoch = 0
         # freshly-split PGs (parents and children): their members share
         # the parent's last-complete, so the LEAN peering path would
         # skip the inventory exchange that redistributes shards — force
@@ -482,6 +487,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if old is None or newmap.epoch > old.epoch:
             self._split_pgs(old, newmap)
             self._merge_pgs(old, newmap)
+            self._applied_epoch = newmap.epoch
             self._note_intervals()
             self._start_recovery()
             self._notify_demoted(old)
@@ -520,10 +526,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if self.osd_id in [u for u in up if u is not None]:
                 continue
             if old is not None and cid.pool in old.pools \
-                    and cid.pg_seed < old.pools[cid.pool].pg_num:
-                # (a just-split child seed did not EXIST in the old map:
-                # its up set is computable but meaningless — fall
-                # through and notify the child primary of our shards)
+                    and cid.pg_seed < old.pools[cid.pool].pg_num \
+                    and old.pools[cid.pool].pg_num == pool.pg_num:
+                # (a just-split child seed did not EXIST in the old map,
+                # and across ANY pg_num change a fold/split just moved
+                # objects into this collection — in both cases the old
+                # up set says nothing about what we now hold, so fall
+                # through and notify the primary of our shards.  A
+                # merge-target primary may have closed its peering
+                # round against PRE-fold answers; this notify is what
+                # heals that hole.)
                 old_up = old.pg_to_up_osds(cid.pool, cid.pg_seed)
                 if self.osd_id not in [u for u in old_up if u is not None]:
                     continue  # was not a member before either: no change
@@ -2932,7 +2944,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                epoch=self.osdmap.epoch))
 
     def _handle_pg_query(self, conn, m: MPGQuery) -> None:
-        if self.osdmap is not None and m.epoch > self.osdmap.epoch \
+        if self.osdmap is not None and m.epoch > self._applied_epoch \
                 and not self._stop.is_set() \
                 and getattr(m, "_defers", 0) < 40:
             # The primary peers at an epoch I have not applied yet — my
@@ -2967,17 +2979,29 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # O(objects) inventory walk entirely.  head_epoch rides along so
         # the primary can detect a fork at my head (same version, other
         # interval) and demand the full log.
+        inv = None
+        if lc == 0:
+            inv = self._inventory(m.pgid)  # walked once, reused below
         if (not m.force_full and m.primary_last >= 0
                 and lc == last
                 and lc <= m.primary_last
-                and (lc + 1 >= m.primary_floor or lc == m.primary_last)):
+                and (lc + 1 >= m.primary_floor or lc == m.primary_last)
+                and (lc > 0 or not inv)):
+            # (lc == 0 with a NON-empty collection excluded: a freshly
+            # merged/reset PG has an empty LOG but full data — a lean
+            # "in sync at v0" answer would hide every object it holds.
+            # A truly empty lc==0 PG stays lean: forcing inventories
+            # there made the primary schedule spurious rebuilds that
+            # raced scrub repair.)
             conn.send(MPGInfo(m.pgid, self.osd_id, -2, {},
                               dict(self._tombstones.get(m.pgid, {})),
                               last_complete=lc, lean=True,
                               head_epoch=head_epoch,
                               les=self._les(m.pgid)))
             return
-        conn.send(MPGInfo(m.pgid, self.osd_id, -2, self._inventory(m.pgid),
+        if inv is None:
+            inv = self._inventory(m.pgid)
+        conn.send(MPGInfo(m.pgid, self.osd_id, -2, inv,
                           dict(self._tombstones.get(m.pgid, {})),
                           last_complete=lc, head_epoch=head_epoch,
                           log_evs={e.version: e.epoch for e in ents},
