@@ -1,0 +1,131 @@
+"""NVMe-oF gateway (src/nvmeof/ role): an NVMe/TCP target whose
+namespaces are rbd images, driven by the in-repo initiator over real
+sockets — the same target+initiator pattern as the NBD gateway."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.services.nvmeof import LBA_SIZE, NvmeInitiator, NvmeofTarget
+from ceph_tpu.services.rbd import RBD
+from ceph_tpu.tools.vstart import MiniCluster
+from tests.test_cluster import make_cfg
+
+RNG = np.random.default_rng(71)
+MiB = 1024 * 1024
+
+
+@pytest.fixture
+def tgt():
+    c = MiniCluster(n_osds=4, cfg=make_cfg()).start()
+    client = c.client()
+    client.create_pool("rbd", size=2, pg_num=4)
+    rbd = RBD(client)
+    rbd.create("rbd", "vol0", 8 * MiB, object_size=MiB).close()
+    rbd.create("rbd", "vol1", 4 * MiB, object_size=MiB).close()
+    t = NvmeofTarget(client, "rbd")
+    t.add_namespace("vol0")
+    t.add_namespace("vol1")
+    yield c, client, rbd, t
+    t.stop()
+    c.stop()
+
+
+def test_connect_identify(tgt):
+    c, client, rbd, t = tgt
+    ini = NvmeInitiator("127.0.0.1", t.port)
+    try:
+        assert ini.ctrl_id >= 1
+        info = ini.identify_controller()
+        assert info["subnqn"] == "nqn.2016-06.io.ceph-tpu:sub1"
+        assert info["nn"] == 2
+        assert ini.list_namespaces() == [1, 2]
+        ns1 = ini.identify_namespace(1)
+        assert ns1 == {"nsze": 8 * MiB // LBA_SIZE,
+                       "lba_size": LBA_SIZE}
+        assert ini.identify_namespace(2)["nsze"] == 4 * MiB // LBA_SIZE
+        with pytest.raises(KeyError):
+            ini.identify_namespace(9)
+        ini.keep_alive()
+    finally:
+        ini.close()
+
+
+def test_block_io_roundtrip(tgt):
+    c, client, rbd, t = tgt
+    ini = NvmeInitiator("127.0.0.1", t.port)
+    try:
+        data = RNG.integers(0, 256, 64 * LBA_SIZE,
+                            dtype=np.uint8).tobytes()
+        ini.write(1, 100, data)
+        ini.flush(1)
+        assert ini.read(1, 100, 64) == data
+        # unwritten LBAs read back as zeros
+        assert ini.read(1, 4000, 2) == b"\x00" * (2 * LBA_SIZE)
+        # partial overwrite at an interior LBA
+        patch = b"\xAB" * LBA_SIZE
+        ini.write(1, 110, patch)
+        got = ini.read(1, 100, 64)
+        assert got[:10 * LBA_SIZE] == data[:10 * LBA_SIZE]
+        assert got[10 * LBA_SIZE:11 * LBA_SIZE] == patch
+        assert got[11 * LBA_SIZE:] == data[11 * LBA_SIZE:]
+    finally:
+        ini.close()
+
+
+def test_namespaces_isolate_and_map_to_rbd(tgt):
+    """The gateway is just another librbd client: NVMe writes are the
+    SAME bytes an rbd Image handle reads (and vice versa)."""
+    c, client, rbd, t = tgt
+    ini = NvmeInitiator("127.0.0.1", t.port)
+    try:
+        ini.write(1, 0, b"\x11" * LBA_SIZE)
+        ini.write(2, 0, b"\x22" * LBA_SIZE)
+        assert ini.read(1, 0, 1) == b"\x11" * LBA_SIZE
+        assert ini.read(2, 0, 1) == b"\x22" * LBA_SIZE
+        img = rbd.open("rbd", "vol0")
+        assert img.read(0, LBA_SIZE) == b"\x11" * LBA_SIZE
+        img.write(LBA_SIZE, b"\x33" * LBA_SIZE)  # rbd-side write...
+        img.close()
+        assert ini.read(1, 1, 1) == b"\x33" * LBA_SIZE  # ...nvme-visible
+    finally:
+        ini.close()
+
+
+def test_two_initiators_and_control_plane(tgt):
+    c, client, rbd, t = tgt
+    a = NvmeInitiator("127.0.0.1", t.port)
+    b = NvmeInitiator("127.0.0.1", t.port)
+    try:
+        assert a.ctrl_id != b.ctrl_id   # distinct controllers
+        a.write(1, 0, b"\x44" * LBA_SIZE)
+        assert b.read(1, 0, 1) == b"\x44" * LBA_SIZE
+        # control plane: remove a namespace; IO on it now refuses
+        assert t.list_namespaces() == {1: "vol0", 2: "vol1"}
+        t.remove_namespace(2)
+        with pytest.raises(AssertionError):
+            b.read(2, 0, 1)
+        rbd.create("rbd", "vol2", 2 * MiB, object_size=MiB).close()
+        nsid = t.add_namespace("vol2")
+        assert nsid == 2  # max+1 allocation: {1} -> 2 here
+        assert b.identify_namespace(2)["nsze"] == 2 * MiB // LBA_SIZE
+    finally:
+        a.close()
+        b.close()
+
+
+def test_out_of_range_io_refused(tgt):
+    """Clamped short reads with SC_SUCCESS would silently corrupt
+    consumers: out-of-range LBAs must error (LBA Out of Range)."""
+    c, client, rbd, t = tgt
+    ini = NvmeInitiator("127.0.0.1", t.port)
+    try:
+        nsze = ini.identify_namespace(1)["nsze"]
+        with pytest.raises(AssertionError):
+            ini.read(1, nsze - 1, 4)       # tail-straddling read
+        with pytest.raises(AssertionError):
+            ini.write(1, nsze, b"x" * LBA_SIZE)
+        # the last in-range LBA still works
+        ini.write(1, nsze - 1, b"z" * LBA_SIZE)
+        assert ini.read(1, nsze - 1, 1) == b"z" * LBA_SIZE
+    finally:
+        ini.close()
