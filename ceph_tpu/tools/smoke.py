@@ -2,9 +2,11 @@
 
 Boots an in-process cluster and drives every subsystem the way a user
 would — EC pools with snapshots, divergence recovery, rbd with
-journaling over NBD, versioned S3 with IAM, CephFS .snap views, the
-mgr dashboard, distributed tracing, and the EC audit — printing a
-scorecard.  Exit 0 iff every check passed.
+journaling over NBD, versioned S3 with IAM + STS + notifications +
+the Swift dialect, CephFS .snap views and standby-replay, cephx caps
+enforcement, live pg_num scaling (split + merge), the NVMe/TCP
+gateway, the mgr dashboard, distributed tracing, and the EC audit —
+printing a scorecard.  Exit 0 iff every check passed.
 
     python -m ceph_tpu.tools.smoke            # full run (~1 min)
     python -m ceph_tpu.tools.smoke --quick    # core slice only
@@ -208,6 +210,156 @@ def main(argv=None) -> int:
                     assert st["osds"]["total"] == args.osds
                 finally:
                     mgr.stop()
+
+            @check("pg split + merge round trip")
+            def _scale():
+                client.create_pool("scale", size=2, pg_num=2)
+                objs = {f"sc{i}": bytes([i]) * 2000 for i in range(16)}
+                for n, d in objs.items():
+                    client.write_full("scale", n, d)
+                for target in (8, 2):
+                    client.mon_command({"prefix": "osd pool set-pg-num",
+                                        "pool": "scale",
+                                        "pg_num": target})
+                    deadline = time.time() + 20
+                    left = dict(objs)
+                    while left and time.time() < deadline:
+                        for n in list(left):
+                            try:
+                                if client.read("scale", n) == left[n]:
+                                    del left[n]
+                            except Exception:  # noqa: BLE001
+                                pass
+                        time.sleep(0.2)
+                    assert not left, (target, sorted(left)[:3])
+
+            @check("cephx caps enforced at osd/mon")
+            def _auth():
+                from ..client.rados import RadosError
+                ac = MiniCluster(n_osds=3, cfg=cfg, auth=True).start()
+                try:
+                    admin = ac.client()
+                    admin.create_pool("ax", size=2, pg_num=2)
+                    admin.create_pool("ay", size=2, pg_num=2)
+                    out = admin.mon_command({
+                        "prefix": "auth get-or-create",
+                        "entity": "client.lim",
+                        "caps": {"mon": "allow r",
+                                 "osd": "allow rw pool=ax"}})
+                    lim = ac.client(entity="client.lim",
+                                    key=bytes.fromhex(out["key"]))
+                    lim.write_full("ax", "o", b"mine")
+                    assert lim.read("ax", "o") == b"mine"
+                    for op in (lambda: lim.write_full("ay", "o", b"x"),
+                               lambda: lim.create_pool("az", size=2,
+                                                       pg_num=1)):
+                        try:
+                            op()
+                            raise AssertionError("not denied")
+                        except RadosError as e:
+                            assert e.code == -13, e
+                finally:
+                    ac.stop()
+
+            @check("rgw notifications + sts + swift")
+            def _rgw2():
+                import http.client as _hc
+
+                from ..services.rgw import RgwGateway
+                client.create_pool("rgw2", size=2, pg_num=2)
+                g = RgwGateway(c.client(), "rgw2",
+                               users={"AKIAA": "sek"})
+                try:
+                    g.create_bucket("b")
+                    g.set_bucket_owner("b", "AKIAA")
+                    g.create_topic("t")
+                    g.put_bucket_notification("b", [
+                        {"id": "n", "topic": "t",
+                         "events": ["s3:ObjectCreated:*"]}])
+                    g.put_object("b", "k", b"v")
+                    evs = g.pull_events("t")
+                    assert [e["eventName"] for e in evs] == \
+                        ["s3:ObjectCreated:Put"]
+                    g.create_role("r", trust=["AKIAA"], policy={
+                        "Statement": [{"Effect": "Allow",
+                                       "Action": ["s3:GetObject"],
+                                       "Resource": ["b"]}]})
+                    creds = g.assume_role("AKIAA", "r", duration=30)
+                    assert g.sts_principal(
+                        creds["access_key"],
+                        creds["session_token"]) == "sts:r"
+                    # swift: token mint + object round trip
+                    conn = _hc.HTTPConnection("127.0.0.1", g.port,
+                                              timeout=5)
+                    conn.request("GET", "/auth/v1.0",
+                                 headers={"X-Auth-User": "AKIAA",
+                                          "X-Auth-Key": "sek"})
+                    tok = dict(conn.getresponse().headers)[
+                        "X-Auth-Token"]
+                    conn.close()
+                    h = {"X-Auth-Token": tok}
+                    conn = _hc.HTTPConnection("127.0.0.1", g.port,
+                                              timeout=5)
+                    conn.request("GET", "/swift/v1/b/k", headers=h)
+                    r = conn.getresponse()
+                    assert (r.status, r.read()) == (200, b"v")
+                    conn.close()
+                finally:
+                    g.stop()
+
+            @check("nvme-of target over rbd")
+            def _nvme():
+                from ..services.nvmeof import (LBA_SIZE, NvmeInitiator,
+                                               NvmeofTarget)
+                from ..services.rbd import RBD
+                client.create_pool("nvme", size=2, pg_num=2)
+                RBD(client).create("nvme", "lun0", 4 << 20,
+                                   object_size=1 << 20).close()
+                t = NvmeofTarget(c.client(), "nvme")
+                ini = None
+                try:
+                    t.add_namespace("lun0")
+                    ini = NvmeInitiator("127.0.0.1", t.port)
+                    assert ini.identify_controller()["nn"] == 1
+                    ini.write(1, 10, b"\x5a" * (4 * LBA_SIZE))
+                    assert ini.read(1, 10, 4) == b"\x5a" * (4 * LBA_SIZE)
+                finally:
+                    if ini is not None:
+                        ini.close()
+                    t.stop()
+
+            @check("mds standby-replay promotion")
+            def _standby():
+                from ..services.fs import FsClient
+                from ..services.mds import MdsDaemon, StandbyReplayMds
+                client.create_pool("fsx", size=2, pg_num=2)
+                active = MdsDaemon(client, "fsx")
+                fs = FsClient(client, "fsx", mds=active)
+                standby = None
+                fs2 = None
+                try:
+                    fs.mkdir("/w")
+                    fs.create("/w/f")
+                    fs.write_file("/w/f", b"warm")
+                    standby = StandbyReplayMds(c.client(), "fsx")
+                    time.sleep(0.2)
+                    fs.unmount()
+                    fs = None
+                    promoted, replayed = standby.promote()
+                    assert replayed == 0  # clean handoff: no window
+                    fs2 = FsClient(client, "fsx", mds=promoted)
+                    assert fs2.read_file("/w/f") == b"warm"
+                finally:
+                    # a mid-check failure must not leave the tail
+                    # thread polling or sessions registered
+                    if standby is not None:
+                        standby.stop()
+                    for handle in (fs, fs2):
+                        if handle is not None:
+                            try:
+                                handle.unmount()
+                            except Exception:  # noqa: BLE001
+                                pass
 
         @check("jax kernel parity (CPU mesh)")
         def _kernel():
