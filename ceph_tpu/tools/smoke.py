@@ -328,6 +328,27 @@ def main(argv=None) -> int:
                         ini.close()
                     t.stop()
 
+            @check("smb share over cephfs")
+            def _smb():
+                from ..services.smb import SmbClient, SmbServer
+                client.create_pool("smbfs", size=2, pg_num=2)
+                srv = SmbServer(lambda: c.client())
+                cl = None
+                try:
+                    srv.add_share("share", "smbfs")
+                    cl = SmbClient("127.0.0.1", srv.port)
+                    cl.tree_connect("share")
+                    f = cl.create_file("hello.txt")
+                    cl.write(f, 0, b"smoke over smb")
+                    cl.close_file(f)
+                    f = cl.open("hello.txt")
+                    assert cl.read(f, 0, 64) == b"smoke over smb"
+                    cl.close_file(f)
+                finally:
+                    if cl is not None:
+                        cl.close()
+                    srv.stop()
+
             @check("mds standby-replay promotion")
             def _standby():
                 from ..services.fs import FsClient
