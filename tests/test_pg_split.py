@@ -93,7 +93,7 @@ def test_split_moves_objects_to_child_seeds(cluster):
         client.write_full("grow", n, n.encode() * 50)
     client.mon_command({"prefix": "osd pool set-pg-num",
                         "pool": "grow", "pg_num": 8})
-    cluster.settle(0.5)
+    _poll_reads(client, "grow", {n: n.encode() * 50 for n in names})
     pool_id = client._pool_id("grow")
     # every object now lives (only) in the collection of its NEW seed
     moved = 0
@@ -110,7 +110,6 @@ def test_split_moves_objects_to_child_seeds(cluster):
                         if o.shard > -2}
                 assert n not in held, \
                     f"{n} still in parent pg {old_seed} on osd.{osd.osd_id}"
-        assert client.read("grow", n) == n.encode() * 50
     assert moved > 0  # the split actually redistributed something
 
 
