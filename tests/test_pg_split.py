@@ -93,7 +93,8 @@ def test_split_moves_objects_to_child_seeds(cluster):
         client.write_full("grow", n, n.encode() * 50)
     client.mon_command({"prefix": "osd pool set-pg-num",
                         "pool": "grow", "pg_num": 8})
-    _poll_reads(client, "grow", {n: n.encode() * 50 for n in names})
+    _poll_reads(client, "grow", {n: n.encode() * 50 for n in names},
+                timeout=45)
     pool_id = client._pool_id("grow")
     # every object now lives (only) in the collection of its NEW seed
     moved = 0
