@@ -19,7 +19,11 @@ def adm(tmp_path):
     spec = {"osds": [{"id": i, "store": "filestore"}
                      for i in range(4)],
             "pools": [{"name": "up", "size": 2, "pg_num": 8}]}
-    a = CephAdm(spec, str(tmp_path)).deploy()
+    # a loaded CI box can stall a child interpreter past a 2s grace,
+    # and the resulting down/up flap cascades re-peer everything for
+    # minutes — use a grace that tolerates scheduler starvation
+    a = CephAdm(spec, str(tmp_path),
+                cfg_overrides={"osd_heartbeat_grace": 5.0}).deploy()
     yield a
     a.teardown()
 
@@ -95,16 +99,23 @@ def test_rolling_restart_under_load(adm):
     expect = {**objs, **written_during}
     deadline = time.time() + 30
     remaining = dict(expect)
+    errs: dict = {}
     while remaining and time.time() < deadline:
         for name in list(remaining):
             try:
                 if client.read("up", name) == remaining[name]:
                     del remaining[name]
-            except Exception:  # noqa: BLE001 - still recovering
-                pass
+            except Exception as e:  # noqa: BLE001 - still recovering
+                errs[name] = repr(e)[:70]
         if remaining:
             time.sleep(0.3)
-    assert not remaining, sorted(remaining)
+    if remaining:
+        pid = client._pool_id("up")
+        detail = {n: (client.osdmap.object_to_pg(pid, n),
+                      client.osdmap.pg_to_up_osds(
+                          pid, client.osdmap.object_to_pg(pid, n)),
+                      errs.get(n)) for n in sorted(remaining)[:6]}
+        raise AssertionError(f"stuck: {detail}")
     assert client.scrub_pool("up", deep=True) == []
     inv = adm.ls()
     assert all(d["state"] == "running" for d in inv)
