@@ -105,8 +105,110 @@ def _recorded_tpu() -> dict | None:
     return None
 
 
+def ec_batch_bench() -> int:
+    """`--ec-batch` mode: cross-op batched vs per-op encode under a
+    simulated multi-client write burst (8 writer threads submitting
+    full-stripe encodes through an ECBatcher), same one-line JSON
+    schema as the headline.  value = batched-path GB/s; vs_baseline =
+    batched / per-op (pass-through, window=0) on the same buffers;
+    extra keys carry ops/launch and flush-reason counts.  Parity is
+    digest-verified against the numpy gf256 oracle for EVERY op.
+
+    Runs on the CPU jax backend by default (the axon tunnel wedges —
+    see module docstring); set BENCH_EC_BATCH_DEVICE=1 to let jax pick
+    the real device."""
+    import threading
+
+    import numpy as np
+
+    if not os.environ.get("BENCH_EC_BATCH_DEVICE"):
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ceph_tpu.utils.jaxenv import force_cpu
+        force_cpu()
+    from ceph_tpu import ec
+    from ceph_tpu.ec.batcher import ECBatcher
+    from ceph_tpu.ops import gf256
+
+    chunk = 16 * 1024
+    writers, ops_per = 8, 24
+    codec = ec.factory("tpu", {"k": K, "m": M, "backend": "jax"})
+    rng = np.random.default_rng(5)
+    payloads = [[rng.integers(0, 256, (K, chunk), dtype=np.uint8)
+                 for _ in range(ops_per)] for _ in range(writers)]
+
+    def burst(batcher):
+        results = [[None] * ops_per for _ in range(writers)]
+        barrier = threading.Barrier(writers + 1)
+
+        def writer(w):
+            barrier.wait()
+            for i, data in enumerate(payloads[w]):
+                results[w][i] = np.asarray(
+                    batcher.encode(codec, data)[0])
+
+        threads = [threading.Thread(target=writer, args=(w,))
+                   for w in range(writers)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        return results, time.perf_counter() - t0
+
+    # warm the compile caches off the clock: every pow2 stripe-count
+    # fold shape a burst can produce (coalescing patterns vary run to
+    # run; a cold XLA compile leaking into the timed burst would swamp
+    # the measurement), then one full warm burst
+    from ceph_tpu.ec.batcher import bucket_len
+    bucket = bucket_len(chunk)
+    n2 = 1
+    while n2 <= writers:
+        codec.encode_chunks(np.zeros((K, n2 * bucket), dtype=np.uint8))
+        n2 <<= 1
+    warm = ECBatcher(window_us=2000, max_bytes=64 << 20)
+    burst(warm)
+    batched = ECBatcher(window_us=2000, max_bytes=64 << 20)
+    res_b, dt_b = burst(batched)
+    perop = ECBatcher(window_us=0)
+    res_p, dt_p = burst(perop)
+
+    verified = True
+    for w in range(writers):
+        for i in range(ops_per):
+            want = gf256.encode_region(codec.matrix, payloads[w][i])
+            if not (np.array_equal(res_b[w][i], want)
+                    and np.array_equal(res_p[w][i], want)):
+                verified = False
+    src_bytes = writers * ops_per * K * chunk
+    gbps_b = src_bytes / dt_b / 2**30
+    gbps_p = src_bytes / dt_p / 2**30
+    st = batched.stats
+    total_ops = writers * ops_per
+    backend = "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else "dev"
+    print(json.dumps({
+        "metric": (f"EC encode GB/s batched-vs-per-op (k={K},m={M}, "
+                   f"{chunk // 1024}KiB chunks, {writers}-writer burst, "
+                   f"jax-{backend} kernels, digest-verified)"),
+        "value": round(gbps_b, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps_b / gbps_p, 3) if gbps_p > 0 else None,
+        "ops_per_launch": round(total_ops / st["launches"], 2),
+        "launches_batched": st["launches"],
+        "launches_per_op": perop.stats["launches"],
+        "window_flush": st["window"],
+        "size_flush": st["size"],
+        "idle_flush": st["idle"],
+        "per_op_gbps": round(gbps_p, 3),
+        "digest_verified": verified,
+    }))
+    return 0 if verified else 1
+
+
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    if "--ec-batch" in sys.argv[1:]:
+        return ec_batch_bench()
     cpu = cpu_baseline_gbps()
     print(f"bench: cpu single-thread baseline {cpu:.2f} GB/s", file=sys.stderr)
     dev = tpu_gbps()

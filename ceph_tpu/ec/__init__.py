@@ -5,12 +5,13 @@ ErasureCodeInterface -> interface.ErasureCode, ErasureCodePluginRegistry ->
 registry, jerasure/isa/lrc/shec/clay plugins -> plugin_*.py modules.
 """
 
+from .batcher import ECBatcher
 from .interface import (ChunkMap, ErasureCode, ErasureCodeError, Flags,
                         Profile, EC_ALIGN_SIZE, SIMD_ALIGN)
 from .registry import factory, preload, register, registered
 
 __all__ = [
-    "ChunkMap", "ErasureCode", "ErasureCodeError", "Flags", "Profile",
-    "EC_ALIGN_SIZE", "SIMD_ALIGN", "factory", "preload", "register",
-    "registered",
+    "ChunkMap", "ECBatcher", "ErasureCode", "ErasureCodeError", "Flags",
+    "Profile", "EC_ALIGN_SIZE", "SIMD_ALIGN", "factory", "preload",
+    "register", "registered",
 ]

@@ -1,0 +1,380 @@
+"""Cross-op EC batching: coalesce stripe work into single folded launches.
+
+The OSD hot path issues one synchronous encode (or degraded-read decode)
+per client op, paying a full host->device->host round trip — and
+potentially a recompile — per call.  Columns of a GF(2^8) region matmul
+are independent, so concurrent full-stripe encodes (and decodes) from
+different ops/PGs that share a ``(matrix, k, m)`` signature fold into ONE
+``(k, sum L)`` launch (the ``TpuCode.encode_batch`` fold, the
+``(batch, k+m, chunk)`` HBM layout of SURVEY.md §5) with results
+scattered back per op.  arXiv:1709.05365 measures online-EC throughput
+dominated by exactly this per-request coding overhead; arXiv:2108.02692
+locates the order-of-magnitude wins in batching/fusing region work.
+
+Mechanics (no background thread, so nothing can leak at shutdown):
+
+- a submitting thread appends its op to the queue for its signature and
+  BLOCKS until its results are ready;
+- the first op queued per signature is the *leader*: it waits out the
+  coalescing window (``window_us``) on a condition variable, then flushes
+  everything queued behind it (flush reason ``window``, or ``idle`` when
+  it expired alone);
+- an arrival that pushes a signature's pending source bytes past
+  ``max_bytes`` flushes immediately itself (reason ``size``), waking the
+  leader;
+- ``window_us == 0`` is pass-through: the op executes inline through the
+  codec's own per-op entry points — bit-identical to the unbatched path.
+
+Length-bucketed padding: each op's chunk length pads up to a power-of-two
+bucket and the stripe count per launch pads to a power of two, so the
+``RegionMatmul`` compile cache (and the fused encode+CRC op cache) see a
+bounded set of shapes.  Zero columns encode/decode to zero under a
+linear code, so the padding is sliced away without affecting bytes.
+
+Checksums: a launch whose ops all want csums and share one exact chunk
+length rides the fused encode+CRC32C device pass (``Checksummer.h:13``
+role — one launch produces parity AND every per-chunk digest); mixed
+lengths fall back to the same CPU CRC sweep the non-jax backends use,
+still over a single folded parity launch.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Sequence
+
+import numpy as np
+
+from ..ops import native
+from .interface import ChunkMap
+from .matrix_code import MatrixErasureCode
+
+FLUSH_WINDOW = "window"
+FLUSH_SIZE = "size"
+FLUSH_IDLE = "idle"
+
+#: perf counters the batcher registers on the registry it is handed
+COUNTERS = ("ec_batch_launches", "ec_batch_coalesced_ops",
+            "ec_batch_bytes", "ec_batch_flush_window",
+            "ec_batch_flush_size", "ec_batch_flush_idle")
+HISTOGRAMS = ("ec_batch_ops_per_launch", "ec_batch_bytes_per_launch")
+
+
+def bucket_len(length: int) -> int:
+    """Pad target for one op's chunk length: the next power of two, with
+    a 512-byte floor (the uint32-lane tiling quantum of RegionMatmul) —
+    a bounded set of shapes instead of one compile per client length."""
+    b = 512
+    while b < length:
+        b <<= 1
+    return b
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class _PendingOp:
+    """One submitted encode/decode riding a folded launch."""
+
+    __slots__ = ("codec", "streams", "chunks", "want", "length",
+                 "with_csums", "callback", "deadline", "taken", "done",
+                 "parity", "csums", "decoded", "error")
+
+    def __init__(self, codec, *, streams=None, chunks=None, want=None,
+                 length=0, with_csums=False, callback=None):
+        self.codec = codec
+        self.streams = streams      # encode: (k, L) uint8
+        self.chunks = chunks        # decode: shard -> (L,) uint8
+        self.want = want            # decode: shard ids to produce
+        self.length = length
+        self.with_csums = with_csums
+        self.callback = callback
+        self.deadline = 0.0
+        self.taken = False          # removed from the queue by a flusher
+        self.done = False
+        self.parity = None
+        self.csums = None
+        self.decoded = None
+        self.error: BaseException | None = None
+
+
+class ECBatcher:
+    """Coalesces concurrent same-signature EC stripe work per launch.
+
+    Thread-safe; blocking ``encode``/``decode`` are the only entry
+    points, so every pending op has a live waiter and none can leak.
+    """
+
+    def __init__(self, *, window_us: float = 500.0,
+                 max_bytes: int = 8 << 20, perf=None):
+        self.window_us = float(window_us)
+        self.max_bytes = int(max_bytes)
+        self._cv = threading.Condition()
+        self._groups: dict[tuple, list[_PendingOp]] = {}
+        self._group_bytes: dict[tuple, int] = {}
+        self.stats = {"launches": 0, "ops": 0, "bytes": 0,
+                      FLUSH_WINDOW: 0, FLUSH_SIZE: 0, FLUSH_IDLE: 0}
+        self._perf = perf
+        if perf is not None:
+            perf.add_many(COUNTERS)
+            from ..utils.perf import CounterType
+            for h in HISTOGRAMS:
+                perf.add(h, CounterType.HISTOGRAM)
+
+    # ------------------------------------------------------------- public
+    def encode(self, codec, data_chunks: np.ndarray, *,
+               with_csums: bool = False,
+               callback: Callable | None = None):
+        """Encode one op's (k, L) data chunks; returns (parity, csums)
+        exactly as the per-op codec entry points would.  Blocks until the
+        folded launch carrying this op completes; ``callback(parity,
+        csums)`` (if given) fires before the call returns."""
+        data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
+        L = int(data_chunks.shape[-1])
+        foldable = (isinstance(codec, MatrixErasureCode)
+                    and type(codec).encode_chunks
+                    is MatrixErasureCode.encode_chunks
+                    and data_chunks.ndim == 2
+                    and data_chunks.shape[0] == codec.k  # bad shape:
+                    # per-op path raises the codec's own error without
+                    # poisoning coalesced neighbors
+                    and L > 0)
+        if self.window_us <= 0 or not foldable:
+            return self._passthrough_encode(codec, data_chunks,
+                                            with_csums, callback)
+        sig = ("enc", codec.matrix.tobytes(), codec.k, codec.m,
+               bool(with_csums), bucket_len(L))
+        op = _PendingOp(codec, streams=data_chunks, length=L,
+                        with_csums=with_csums, callback=callback)
+        self._submit(sig, op, data_chunks.nbytes, self._flush_encode)
+        if op.error is not None:
+            raise op.error
+        return op.parity, op.csums
+
+    def decode(self, codec, want: Sequence[int], chunks: ChunkMap, *,
+               callback: Callable | None = None) -> ChunkMap:
+        """Batched counterpart of ``ErasureCode.decode``: present shards
+        pass through, missing ones reconstruct via a coalesced
+        decode_chunks launch shared with concurrent same-signature ops
+        (same survivor set, same (matrix, k, m), same length bucket)."""
+        want = list(want)
+        need = sorted(i for i in want if i not in chunks)
+        if not need:
+            out = {i: chunks[i] for i in want}
+            if callback is not None:
+                callback(out)
+            return out
+        arrays = {i: np.ascontiguousarray(c, dtype=np.uint8)
+                  for i, c in chunks.items()}
+        lengths = {int(c.shape[-1]) for c in arrays.values()}
+        foldable = (isinstance(codec, MatrixErasureCode)
+                    and type(codec).decode_chunks
+                    is MatrixErasureCode.decode_chunks
+                    and len(lengths) == 1
+                    and all(c.ndim == 1 for c in arrays.values())
+                    and 0 not in lengths)
+        if self.window_us <= 0 or not foldable:
+            return self._passthrough_decode(codec, want, chunks, callback)
+        L = lengths.pop()
+        sig = ("dec", codec.matrix.tobytes(), codec.k, codec.m,
+               tuple(sorted(arrays)), tuple(need), bucket_len(L))
+        # the callback is fired below by THIS thread, after present
+        # shards merge back in — not by the flusher
+        op = _PendingOp(codec, chunks=arrays, want=need, length=L)
+        nbytes = sum(c.nbytes for c in arrays.values())
+        self._submit(sig, op, nbytes, self._flush_decode)
+        if op.error is not None:
+            raise op.error
+        out = dict(op.decoded)
+        for i in want:
+            if i in chunks:
+                out[i] = chunks[i]
+        out = {i: out[i] for i in want}
+        if callback is not None:
+            self._fire(op, callback, out)
+            if op.error is not None:
+                raise op.error
+        return out
+
+    def pending_ops(self) -> int:
+        """Ops queued and not yet taken by a flusher (0 when quiescent)."""
+        with self._cv:
+            return sum(len(q) for q in self._groups.values())
+
+    # ------------------------------------------------- submit/wait machinery
+    def _submit(self, sig: tuple, op: _PendingOp, nbytes: int,
+                flush) -> None:
+        op.deadline = time.monotonic() + self.window_us * 1e-6
+        ops = reason = None
+        with self._cv:
+            q = self._groups.setdefault(sig, [])
+            q.append(op)
+            total = self._group_bytes.get(sig, 0) + nbytes
+            self._group_bytes[sig] = total
+            if total >= self.max_bytes:
+                ops, reason = self._take_locked(sig), FLUSH_SIZE
+            else:
+                while not op.done:
+                    now = time.monotonic()
+                    if not op.taken and now >= op.deadline:
+                        ops = self._take_locked(sig)
+                        reason = (FLUSH_WINDOW if len(ops) > 1
+                                  else FLUSH_IDLE)
+                        break
+                    self._cv.wait(timeout=None if op.taken
+                                  else max(0.0, op.deadline - now))
+        if ops is not None:
+            flush(sig, ops, reason)
+        if not op.done:  # flushed by another thread after we broke out
+            with self._cv:
+                while not op.done:
+                    self._cv.wait()
+
+    def _take_locked(self, sig: tuple) -> list[_PendingOp]:
+        ops = self._groups.pop(sig, [])
+        self._group_bytes.pop(sig, None)
+        for o in ops:
+            o.taken = True
+        return ops
+
+    def _complete(self, ops: list[_PendingOp], src_bytes: int,
+                  reason: str) -> None:
+        self._account(len(ops), src_bytes, reason)
+        with self._cv:
+            for o in ops:
+                o.done = True
+            self._cv.notify_all()
+
+    def _fire(self, op: _PendingOp, callback: Callable, *args) -> None:
+        try:
+            callback(*args)
+        except BaseException as e:  # surfaced to the op's own waiter
+            op.error = e
+
+    def _account(self, n_ops: int, src_bytes: int, reason: str) -> None:
+        with self._cv:
+            self.stats["launches"] += 1
+            self.stats["ops"] += n_ops
+            self.stats["bytes"] += src_bytes
+            self.stats[reason] += 1
+        p = self._perf
+        if p is not None:
+            p.inc("ec_batch_launches")
+            p.inc("ec_batch_coalesced_ops", n_ops)
+            p.inc("ec_batch_bytes", src_bytes)
+            p.inc(f"ec_batch_flush_{reason}")
+            p.hinc("ec_batch_ops_per_launch", n_ops)
+            p.hinc("ec_batch_bytes_per_launch", src_bytes)
+
+    # ------------------------------------------------------- pass-through
+    def _passthrough_encode(self, codec, data_chunks, with_csums,
+                            callback):
+        if with_csums:
+            enc_csum = getattr(codec, "encode_chunks_with_csums", None)
+            if enc_csum is not None:
+                parity, csums = enc_csum(data_chunks)
+            else:
+                parity, csums = codec.encode_chunks(data_chunks), None
+        else:
+            parity, csums = codec.encode_chunks(data_chunks), None
+        self._account(1, data_chunks.nbytes, FLUSH_IDLE)
+        if callback is not None:
+            callback(parity, csums)
+        return parity, csums
+
+    def _passthrough_decode(self, codec, want, chunks, callback):
+        out = codec.decode(want, chunks)
+        self._account(1, sum(np.asarray(c).nbytes
+                             for c in chunks.values()), FLUSH_IDLE)
+        if callback is not None:
+            callback(out)
+        return out
+
+    # ------------------------------------------------------------ flushes
+    def _flush_encode(self, sig: tuple, ops: list[_PendingOp],
+                      reason: str) -> None:
+        bucket = sig[-1]
+        codec = ops[0].codec
+        k = codec.k
+        src_bytes = sum(o.streams.nbytes for o in ops)
+        try:
+            n = len(ops)
+            n2 = _pow2(n)  # stripe-count padding: bounded shape set
+            # fused needs one EXACT chunk length across the launch (the
+            # device CRC is per whole chunk — a padded chunk would
+            # digest its padding); the shared length need not be a
+            # power of two.  _csum_op_if_ready keeps the multi-second
+            # XLA compile OFF this path: until the op is warm the CPU
+            # CRC sweep below produces the same digests.
+            L0 = ops[0].length
+            op_fn = None
+            if (sig[4]  # every op in the group wants csums
+                    and getattr(codec, "_backend", None) == "jax"
+                    and all(o.length == L0 for o in ops)
+                    and L0 % 4 == 0):
+                op_fn = codec._csum_op_if_ready(L0, n2 * L0)
+            if op_fn is not None:
+                # ONE device pass: parity + per-chunk CRC32C for every
+                # stripe in the launch (csums (k+m, n2), one per stripe)
+                folded = np.zeros((k, n2 * L0), dtype=np.uint8)
+                for i, o in enumerate(ops):
+                    folded[:, i * L0: (i + 1) * L0] = o.streams
+                dev_parity, dev_csums = op_fn(folded)
+                parity = np.asarray(dev_parity)
+                csums = np.asarray(dev_csums)
+                for i, o in enumerate(ops):
+                    o.parity = parity[:, i * L0: (i + 1) * L0]
+                    o.csums = csums[:, i]
+            else:
+                folded = np.zeros((k, n2 * bucket), dtype=np.uint8)
+                for i, o in enumerate(ops):
+                    folded[:, i * bucket: i * bucket + o.length] = \
+                        o.streams
+                # device-resident matmul: one launch, one host sync
+                parity = np.asarray(
+                    codec._matmul_device(codec.matrix, folded))
+                for i, o in enumerate(ops):
+                    o.parity = parity[:, i * bucket: i * bucket + o.length]
+                    if o.with_csums:
+                        stack = np.concatenate([o.streams, o.parity],
+                                               axis=0)
+                        o.csums = np.array(
+                            [native.crc32c(row.tobytes())
+                             for row in stack], dtype=np.uint32)
+            for o in ops:
+                if o.callback is not None:
+                    self._fire(o, o.callback, o.parity, o.csums)
+        except BaseException as e:
+            for o in ops:
+                o.error = e
+        finally:
+            self._complete(ops, src_bytes, reason)
+
+    def _flush_decode(self, sig: tuple, ops: list[_PendingOp],
+                      reason: str) -> None:
+        bucket = sig[-1]
+        codec = ops[0].codec
+        avail, want = sig[4], list(sig[5])
+        src_bytes = sum(sum(c.nbytes for c in o.chunks.values())
+                        for o in ops)
+        try:
+            n2 = _pow2(len(ops))
+            flat = {s: np.zeros(n2 * bucket, dtype=np.uint8)
+                    for s in avail}
+            for i, o in enumerate(ops):
+                for s, c in o.chunks.items():
+                    flat[s][i * bucket: i * bucket + o.length] = c
+            out = codec.decode_chunks(want, flat)
+            for i, o in enumerate(ops):
+                o.decoded = {s: row[i * bucket: i * bucket + o.length]
+                             for s, row in out.items()}
+        except BaseException as e:
+            for o in ops:
+                o.error = e
+        finally:
+            self._complete(ops, src_bytes, reason)

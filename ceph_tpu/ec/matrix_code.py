@@ -10,6 +10,7 @@ ErasureCodeIsaTableCache LRU, ErasureCodeIsa.cc:513-563).
 
 from __future__ import annotations
 
+import threading
 from typing import Sequence
 
 import numpy as np
@@ -33,25 +34,63 @@ class MatrixErasureCode(ErasureCode):
     #: subclasses set this in _init_from_profile
     matrix: np.ndarray
 
+    #: cache bounds (class attrs so tests can shrink them)
+    JAX_OPS_CAP = 64
+    DECODE_CACHE_CAP = 256
+
     def _init_matrix_backend(self) -> None:
         self._backend = _pick_backend(self.profile.get("backend", "auto"))
         self._decode_cache: dict[tuple[int, ...], np.ndarray] = {}
         # compiled-kernel cache keyed by matrix bytes (encode matrix plus
-        # decode matrices), so repeated decodes reuse their compilation
+        # decode matrices), so repeated decodes reuse their compilation.
+        # True LRU: hits re-insert at the dict's end, eviction pops the
+        # front (the ErasureCodeIsaTableCache semantics, ref :513-563) —
+        # a hot entry must survive churn from one-shot signatures.
         self._jax_ops: dict[bytes, object] = {}
+        # sharded OSD workers (and batcher flushers) hit these caches
+        # concurrently; the LRU touch is pop+reinsert, which must not
+        # interleave
+        self._cache_lock = threading.Lock()
+        # fused encode+CRC ops compile in the BACKGROUND (seconds of
+        # XLA work; done synchronously on the IO path it stalls every
+        # in-process OSD past the heartbeat grace and the cluster marks
+        # itself down): shapes warmed/warming, guarded by _cache_lock
+        self._csum_ready: set[tuple[int, int]] = set()
+        self._csum_building: set[tuple[int, int]] = set()
         if self._backend == "jax":
             self._jax_matmul(self.matrix)  # build the encode op eagerly
 
-    def _jax_matmul(self, M: np.ndarray):
-        key = M.tobytes() + bytes(M.shape)
-        op = self._jax_ops.get(key)
-        if op is None:
-            from ..ops import ec_kernels  # deferred: jax import is heavy
-            op = ec_kernels.RegionMatmul(M)
-            if len(self._jax_ops) > 64:
-                self._jax_ops.pop(next(iter(self._jax_ops)))
+    def _jax_op_cached(self, key: bytes, build):
+        with self._cache_lock:
+            op = self._jax_ops.pop(key, None)
+            if op is not None:
+                self._jax_ops[key] = op  # LRU touch: re-insert at end
+                return op
+        op = build()  # trace-lazy, but still outside the lock
+        with self._cache_lock:
+            hit = self._jax_ops.pop(key, None)
+            if hit is not None:
+                op = hit  # another thread built it first: keep one
+            elif len(self._jax_ops) > self.JAX_OPS_CAP:
+                old = next(iter(self._jax_ops))
+                self._jax_ops.pop(old)
+                if old.startswith(b"csum"):
+                    # an evicted fused op loses its compiled executables
+                    # with it: its shapes must leave the ready set too,
+                    # or the next "ready" hit would rebuild and compile
+                    # synchronously on the IO path
+                    n = int.from_bytes(old[-8:], "little")
+                    self._csum_ready = {s for s in self._csum_ready
+                                        if s[0] != n}
             self._jax_ops[key] = op
         return op
+
+    def _jax_matmul(self, M: np.ndarray):
+        def build():
+            from ..ops import ec_kernels  # deferred: jax import is heavy
+            return ec_kernels.RegionMatmul(M)
+
+        return self._jax_op_cached(M.tobytes() + bytes(M.shape), build)
 
     def get_flags(self) -> Flags:
         return (Flags.PARITY_DELTA_OPTIMIZATION | Flags.ZERO_PADDING |
@@ -59,12 +98,20 @@ class MatrixErasureCode(ErasureCode):
                 Flags.PARTIAL_WRITE_OPTIMIZATION)
 
     # -- region multiply through the selected backend ----------------------
-    def _matmul(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    def _matmul_device(self, M: np.ndarray, rows: np.ndarray):
+        """Backend-resident region multiply: on the jax backend the
+        result STAYS a device array (no np.asarray sync), so callers
+        folding many stripes into one launch — the ECBatcher, the fused
+        encode+CRC pass — pay one host sync for the whole batch instead
+        of one per op.  Other backends return numpy directly."""
         if self._backend == "native":
             return native.encode_region(M, rows)
         if self._backend == "jax":
-            return np.asarray(self._jax_matmul(M)(rows))
+            return self._jax_matmul(M)(rows)
         return gf256.encode_region(M, rows)
+
+    def _matmul(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
+        return np.asarray(self._matmul_device(M, rows))
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
@@ -93,34 +140,97 @@ class MatrixErasureCode(ErasureCode):
             return parity, np.array([native.crc32c(row.tobytes())
                                      for row in stack], dtype=np.uint32)
         if self._backend == "jax" and nbytes % 4 == 0 and nbytes >= 4:
-            key = b"csum" + self.matrix.tobytes() + nbytes.to_bytes(8,
-                                                                    "little")
-            op = self._jax_ops.get(key)
-            if op is None:
-                import jax
-
-                from ..models.stripe_codec import StripeCodec
-                codec = StripeCodec.__new__(StripeCodec)
-                codec.k, codec.m = self.k, self.m
-                codec.matrix = self.matrix
-                op = jax.jit(codec.encode_csum_graph(nbytes))
-                if len(self._jax_ops) > 64:
-                    self._jax_ops.pop(next(iter(self._jax_ops)))
-                self._jax_ops[key] = op
-            parity, csums = op(data_chunks)
-            return np.asarray(parity), np.asarray(csums)[:, 0]
+            op = self._csum_op_if_ready(nbytes, nbytes)
+            if op is not None:
+                parity, csums = op(data_chunks)
+                return np.asarray(parity), np.asarray(csums)[:, 0]
+            # op still compiling in the background: CPU csums this time
+            # (identical values), fused from the next call on
         parity = self._matmul(self.matrix, data_chunks)
         stack = np.concatenate([data_chunks, parity], axis=0)
         csums = np.array([native.crc32c(row.tobytes())
                           for row in stack], dtype=np.uint32)
         return parity, csums
 
+    def _csum_op(self, nbytes: int):
+        """Fused encode+CRC32C device op for chunk length ``nbytes``:
+        fn((k, batch*nbytes) data) -> (parity (m, batch*nbytes),
+        csums (k+m, batch)) — parity and every per-chunk digest leave
+        the device together (Checksummer.h:13 role).  Cached per
+        (matrix, nbytes) alongside the plain matmul kernels."""
+        def build():
+            import jax
+
+            from ..models.stripe_codec import StripeCodec
+            codec = StripeCodec.__new__(StripeCodec)
+            codec.k, codec.m = self.k, self.m
+            codec.matrix = self.matrix
+            return jax.jit(codec.encode_csum_graph(nbytes))
+
+        key = b"csum" + self.matrix.tobytes() + nbytes.to_bytes(8, "little")
+        return self._jax_op_cached(key, build)
+
+    def _csum_op_if_ready(self, nbytes: int, total: int):
+        """Non-blocking fused-op lookup for input width ``total`` (a
+        batch of ``total // nbytes`` chunks).
+
+        On a real TPU backend the op is returned directly (the
+        persistent XLA compile cache absorbs the one-time cost — the
+        deployment shape the fused Checksummer pass exists for).  On
+        the CPU jax platform the compile costs SECONDS per shape and
+        saturates every core; inside an in-process test cluster that
+        blows the heartbeat grace of every OSD sharing the interpreter
+        and the cluster marks itself down.  So off-TPU the op is only
+        returned once compiled, callers take the (byte-identical)
+        native CRC sweep meanwhile, and background warming is opt-in
+        via the ec profile key ``csum_warm``."""
+        import jax  # the caller is jax-backend, so this is loaded
+
+        if jax.default_backend() == "tpu":
+            return self._csum_op(nbytes)
+        shape = (nbytes, total)
+        with self._cache_lock:
+            if shape in self._csum_ready:
+                ready = True
+            elif (shape in self._csum_building
+                  or str(self.profile.get("csum_warm", "off")).lower()
+                  not in ("on", "true", "1", "yes")):
+                return None
+            else:
+                self._csum_building.add(shape)
+                ready = False
+        if ready:
+            return self._csum_op(nbytes)
+
+        def warm():
+            try:
+                op = self._csum_op(nbytes)
+                op(np.zeros((self.k, total), dtype=np.uint8))  # compile
+                with self._cache_lock:
+                    self._csum_ready.add(shape)
+            except Exception:  # noqa: BLE001 - fallback path stays CPU
+                pass
+            finally:
+                with self._cache_lock:
+                    self._csum_building.discard(shape)
+
+        threading.Thread(target=warm, name="ec-csum-warm",
+                         daemon=True).start()
+        return None
+
     def _get_decode_matrix(self, available: Sequence[int]) -> np.ndarray:
         key = tuple(available[: self.k])
-        hit = self._decode_cache.get(key)
-        if hit is None:
-            hit = gf256.decode_matrix(self.matrix, self.k, list(key))
-            if len(self._decode_cache) > 256:  # signature LRU, ref :513-563
+        with self._cache_lock:
+            hit = self._decode_cache.pop(key, None)
+            if hit is not None:
+                # LRU touch: re-insert at the end so hot signatures
+                # survive eviction churn from one-shot ones
+                self._decode_cache[key] = hit
+                return hit
+        hit = gf256.decode_matrix(self.matrix, self.k, list(key))
+        with self._cache_lock:
+            # signature LRU, ref :513-563
+            if len(self._decode_cache) > self.DECODE_CACHE_CAP:
                 self._decode_cache.pop(next(iter(self._decode_cache)))
             self._decode_cache[key] = hit
         return hit
@@ -140,7 +250,13 @@ class MatrixErasureCode(ErasureCode):
         data_full: np.ndarray | None = None
         if want_data or want_parity:
             missing_data = [i for i in range(self.k) if i not in chunks]
-            if missing_data or want_parity:
+            if not missing_data:
+                # all k data rows present: the first k sorted survivors
+                # ARE the data rows in order — wanted parity is one
+                # direct matmul against the coding matrix below, with no
+                # decode-matrix build/inversion
+                data_full = stack if want_parity else None
+            else:
                 D = self._get_decode_matrix(use)
                 if want_parity or len(missing_data) > 1:
                     data_full = self._matmul(D, stack)

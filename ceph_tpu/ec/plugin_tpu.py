@@ -54,8 +54,9 @@ class TpuCode(MatrixErasureCode):
         if k != self.k:
             raise ErasureCodeError(f"expected k={self.k}, got {k}")
         folded = stripes.transpose(1, 0, 2).reshape(k, b * L)
-        parity = self._matmul(self.matrix, folded)
-        return np.asarray(parity).reshape(self.m, b, L).transpose(1, 0, 2)
+        # device-resident multiply: ONE host sync for the whole batch
+        parity = np.asarray(self._matmul_device(self.matrix, folded))
+        return parity.reshape(self.m, b, L).transpose(1, 0, 2)
 
     def decode_batch(self, want: list[int], stripes: ChunkMap) -> ChunkMap:
         """Batched decode: stripes maps shard id -> (batch, L) arrays; the

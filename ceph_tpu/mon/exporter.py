@@ -88,6 +88,16 @@ def render_metrics(mon=None) -> str:
                              else f"perf counter {cname} {sub}",
                              typ="counter")
                         first_metric.add(metric)
+                # pow-2 histograms (e.g. the EC batcher's ops-per-launch
+                # distribution): one labeled series per occupied bucket,
+                # bucket b covering values in [2^(b-1), 2^b)
+                for b, n in sorted(val.get("buckets_pow2", {}).items()):
+                    metric = f"{base}_bucket"
+                    emit(metric, n, {"daemon": daemon, "pow2": b},
+                         help_=None if metric in first_metric
+                         else f"perf histogram {cname} pow-2 buckets",
+                         typ="counter")
+                    first_metric.add(metric)
             elif isinstance(val, (int, float)):
                 emit(base, val, {"daemon": daemon},
                      help_=None if base in first_metric

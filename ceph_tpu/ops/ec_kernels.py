@@ -30,6 +30,8 @@ interpret mode for CI coverage of the kernel itself.
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -165,15 +167,23 @@ class RegionMatmul:
         self._interpret = interpret and not on_tpu
         self._use_pallas = on_tpu or self._interpret
         self._shape_cache: dict[tuple, object] = {}
+        # one matmul op serves many threads (OSD shard workers, batcher
+        # flushers); the LRU touch and eviction must not interleave
+        self._cache_lock = threading.Lock()
 
     def _compiled(self, key: tuple):
-        fn = self._shape_cache.get(key)
-        if fn is None:
-            kind, n4 = key
-            fn = (self._build_u32(n4) if kind == "u32"
-                  else self._build_u8(n4))
-            if len(self._shape_cache) >= 16:
-                self._shape_cache.pop(next(iter(self._shape_cache)))
+        # true LRU: a hot shape must not be evicted just because it was
+        # compiled first (a hit re-inserts behind newer one-shots).
+        # Building under the lock is fine — jax.jit wrapping is lazy;
+        # the expensive trace happens at first call, outside the lock.
+        with self._cache_lock:
+            fn = self._shape_cache.pop(key, None)
+            if fn is None:
+                kind, n4 = key
+                fn = (self._build_u32(n4) if kind == "u32"
+                      else self._build_u8(n4))
+                if len(self._shape_cache) >= 16:
+                    self._shape_cache.pop(next(iter(self._shape_cache)))
             self._shape_cache[key] = fn
         return fn
 

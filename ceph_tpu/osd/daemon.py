@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from .. import ec
+from ..ec.batcher import ECBatcher
 from ..ec.stripe import StripeInfo, plan_write
 from ..mon.maps import OSDMap
 from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
@@ -277,6 +278,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             "ec_cache_miss", "map_inc", "map_full",
                             "snap_trims"])
         self.perf.add("op_lat", CounterType.TIME)
+        # cross-op EC batching (ec/batcher.py): concurrent stripe
+        # encodes/decodes sharing a (matrix, k, m) signature coalesce
+        # into ONE folded kernel launch within a small window; engaged
+        # per codec by _ec_batch_on (jax backend only by default).  The
+        # batcher registers its launch/flush counters on this OSD's perf
+        # registry, so `perf dump` and the exporter carry them.
+        self._ec_batcher = ECBatcher(
+            window_us=self.cfg["ec_batch_window_us"],
+            max_bytes=self.cfg["ec_batch_max_bytes"],
+            perf=self.perf)
         # op scheduler (OpScheduler/mClockScheduler role): the messenger
         # thread classifies+enqueues; ONE dequeue worker executes
         # handlers, preserving single-threaded handler semantics while
@@ -921,6 +932,45 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._ec_codecs[pool_id] = codec
         return codec
 
+    def _ec_batch_on(self, codec) -> bool:
+        """Whether this codec's stripe work routes through the cross-op
+        batcher: pool ec-profile key 'batch' wins, then the ec_batch
+        option; 'auto' engages on the jax backend only (numpy/native
+        launches are cheap CPU calls — coalescing would only add the
+        window latency) and only under the sharded mclock scheduler —
+        fifo mode runs client ops inline on ONE dispatch thread, so a
+        second op can never be in flight to coalesce with and the
+        window would be pure added latency."""
+        mode = str(codec.profile.get("batch",
+                                     self.cfg["ec_batch"])).lower()
+        if mode in ("on", "true", "1", "yes"):
+            return True
+        if mode in ("off", "false", "0", "no"):
+            return False
+        return (getattr(codec, "_backend", None) == "jax"
+                and self._use_mclock)
+
+    def _ec_encode(self, codec, streams, with_csums: bool):
+        """One encode launch for one op — or, when batching is engaged,
+        a slot in a folded launch shared with concurrent ops.  Returns
+        (parity, csums); csums is None when the codec has no fused path
+        and with_csums was not requested."""
+        if self._ec_batch_on(codec):
+            return self._ec_batcher.encode(codec, streams,
+                                           with_csums=with_csums)
+        if with_csums:
+            enc_csum = getattr(codec, "encode_chunks_with_csums", None)
+            if enc_csum is not None:
+                return enc_csum(streams)
+        return codec.encode_chunks(streams), None
+
+    def _ec_decode(self, codec, want, chunks):
+        """Decode wanted shards — coalesced with concurrent decodes of
+        the same erasure signature when batching is engaged."""
+        if self._ec_batch_on(codec):
+            return self._ec_batcher.decode(codec, want, chunks)
+        return codec.decode(want, chunks)
+
     # ----------------------------------------------------------- pg log
     def _pglog(self, pgid: PgId) -> PGLog:
         pl = self._pglogs.get(pgid)
@@ -1455,11 +1505,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # re-sweeping the bytes on CPU.
         self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(m.data)
-        enc_csum = getattr(codec, "encode_chunks_with_csums", None)
-        if enc_csum is not None:
-            parity, csums = enc_csum(streams)
-        else:
-            parity, csums = codec.encode_chunks(streams), None
+        parity, csums = self._ec_encode(codec, streams, with_csums=True)
         attrs = {"v": version, "len": len(m.data)}
         if self._ec_whiteout(pgid, m.oid):
             attrs["wh"] = 0  # write resurrects a whiteout'd head
@@ -1544,7 +1590,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         version = self._next_version(pgid)
         self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(row_bytes)
-        parity = codec.encode_chunks(streams)
+        parity, _csums = self._ec_encode(codec, streams, with_csums=False)
         base = row0 * si.chunk_size
         tid = next(self._tids)
         remote = sum(1 for o in up
@@ -1836,7 +1882,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if all(i in have for i in data_ids):
                 streams = [have[i] for i in data_ids]
             else:
-                dec = codec.decode(data_ids, have)
+                dec = self._ec_decode(codec, data_ids, have)
                 streams = [dec[i] for i in data_ids]
             old = si.ro_assemble(streams).tobytes()
             buf = bytearray(nrows * si.stripe_width)
@@ -2220,7 +2266,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if all(i in chunks for i in data_ids):
             streams = [chunks[i] for i in data_ids]
         else:
-            decoded = codec.decode(data_ids, dict(chunks))
+            decoded = self._ec_decode(codec, data_ids, dict(chunks))
             streams = [decoded[i] for i in data_ids]
         ro = si.ro_assemble(streams).tobytes()
         if pr.row_len:
@@ -3764,7 +3810,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 if len(chunks) < codec.k:
                     self._requery_pg(pgid)
                     return
-                out = codec.decode([shard], dict(chunks))
+                out = self._ec_decode(codec, [shard], dict(chunks))
                 rebuilt = out[shard]
             total = self._ec_total_len(pr)
             self.perf.inc("recovery_push")

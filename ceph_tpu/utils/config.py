@@ -191,6 +191,21 @@ OPTIONS: list[Option] = [
            startup=True),
     Option("ec_stripe_batch", int, 64, OptionLevel.ADVANCED,
            "stripes batched per device EC launch", min=1, max=4096),
+    Option("ec_batch", str, "auto", OptionLevel.ADVANCED,
+           "cross-op EC batching (ec/batcher.py): coalesce concurrent "
+           "same-signature stripe encodes/decodes into one folded kernel "
+           "launch; auto engages on the jax backend only (per-op pool "
+           "override via ec profile key 'batch')",
+           enum_values=("auto", "on", "off")),
+    Option("ec_batch_window_us", float, 500.0, OptionLevel.ADVANCED,
+           "max microseconds an EC op waits to coalesce with concurrent "
+           "stripe work (0 = pass-through: per-op launches, bit-identical "
+           "to the unbatched path)", min=0.0, max=1_000_000.0,
+           see_also=("ec_batch", "ec_batch_max_bytes")),
+    Option("ec_batch_max_bytes", int, 8 << 20, OptionLevel.ADVANCED,
+           "pending source bytes per EC batch signature that force an "
+           "immediate size-flush before the window expires", min=4096,
+           see_also=("ec_batch", "ec_batch_window_us")),
     Option("osd_ec_stripe_unit", int, 4096, OptionLevel.ADVANCED,
            "EC chunk size (bytes per shard per stripe row); must be a "
            "multiple of 4096 (the EC_ALIGN_SIZE page-alignment contract, "

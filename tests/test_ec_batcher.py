@@ -1,0 +1,333 @@
+"""ECBatcher tests: batched vs per-op byte-exactness against the numpy
+gf256 oracle, every flush path (window / size / idle), mixed lengths and
+mixed (k, m) signatures in flight, degraded-read decode coalescing, and
+the pass-through (window=0) identity + no-leak smoke.
+
+Runs on the CPU jax backend (conftest forces JAX_PLATFORMS=cpu); the
+math is identical on TPU — kernels are covered by test_ec_kernels.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from ceph_tpu import ec
+from ceph_tpu.ec.batcher import (ECBatcher, FLUSH_IDLE, FLUSH_SIZE,
+                                 FLUSH_WINDOW, bucket_len)
+from ceph_tpu.ops import gf256, native
+
+RNG = np.random.default_rng(11)
+
+
+def _codec(k=4, m=2):
+    return ec.factory("tpu", {"k": k, "m": m, "backend": "jax"})
+
+
+def _oracle_parity(codec, data):
+    return gf256.encode_region(codec.matrix, data)
+
+
+def _oracle_csums(data, parity):
+    stack = np.concatenate([data, np.asarray(parity)], axis=0)
+    return np.array([native.crc32c(row.tobytes()) for row in stack],
+                    dtype=np.uint32)
+
+
+def _burst(batcher, codec, payloads, *, with_csums=False, stagger=0.02):
+    """Submit each payload from its own thread; first thread leads."""
+    results = [None] * len(payloads)
+    errors = []
+
+    def writer(i):
+        try:
+            results[i] = batcher.encode(codec, payloads[i],
+                                        with_csums=with_csums)
+        except Exception as e:  # noqa: BLE001 - surfaced by the test
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(len(payloads))]
+    threads[0].start()
+    time.sleep(stagger)  # let the leader enter its window first
+    for t in threads[1:]:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    return results
+
+
+def test_bucket_len_bounded():
+    assert bucket_len(1) == 512
+    assert bucket_len(512) == 512
+    assert bucket_len(513) == 1024
+    assert bucket_len(4096) == 4096
+    assert bucket_len(5000) == 8192
+
+
+def test_passthrough_window0_bit_identical_no_leaks():
+    """window=0 pass-through: bit-identical to the per-op codec entry
+    points, every callback fired synchronously, nothing pending."""
+    codec = _codec()
+    b = ECBatcher(window_us=0)
+    fired = []
+    for L in (512, 1000, 4096, 53_248):
+        data = RNG.integers(0, 256, (4, L), dtype=np.uint8)
+        parity, csums = b.encode(codec, data, with_csums=True,
+                                 callback=lambda p, c: fired.append(1))
+        want_p, want_c = codec.encode_chunks_with_csums(data)
+        assert np.array_equal(np.asarray(parity), want_p)
+        assert np.array_equal(np.asarray(csums), want_c)
+        # plain encode too
+        p2, c2 = b.encode(codec, data, with_csums=False,
+                          callback=lambda p, c: fired.append(1))
+        assert np.array_equal(np.asarray(p2), codec.encode_chunks(data))
+        assert c2 is None
+    # decode pass-through
+    full = codec.encode(b"q" * 8192)
+    avail = {i: c for i, c in full.items() if i != 2}
+    out = b.decode(codec, [0, 1, 2, 3], dict(avail),
+                   callback=lambda o: fired.append(1))
+    ref = codec.decode([0, 1, 2, 3], dict(avail))
+    for i in ref:
+        assert np.array_equal(np.asarray(out[i]), np.asarray(ref[i]))
+    assert len(fired) == 9  # 4 lengths x 2 encodes + 1 decode
+    assert b.pending_ops() == 0
+    assert b.stats["launches"] == 9
+    assert b.stats[FLUSH_IDLE] == 9 and b.stats[FLUSH_WINDOW] == 0
+
+
+def test_size_flush_coalesces_two_ops_one_launch():
+    """Second arrival crosses max_bytes -> ONE folded launch, reason
+    'size', both results byte-exact vs the oracle."""
+    codec = _codec()
+    L = 4096
+    b = ECBatcher(window_us=10_000_000, max_bytes=2 * 4 * L)
+    pays = [RNG.integers(0, 256, (4, L), dtype=np.uint8)
+            for _ in range(2)]
+    results = _burst(b, codec, pays, with_csums=True)
+    for data, (parity, csums) in zip(pays, results):
+        assert np.array_equal(np.asarray(parity), _oracle_parity(codec,
+                                                                 data))
+        assert np.array_equal(np.asarray(csums),
+                              _oracle_csums(data, parity))
+    assert b.stats["launches"] == 1
+    assert b.stats["ops"] == 2
+    assert b.stats[FLUSH_SIZE] == 1
+    assert b.pending_ops() == 0
+
+
+def test_mixed_lengths_coalesce_byte_exact():
+    """Ops of different lengths share a bucket, pad, and slice back
+    byte-exact (csums fall back to the CPU sweep — still exact)."""
+    codec = _codec()
+    lens = [1000, 700, 1024]
+    b = ECBatcher(window_us=10_000_000,
+                  max_bytes=4 * sum(lens))  # third arrival size-flushes
+    pays = [RNG.integers(0, 256, (4, L), dtype=np.uint8) for L in lens]
+    results = _burst(b, codec, pays, with_csums=True)
+    for data, (parity, csums) in zip(pays, results):
+        assert np.array_equal(np.asarray(parity),
+                              _oracle_parity(codec, data))
+        assert np.array_equal(np.asarray(csums),
+                              _oracle_csums(data, parity))
+    assert b.stats["launches"] == 1 and b.stats["ops"] == 3
+
+
+def test_window_flush_coalesces():
+    """Leader waits out the window; a follower arriving inside it rides
+    the same launch (reason 'window')."""
+    codec = _codec()
+    L = 2048
+    b = ECBatcher(window_us=1_500_000)  # 1.5s: CI-safe margin
+    pays = [RNG.integers(0, 256, (4, L), dtype=np.uint8)
+            for _ in range(2)]
+    results = _burst(b, codec, pays, stagger=0.1)
+    for data, (parity, _c) in zip(pays, results):
+        assert np.array_equal(np.asarray(parity),
+                              _oracle_parity(codec, data))
+    assert b.stats["launches"] == 1
+    assert b.stats[FLUSH_WINDOW] == 1
+    assert b.stats["ops"] == 2
+
+
+def test_mixed_signatures_in_flight():
+    """Two (k, m) signatures in flight at once form two independent
+    groups — one launch each, results exact for both codecs."""
+    c42, c83 = _codec(4, 2), _codec(8, 3)
+    b = ECBatcher(window_us=1_500_000)
+    L = 1024
+    p42 = [RNG.integers(0, 256, (4, L), dtype=np.uint8)
+           for _ in range(2)]
+    p83 = [RNG.integers(0, 256, (8, L), dtype=np.uint8)
+           for _ in range(2)]
+    results = {}
+    errors = []
+
+    def writer(key, codec, data):
+        try:
+            results[key] = b.encode(codec, data)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(("a", i), c42,
+                                                     p42[i]))
+               for i in range(2)]
+    threads += [threading.Thread(target=writer, args=(("b", i), c83,
+                                                      p83[i]))
+                for i in range(2)]
+    threads[0].start()
+    threads[2].start()
+    time.sleep(0.1)
+    threads[1].start()
+    threads[3].start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for i in range(2):
+        assert np.array_equal(np.asarray(results[("a", i)][0]),
+                              _oracle_parity(c42, p42[i]))
+        assert np.array_equal(np.asarray(results[("b", i)][0]),
+                              _oracle_parity(c83, p83[i]))
+    assert b.stats["launches"] == 2
+    assert b.stats["ops"] == 4
+    assert b.pending_ops() == 0
+
+
+def test_degraded_decode_coalesce():
+    """Two degraded-read decodes with the same erasure signature ride
+    one decode_chunks flush, byte-exact vs the per-op decode."""
+    codec = _codec()
+    L = 4096
+    stripes = [RNG.integers(0, 256, (4, L), dtype=np.uint8)
+               for _ in range(2)]
+    cases = []
+    for data in stripes:
+        parity = _oracle_parity(codec, data)
+        chunks = {0: data[0], 2: data[2], 3: data[3],
+                  4: parity[0], 5: parity[1]}  # shard 1 erased
+        cases.append((data, chunks))
+    b = ECBatcher(window_us=1_500_000)
+    out = [None, None]
+    errors = []
+
+    def reader(i):
+        try:
+            out[i] = b.decode(codec, [0, 1, 2, 3], dict(cases[i][1]))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t0 = threading.Thread(target=reader, args=(0,))
+    t1 = threading.Thread(target=reader, args=(1,))
+    t0.start()
+    time.sleep(0.1)
+    t1.start()
+    t0.join()
+    t1.join()
+    assert not errors, errors
+    for i, (data, chunks) in enumerate(cases):
+        ref = codec.decode([0, 1, 2, 3], dict(chunks))
+        for s in ref:
+            assert np.array_equal(np.asarray(out[i][s]),
+                                  np.asarray(ref[s])), (i, s)
+            assert np.array_equal(np.asarray(out[i][s]), data[s]), (i, s)
+    assert b.stats["launches"] == 1
+    assert b.stats["ops"] == 2
+    assert b.pending_ops() == 0
+
+
+def test_decode_all_present_no_launch():
+    """Wanted shards all present: pure pass-through dict, no launch."""
+    codec = _codec()
+    full = codec.encode(b"y" * 8192)
+    b = ECBatcher(window_us=1000)
+    out = b.decode(codec, [0, 1], {i: full[i] for i in range(4)})
+    assert np.array_equal(out[0], full[0])
+    assert b.stats["launches"] == 0
+
+
+def test_batched_encode_matches_oracle_many_lengths():
+    """Sequential (idle-flush) batched encodes across many lengths stay
+    byte-exact — covers the bucket/pad/slice path without threads."""
+    codec = _codec()
+    b = ECBatcher(window_us=50)  # tiny window: each op idle-flushes
+    # 12_288 = 3 stripe rows of 4096: NOT a power of two but % 4 == 0,
+    # so the fused encode+CRC device path must still engage
+    for L in (512, 513, 1000, 2048, 4096, 10_000, 12_288, 53_248):
+        data = RNG.integers(0, 256, (4, L), dtype=np.uint8)
+        parity, csums = b.encode(codec, data, with_csums=True)
+        assert np.array_equal(np.asarray(parity),
+                              _oracle_parity(codec, data)), L
+        assert np.array_equal(np.asarray(csums),
+                              _oracle_csums(data, parity)), L
+    assert b.pending_ops() == 0
+
+
+def test_fused_csum_path_after_warm():
+    """With csum_warm enabled the fused encode+CRC op compiles in the
+    background; once ready, a batched flush rides it — digests equal
+    the native CRC sweep."""
+    codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "jax",
+                               "csum_warm": "on"})
+    L = 4096
+    b = ECBatcher(window_us=50)
+    data = RNG.integers(0, 256, (4, L), dtype=np.uint8)
+    b.encode(codec, data, with_csums=True)  # kicks off the warm
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if (L, L) in codec._csum_ready:
+            break
+        time.sleep(0.05)
+    assert (L, L) in codec._csum_ready, "warm thread never finished"
+    assert codec._csum_op_if_ready(L, L) is not None
+    parity, csums = b.encode(codec, data, with_csums=True)  # fused now
+    assert np.array_equal(np.asarray(parity), _oracle_parity(codec, data))
+    assert np.array_equal(np.asarray(csums), _oracle_csums(data, parity))
+
+
+def test_csum_ready_invalidated_on_eviction():
+    """Evicting a fused csum op from the kernel LRU must also drop its
+    shapes from the ready set — a stale 'ready' would put the XLA
+    compile back on the IO path."""
+    codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "jax",
+                               "csum_warm": "on"})
+    L = 512
+    assert codec._csum_op_if_ready(L, L) is None  # kicks off the warm
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and (L, L) not in codec._csum_ready:
+        time.sleep(0.05)
+    assert (L, L) in codec._csum_ready
+    codec.JAX_OPS_CAP = 1
+    for i in range(4):  # churn the LRU until the csum op is evicted
+        codec._jax_op_cached(b"dummy%d" % i, object)
+    assert not any(k.startswith(b"csum") for k in codec._jax_ops)
+    assert (L, L) not in codec._csum_ready
+
+
+def test_bad_shape_fails_alone_not_the_batch():
+    """An op with the wrong k must raise the codec's own error via the
+    per-op path — never fold and poison coalesced neighbors."""
+    import pytest
+
+    from ceph_tpu.ec import ErasureCodeError
+    codec = _codec(4, 2)
+    b = ECBatcher(window_us=10_000)
+    bad = RNG.integers(0, 256, (3, 1024), dtype=np.uint8)  # k-1 rows
+    with pytest.raises(ErasureCodeError):
+        b.encode(codec, bad)
+    good = RNG.integers(0, 256, (4, 1024), dtype=np.uint8)
+    parity, _ = b.encode(codec, good)
+    assert np.array_equal(np.asarray(parity), _oracle_parity(codec, good))
+    assert b.pending_ops() == 0
+
+
+def test_non_matrix_codec_passes_through():
+    """A codec whose encode isn't a plain region matmul (CLAY's coupled
+    layers) must never fold — pass-through with exact results."""
+    clay = ec.factory("clay", {"k": "4", "m": "2"})
+    data = RNG.integers(0, 256, (4, 4096), dtype=np.uint8)
+    b = ECBatcher(window_us=10_000)
+    parity, _ = b.encode(clay, data)
+    assert np.array_equal(np.asarray(parity), clay.encode_chunks(data))
+    assert b.stats[FLUSH_IDLE] == 1

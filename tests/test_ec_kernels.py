@@ -105,6 +105,69 @@ def test_decode_kernel_cache_reused():
     assert len(codec._jax_ops) == n_ops  # same decode matrix -> same op
 
 
+def test_decode_cache_true_lru():
+    """Hot decode signatures survive eviction churn (true LRU, not
+    FIFO-posing-as-LRU): touching an entry refreshes its recency."""
+    from ceph_tpu import ec
+    codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "numpy"})
+    codec.DECODE_CACHE_CAP = 3
+    hot = [0, 1, 2, 4]
+    cold = ([0, 1, 2, 5], [0, 1, 3, 4], [0, 1, 3, 5], [0, 2, 3, 4])
+    codec._get_decode_matrix(hot)
+    for sig in cold[:3]:
+        codec._get_decode_matrix(sig)
+        codec._get_decode_matrix(hot)  # touch: must move to the end
+    codec._get_decode_matrix(cold[3])  # overflow: evicts a COLD entry
+    assert tuple(hot) in codec._decode_cache
+    assert tuple(cold[0]) not in codec._decode_cache
+
+
+def test_jax_op_cache_true_lru():
+    """Same LRU contract for the compiled-kernel cache: the encode op
+    (hottest entry) must not be evicted by one-shot decode matrices."""
+    from ceph_tpu import ec
+    from ceph_tpu.ops import gf256 as gf
+    codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "jax"})
+    codec.JAX_OPS_CAP = 2
+    enc_key = codec.matrix.tobytes() + bytes(codec.matrix.shape)
+    data = RNG.integers(0, 256, (4, 512), dtype=np.uint8)
+    for erased in ((0, 5), (1, 5), (2, 5)):
+        chunks = codec.encode(data.tobytes())
+        avail = {i: c for i, c in chunks.items() if i not in erased}
+        codec.decode([erased[0]], avail)   # one-shot decode matrix
+        codec.encode_chunks(data)          # touch the encode op
+    assert enc_key in codec._jax_ops  # survived 3 one-shot evictions
+    want = gf.encode_region(codec.matrix, data)
+    assert np.array_equal(codec.encode_chunks(data), want)
+
+
+def test_parity_only_decode_skips_inversion():
+    """All k data chunks present + only parity wanted: one direct
+    matmul against the coding matrix — no decode-matrix build."""
+    from ceph_tpu import ec
+    codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "numpy"})
+    data = RNG.integers(0, 256, (4, 2048), dtype=np.uint8)
+    chunks = {i: data[i] for i in range(4)}
+    out = codec.decode_chunks([4, 5], chunks)
+    want = gf256.encode_region(codec.matrix, data)
+    assert np.array_equal(out[4], want[0])
+    assert np.array_equal(out[5], want[1])
+    assert codec._decode_cache == {}  # no inversion happened
+
+
+def test_region_matmul_shape_cache_true_lru():
+    """RegionMatmul's compile cache also refreshes on hit."""
+    M = gf256.vandermonde_matrix(4, 2)
+    op = RegionMatmul(M)
+    hot = RNG.integers(0, 256, (4, 512), dtype=np.uint8)
+    op(hot)
+    hot_key = next(iter(op._shape_cache))
+    for L in (1024, 1536, 2048):
+        op(RNG.integers(0, 256, (4, L), dtype=np.uint8))
+        op(hot)  # touch
+    assert list(op._shape_cache)[-1] == hot_key
+
+
 def test_batch_fold_equivalence():
     """(batch, k, L) folding into (k, batch*L) is exact."""
     from ceph_tpu import ec
