@@ -105,38 +105,58 @@ def _recorded_tpu() -> dict | None:
     return None
 
 
+def _force_bench_cpu() -> bool:
+    """CPU-hermetic bench leg with 8 forced-host devices (the axon
+    tunnel wedges — see module docstring); set BENCH_EC_BATCH_DEVICE=1
+    to let jax pick the real device pool instead."""
+    if os.environ.get("BENCH_EC_BATCH_DEVICE"):
+        return False
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ceph_tpu.utils.jaxenv import force_cpu
+    force_cpu(device_count=8)
+    return True
+
+
 def ec_batch_bench() -> int:
     """`--ec-batch` mode: cross-op batched vs per-op encode under a
     simulated multi-client write burst (8 writer threads submitting
     full-stripe encodes through an ECBatcher), same one-line JSON
     schema as the headline.  value = batched-path GB/s; vs_baseline =
     batched / per-op (pass-through, window=0) on the same buffers;
-    extra keys carry ops/launch and flush-reason counts.  Parity is
+    extra keys carry ops/launch and flush-reason counts, the
+    mesh-SHARDED batcher leg (the folded launch fanned over the device
+    mesh — 8 forced-host CPU devices by default, the real pool with
+    BENCH_EC_BATCH_DEVICE=1), and the adaptive-window trajectory
+    (after a single-writer trickle vs after the burst).  Parity is
     digest-verified against the numpy gf256 oracle for EVERY op.
 
-    Runs on the CPU jax backend by default (the axon tunnel wedges —
-    see module docstring); set BENCH_EC_BATCH_DEVICE=1 to let jax pick
-    the real device."""
+    Honest-measurement note: on the CPU platform one XLA device
+    already uses every host core, so `sharded_vs_single` near 1.0 is
+    the expected CPU ceiling — the CPU leg proves byte-identity and
+    exercises the real shard_map path; the >1 wins need real chips."""
     import threading
 
     import numpy as np
 
-    if not os.environ.get("BENCH_EC_BATCH_DEVICE"):
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        from ceph_tpu.utils.jaxenv import force_cpu
-        force_cpu()
+    on_cpu = _force_bench_cpu()
+    import jax
+
     from ceph_tpu import ec
     from ceph_tpu.ec.batcher import ECBatcher
     from ceph_tpu.ops import gf256
 
+    n_dev = len(jax.devices())
     chunk = 16 * 1024
     writers, ops_per = 8, 24
-    codec = ec.factory("tpu", {"k": K, "m": M, "backend": "jax"})
+    codec = ec.factory("tpu", {"k": K, "m": M, "backend": "jax",
+                               "shard": "off"})
+    sharded_codec = ec.factory("tpu", {"k": K, "m": M, "backend": "jax",
+                                       "shard": str(n_dev)})
     rng = np.random.default_rng(5)
     payloads = [[rng.integers(0, 256, (K, chunk), dtype=np.uint8)
                  for _ in range(ops_per)] for _ in range(writers)]
 
-    def burst(batcher):
+    def burst(batcher, cdc):
         results = [[None] * ops_per for _ in range(writers)]
         barrier = threading.Barrier(writers + 1)
 
@@ -144,7 +164,7 @@ def ec_batch_bench() -> int:
             barrier.wait()
             for i, data in enumerate(payloads[w]):
                 results[w][i] = np.asarray(
-                    batcher.encode(codec, data)[0])
+                    batcher.encode(cdc, data)[0])
 
         threads = [threading.Thread(target=writer, args=(w,))
                    for w in range(writers)]
@@ -159,33 +179,58 @@ def ec_batch_bench() -> int:
     # warm the compile caches off the clock: every pow2 stripe-count
     # fold shape a burst can produce (coalescing patterns vary run to
     # run; a cold XLA compile leaking into the timed burst would swamp
-    # the measurement), then one full warm burst
-    from ceph_tpu.ec.batcher import bucket_len
+    # the measurement), then one full warm burst per codec
+    from ceph_tpu.ec.batcher import bucket_len, shard_pad
     bucket = bucket_len(chunk)
     n2 = 1
     while n2 <= writers:
         codec.encode_chunks(np.zeros((K, n2 * bucket), dtype=np.uint8))
+        # sharded shapes use the FLUSH path's shard_pad padding
+        # (matters on non-pow2 device pools)
+        ns, n2s = shard_pad(n2, n_dev)
+        sharded_codec._matmul_device(
+            sharded_codec.matrix,
+            np.zeros((K, n2s * bucket), dtype=np.uint8), n_shard=ns)
         n2 <<= 1
-    warm = ECBatcher(window_us=2000, max_bytes=64 << 20)
-    burst(warm)
+    burst(ECBatcher(window_us=2000, max_bytes=64 << 20), codec)
+    burst(ECBatcher(window_us=2000, max_bytes=64 << 20), sharded_codec)
+
     batched = ECBatcher(window_us=2000, max_bytes=64 << 20)
-    res_b, dt_b = burst(batched)
+    res_b, dt_b = burst(batched, codec)
+    sharded = ECBatcher(window_us=2000, max_bytes=64 << 20)
+    res_s, dt_s = burst(sharded, sharded_codec)
     perop = ECBatcher(window_us=0)
-    res_p, dt_p = burst(perop)
+    res_p, dt_p = burst(perop, codec)
+
+    # adaptive window: a single-writer trickle must shrink it off the
+    # 500us default, the 8-writer burst must grow it back.  The ceiling
+    # is set above this host's per-launch latency (CPU-jax launches run
+    # milliseconds; real-chip deployments keep the 4000us default) so
+    # probe flushes can actually observe the burst arriving.
+    adaptive = ECBatcher(window_us=500, adaptive=True, target_ops=4.0,
+                         window_min_us=50, window_max_us=20_000,
+                         max_bytes=8 * K * chunk)
+    for data in payloads[0]:  # sequential: every launch flies alone
+        adaptive.encode(codec, data)
+    window_after_trickle = adaptive.window_us
+    burst(adaptive, codec)  # 4-op size flushes pull the EWMA to target
+    window_after_burst = adaptive.window_us
 
     verified = True
     for w in range(writers):
         for i in range(ops_per):
             want = gf256.encode_region(codec.matrix, payloads[w][i])
             if not (np.array_equal(res_b[w][i], want)
+                    and np.array_equal(res_s[w][i], want)
                     and np.array_equal(res_p[w][i], want)):
                 verified = False
     src_bytes = writers * ops_per * K * chunk
     gbps_b = src_bytes / dt_b / 2**30
+    gbps_s = src_bytes / dt_s / 2**30
     gbps_p = src_bytes / dt_p / 2**30
     st = batched.stats
     total_ops = writers * ops_per
-    backend = "cpu" if os.environ.get("JAX_PLATFORMS") == "cpu" else "dev"
+    backend = "cpu" if on_cpu else "dev"
     print(json.dumps({
         "metric": (f"EC encode GB/s batched-vs-per-op (k={K},m={M}, "
                    f"{chunk // 1024}KiB chunks, {writers}-writer burst, "
@@ -200,6 +245,156 @@ def ec_batch_bench() -> int:
         "size_flush": st["size"],
         "idle_flush": st["idle"],
         "per_op_gbps": round(gbps_p, 3),
+        "sharded_gbps": round(gbps_s, 3),
+        "sharded_vs_single": (round(gbps_s / gbps_b, 3)
+                              if gbps_b > 0 else None),
+        "shard_devices": n_dev,
+        "sharded_launches": sharded.stats["sharded_launches"],
+        "sharded_ops_per_launch": round(
+            total_ops / sharded.stats["launches"], 2),
+        "adaptive_window_start_us": 500.0,
+        "adaptive_window_after_trickle_us": round(window_after_trickle, 1),
+        "adaptive_window_after_burst_us": round(window_after_burst, 1),
+        "adaptive_converged": (window_after_trickle < 500.0
+                               < window_after_burst),
+        "digest_verified": verified,
+    }))
+    return 0 if verified else 1
+
+
+def ec_recovery_bench() -> int:
+    """`--ec-recovery` mode: the PG-recovery-storm scenario — one OSD's
+    shards drop and a burst of stripes decode-rebuilds through the
+    batcher (ROADMAP "recovery-burst batching").  8 reader threads each
+    rebuild their stripes' missing shard from the k survivors; the
+    shared erasure signature makes the whole storm one coalescing
+    group.  Reports per-op latency and ops/launch for unbatched
+    (window=0) vs batched vs mesh-sharded, sweeps ec_batch_max_bytes on
+    the batched leg, and digest-verifies every rebuilt chunk against
+    the original data.  value = best batched rebuild GB/s (source =
+    survivor bytes read per op); vs_baseline = batched / unbatched."""
+    import threading
+
+    import numpy as np
+
+    on_cpu = _force_bench_cpu()
+    import jax
+
+    from ceph_tpu import ec
+    from ceph_tpu.ec.batcher import ECBatcher, bucket_len, shard_pad
+    from ceph_tpu.ops import gf256
+
+    n_dev = len(jax.devices())
+    chunk = 16 * 1024
+    readers, ops_per = 8, 12
+    lost = 1  # the downed OSD's shard, erased from every stripe
+    single = ec.factory("tpu", {"k": K, "m": M, "backend": "jax",
+                                "shard": "off"})
+    sharded = ec.factory("tpu", {"k": K, "m": M, "backend": "jax",
+                                 "shard": str(n_dev)})
+    rng = np.random.default_rng(7)
+    want = list(range(K))
+    cases = [[None] * ops_per for _ in range(readers)]
+    for r in range(readers):
+        for i in range(ops_per):
+            data = rng.integers(0, 256, (K, chunk), dtype=np.uint8)
+            parity = gf256.encode_region(single.matrix, data)
+            chunks = {j: data[j] for j in range(K) if j != lost}
+            chunks.update({K + j: parity[j] for j in range(M)})
+            cases[r][i] = (data, chunks)
+
+    def storm(batcher, cdc):
+        """Returns (per-op wall seconds, burst wall seconds, ok)."""
+        lat = [[0.0] * ops_per for _ in range(readers)]
+        ok = [True]
+        barrier = threading.Barrier(readers + 1)
+
+        def reader(r):
+            barrier.wait()
+            for i, (data, chunks) in enumerate(cases[r]):
+                t0 = time.perf_counter()
+                out = batcher.decode(cdc, want, dict(chunks))
+                lat[r][i] = time.perf_counter() - t0
+                if not np.array_equal(np.asarray(out[lost]), data[lost]):
+                    ok[0] = False
+
+        threads = [threading.Thread(target=reader, args=(r,))
+                   for r in range(readers)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        flat = sorted(x for row in lat for x in row)
+        return flat, time.perf_counter() - t0, ok[0]
+
+    # warm decode kernels off the clock (decode matrix + fold shapes);
+    # sharded shapes follow the flush path's shard_pad padding
+    bucket = bucket_len(chunk)
+    n2 = 1
+    while n2 <= readers:
+        flat = {s: np.zeros(n2 * bucket, dtype=np.uint8)
+                for s in sorted(cases[0][0][1])}
+        single.decode_chunks(want, flat)
+        ns, n2s = shard_pad(n2, n_dev)
+        flat_s = {s: np.zeros(n2s * bucket, dtype=np.uint8)
+                  for s in sorted(cases[0][0][1])}
+        sharded.decode_chunks(want, flat_s, n_shard=ns)
+        n2 <<= 1
+
+    src_per_op = K * chunk  # survivor bytes read to rebuild one stripe
+    total_ops = readers * ops_per
+    results = {}
+    sweep = {}
+    best = (None, 0.0)
+    for mb in (1 << 20, 4 << 20, 16 << 20, 64 << 20):
+        b = ECBatcher(window_us=2000, max_bytes=mb)
+        lats, wall, ok = storm(b, single)
+        gbps = total_ops * src_per_op / wall / 2**30
+        sweep[f"{mb >> 20}MiB"] = {
+            "gbps": round(gbps, 3),
+            "per_op_ms_p50": round(lats[len(lats) // 2] * 1e3, 3),
+            "ops_per_launch": round(total_ops / b.stats["launches"], 2),
+            "ok": ok,
+        }
+        if ok and gbps > best[1]:
+            best = (mb, gbps)
+    best_mb = best[0] or (8 << 20)
+
+    for name, batcher, cdc in (
+            ("unbatched", ECBatcher(window_us=0), single),
+            ("batched", ECBatcher(window_us=2000, max_bytes=best_mb),
+             single),
+            ("sharded", ECBatcher(window_us=2000, max_bytes=best_mb),
+             sharded)):
+        lats, wall, ok = storm(batcher, cdc)
+        results[name] = {
+            "gbps": round(total_ops * src_per_op / wall / 2**30, 3),
+            "per_op_ms_p50": round(lats[len(lats) // 2] * 1e3, 3),
+            "per_op_ms_p95": round(lats[int(len(lats) * 0.95)] * 1e3, 3),
+            "ops_per_launch": round(
+                total_ops / batcher.stats["launches"], 2),
+            "sharded_launches": batcher.stats["sharded_launches"],
+            "ok": ok,
+        }
+    verified = all(v["ok"] for v in results.values()) and \
+        all(v["ok"] for v in sweep.values())
+    backend = "cpu" if on_cpu else "dev"
+    gbps_b = results["batched"]["gbps"]
+    gbps_u = results["unbatched"]["gbps"]
+    print(json.dumps({
+        "metric": (f"EC recovery-storm rebuild GB/s (k={K},m={M}, "
+                   f"{chunk // 1024}KiB chunks, shard {lost} lost, "
+                   f"{readers}-reader burst, jax-{backend} kernels, "
+                   f"digest-verified)"),
+        "value": gbps_b,
+        "unit": "GB/s",
+        "vs_baseline": round(gbps_b / gbps_u, 3) if gbps_u > 0 else None,
+        "max_bytes_sweep": sweep,
+        "max_bytes_sweet_spot": f"{best_mb >> 20}MiB",
+        "shard_devices": n_dev,
+        "scenarios": results,
         "digest_verified": verified,
     }))
     return 0 if verified else 1
@@ -209,6 +404,8 @@ def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if "--ec-batch" in sys.argv[1:]:
         return ec_batch_bench()
+    if "--ec-recovery" in sys.argv[1:]:
+        return ec_recovery_bench()
     cpu = cpu_baseline_gbps()
     print(f"bench: cpu single-thread baseline {cpu:.2f} GB/s", file=sys.stderr)
     dev = tpu_gbps()

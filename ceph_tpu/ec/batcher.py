@@ -25,10 +25,25 @@ Mechanics (no background thread, so nothing can leak at shutdown):
 - ``window_us == 0`` is pass-through: the op executes inline through the
   codec's own per-op entry points — bit-identical to the unbatched path.
 
-Length-bucketed padding: each op's chunk length pads up to a power-of-two
-bucket and the stripe count per launch pads to a power of two, so the
-``RegionMatmul`` compile cache (and the fused encode+CRC op cache) see a
-bounded set of shapes.  Zero columns encode/decode to zero under a
+Mesh fan-out: when the codec resolves a device fan-out > 1 (profile key
+``shard`` / the ``ec_shard`` option — see MatrixErasureCode.
+shard_devices), a flushed batch's folded ``(k, sum L)`` launch shards
+its length axis across the device mesh (parallel/distributed.
+make_folded_matmul): an 8-chip pool encodes an 8-writer burst in ~one
+chip-time.  Single-device and CPU fall-through stays byte-identical.
+
+Adaptive window: with ``adaptive=True`` the coalescing window resizes
+itself per flush from the observed ops-per-launch (EWMA toward
+``target_ops``, clamped to [window_min_us, window_max_us]), so a
+lightly-loaded OSD stops paying a fixed window as pure latency while a
+bursty one grows it to coalesce more.  ``window_us == 0`` still means
+pass-through — the controller never engages.
+
+Length-bucketed padding: each op's chunk length pads up to a
+power-of-two-or-1.5x-half-step bucket and the stripe count per launch
+pads to a power of two (rounded to the device fan-out when sharded), so
+the ``RegionMatmul`` compile cache (and the fused encode+CRC op cache)
+see a bounded set of shapes.  Zero columns encode/decode to zero under a
 linear code, so the padding is sliced away without affecting bytes.
 
 Checksums: a launch whose ops all want csums and share one exact chunk
@@ -54,19 +69,33 @@ FLUSH_WINDOW = "window"
 FLUSH_SIZE = "size"
 FLUSH_IDLE = "idle"
 
-#: perf counters the batcher registers on the registry it is handed
+#: perf counters the batcher registers on the registry it is handed —
+#: ALWAYS registered (zeroed) even when batching is off/pass-through, so
+#: `perf dump` and the prometheus exporter expose one stable schema
+#: across backends
 COUNTERS = ("ec_batch_launches", "ec_batch_coalesced_ops",
             "ec_batch_bytes", "ec_batch_flush_window",
-            "ec_batch_flush_size", "ec_batch_flush_idle")
-HISTOGRAMS = ("ec_batch_ops_per_launch", "ec_batch_bytes_per_launch")
+            "ec_batch_flush_size", "ec_batch_flush_idle",
+            "ec_batch_sharded_launches")
+HISTOGRAMS = ("ec_batch_ops_per_launch", "ec_batch_bytes_per_launch",
+              "ec_batch_sharded_devices_per_launch",
+              "ec_batch_sharded_shard_bytes")
+#: settable gauges (CounterType.U64): the live adaptive-window value
+GAUGES = ("ec_batch_window_us_now",)
 
 
 def bucket_len(length: int) -> int:
-    """Pad target for one op's chunk length: the next power of two, with
-    a 512-byte floor (the uint32-lane tiling quantum of RegionMatmul) —
-    a bounded set of shapes instead of one compile per client length."""
+    """Pad target for one op's chunk length: powers of two PLUS the
+    1.5x half-steps between them (512, 768, 1024, 1536, 2048, ...),
+    with a 512-byte floor (the uint32-lane tiling quantum of
+    RegionMatmul).  Still a bounded set of shapes for the compile
+    cache — two per octave instead of one — but a just-over-pow2 chunk
+    (the 4 KiB + header case) now pads <= 50% instead of almost 2x."""
     b = 512
     while b < length:
+        half = b + (b >> 1)
+        if length <= half:
+            return half
         b <<= 1
     return b
 
@@ -78,12 +107,25 @@ def _pow2(n: int) -> int:
     return p
 
 
+def shard_pad(n2: int, n_shard: int) -> tuple[int, int]:
+    """(effective fan-out, padded stripe count) a flush uses for a
+    pow2-padded stripe count ``n2`` on an ``n_shard``-device pool: the
+    fan-out caps at the stripe count (a 2-op flush on an 8-chip pool
+    shards 2 ways instead of inflating the fold 4x with empty slots),
+    then the count rounds up to a multiple of the fan-out so sum L
+    splits into whole per-device slices.  ONE definition shared by the
+    flush paths and the bench warm-up loops — hand-copied shape rules
+    would silently drift and leak cold compiles into timed bursts."""
+    ns = max(1, min(n_shard, n2))
+    return ns, -(-n2 // ns) * ns
+
+
 class _PendingOp:
     """One submitted encode/decode riding a folded launch."""
 
     __slots__ = ("codec", "streams", "chunks", "want", "length",
-                 "with_csums", "callback", "deadline", "taken", "done",
-                 "parity", "csums", "decoded", "error")
+                 "with_csums", "callback", "deadline", "submitted",
+                 "taken", "done", "parity", "csums", "decoded", "error")
 
     def __init__(self, codec, *, streams=None, chunks=None, want=None,
                  length=0, with_csums=False, callback=None):
@@ -95,6 +137,7 @@ class _PendingOp:
         self.with_csums = with_csums
         self.callback = callback
         self.deadline = 0.0
+        self.submitted = 0.0
         self.taken = False          # removed from the queue by a flusher
         self.done = False
         self.parity = None
@@ -110,14 +153,49 @@ class ECBatcher:
     points, so every pending op has a live waiter and none can leak.
     """
 
+    #: adaptive-window controller constants: EWMA weight of the newest
+    #: launch, the multiplicative shrink step per solo flush, and the
+    #: probe cadence — every PROBE_EVERY-th flush the next leader waits
+    #: the MAX window, so a batcher parked at the floor can still see a
+    #: burst arrive and grow back (without probes, a floor-length
+    #: window flushes every op alone and the controller is blind to
+    #: load returning; the amortized latency cost of a probe is
+    #: (window_max - window) / PROBE_EVERY, well under the fixed
+    #: window it replaces)
+    ADAPT_ALPHA = 0.25
+    ADAPT_SHRINK = 0.7
+    PROBE_EVERY = 16
+
     def __init__(self, *, window_us: float = 500.0,
-                 max_bytes: int = 8 << 20, perf=None):
+                 max_bytes: int = 8 << 20, perf=None,
+                 adaptive: bool = False, target_ops: float = 4.0,
+                 window_min_us: float = 50.0,
+                 window_max_us: float = 4000.0):
         self.window_us = float(window_us)
         self.max_bytes = int(max_bytes)
+        # adaptive coalescing window: resize window_us from the observed
+        # ops-per-launch (EWMA toward target_ops, clamped to
+        # [window_min_us, window_max_us]) so a lightly-loaded OSD stops
+        # paying the full window as pure latency while a bursty one
+        # grows it to coalesce more.  window_us == 0 disables batching
+        # outright (pass-through) and the controller never engages.
+        self.adaptive = bool(adaptive) and self.window_us > 0
+        # a target below 2 degenerates the controller (every 1-op flush
+        # satisfies n_ops >= target, so grow pins the window at the
+        # ceiling and shrink becomes unreachable) — and "coalesce 1 op"
+        # is not a coalescing target at all, that's what the floor/off
+        # settings are for
+        self.target_ops = max(2.0, float(target_ops))
+        self.window_min_us = max(1.0, float(window_min_us))
+        self.window_max_us = max(self.window_min_us, float(window_max_us))
+        self._ops_ewma = self.target_ops  # neutral start: no drift
+        self._flushes_since_probe = 0
+        self._probe_next = False
         self._cv = threading.Condition()
         self._groups: dict[tuple, list[_PendingOp]] = {}
         self._group_bytes: dict[tuple, int] = {}
         self.stats = {"launches": 0, "ops": 0, "bytes": 0,
+                      "sharded_launches": 0,
                       FLUSH_WINDOW: 0, FLUSH_SIZE: 0, FLUSH_IDLE: 0}
         self._perf = perf
         if perf is not None:
@@ -125,6 +203,9 @@ class ECBatcher:
             from ..utils.perf import CounterType
             for h in HISTOGRAMS:
                 perf.add(h, CounterType.HISTOGRAM)
+            for g in GAUGES:
+                perf.add(g, CounterType.U64)
+            perf.set("ec_batch_window_us_now", round(self.window_us, 1))
 
     # ------------------------------------------------------------- public
     def encode(self, codec, data_chunks: np.ndarray, *,
@@ -209,10 +290,23 @@ class ECBatcher:
     # ------------------------------------------------- submit/wait machinery
     def _submit(self, sig: tuple, op: _PendingOp, nbytes: int,
                 flush) -> None:
-        op.deadline = time.monotonic() + self.window_us * 1e-6
         ops = reason = None
         with self._cv:
             q = self._groups.setdefault(sig, [])
+            op.submitted = time.monotonic()
+            if q:
+                # the group's window is the LEADER's: a follower must
+                # not cut a longer (probe) window short with its own
+                # shorter deadline — with a uniform window the leader's
+                # deadline is the earliest anyway, so this is the same
+                # flush point the per-op deadline always produced
+                op.deadline = q[0].deadline
+            else:
+                w = self.window_us
+                if self.adaptive and self._probe_next:
+                    self._probe_next = False
+                    w = self.window_max_us
+                op.deadline = op.submitted + w * 1e-6
             q.append(op)
             total = self._group_bytes.get(sig, 0) + nbytes
             self._group_bytes[sig] = total
@@ -243,12 +337,70 @@ class ECBatcher:
         return ops
 
     def _complete(self, ops: list[_PendingOp], src_bytes: int,
-                  reason: str) -> None:
-        self._account(len(ops), src_bytes, reason)
+                  reason: str, n_shard: int = 1,
+                  shard_bytes: int = 0) -> None:
+        self._account(len(ops), src_bytes, reason, n_shard, shard_bytes)
+        self._adapt(ops)
         with self._cv:
             for o in ops:
                 o.done = True
             self._cv.notify_all()
+
+    def _shard_fanout(self, codec, n2: int) -> tuple[int, int]:
+        """(fan-out, padded stripe count) for this flush — the codec's
+        resolved shard count run through shard_pad (capped at the
+        stripe count, count rounded up to the fan-out)."""
+        sd = getattr(codec, "shard_devices", None)
+        if sd is None:
+            return 1, n2
+        return shard_pad(n2, sd())
+
+    def _adapt(self, ops: list[_PendingOp]) -> None:
+        """One controller step per flush: EWMA the launch's op count,
+        then grow the window when coalescing is paying and shrink it
+        toward the floor when launches fly nearly alone (a trickle
+        gains nothing from waiting — the fixed-window latency tax this
+        controller exists to remove).
+
+        Sizing is RATE-BASED: any flush that actually coalesced (>= 2
+        ops) measures the ops' arrival span and the window STEERS
+        halfway toward the span a target-sized group needs (x1.25
+        margin) — converging from BOTH sides, so sustained load settles
+        near the target-sized window instead of ratcheting to the
+        ceiling (a grow-only x-step pins at window_max under any load
+        meeting the target, taxing every op with the max window), and
+        simultaneous arrivals that need no window at all walk it back
+        down.  A multiplicative step alone also cannot climb when the
+        coalescing-vs-window curve is a step at the launch latency —
+        every probe's gain would be undone by the shrinks between
+        probes; steering to the measured span clears the step in one
+        move."""
+        if not self.adaptive:
+            return
+        n_ops = len(ops)
+        with self._cv:
+            a = self.ADAPT_ALPHA
+            self._ops_ewma = (1 - a) * self._ops_ewma + a * n_ops
+            self._flushes_since_probe += 1
+            if self._flushes_since_probe >= self.PROBE_EVERY:
+                self._flushes_since_probe = 0
+                self._probe_next = True
+            w = self.window_us
+            if n_ops >= 2:
+                # direct evidence of a stream: steer toward the window
+                # a target-sized group needs at the observed rate
+                span = (max(o.submitted for o in ops)
+                        - min(o.submitted for o in ops))
+                est = (span / (n_ops - 1)
+                       * (self.target_ops - 1) * 1.25 * 1e6)
+                w = 0.5 * w + 0.5 * est
+            elif self._ops_ewma < max(1.5, self.target_ops / 2):
+                # launches flying alone: waiting buys nothing
+                w = w * self.ADAPT_SHRINK
+            self.window_us = min(self.window_max_us,
+                                 max(self.window_min_us, w))
+        if self._perf is not None:
+            self._perf.set("ec_batch_window_us_now", round(w, 1))
 
     def _fire(self, op: _PendingOp, callback: Callable, *args) -> None:
         try:
@@ -256,12 +408,15 @@ class ECBatcher:
         except BaseException as e:  # surfaced to the op's own waiter
             op.error = e
 
-    def _account(self, n_ops: int, src_bytes: int, reason: str) -> None:
+    def _account(self, n_ops: int, src_bytes: int, reason: str,
+                 n_shard: int = 1, shard_bytes: int = 0) -> None:
         with self._cv:
             self.stats["launches"] += 1
             self.stats["ops"] += n_ops
             self.stats["bytes"] += src_bytes
             self.stats[reason] += 1
+            if n_shard > 1:
+                self.stats["sharded_launches"] += 1
         p = self._perf
         if p is not None:
             p.inc("ec_batch_launches")
@@ -270,6 +425,10 @@ class ECBatcher:
             p.inc(f"ec_batch_flush_{reason}")
             p.hinc("ec_batch_ops_per_launch", n_ops)
             p.hinc("ec_batch_bytes_per_launch", src_bytes)
+            if n_shard > 1:
+                p.inc("ec_batch_sharded_launches")
+                p.hinc("ec_batch_sharded_devices_per_launch", n_shard)
+                p.hinc("ec_batch_sharded_shard_bytes", shard_bytes)
 
     # ------------------------------------------------------- pass-through
     def _passthrough_encode(self, codec, data_chunks, with_csums,
@@ -302,18 +461,22 @@ class ECBatcher:
         codec = ops[0].codec
         k = codec.k
         src_bytes = sum(o.streams.nbytes for o in ops)
+        ns, shard_bytes = 1, 0
         try:
             n = len(ops)
             n2 = _pow2(n)  # stripe-count padding: bounded shape set
+            ns, n2s = self._shard_fanout(codec, n2)
             # fused needs one EXACT chunk length across the launch (the
             # device CRC is per whole chunk — a padded chunk would
             # digest its padding); the shared length need not be a
             # power of two.  _csum_op_if_ready keeps the multi-second
             # XLA compile OFF this path: until the op is warm the CPU
-            # CRC sweep below produces the same digests.
+            # CRC sweep below produces the same digests.  A sharded
+            # flush skips the fused op (the CRC plan is single-device);
+            # its csums ride the CPU sweep while parity fans out.
             L0 = ops[0].length
             op_fn = None
-            if (sig[4]  # every op in the group wants csums
+            if (ns == 1 and sig[4]  # every op in the group wants csums
                     and getattr(codec, "_backend", None) == "jax"
                     and all(o.length == L0 for o in ops)
                     and L0 % 4 == 0):
@@ -328,18 +491,28 @@ class ECBatcher:
                 parity = np.asarray(dev_parity)
                 csums = np.asarray(dev_csums)
                 for i, o in enumerate(ops):
-                    o.parity = parity[:, i * L0: (i + 1) * L0]
-                    o.csums = csums[:, i]
+                    # copy out of the launch buffer: a retained per-op
+                    # result must not pin the whole (m, n2*L) fold
+                    o.parity = parity[:, i * L0: (i + 1) * L0].copy()
+                    o.csums = csums[:, i].copy()
             else:
+                # mesh fan-out: the shard_pad stripe count splits sum L
+                # into whole per-device column slices (still a bounded
+                # shape set: pow2 rounded to the fan-out)
+                n2 = n2s
                 folded = np.zeros((k, n2 * bucket), dtype=np.uint8)
                 for i, o in enumerate(ops):
                     folded[:, i * bucket: i * bucket + o.length] = \
                         o.streams
-                # device-resident matmul: one launch, one host sync
+                # device-resident matmul: one launch, one host sync;
+                # ns > 1 fans the folded columns over the device mesh
                 parity = np.asarray(
-                    codec._matmul_device(codec.matrix, folded))
+                    codec._matmul_device(codec.matrix, folded,
+                                         n_shard=ns))
+                shard_bytes = folded.nbytes // ns if ns > 1 else 0
                 for i, o in enumerate(ops):
-                    o.parity = parity[:, i * bucket: i * bucket + o.length]
+                    o.parity = \
+                        parity[:, i * bucket: i * bucket + o.length].copy()
                     if o.with_csums:
                         stack = np.concatenate([o.streams, o.parity],
                                                axis=0)
@@ -353,7 +526,7 @@ class ECBatcher:
             for o in ops:
                 o.error = e
         finally:
-            self._complete(ops, src_bytes, reason)
+            self._complete(ops, src_bytes, reason, ns, shard_bytes)
 
     def _flush_decode(self, sig: tuple, ops: list[_PendingOp],
                       reason: str) -> None:
@@ -362,19 +535,24 @@ class ECBatcher:
         avail, want = sig[4], list(sig[5])
         src_bytes = sum(sum(c.nbytes for c in o.chunks.values())
                         for o in ops)
+        ns, shard_bytes = 1, 0
         try:
-            n2 = _pow2(len(ops))
+            ns, n2 = self._shard_fanout(codec, _pow2(len(ops)))
             flat = {s: np.zeros(n2 * bucket, dtype=np.uint8)
                     for s in avail}
             for i, o in enumerate(ops):
                 for s, c in o.chunks.items():
                     flat[s][i * bucket: i * bucket + o.length] = c
-            out = codec.decode_chunks(want, flat)
+            out = codec.decode_chunks(want, flat, n_shard=ns)
+            shard_bytes = (sum(c.nbytes for c in flat.values()) // ns
+                           if ns > 1 else 0)
             for i, o in enumerate(ops):
-                o.decoded = {s: row[i * bucket: i * bucket + o.length]
-                             for s, row in out.items()}
+                # copy out of the launch buffer (see _flush_encode)
+                o.decoded = {
+                    s: row[i * bucket: i * bucket + o.length].copy()
+                    for s, row in out.items()}
         except BaseException as e:
             for o in ops:
                 o.error = e
         finally:
-            self._complete(ops, src_bytes, reason)
+            self._complete(ops, src_bytes, reason, ns, shard_bytes)

@@ -92,26 +92,90 @@ class MatrixErasureCode(ErasureCode):
 
         return self._jax_op_cached(M.tobytes() + bytes(M.shape), build)
 
+    def _jax_matmul_sharded(self, M: np.ndarray, n_shard: int):
+        """shard_map'd folded region multiply over a flat n_shard-device
+        mesh (parallel/distributed.make_folded_matmul) — the multi-chip
+        fan-out for folded (k, sum L) launches.  Cached in the same
+        kernel LRU as the single-device ops, keyed by (matrix, fan-out).
+        Returns None when the mesh cannot be built (fewer devices than
+        requested appeared since resolution) so callers fall back to the
+        single-device launch rather than raising off the IO path."""
+        def build():
+            import jax  # deferred: jax import is heavy
+
+            from ..parallel.distributed import make_folded_matmul
+            from ..parallel.mesh import make_flat_mesh
+            try:
+                mesh = make_flat_mesh(n_shard)
+            except (ValueError, RuntimeError):
+                return None
+            return jax.jit(make_folded_matmul(M, mesh))
+
+        key = (b"shard" + n_shard.to_bytes(4, "little")
+               + M.tobytes() + bytes(M.shape))
+        return self._jax_op_cached(key, build)
+
+    def shard_devices(self) -> int:
+        """Resolved device fan-out for folded launches (1 = single
+        device, the PR-1 path).  Profile key ``shard`` (seeded from the
+        ``ec_shard`` option by the OSD): ``off`` -> 1; an integer N ->
+        min(N, device count); ``auto`` engages the whole accelerator
+        pool but falls through to 1 on the CPU platform — one XLA:CPU
+        device already uses every host core, so fanning virtual devices
+        only adds dispatch overhead (forced-host CPU meshes opt in with
+        an explicit N, as the mesh tests and benches do)."""
+        if self._backend != "jax":
+            return 1
+        cached = getattr(self, "_shard_devices_cached", None)
+        if cached is not None:
+            return cached
+        mode = str(self.profile.get("shard", "auto")).lower()
+        n = 1
+        if mode not in ("off", "false", "no", "0"):
+            try:
+                import jax
+                ndev = len(jax.devices())
+                if mode in ("auto", "on", "true", "yes"):
+                    n = ndev if jax.default_backend() != "cpu" else 1
+                else:
+                    n = min(int(mode), ndev)
+            except (ValueError, RuntimeError):
+                n = 1
+        n = max(1, n)
+        self._shard_devices_cached = n
+        return n
+
     def get_flags(self) -> Flags:
         return (Flags.PARITY_DELTA_OPTIMIZATION | Flags.ZERO_PADDING |
                 Flags.OPTIMIZED_SUPPORTED | Flags.PARTIAL_READ_OPTIMIZATION |
                 Flags.PARTIAL_WRITE_OPTIMIZATION)
 
     # -- region multiply through the selected backend ----------------------
-    def _matmul_device(self, M: np.ndarray, rows: np.ndarray):
+    def _matmul_device(self, M: np.ndarray, rows: np.ndarray, *,
+                       n_shard: int = 1):
         """Backend-resident region multiply: on the jax backend the
         result STAYS a device array (no np.asarray sync), so callers
         folding many stripes into one launch — the ECBatcher, the fused
         encode+CRC pass — pay one host sync for the whole batch instead
-        of one per op.  Other backends return numpy directly."""
+        of one per op.  Other backends return numpy directly.
+
+        ``n_shard > 1`` fans the launch over a flat device mesh, length
+        axis sharded (make_folded_matmul) — engaged only when the column
+        count splits into whole uint32 lanes per device; anything else
+        falls through to the single-device launch, byte-identical."""
         if self._backend == "native":
             return native.encode_region(M, rows)
         if self._backend == "jax":
+            if n_shard > 1 and rows.shape[-1] % (4 * n_shard) == 0:
+                op = self._jax_matmul_sharded(M, n_shard)
+                if op is not None:
+                    return op(rows)
             return self._jax_matmul(M)(rows)
         return gf256.encode_region(M, rows)
 
-    def _matmul(self, M: np.ndarray, rows: np.ndarray) -> np.ndarray:
-        return np.asarray(self._matmul_device(M, rows))
+    def _matmul(self, M: np.ndarray, rows: np.ndarray, *,
+                n_shard: int = 1) -> np.ndarray:
+        return np.asarray(self._matmul_device(M, rows, n_shard=n_shard))
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
@@ -167,8 +231,15 @@ class MatrixErasureCode(ErasureCode):
             codec.matrix = self.matrix
             return jax.jit(codec.encode_csum_graph(nbytes))
 
-        key = b"csum" + self.matrix.tobytes() + nbytes.to_bytes(8, "little")
-        return self._jax_op_cached(key, build)
+        return self._jax_op_cached(self._csum_key(nbytes), build)
+
+    def _csum_key(self, nbytes: int) -> bytes:
+        """Kernel-LRU key of the fused encode+CRC op for this chunk
+        length — ONE definition, shared by the cache insert (_csum_op),
+        the eviction ready-set purge, and the warm thread's
+        still-cached check, which silently diverge otherwise."""
+        return (b"csum" + self.matrix.tobytes()
+                + nbytes.to_bytes(8, "little"))
 
     def _csum_op_if_ready(self, nbytes: int, total: int):
         """Non-blocking fused-op lookup for input width ``total`` (a
@@ -206,8 +277,15 @@ class MatrixErasureCode(ErasureCode):
             try:
                 op = self._csum_op(nbytes)
                 op(np.zeros((self.k, total), dtype=np.uint8))  # compile
+                key = self._csum_key(nbytes)
                 with self._cache_lock:
-                    self._csum_ready.add(shape)
+                    # the compile ran for seconds outside the lock: if
+                    # cache churn evicted the op meanwhile, its ready-set
+                    # purge already happened and adding the shape now
+                    # would mark READY an op whose executable is gone —
+                    # putting the synchronous compile back on the IO path
+                    if key in self._jax_ops:
+                        self._csum_ready.add(shape)
             except Exception:  # noqa: BLE001 - fallback path stays CPU
                 pass
             finally:
@@ -235,7 +313,8 @@ class MatrixErasureCode(ErasureCode):
             self._decode_cache[key] = hit
         return hit
 
-    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
+    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap, *,
+                      n_shard: int = 1) -> ChunkMap:
         avail = sorted(i for i in chunks if i < self.chunk_count)
         if len(avail) < self.k:
             raise ErasureCodeError(
@@ -259,18 +338,19 @@ class MatrixErasureCode(ErasureCode):
             else:
                 D = self._get_decode_matrix(use)
                 if want_parity or len(missing_data) > 1:
-                    data_full = self._matmul(D, stack)
+                    data_full = self._matmul(D, stack, n_shard=n_shard)
                 else:
                     # single-row recovery: multiply only the needed rows
                     data_full = np.zeros((self.k, L), dtype=np.uint8)
-                    sub = self._matmul(D[want_data], stack)
+                    sub = self._matmul(D[want_data], stack,
+                                       n_shard=n_shard)
                     for r, i in enumerate(want_data):
                         data_full[i] = sub[r]
             for i in want_data:
                 out[i] = chunks[i] if i in chunks else data_full[i]
         if want_parity:
             parity = self._matmul(self.matrix[[i - self.k for i in want_parity]],
-                                  data_full)
+                                  data_full, n_shard=n_shard)
             for r, i in enumerate(want_parity):
                 out[i] = parity[r]
         return out

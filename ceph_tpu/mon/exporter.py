@@ -99,9 +99,13 @@ def render_metrics(mon=None) -> str:
                          typ="counter")
                     first_metric.add(metric)
             elif isinstance(val, (int, float)):
+                # settable gauges (the adaptive EC-batch window, any
+                # future *_now values) must not be typed counter —
+                # rate() over a value that moves both ways is nonsense
+                typ = "gauge" if cname.endswith("_now") else "counter"
                 emit(base, val, {"daemon": daemon},
                      help_=None if base in first_metric
-                     else f"perf counter {cname}", typ="counter")
+                     else f"perf counter {cname}", typ=typ)
                 first_metric.add(base)
     return "\n".join(lines) + "\n"
 

@@ -281,12 +281,20 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # cross-op EC batching (ec/batcher.py): concurrent stripe
         # encodes/decodes sharing a (matrix, k, m) signature coalesce
         # into ONE folded kernel launch within a small window; engaged
-        # per codec by _ec_batch_on (jax backend only by default).  The
-        # batcher registers its launch/flush counters on this OSD's perf
-        # registry, so `perf dump` and the exporter carry them.
+        # per codec by _ec_batch_on (jax backend only by default), with
+        # the window self-sizing from the observed ops-per-launch when
+        # ec_batch_adaptive is on and the folded launch fanning across
+        # the device mesh per the codec's ec_shard resolution.  The
+        # batcher registers its launch/flush/shard counters on this
+        # OSD's perf registry — zeroed even when batching is off, so
+        # `perf dump` and the exporter expose one stable schema.
         self._ec_batcher = ECBatcher(
             window_us=self.cfg["ec_batch_window_us"],
             max_bytes=self.cfg["ec_batch_max_bytes"],
+            adaptive=self.cfg["ec_batch_adaptive"] == "on",
+            target_ops=self.cfg["ec_batch_target_ops"],
+            window_min_us=self.cfg["ec_batch_window_min_us"],
+            window_max_us=self.cfg["ec_batch_window_max_us"],
             perf=self.perf)
         # op scheduler (OpScheduler/mClockScheduler role): the messenger
         # thread classifies+enqueues; ONE dequeue worker executes
@@ -928,6 +936,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             profile = dict(pool.ec_profile)
             plugin = profile.pop("plugin", self.cfg["ec_plugin"])
             profile.setdefault("backend", self.cfg["ec_backend"])
+            # device fan-out for folded batch launches (mesh-sharded
+            # flushes); pool ec-profile key 'shard' wins over the option
+            profile.setdefault("shard", self.cfg["ec_shard"])
             codec = ec.factory(plugin, profile)
             self._ec_codecs[pool_id] = codec
         return codec

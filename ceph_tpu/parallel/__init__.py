@@ -3,8 +3,8 @@ write/recovery steps (the TPU mapping of SURVEY.md §2.8's strategies —
 stripe batch = data parallel, shard axis = tensor parallel, collectives
 over ICI instead of the reference's messenger fan-out)."""
 
-from .mesh import init_multihost, make_host_mesh, make_mesh
-from .distributed import DistributedStripeEC
+from .mesh import init_multihost, make_flat_mesh, make_host_mesh, make_mesh
+from .distributed import DistributedStripeEC, make_folded_matmul
 
-__all__ = ["make_mesh", "make_host_mesh", "init_multihost",
-           "DistributedStripeEC"]
+__all__ = ["make_mesh", "make_flat_mesh", "make_host_mesh",
+           "init_multihost", "DistributedStripeEC", "make_folded_matmul"]

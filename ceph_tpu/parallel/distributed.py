@@ -36,6 +36,25 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.stripe_codec import StripeCodec
+from ..ops.ec_kernels import gf_matmul_graph
+
+
+def make_folded_matmul(M: np.ndarray, mesh: Mesh, axis: str = "shard"):
+    """Mesh-sharded folded region multiply: fn(rows (c, N) uint8) ->
+    (r, N) uint8 computing M @ rows over GF(2^8) with the LENGTH axis
+    sharded over `axis` — the multi-chip fan-out for the ECBatcher's
+    folded (k, sum L) launches (and any other caller already holding
+    many stripes as one wide tensor).
+
+    Columns of a region matmul are independent, so the shard_map body
+    is the plain encode/decode graph and NO collective runs: an n-device
+    mesh encodes an n-writer burst in ~one chip-time.  Callers pad N to
+    a multiple of n_devices * 4 (uint32 lanes per shard); zero columns
+    encode to zero under a linear code, so padding slices away exact.
+    """
+    g = gf_matmul_graph(np.ascontiguousarray(M, dtype=np.uint8))
+    return shard_map(g, mesh=mesh, in_specs=P(None, axis),
+                     out_specs=P(None, axis))
 
 
 class DistributedStripeEC:

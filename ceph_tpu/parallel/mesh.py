@@ -38,6 +38,20 @@ def make_mesh(n_devices: int | None = None,
     return Mesh(arr, ("dp", "shard"))
 
 
+def make_flat_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-axis ("shard",) mesh over the first n devices — the fan-out
+    topology for FOLDED launches (the ECBatcher's (k, sum L) tensors),
+    where the only parallel dimension is the length axis and no
+    collective ever runs: columns of a GF(2^8) region matmul are
+    independent, so each device computes its column slice and the
+    result is purely the concatenation."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n_devices or len(devices)
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    return Mesh(np.array(devices[:n]), ("shard",))
+
+
 def init_multihost(coordinator_address: str | None = None,
                    num_processes: int | None = None,
                    process_id: int | None = None,

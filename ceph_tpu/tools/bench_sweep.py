@@ -19,6 +19,8 @@ Usage:
     python -m ceph_tpu.tools.bench_sweep --fresh         # start over
     python -m ceph_tpu.tools.bench_sweep --only headline_1M_b64
     python -m ceph_tpu.tools.bench_sweep --cpu           # CPU leg only
+    python -m ceph_tpu.tools.bench_sweep --multichip     # MULTICHIP
+        # blob: graft dryrun + mesh-sharded batcher bench numbers
 """
 
 from __future__ import annotations
@@ -93,12 +95,25 @@ def configs() -> list[dict]:
         plugin(f"clay_k8m4d11_{backend}_repair1", "clay",
                [f"backend={backend}", "k=8", "m=4", "d=11"],
                workload="decode", erasures=1)
+    # 6. cross-op batcher legs (repo-root bench.py): the mesh-sharded
+    # 8-writer burst and the PG-recovery-storm decode burst — the rows
+    # that carry multi-chip batcher numbers into the bench trajectory
+    out.append({"id": "ec_batch_sharded", "tool": "bench_root",
+                "argv": ["--ec-batch"]})
+    out.append({"id": "ec_recovery_storm", "tool": "bench_root",
+                "argv": ["--ec-recovery"]})
     return out
 
 
 def run_config(cfg: dict, timeout: float, env: dict) -> dict:
-    cmd = [sys.executable, "-m", f"ceph_tpu.tools.{cfg['tool']}"] \
-        + cfg["argv"]
+    if cfg["tool"] == "bench_root":
+        # repo-root bench.py modes (they force their own hermetic CPU
+        # leg unless BENCH_EC_BATCH_DEVICE selects the real pool)
+        cmd = [sys.executable, os.path.join(REPO, "bench.py")] \
+            + cfg["argv"]
+    else:
+        cmd = [sys.executable, "-m", f"ceph_tpu.tools.{cfg['tool']}"] \
+            + cfg["argv"]
     t0 = time.time()
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True,
@@ -116,6 +131,56 @@ def run_config(cfg: dict, timeout: float, env: dict) -> dict:
     return {"result": result}
 
 
+def emit_multichip(path: str, n_devices: int = 8,
+                   timeout: float = 600.0) -> int:
+    """Emit a MULTICHIP-style JSON blob: the graft multichip dryrun
+    (which now includes the mesh-sharded ECBatcher leg) plus the
+    sharded-batcher bench numbers, so the per-round bench trajectory
+    captures multi-chip batcher results alongside the MULTICHIP_rNN
+    records the driver keeps.  Hermetic: forced-host CPU devices."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flag = "--xla_force_host_platform_device_count"
+    if flag not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + f" {flag}={n_devices}").strip()
+    blob = {"n_devices": n_devices, "rc": 0, "ok": True,
+            "skipped": False, "tail": ""}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import __graft_entry__ as g; "
+             f"g.dryrun_multichip({n_devices})"],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env=env)
+        blob["rc"] = proc.returncode
+        blob["ok"] = proc.returncode == 0
+        if proc.returncode == 0:
+            # the summary print may embed newlines (a skipped DCN leg
+            # quotes its worker's stderr) — keep from the marker on
+            out = proc.stdout.strip()
+            i = out.rfind("dryrun_multichip")
+            blob["tail"] = (out[i:] if i >= 0
+                            else (out.splitlines() or [""])[-1]) + "\n"
+        else:
+            blob["tail"] = (proc.stdout + "\n" + proc.stderr)[-2000:]
+    except subprocess.TimeoutExpired:
+        blob.update(rc=-1, ok=False,
+                    tail=f"dryrun timeout after {timeout:.0f}s")
+    bench = run_config({"id": "ec_batch_sharded", "tool": "bench_root",
+                        "argv": ["--ec-batch"]}, timeout, env)
+    blob["ec_batch_sharded"] = bench.get("result", bench)
+    if "error" in bench:
+        blob["ok"] = False
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(blob, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    print(json.dumps({"multichip": path, "ok": blob["ok"]}))
+    return 0 if blob["ok"] else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--fresh", action="store_true",
@@ -127,7 +192,18 @@ def main() -> int:
     p.add_argument("--timeout", type=float, default=600.0,
                    help="per-config subprocess timeout (s)")
     p.add_argument("--retries", type=int, default=2)
+    p.add_argument("--multichip", nargs="?",
+                   const="MULTICHIP_BATCH.json", default=None,
+                   metavar="PATH",
+                   help="emit a MULTICHIP-style JSON blob (graft "
+                        "dryrun + sharded batcher bench) instead of "
+                        "sweeping")
     args = p.parse_args()
+
+    if args.multichip:
+        path = args.multichip if os.path.isabs(args.multichip) \
+            else os.path.join(REPO, args.multichip)
+        return emit_multichip(path, timeout=args.timeout)
 
     global STATE
     if args.cpu:

@@ -206,6 +206,36 @@ OPTIONS: list[Option] = [
            "pending source bytes per EC batch signature that force an "
            "immediate size-flush before the window expires", min=4096,
            see_also=("ec_batch", "ec_batch_window_us")),
+    Option("ec_shard", str, "auto", OptionLevel.ADVANCED,
+           "device fan-out for folded EC batch launches: a flushed "
+           "batch's (k, sum L) tensor shards its length axis across "
+           "the device mesh (parallel/distributed.make_folded_matmul). "
+           "'auto' uses every device on an accelerator backend and "
+           "falls through to single-device on CPU (one XLA:CPU device "
+           "already uses every core); 'off' pins single-device; an "
+           "integer N caps the fan-out (clamped to the device count). "
+           "Per-pool override via ec profile key 'shard'",
+           see_also=("ec_batch",)),
+    Option("ec_batch_adaptive", str, "on", OptionLevel.ADVANCED,
+           "resize the coalescing window from the observed "
+           "ops-per-launch (EWMA toward ec_batch_target_ops, clamped "
+           "to [ec_batch_window_min_us, ec_batch_window_max_us]): a "
+           "trickle shrinks the window toward the floor instead of "
+           "paying ec_batch_window_us as pure latency, a burst grows "
+           "it to coalesce more.  ec_batch_window_us=0 still means "
+           "pass-through", enum_values=("on", "off"),
+           see_also=("ec_batch", "ec_batch_window_us")),
+    Option("ec_batch_target_ops", float, 4.0, OptionLevel.ADVANCED,
+           "ops-per-launch the adaptive window steers toward (floor 2: "
+           "a 1-op target would make every flush 'enough' and pin the "
+           "window at the ceiling)",
+           min=2.0, max=4096.0, see_also=("ec_batch_adaptive",)),
+    Option("ec_batch_window_min_us", float, 50.0, OptionLevel.ADVANCED,
+           "adaptive-window floor (microseconds)", min=1.0,
+           max=1_000_000.0, see_also=("ec_batch_adaptive",)),
+    Option("ec_batch_window_max_us", float, 4000.0, OptionLevel.ADVANCED,
+           "adaptive-window ceiling (microseconds)", min=1.0,
+           max=1_000_000.0, see_also=("ec_batch_adaptive",)),
     Option("osd_ec_stripe_unit", int, 4096, OptionLevel.ADVANCED,
            "EC chunk size (bytes per shard per stripe row); must be a "
            "multiple of 4096 (the EC_ALIGN_SIZE page-alignment contract, "

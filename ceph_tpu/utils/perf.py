@@ -99,6 +99,7 @@ class PerfCounters:
         with self._lock:
             c.buckets[b] += 1
             c.count += 1
+            c.sum += value
 
     def avg(self, name: str) -> float:
         c = self._get(name)
@@ -119,8 +120,12 @@ class PerfCounters:
                     out[n] = {"sum": c.sum, "count": c.count,
                               "avg": c.sum / c.count if c.count else 0.0}
                 else:
+                    # sum + count ride along so scrapes see a stable
+                    # (zeroed) series per histogram even before any
+                    # sample lands — and can derive a mean rate
                     nz = {i: v for i, v in enumerate(c.buckets) if v}
-                    out[n] = {"buckets_pow2": nz, "count": c.count}
+                    out[n] = {"buckets_pow2": nz, "count": c.count,
+                              "sum": c.sum}
         return out
 
 

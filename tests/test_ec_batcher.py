@@ -59,11 +59,33 @@ def _burst(batcher, codec, payloads, *, with_csums=False, stagger=0.02):
 
 
 def test_bucket_len_bounded():
+    # pow2 buckets plus 1.5x half-steps: 512, 768, 1024, 1536, 2048, ...
     assert bucket_len(1) == 512
     assert bucket_len(512) == 512
-    assert bucket_len(513) == 1024
+    assert bucket_len(513) == 768
+    assert bucket_len(768) == 768
+    assert bucket_len(769) == 1024
     assert bucket_len(4096) == 4096
-    assert bucket_len(5000) == 8192
+    assert bucket_len(4097) == 6144
+    assert bucket_len(5000) == 6144
+    assert bucket_len(6145) == 8192
+
+
+def test_bucket_len_pad_waste_bounded():
+    """The half-step buckets cap pad waste at 50% of the chunk length
+    above the 512-byte tiling floor — a just-over-pow2 chunk (the
+    4 KiB + header case) must never pad almost 2x."""
+    for L in range(512, 20_000, 7):
+        b = bucket_len(L)
+        assert b >= L and b % 4 == 0
+        assert b - L <= L * 0.5, (L, b)
+    # bucket set stays bounded: two shapes per octave (step 13 < the
+    # narrowest bucket interval, so every bucket is still visited)
+    buckets = {bucket_len(L) for L in range(1, 1 << 20, 13)}
+    assert buckets == {512, 768, 1024, 1536, 2048, 3072, 4096, 6144,
+                       8192, 12_288, 16_384, 24_576, 32_768, 49_152,
+                       65_536, 98_304, 131_072, 196_608, 262_144,
+                       393_216, 524_288, 786_432, 1 << 20}
 
 
 def test_passthrough_window0_bit_identical_no_leaks():
@@ -122,7 +144,7 @@ def test_mixed_lengths_coalesce_byte_exact():
     """Ops of different lengths share a bucket, pad, and slice back
     byte-exact (csums fall back to the CPU sweep — still exact)."""
     codec = _codec()
-    lens = [1000, 700, 1024]
+    lens = [1000, 900, 1024]  # one shared 1024 bucket (769..1024)
     b = ECBatcher(window_us=10_000_000,
                   max_bytes=4 * sum(lens))  # third arrival size-flushes
     pays = [RNG.integers(0, 256, (4, L), dtype=np.uint8) for L in lens]
