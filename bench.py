@@ -216,6 +216,50 @@ def ec_batch_bench() -> int:
     burst(adaptive, codec)  # 4-op size flushes pull the EWMA to target
     window_after_burst = adaptive.window_us
 
+    # --trace leg: sample traced ops through a batched burst and report
+    # the per-stage latency decomposition (ec-op = the op's whole
+    # encode, ec-batch-wait = queued->flushed, ec-flush = the folded
+    # launch incl. host sync) — the stage table every later perf PR is
+    # graded against
+    trace_stages = None
+    if "--trace" in sys.argv[1:]:
+        from ceph_tpu.tools.trace_tool import (format_stage_table,
+                                               stage_stats)
+        from ceph_tpu.utils.tracer import Tracer
+        tracer = Tracer("bench")
+        traced = ECBatcher(window_us=2000, max_bytes=64 << 20)
+        roots = [[None] * ops_per for _ in range(writers)]
+
+        def traced_burst():
+            import threading as _t
+            barrier = _t.Barrier(writers + 1)
+
+            def writer(w):
+                barrier.wait()
+                for i, data in enumerate(payloads[w]):
+                    root = tracer.start("ec-op", writer=w, op=i)
+                    traced.encode(codec, data,
+                                  trace=(tracer, root.ctx))
+                    root.finish()
+                    roots[w][i] = root
+
+            threads = [_t.Thread(target=writer, args=(w,))
+                       for w in range(writers)]
+            for t in threads:
+                t.start()
+            barrier.wait()
+            for t in threads:
+                t.join()
+
+        traced_burst()
+        traces = [tracer.spans_for(roots[w][i].trace_id)
+                  for w in range(writers) for i in range(ops_per)]
+        trace_stages = stage_stats(traces)
+        print("bench: per-stage latency decomposition "
+              f"({writers}x{ops_per} traced ops, batched burst):",
+              file=sys.stderr)
+        print(format_stage_table(trace_stages), file=sys.stderr)
+
     verified = True
     for w in range(writers):
         for i in range(ops_per):
@@ -258,6 +302,8 @@ def ec_batch_bench() -> int:
         "adaptive_converged": (window_after_trickle < 500.0
                                < window_after_burst),
         "digest_verified": verified,
+        **({"trace_stages": trace_stages}
+           if trace_stages is not None else {}),
     }))
     return 0 if verified else 1
 

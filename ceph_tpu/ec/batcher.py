@@ -51,6 +51,14 @@ length rides the fused encode+CRC32C device pass (``Checksummer.h:13``
 role — one launch produces parity AND every per-chunk digest); mixed
 lengths fall back to the same CPU CRC sweep the non-jax backends use,
 still over a single folded parity launch.
+
+Tracing: an op submitted with ``trace=(tracer, parent_ctx)`` gets an
+``ec-batch-wait`` span covering queued -> flushed, and each flush emits
+ONE shared ``ec-flush`` span (parented under the first traced op's wait
+span) tagged with the batch signature, n_ops, bucket length, pad-waste
+ratio, shard fan-out and flush reason; every coalesced op's wait span
+tags the flush span's id, so the collector reconstructs the fan-in
+across traces (utils/tracer.py build_tree + tools/trace_tool.py).
 """
 
 from __future__ import annotations
@@ -125,7 +133,8 @@ class _PendingOp:
 
     __slots__ = ("codec", "streams", "chunks", "want", "length",
                  "with_csums", "callback", "deadline", "submitted",
-                 "taken", "done", "parity", "csums", "decoded", "error")
+                 "taken", "done", "parity", "csums", "decoded", "error",
+                 "tspan")
 
     def __init__(self, codec, *, streams=None, chunks=None, want=None,
                  length=0, with_csums=False, callback=None):
@@ -144,6 +153,7 @@ class _PendingOp:
         self.csums = None
         self.decoded = None
         self.error: BaseException | None = None
+        self.tspan = None           # ec-batch-wait span (traced ops)
 
 
 class ECBatcher:
@@ -210,11 +220,16 @@ class ECBatcher:
     # ------------------------------------------------------------- public
     def encode(self, codec, data_chunks: np.ndarray, *,
                with_csums: bool = False,
-               callback: Callable | None = None):
+               callback: Callable | None = None,
+               trace: tuple | None = None):
         """Encode one op's (k, L) data chunks; returns (parity, csums)
         exactly as the per-op codec entry points would.  Blocks until the
         folded launch carrying this op completes; ``callback(parity,
-        csums)`` (if given) fires before the call returns."""
+        csums)`` (if given) fires before the call returns.  ``trace`` is
+        an optional ``(tracer, parent_ctx)`` pair: the op gets an
+        ``ec-batch-wait`` span (queued -> flushed) and its flush one
+        shared ``ec-flush`` span — the latency decomposition the span
+        tree lost when ops started coalescing."""
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
         L = int(data_chunks.shape[-1])
         foldable = (isinstance(codec, MatrixErasureCode)
@@ -232,13 +247,15 @@ class ECBatcher:
                bool(with_csums), bucket_len(L))
         op = _PendingOp(codec, streams=data_chunks, length=L,
                         with_csums=with_csums, callback=callback)
+        self._trace_submit(op, trace, sig)
         self._submit(sig, op, data_chunks.nbytes, self._flush_encode)
         if op.error is not None:
             raise op.error
         return op.parity, op.csums
 
     def decode(self, codec, want: Sequence[int], chunks: ChunkMap, *,
-               callback: Callable | None = None) -> ChunkMap:
+               callback: Callable | None = None,
+               trace: tuple | None = None) -> ChunkMap:
         """Batched counterpart of ``ErasureCode.decode``: present shards
         pass through, missing ones reconstruct via a coalesced
         decode_chunks launch shared with concurrent same-signature ops
@@ -267,6 +284,7 @@ class ECBatcher:
         # the callback is fired below by THIS thread, after present
         # shards merge back in — not by the flusher
         op = _PendingOp(codec, chunks=arrays, want=need, length=L)
+        self._trace_submit(op, trace, sig)
         nbytes = sum(c.nbytes for c in arrays.values())
         self._submit(sig, op, nbytes, self._flush_decode)
         if op.error is not None:
@@ -286,6 +304,56 @@ class ECBatcher:
         """Ops queued and not yet taken by a flusher (0 when quiescent)."""
         with self._cv:
             return sum(len(q) for q in self._groups.values())
+
+    # ----------------------------------------------------------- tracing
+    @staticmethod
+    def _sig_tag(sig: tuple) -> str:
+        """Human-readable batch-signature tag (the raw sig embeds the
+        whole coding matrix): kind/k.m/length-bucket."""
+        return f"{sig[0]}/k{sig[2]}m{sig[3]}/L{sig[-1]}"
+
+    def _trace_submit(self, op: _PendingOp, trace: tuple | None,
+                      sig: tuple) -> None:
+        """Start the op's ec-batch-wait span (queued -> flushed)."""
+        if trace is None:
+            return
+        tracer, ctx = trace
+        op.tspan = tracer.start("ec-batch-wait", parent=ctx,
+                                sig=self._sig_tag(sig))
+
+    def _trace_flush(self, sig: tuple, ops: list[_PendingOp],
+                     reason: str):
+        """One shared ec-flush span per flush, parented under the first
+        traced op's wait span; every traced op's wait span finishes now
+        and tags the flush span's id, so collector-side assembly
+        (build_tree / trace_tool) reconstructs the fan-in across the
+        coalesced ops' separate traces."""
+        tops = [o for o in ops if o.tspan is not None]
+        if not tops:
+            return None
+        lead = tops[0].tspan
+        fspan = lead._tracer.start("ec-flush", parent=lead.ctx,
+                                   sig=self._sig_tag(sig),
+                                   n_ops=len(ops), reason=reason)
+        for o in tops:
+            o.tspan.tag("flush_span", fspan.span_id)
+            o.tspan.tag("flush_reason", reason)
+            o.tspan.finish()
+        return fspan
+
+    @staticmethod
+    def _trace_flush_done(fspan, *, bucket: int, src_cols: int,
+                          padded_cols: int, n_shard: int) -> None:
+        """Close the flush span with the launch-shape tags: bucket
+        length, pad-waste ratio (padded columns that carried no op
+        bytes), and the device fan-out."""
+        if fspan is None:
+            return
+        waste = (1.0 - src_cols / padded_cols) if padded_cols else 0.0
+        fspan.tag("bucket", bucket)
+        fspan.tag("pad_waste", round(waste, 4))
+        fspan.tag("n_shard", n_shard)
+        fspan.finish()
 
     # ------------------------------------------------- submit/wait machinery
     def _submit(self, sig: tuple, op: _PendingOp, nbytes: int,
@@ -397,9 +465,11 @@ class ECBatcher:
             elif self._ops_ewma < max(1.5, self.target_ops / 2):
                 # launches flying alone: waiting buys nothing
                 w = w * self.ADAPT_SHRINK
-            self.window_us = min(self.window_max_us,
-                                 max(self.window_min_us, w))
+            w = min(self.window_max_us, max(self.window_min_us, w))
+            self.window_us = w
         if self._perf is not None:
+            # the CLAMPED value: the gauge must report the window the
+            # batcher actually uses, not the controller's raw estimate
             self._perf.set("ec_batch_window_us_now", round(w, 1))
 
     def _fire(self, op: _PendingOp, callback: Callable, *args) -> None:
@@ -462,6 +532,8 @@ class ECBatcher:
         k = codec.k
         src_bytes = sum(o.streams.nbytes for o in ops)
         ns, shard_bytes = 1, 0
+        padded_cols = 0
+        fspan = self._trace_flush(sig, ops, reason)
         try:
             n = len(ops)
             n2 = _pow2(n)  # stripe-count padding: bounded shape set
@@ -484,12 +556,20 @@ class ECBatcher:
             if op_fn is not None:
                 # ONE device pass: parity + per-chunk CRC32C for every
                 # stripe in the launch (csums (k+m, n2), one per stripe)
+                padded_cols = n2 * L0
                 folded = np.zeros((k, n2 * L0), dtype=np.uint8)
                 for i, o in enumerate(ops):
                     folded[:, i * L0: (i + 1) * L0] = o.streams
-                dev_parity, dev_csums = op_fn(folded)
-                parity = np.asarray(dev_parity)
-                csums = np.asarray(dev_csums)
+                # the fused launch rides the same profiled path as the
+                # plain matmul (device-execute timed around
+                # block_until_ready, host_sync = the copy only) — the
+                # decomposition must not misattribute the main batched
+                # path's compute to the sync bucket
+                dev_parity, dev_csums = codec._profiled_launch(
+                    op_fn, folded,
+                    f"csum/{codec.m}x{k}/L{L0}x{n2 * L0}")
+                parity = codec.host_sync(dev_parity)
+                csums = codec.host_sync(dev_csums)
                 for i, o in enumerate(ops):
                     # copy out of the launch buffer: a retained per-op
                     # result must not pin the whole (m, n2*L) fold
@@ -500,13 +580,14 @@ class ECBatcher:
                 # into whole per-device column slices (still a bounded
                 # shape set: pow2 rounded to the fan-out)
                 n2 = n2s
+                padded_cols = n2 * bucket
                 folded = np.zeros((k, n2 * bucket), dtype=np.uint8)
                 for i, o in enumerate(ops):
                     folded[:, i * bucket: i * bucket + o.length] = \
                         o.streams
                 # device-resident matmul: one launch, one host sync;
                 # ns > 1 fans the folded columns over the device mesh
-                parity = np.asarray(
+                parity = codec.host_sync(
                     codec._matmul_device(codec.matrix, folded,
                                          n_shard=ns))
                 shard_bytes = folded.nbytes // ns if ns > 1 else 0
@@ -526,6 +607,10 @@ class ECBatcher:
             for o in ops:
                 o.error = e
         finally:
+            self._trace_flush_done(
+                fspan, bucket=bucket,
+                src_cols=sum(o.length for o in ops),
+                padded_cols=padded_cols, n_shard=ns)
             self._complete(ops, src_bytes, reason, ns, shard_bytes)
 
     def _flush_decode(self, sig: tuple, ops: list[_PendingOp],
@@ -536,8 +621,11 @@ class ECBatcher:
         src_bytes = sum(sum(c.nbytes for c in o.chunks.values())
                         for o in ops)
         ns, shard_bytes = 1, 0
+        padded_cols = 0
+        fspan = self._trace_flush(sig, ops, reason)
         try:
             ns, n2 = self._shard_fanout(codec, _pow2(len(ops)))
+            padded_cols = n2 * bucket
             flat = {s: np.zeros(n2 * bucket, dtype=np.uint8)
                     for s in avail}
             for i, o in enumerate(ops):
@@ -555,4 +643,8 @@ class ECBatcher:
             for o in ops:
                 o.error = e
         finally:
+            self._trace_flush_done(
+                fspan, bucket=bucket,
+                src_cols=sum(o.length for o in ops),
+                padded_cols=padded_cols, n_shard=ns)
             self._complete(ops, src_bytes, reason, ns, shard_bytes)

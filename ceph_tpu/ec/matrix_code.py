@@ -11,12 +11,14 @@ ErasureCodeIsaTableCache LRU, ErasureCodeIsa.cc:513-563).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Sequence
 
 import numpy as np
 
 from ..ops import gf256
 from ..ops import native
+from ..utils.perf import kernel_profiler
 from .interface import ChunkMap, ErasureCode, ErasureCodeError, Flags
 
 
@@ -57,19 +59,30 @@ class MatrixErasureCode(ErasureCode):
         # itself down): shapes warmed/warming, guarded by _cache_lock
         self._csum_ready: set[tuple[int, int]] = set()
         self._csum_building: set[tuple[int, int]] = set()
+        # (kernel sig, input shape) pairs already launched once: jit
+        # compiles per input shape, so the FIRST launch of a pair is
+        # the XLA compile and is profiled as such (kernel-LRU eviction
+        # can re-trigger a compile that lands in the device bucket —
+        # rare churn noise, not worth tracking eviction generations)
+        self._kern_shapes_seen: set[tuple] = set()
         if self._backend == "jax":
             self._jax_matmul(self.matrix)  # build the encode op eagerly
 
+    _MISS = object()  # cache-miss sentinel: a stored None is a HIT
+    # (the sharded-matmul builder caches None for "mesh can't be
+    # built" so the single-device fall-through doesn't re-attempt
+    # mesh construction on every flush)
+
     def _jax_op_cached(self, key: bytes, build):
         with self._cache_lock:
-            op = self._jax_ops.pop(key, None)
-            if op is not None:
+            op = self._jax_ops.pop(key, self._MISS)
+            if op is not self._MISS:
                 self._jax_ops[key] = op  # LRU touch: re-insert at end
                 return op
         op = build()  # trace-lazy, but still outside the lock
         with self._cache_lock:
-            hit = self._jax_ops.pop(key, None)
-            if hit is not None:
+            hit = self._jax_ops.pop(key, self._MISS)
+            if hit is not self._MISS:
                 op = hit  # another thread built it first: keep one
             elif len(self._jax_ops) > self.JAX_OPS_CAP:
                 old = next(iter(self._jax_ops))
@@ -169,13 +182,64 @@ class MatrixErasureCode(ErasureCode):
             if n_shard > 1 and rows.shape[-1] % (4 * n_shard) == 0:
                 op = self._jax_matmul_sharded(M, n_shard)
                 if op is not None:
-                    return op(rows)
-            return self._jax_matmul(M)(rows)
+                    return self._profiled_launch(
+                        op, rows,
+                        f"matmul/{M.shape[0]}x{M.shape[1]}"
+                        f"/L{rows.shape[-1]}/s{n_shard}")
+            return self._profiled_launch(
+                self._jax_matmul(M), rows,
+                f"matmul/{M.shape[0]}x{M.shape[1]}/L{rows.shape[-1]}")
         return gf256.encode_region(M, rows)
+
+    def _profiled_launch(self, op, rows, sig: str):
+        """One timed device launch: elapsed measured around
+        ``block_until_ready`` (dispatch + device execute, NOT the
+        host-side copy — that's host_sync's slice).  jit compiles per
+        input shape, so a (kernel, shape) pair's first launch IS the
+        XLA compile and is recorded as a compile event; the sync a
+        caller pays right after is unchanged — callers materialize the
+        folded result immediately anyway, so blocking here adds no sync
+        the hot path wasn't already paying per launch.  Handles ops
+        returning a tuple (the fused encode+CRC pass) by blocking on
+        every element."""
+        t0 = time.perf_counter()
+        out = op(rows)
+        if isinstance(out, tuple):
+            out = tuple(o.block_until_ready()
+                        if hasattr(o, "block_until_ready") else o
+                        for o in out)
+        elif hasattr(out, "block_until_ready"):
+            out = out.block_until_ready()
+        dt = time.perf_counter() - t0
+        key = (sig, rows.shape)
+        with self._cache_lock:
+            first = key not in self._kern_shapes_seen
+            if first:
+                self._kern_shapes_seen.add(key)
+        kernel_profiler().note("compile" if first else "device", sig, dt)
+        return out
+
+    def host_sync(self, dev, sig: str | None = None):
+        """Materialize a device result on the host, timing the
+        device->host transfer as the profiler's host-sync slice (a
+        numpy input passes through untimed — non-jax backends never
+        left the host).  Default signature carries the result shape so
+        the per-signature dump splits syncs the same way it splits
+        launches."""
+        if isinstance(dev, np.ndarray):
+            return dev
+        if sig is None:
+            shape = "x".join(str(d) for d in getattr(dev, "shape", ()))
+            sig = f"sync/{shape}"
+        t0 = time.perf_counter()
+        out = np.asarray(dev)
+        kernel_profiler().note("sync", sig, time.perf_counter() - t0)
+        return out
 
     def _matmul(self, M: np.ndarray, rows: np.ndarray, *,
                 n_shard: int = 1) -> np.ndarray:
-        return np.asarray(self._matmul_device(M, rows, n_shard=n_shard))
+        return self.host_sync(self._matmul_device(M, rows,
+                                                  n_shard=n_shard))
 
     def encode_chunks(self, data_chunks: np.ndarray) -> np.ndarray:
         data_chunks = np.ascontiguousarray(data_chunks, dtype=np.uint8)
@@ -206,8 +270,11 @@ class MatrixErasureCode(ErasureCode):
         if self._backend == "jax" and nbytes % 4 == 0 and nbytes >= 4:
             op = self._csum_op_if_ready(nbytes, nbytes)
             if op is not None:
-                parity, csums = op(data_chunks)
-                return np.asarray(parity), np.asarray(csums)[:, 0]
+                parity, csums = self._profiled_launch(
+                    op, data_chunks,
+                    f"csum/{self.m}x{self.k}/L{nbytes}x{nbytes}")
+                return self.host_sync(parity), \
+                    self.host_sync(csums)[:, 0]
             # op still compiling in the background: CPU csums this time
             # (identical values), fused from the next call on
         parity = self._matmul(self.matrix, data_chunks)
@@ -276,7 +343,12 @@ class MatrixErasureCode(ErasureCode):
         def warm():
             try:
                 op = self._csum_op(nbytes)
+                t0 = time.perf_counter()
                 op(np.zeros((self.k, total), dtype=np.uint8))  # compile
+                kernel_profiler().note(
+                    "compile",
+                    f"csum/{self.m}x{self.k}/L{nbytes}x{total}",
+                    time.perf_counter() - t0)
                 key = self._csum_key(nbytes)
                 with self._cache_lock:
                     # the compile ran for seconds outside the lock: if

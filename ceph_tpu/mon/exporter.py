@@ -24,28 +24,24 @@ def _sanitize(name: str) -> str:
 
 def render_metrics(mon=None) -> str:
     """The prometheus text format body (flat counters + labeled
-    per-daemon series, sum/count pairs for timers)."""
-    lines: list[str] = []
+    per-daemon series, sum/count pairs for timers).
+
+    Samples are COLLECTED first and rendered grouped per metric: the
+    text exposition format requires every sample of a metric in one
+    group under a single HELP/TYPE header — the old per-daemon outer
+    loop interleaved one metric's series across daemons, which strict
+    parsers (promtool, the client_python text parser) reject."""
+    # metric -> {"help": str, "type": str, "samples": [(labels, value)]}
+    groups: dict[str, dict] = {}
 
     def emit(metric: str, value, labels: dict | None = None,
              help_: str | None = None, typ: str = "gauge"):
         m = f"{_PREFIX}_{_sanitize(metric)}"
-        if help_:
-            lines.append(f"# HELP {m} {help_}")
-            lines.append(f"# TYPE {m} {typ}")
-        lab = ""
-        if labels:
-            pairs = ",".join(f'{k}="{v}"' for k, v in sorted(
-                labels.items()))
-            lab = "{" + pairs + "}"
-        # exact rendering: %g truncates to 6 significant digits, which
-        # corrupts byte counters past ~1e6 (rate()/delta() go wrong)
-        if isinstance(value, bool):
-            value = int(value)
-        if isinstance(value, int):
-            lines.append(f"{m}{lab} {value}")
-        else:
-            lines.append(f"{m}{lab} {float(value)!r}")
+        g = groups.get(m)
+        if g is None:
+            g = groups[m] = {"help": help_ or f"{metric}",
+                             "type": typ, "samples": []}
+        g["samples"].append((dict(labels) if labels else {}, value))
 
     if mon is not None:
         # snapshot under the monitor lock: the HTTP thread must not
@@ -57,7 +53,8 @@ def render_metrics(mon=None) -> str:
             n_osds = len(mon.osdmap.osds)
             n_pools = len(mon.osdmap.pools)
             epoch = mon.osdmap.epoch
-            stats_copy = [dict(s) for s in mon._osd_stats.values()]
+            stats_copy = {i: dict(s)
+                          for i, s in mon._osd_stats.items()}
         emit("osdmap_epoch", epoch,
              help_="current OSDMap epoch", typ="counter")
         emit("osd_total", n_osds, help_="known OSDs")
@@ -67,46 +64,66 @@ def render_metrics(mon=None) -> str:
         emit("mon_is_leader", 1 if mon.is_leader else 0,
              help_="1 when this monitor leads the quorum")
         agg: dict[str, float] = {}
-        for stats in stats_copy:
+        for stats in stats_copy.values():
             for k, v in stats.items():
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     agg[k] = agg.get(k, 0) + v
         for k, v in sorted(agg.items()):
             emit(f"cluster_{k}", v,
                  help_=f"sum of per-osd reported {k}")
+        # SLOW_OPS per daemon (the health mux's exporter face): ops
+        # currently blocked past osd_op_complaint_time, as reported in
+        # the daemon's latest stats heartbeat
+        for i, stats in sorted(stats_copy.items()):
+            emit("daemon_slow_ops", int(stats.get("slow_ops", 0)),
+                 {"daemon": f"osd.{i}"},
+                 help_="ops currently slower than "
+                       "osd_op_complaint_time", typ="gauge")
     # per-daemon perf counters (the MMgrReport/DaemonMetricCollector feed)
-    first_metric: set[str] = set()
     for daemon, counters in global_perf().dump().items():
         for cname, val in counters.items():
             base = f"daemon_{_sanitize(cname)}"
             if isinstance(val, dict):
                 for sub in ("sum", "count", "sum_seconds"):
                     if sub in val:
-                        metric = f"{base}_{sub}"
-                        emit(metric, val[sub], {"daemon": daemon},
-                             help_=None if metric in first_metric
-                             else f"perf counter {cname} {sub}",
+                        emit(f"{base}_{sub}", val[sub],
+                             {"daemon": daemon},
+                             help_=f"perf counter {cname} {sub}",
                              typ="counter")
-                        first_metric.add(metric)
                 # pow-2 histograms (e.g. the EC batcher's ops-per-launch
                 # distribution): one labeled series per occupied bucket,
                 # bucket b covering values in [2^(b-1), 2^b)
                 for b, n in sorted(val.get("buckets_pow2", {}).items()):
-                    metric = f"{base}_bucket"
-                    emit(metric, n, {"daemon": daemon, "pow2": b},
-                         help_=None if metric in first_metric
-                         else f"perf histogram {cname} pow-2 buckets",
+                    emit(f"{base}_bucket", n,
+                         {"daemon": daemon, "pow2": b},
+                         help_=f"perf histogram {cname} pow-2 buckets",
                          typ="counter")
-                    first_metric.add(metric)
             elif isinstance(val, (int, float)):
                 # settable gauges (the adaptive EC-batch window, any
                 # future *_now values) must not be typed counter —
                 # rate() over a value that moves both ways is nonsense
                 typ = "gauge" if cname.endswith("_now") else "counter"
                 emit(base, val, {"daemon": daemon},
-                     help_=None if base in first_metric
-                     else f"perf counter {cname}", typ=typ)
-                first_metric.add(base)
+                     help_=f"perf counter {cname}", typ=typ)
+    lines: list[str] = []
+    for m, g in groups.items():
+        lines.append(f"# HELP {m} {g['help']}")
+        lines.append(f"# TYPE {m} {g['type']}")
+        for labels, value in g["samples"]:
+            lab = ""
+            if labels:
+                pairs = ",".join(f'{k}="{v}"' for k, v in sorted(
+                    labels.items()))
+                lab = "{" + pairs + "}"
+            # exact rendering: %g truncates to 6 significant digits,
+            # which corrupts byte counters past ~1e6 (rate()/delta()
+            # go wrong)
+            if isinstance(value, bool):
+                value = int(value)
+            if isinstance(value, int):
+                lines.append(f"{m}{lab} {value}")
+            else:
+                lines.append(f"{m}{lab} {float(value)!r}")
     return "\n".join(lines) + "\n"
 
 

@@ -113,6 +113,9 @@ class StatusModule(MgrModule):
         with self.mgr.mon._lock:
             epoch = self.mgr.mon.osdmap.epoch
             pools = len(self.mgr.mon.osdmap.pools)
+            # same health mux `ceph status` serves: OSD_DOWN + SLOW_OPS
+            checks = self.mgr.mon._health_checks(
+                self.mgr.mon.osdmap.up_osds())
         return {
             "epoch": epoch,
             "osds": {"total": len(osds),
@@ -121,9 +124,8 @@ class StatusModule(MgrModule):
                                if in_)},
             "pools": pools,
             "bytes_used": used,
-            "health": ("HEALTH_OK"
-                       if all(up for _i, up, _in, _h in osds)
-                       else "HEALTH_WARN"),
+            "health": "HEALTH_WARN" if checks else "HEALTH_OK",
+            "checks": checks,
         }
 
 

@@ -1553,6 +1553,7 @@ class MonitorLite(Dispatcher):
             for s in self._osd_stats.values():
                 for k in agg:
                     agg[k] += s.get(k, 0)
+            checks = self._health_checks(up)
             # raw sums count each replica/shard; objects are logical-ish
             return 0, {"epoch": self.osdmap.epoch,
                        "num_osds": len(self.osdmap.osds),
@@ -1563,8 +1564,9 @@ class MonitorLite(Dispatcher):
                        "quorum": {"leader": self._leader,
                                   "term": self._term,
                                   "role": self._role},
-                       "health": "HEALTH_OK" if len(up) == len(
-                           self.osdmap.osds) else "HEALTH_WARN"}
+                       "health": ("HEALTH_WARN" if checks
+                                  else "HEALTH_OK"),
+                       "checks": checks}
         if prefix == "osd stats":
             return 0, {f"osd.{i}": dict(s)
                        for i, s in sorted(self._osd_stats.items())}
@@ -1669,6 +1671,39 @@ class MonitorLite(Dispatcher):
             if moves:
                 self._commit_map(f"balancer: {len(moves)} upmap moves")
             return 0, {"moves": moves}
+
+    def _health_checks(self, up: list) -> dict:
+        """The health mux (the reference's health check map feeding
+        `ceph status`): OSD_DOWN from the map, SLOW_OPS folded from the
+        daemons' stats reports (dump_historic_slow_ops -> mon path) —
+        driven by CURRENTLY blocked ops, so the warning clears on its
+        own when they finish and the next report lands.  Caller holds
+        _lock."""
+        checks: dict[str, dict] = {}
+        n_down = len(self.osdmap.osds) - len(up)
+        if n_down > 0:
+            checks["OSD_DOWN"] = {
+                "severity": "HEALTH_WARN",
+                "summary": f"{n_down} osds down"}
+        slow_daemons = {
+            f"osd.{i}": {"slow_ops": int(s.get("slow_ops", 0)),
+                         "slow_ops_total": int(
+                             s.get("slow_ops_total", 0)),
+                         "worst": list(s.get("slow_ops_worst", []))}
+            for i, s in sorted(self._osd_stats.items())
+            if s.get("slow_ops", 0)}
+        if slow_daemons:
+            total = sum(d["slow_ops"] for d in slow_daemons.values())
+            oldest = max(
+                (w["age_seconds"] for d in slow_daemons.values()
+                 for w in d["worst"]), default=0.0)
+            checks["SLOW_OPS"] = {
+                "severity": "HEALTH_WARN",
+                "summary": (f"{total} slow ops, oldest "
+                            f"{oldest:.1f}s, daemons "
+                            f"{sorted(slow_daemons)}"),
+                "detail": slow_daemons}
+        return checks
 
     def _handle_stats(self, conn, m: MStatsReport) -> None:
         with self._lock:

@@ -27,6 +27,7 @@ daemon per OSD:
 from __future__ import annotations
 
 import collections
+import contextlib
 import itertools
 import threading
 import time
@@ -103,6 +104,7 @@ class _PendingRead:
     stat_only: bool = False  # reply with the object length, not data
     # recovery reads carry a completion callback instead of a client
     on_done: object = None
+    span: object = None    # op span (traced reads): decode stage parent
     stamp: float = field(default_factory=time.time)
 
 
@@ -243,7 +245,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._recovery_inflight = 0
         self._recovery_pg_ops: dict[PgId, int] = {}
         self.inject = FaultInjection()
-        self.op_tracker = OpTracker()
+        # slow-op complaint threshold + historic ring are operator
+        # knobs (the reference's osd_op_complaint_time /
+        # osd_op_history_size), not hardcoded tracker defaults
+        self.op_tracker = OpTracker(
+            history_size=self.cfg["osd_op_history_size"],
+            slow_op_seconds=self.cfg["osd_op_complaint_time"])
         self.tracer = Tracer(self.name)
         self._init_objops()
         self._init_snaps()
@@ -374,6 +381,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return self.op_tracker.dump_historic_ops()
         if cmd == "dump_slow_ops":
             return self.op_tracker.slow_ops()
+        if cmd == "dump_historic_slow_ops":
+            return self.op_tracker.dump_historic_slow_ops()
+        if cmd == "dump_kernel_profile":
+            from ..utils.perf import kernel_profiler
+            return kernel_profiler().dump()
         if cmd == "config show":
             return self.cfg.dump()
         if cmd == "dump_op_queue":
@@ -961,26 +973,49 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         return (getattr(codec, "_backend", None) == "jax"
                 and self._use_mclock)
 
-    def _ec_encode(self, codec, streams, with_csums: bool):
+    def _ec_encode(self, codec, streams, with_csums: bool, m=None):
         """One encode launch for one op — or, when batching is engaged,
         a slot in a folded launch shared with concurrent ops.  Returns
         (parity, csums); csums is None when the codec has no fused path
-        and with_csums was not requested."""
+        and with_csums was not requested.  A traced op (``m`` carries a
+        span) wraps the call in an ``ec-encode`` span whose children —
+        ``ec-batch-wait`` + the shared ``ec-flush`` — decompose where
+        the encode time went (window wait vs launch)."""
+        span = getattr(m, "_span", None) if m is not None else None
         if self._ec_batch_on(codec):
+            if span is not None:
+                with self.tracer.start("ec-encode",
+                                       parent=span.ctx) as sp:
+                    return self._ec_batcher.encode(
+                        codec, streams, with_csums=with_csums,
+                        trace=(self.tracer, sp.ctx))
             return self._ec_batcher.encode(codec, streams,
                                            with_csums=with_csums)
-        if with_csums:
-            enc_csum = getattr(codec, "encode_chunks_with_csums", None)
-            if enc_csum is not None:
-                return enc_csum(streams)
-        return codec.encode_chunks(streams), None
+        with (self.tracer.start("ec-encode", parent=span.ctx)
+              if span is not None else contextlib.nullcontext()):
+            if with_csums:
+                enc_csum = getattr(codec, "encode_chunks_with_csums",
+                                   None)
+                if enc_csum is not None:
+                    return enc_csum(streams)
+            return codec.encode_chunks(streams), None
 
-    def _ec_decode(self, codec, want, chunks):
+    def _ec_decode(self, codec, want, chunks, span=None):
         """Decode wanted shards — coalesced with concurrent decodes of
-        the same erasure signature when batching is engaged."""
+        the same erasure signature when batching is engaged.  ``span``
+        (the op's span, when traced) wraps the call in an ``ec-decode``
+        span with the same batch-wait/flush decomposition underneath."""
         if self._ec_batch_on(codec):
+            if span is not None:
+                with self.tracer.start("ec-decode",
+                                       parent=span.ctx) as sp:
+                    return self._ec_batcher.decode(
+                        codec, want, chunks,
+                        trace=(self.tracer, sp.ctx))
             return self._ec_batcher.decode(codec, want, chunks)
-        return codec.decode(want, chunks)
+        with (self.tracer.start("ec-decode", parent=span.ctx)
+              if span is not None else contextlib.nullcontext()):
+            return codec.decode(want, chunks)
 
     # ----------------------------------------------------------- pg log
     def _pglog(self, pgid: PgId) -> PGLog:
@@ -1516,7 +1551,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # re-sweeping the bytes on CPU.
         self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(m.data)
-        parity, csums = self._ec_encode(codec, streams, with_csums=True)
+        parity, csums = self._ec_encode(codec, streams, with_csums=True,
+                                        m=m)
         attrs = {"v": version, "len": len(m.data)}
         if self._ec_whiteout(pgid, m.oid):
             attrs["wh"] = 0  # write resurrects a whiteout'd head
@@ -1601,7 +1637,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         version = self._next_version(pgid)
         self._ec_cache.invalidate(pgid, m.oid)  # version moves past it
         streams = si.ro_scatter(row_bytes)
-        parity, _csums = self._ec_encode(codec, streams, with_csums=False)
+        parity, _csums = self._ec_encode(codec, streams,
+                                         with_csums=False, m=m)
         base = row0 * si.chunk_size
         tid = next(self._tids)
         remote = sum(1 for o in up
@@ -1893,7 +1930,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if all(i in have for i in data_ids):
                 streams = [have[i] for i in data_ids]
             else:
-                dec = self._ec_decode(codec, data_ids, have)
+                dec = self._ec_decode(codec, data_ids, have,
+                                      span=getattr(m, "_span", None))
                 streams = [dec[i] for i in data_ids]
             old = si.ro_assemble(streams).tobytes()
             buf = bytearray(nrows * si.stripe_width)
@@ -2098,6 +2136,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           total_shards=sum(1 for u in up if u is not None),
                           offset=m.offset, length=m.length,
                           row_base=row_base, row_len=row_len)
+        pr.span = getattr(m, "_span", None)
         self._pending_reads[tid] = pr
         self._fan_shard_reads(tid, pgid, m.oid, up, extents=extents)
 
@@ -2277,7 +2316,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if all(i in chunks for i in data_ids):
             streams = [chunks[i] for i in data_ids]
         else:
-            decoded = self._ec_decode(codec, data_ids, dict(chunks))
+            decoded = self._ec_decode(codec, data_ids, dict(chunks),
+                                      span=pr.span)
             streams = [decoded[i] for i in data_ids]
         ro = si.ro_assemble(streams).tobytes()
         if pr.row_len:
@@ -2653,6 +2693,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if time.monotonic() - t0 > budget:
                 partial = True
                 break
+        # SLOW_OPS feed (the dump_historic_slow_ops -> health mux path):
+        # currently-blocked slow ops drive the mon's HEALTH_WARN (they
+        # clear when the ops finish); the cumulative count and the worst
+        # offenders ride along for the per-daemon health detail
+        slow = self.op_tracker.slow_summary()
         self.messenger.send_message(
             self.mon,
             MStatsReport(self.osd_id,
@@ -2663,7 +2708,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           "op_w": self.perf.get("op_w"),
                           "op_r": self.perf.get("op_r"),
                           "recovery_push": self.perf.get("recovery_push"),
-                          "scrub_errors": self.perf.get("scrub_errors")}))
+                          "scrub_errors": self.perf.get("scrub_errors"),
+                          "slow_ops": slow["inflight"],
+                          "slow_ops_total": slow["total"],
+                          "slow_ops_worst": slow["worst"]}))
 
     def _handle_ping(self, conn, m: MOSDPing) -> None:
         conn.send(MOSDPingReply(self.osd_id, m.stamp))

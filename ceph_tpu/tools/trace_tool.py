@@ -1,0 +1,200 @@
+"""Critical-path trace tooling: merge per-daemon span rings, print a
+waterfall, aggregate per-stage self-time.
+
+The collector+analysis half of the tracing story (utils/tracer.py is
+the recording half): every daemon keeps a bounded local span ring and
+answers ``dump_tracing`` over its admin socket; this tool plays the
+jaeger-query role — merge the rings for one trace id into a tree,
+render it as a text waterfall (offset/duration bars per span), and
+aggregate MANY traces into per-stage p50/p99 tables of total and SELF
+time (a span's duration minus its children's — the time the stage
+itself burned, which is what finds the next optimization; the EC
+batcher measurement papers in PAPERS.md live on exactly this
+decomposition).
+
+CLI::
+
+    python -m ceph_tpu.tools.trace_tool --asok-dir /tmp/asok \
+        --trace-id 123456
+
+queries every ``*.asok`` in the directory, merges the rings, prints the
+waterfall and the per-stage table.  The library half (merge_spans /
+waterfall / stage_stats) is what ``bench.py --ec-batch --trace`` and
+the tests drive directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from ..utils.tracer import build_tree
+
+
+def merge_spans(span_lists) -> list[dict]:
+    """Merge per-daemon/per-client span dumps for one trace, dropping
+    duplicates (a collector may see the same ring twice)."""
+    seen: set[int] = set()
+    out: list[dict] = []
+    for spans in span_lists:
+        for s in spans:
+            if s["span_id"] not in seen:
+                seen.add(s["span_id"])
+                out.append(s)
+    return out
+
+
+def _walk(nodes, depth=0):
+    for n in nodes:
+        yield n, depth
+        yield from _walk(n["children"], depth + 1)
+
+
+def waterfall(spans: list[dict], width: int = 40) -> str:
+    """Text waterfall for one trace: the span tree with per-span
+    offset/duration bars on a shared time axis (roots at t=0)."""
+    tree = build_tree(merge_spans([spans]))
+    if not tree:
+        return "(no spans)"
+    t0 = min(n["start"] for n, _ in _walk(tree))
+    t1 = max((n["end"] or n["start"]) for n, _ in _walk(tree))
+    total = max(t1 - t0, 1e-9)
+    rows = []
+    for n, depth in _walk(tree):
+        off = n["start"] - t0
+        dur = ((n["end"] or t1) - n["start"])
+        left = int(off / total * width)
+        bar = max(1, int(dur / total * width))
+        lane = " " * left + "#" * min(bar, width - left)
+        name = "  " * depth + n["name"]
+        flags = " (in flight)" if n.get("in_flight") else ""
+        tag = ""
+        if "flush_span" in n.get("tags", {}):
+            tag = f" ->flush:{n['tags']['flush_span'] & 0xFFFF:x}"
+        rows.append((name, lane, off * 1e3, dur * 1e3,
+                     n["service"], flags + tag))
+    namew = max(len(r[0]) for r in rows)
+    lines = [f"trace {tree[0]['trace_id']}: "
+             f"{len(rows)} spans, {total * 1e3:.3f} ms total"]
+    for name, lane, off, dur, svc, extra in rows:
+        lines.append(f"{name:<{namew}} |{lane:<{width}}| "
+                     f"+{off:8.3f}ms {dur:8.3f}ms  {svc}{extra}")
+    return "\n".join(lines)
+
+
+def _dur_ms(n: dict) -> float:
+    """A span's duration for aggregation: finished spans from their
+    own start/end; an in-flight span (end=0 — the hung-op case the
+    dumps exist to surface) uses the dur_ms the dumping tracer
+    measured to its now, so hung stages show their real age instead
+    of a zero that would point the operator at the wrong stage."""
+    if n.get("end"):
+        return (n["end"] - n["start"]) * 1e3
+    return float(n.get("dur_ms", 0.0))
+
+
+def self_times(spans: list[dict]) -> list[dict]:
+    """Per span: total duration and SELF time (duration minus the sum
+    of direct children's durations, floored at 0 — overlapping async
+    children can exceed the parent's wall time)."""
+    tree = build_tree(merge_spans([spans]))
+    out = []
+    for n, _ in _walk(tree):
+        dur = _dur_ms(n)
+        child = sum(_dur_ms(c) for c in n["children"])
+        out.append({"name": n["name"], "service": n["service"],
+                    "dur_ms": dur, "self_ms": max(0.0, dur - child)})
+    return out
+
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def stage_stats(traces: list[list[dict]]) -> dict[str, dict]:
+    """Aggregate many traces into per-stage (span name) statistics:
+    count, p50/p99 of total duration and of self time.  THE table a
+    perf PR gets graded against — 'where does an op's latency go' with
+    enough samples for tail behavior."""
+    per_stage: dict[str, list[dict]] = {}
+    for spans in traces:
+        for row in self_times(spans):
+            per_stage.setdefault(row["name"], []).append(row)
+    out = {}
+    for name, rows in sorted(per_stage.items()):
+        durs = sorted(r["dur_ms"] for r in rows)
+        selfs = sorted(r["self_ms"] for r in rows)
+        out[name] = {
+            "count": len(rows),
+            "p50_ms": round(_pct(durs, 0.50), 3),
+            "p99_ms": round(_pct(durs, 0.99), 3),
+            "self_p50_ms": round(_pct(selfs, 0.50), 3),
+            "self_p99_ms": round(_pct(selfs, 0.99), 3),
+        }
+    return out
+
+
+def format_stage_table(stats: dict[str, dict]) -> str:
+    """The per-stage decomposition table, render-ready."""
+    header = (f"{'stage':<24} {'count':>6} {'p50_ms':>9} {'p99_ms':>9} "
+              f"{'self_p50':>9} {'self_p99':>9}")
+    lines = [header, "-" * len(header)]
+    for name, s in stats.items():
+        lines.append(f"{name:<24} {s['count']:>6} {s['p50_ms']:>9.3f} "
+                     f"{s['p99_ms']:>9.3f} {s['self_p50_ms']:>9.3f} "
+                     f"{s['self_p99_ms']:>9.3f}")
+    return "\n".join(lines)
+
+
+def collect_from_asok(asok_dir: str, trace_id: int) -> list[dict]:
+    """Query every daemon admin socket in the directory for its local
+    spans of one trace and merge (the operator-facing collector)."""
+    from ..utils.admin_socket import admin_request
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
+        try:
+            spans = admin_request(path, "dump_tracing",
+                                  trace_id=trace_id)
+        except (OSError, RuntimeError):
+            continue  # mon sockets / dead daemons: skip, keep merging
+        if isinstance(spans, list):
+            # a mon socket answers unknown verbs with an (errno,
+            # detail) pair — also a list; only span dicts merge
+            dumps.append([s for s in spans
+                          if isinstance(s, dict) and "span_id" in s])
+    return merge_spans(dumps)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="merge per-daemon span rings for a trace id and "
+                    "print a waterfall + per-stage decomposition")
+    p.add_argument("--asok-dir", required=True,
+                   help="directory of daemon *.asok admin sockets")
+    p.add_argument("--trace-id", type=int, required=True)
+    p.add_argument("--json", action="store_true",
+                   help="emit the merged spans + stage stats as JSON")
+    args = p.parse_args(argv)
+    spans = collect_from_asok(args.asok_dir, args.trace_id)
+    if not spans:
+        print(f"no spans for trace {args.trace_id}", file=sys.stderr)
+        return 1
+    stats = stage_stats([spans])
+    if args.json:
+        print(json.dumps({"spans": spans, "stages": stats},
+                         default=str))
+    else:
+        print(waterfall(spans))
+        print()
+        print(format_stage_table(stats))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -244,6 +244,16 @@ OPTIONS: list[Option] = [
            "seconds before an in-flight op whose sub-ops never completed "
            "is failed back to the client", min=0.1, max=3600.0,
            see_also=("osd_heartbeat_grace",)),
+    Option("osd_op_complaint_time", float, 5.0, OptionLevel.ADVANCED,
+           "seconds before an op counts as slow (OpTracker complaint "
+           "threshold): in-flight ops past it surface in dump_slow_ops, "
+           "the mon's HEALTH_WARN SLOW_OPS mux and the exporter's "
+           "daemon_slow_ops", min=0.001, max=3600.0,
+           see_also=("osd_op_timeout", "osd_op_history_size")),
+    Option("osd_op_history_size", int, 256, OptionLevel.ADVANCED,
+           "completed ops retained per OSD for dump_historic_ops / "
+           "dump_historic_slow_ops", min=1, max=65536,
+           see_also=("osd_op_complaint_time",)),
     Option("osd_op_queue", str, "mclock", OptionLevel.ADVANCED,
            "op scheduler: mclock (QoS classes) or fifo (inline dispatch)",
            enum_values=("mclock", "fifo"), startup=True),

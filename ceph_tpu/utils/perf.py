@@ -14,6 +14,7 @@ import enum
 import math
 import threading
 import time
+from collections import deque
 from typing import Iterable
 
 
@@ -159,3 +160,83 @@ _GLOBAL = PerfCountersCollection()
 
 def global_perf() -> PerfCountersCollection:
     return _GLOBAL
+
+
+class KernelProfiler:
+    """Per-signature accelerator-kernel timing: compile, device-execute
+    and host-sync seconds (the slices the EC batcher's latency
+    decomposition needs — an op's encode time is window wait + XLA
+    compile + device compute + host sync, and only the first is visible
+    to the tracer without this).
+
+    Samples land twice: as TIME/HISTOGRAM counters on the process-wide
+    ``ec_kernels`` perf registry (so `perf dump` and the prometheus
+    exporter see them with zero extra wiring) and in per-signature
+    aggregates plus a bounded ring of recent COMPILE events, dumpable
+    via the OSD admin-socket verb ``dump_kernel_profile`` — compiles
+    are the rare multi-second cliffs worth individual timestamps; the
+    per-launch samples only matter in aggregate."""
+
+    RING = 64  # recent compile events retained
+
+    #: kind -> (TIME counter, pow2 histogram in microseconds)
+    KINDS = {
+        "compile": ("kernel_compile_time", "kernel_compile_us"),
+        "device": ("kernel_device_time", "kernel_device_us"),
+        "sync": ("kernel_sync_time", "kernel_sync_us"),
+    }
+
+    def __init__(self, perf: PerfCounters | None = None):
+        self._lock = threading.Lock()
+        self._sigs: dict[str, dict] = {}
+        self._compiles: deque[dict] = deque(maxlen=self.RING)
+        self._perf = perf if perf is not None \
+            else _GLOBAL.create("ec_kernels")
+        for tname, hname in self.KINDS.values():
+            self._perf.add(tname, CounterType.TIME)
+            self._perf.add(hname, CounterType.HISTOGRAM)
+
+    def note(self, kind: str, sig: str, seconds: float) -> None:
+        tname, hname = self.KINDS[kind]
+        self._perf.tinc(tname, seconds)
+        self._perf.hinc(hname, seconds * 1e6)
+        with self._lock:
+            agg = self._sigs.setdefault(sig, {
+                k: 0 for k in self.KINDS} | {
+                    f"{k}_seconds": 0.0 for k in self.KINDS} | {
+                    f"{k}_max_seconds": 0.0 for k in self.KINDS})
+            agg[kind] += 1
+            agg[f"{kind}_seconds"] += seconds
+            agg[f"{kind}_max_seconds"] = max(
+                agg[f"{kind}_max_seconds"], seconds)
+            if kind == "compile":
+                self._compiles.append({"sig": sig,
+                                       "seconds": round(seconds, 6),
+                                       "at": time.time()})
+
+    def dump(self) -> dict:
+        """The ``dump_kernel_profile`` document: per-signature
+        aggregates (counts, total/max seconds per kind) + the recent
+        compile-event ring, newest last."""
+        with self._lock:
+            sigs = {s: {k: (round(v, 6) if isinstance(v, float) else v)
+                        for k, v in agg.items()}
+                    for s, agg in sorted(self._sigs.items())}
+            return {"signatures": sigs,
+                    "recent_compiles": list(self._compiles)}
+
+
+_KERNEL_PROFILER: KernelProfiler | None = None
+_KPROF_LOCK = threading.Lock()
+
+
+def kernel_profiler() -> KernelProfiler:
+    """Process-wide kernel profiler (codecs are shared across the OSDs
+    of an in-process cluster, so the profile is too — each daemon's
+    ``dump_kernel_profile`` verb serves this one document, exactly like
+    the reference's per-host compiled-kernel caches)."""
+    global _KERNEL_PROFILER
+    with _KPROF_LOCK:
+        if _KERNEL_PROFILER is None:
+            _KERNEL_PROFILER = KernelProfiler()
+        return _KERNEL_PROFILER

@@ -62,6 +62,23 @@ class Span:
         self.finish()
 
 
+def _span_dict(s: Span, now: float) -> dict:
+    """ONE dict shape for every dump path (spans_for and the no-id
+    dump used to diverge — the id-less shape dropped start/end and
+    broke build_tree's start-sort on merged dumps).  Unfinished spans
+    keep end=0 and carry in_flight=True with the duration measured to
+    `now`, so hung ops are visible in the same tree."""
+    end = s.end
+    d = {"trace_id": s.trace_id, "span_id": s.span_id,
+         "parent_id": s.parent_id, "name": s.name,
+         "service": s.service, "start": s.start, "end": end,
+         "dur_ms": round(((end or now) - s.start) * 1000, 3),
+         "tags": dict(s.tags)}
+    if not end:
+        d["in_flight"] = True
+    return d
+
+
 class Tracer:
     """Per-entity span factory + bounded finished-span ring."""
 
@@ -73,6 +90,9 @@ class Tracer:
         self._seed = (hash(service) & 0xFFFF) << 32
         self._lock = threading.Lock()
         self._done: deque[Span] = deque(maxlen=self.KEEP)
+        # started-but-unfinished spans, so dumps can show hung ops;
+        # bounded like the ring (a leaked span must not grow it forever)
+        self._live: dict[int, Span] = {}
 
     def _next_id(self) -> int:
         return self._seed | next(self._ids)
@@ -86,32 +106,34 @@ class Tracer:
             trace_id, parent_id = int(parent[0]), int(parent[1])
         else:
             trace_id, parent_id = self._next_id(), 0
-        return Span(trace_id, self._next_id(), parent_id, name,
+        span = Span(trace_id, self._next_id(), parent_id, name,
                     self.service, tags=dict(tags), _tracer=self)
+        with self._lock:
+            self._live[span.span_id] = span
+            while len(self._live) > self.KEEP:
+                self._live.pop(next(iter(self._live)))
+        return span
 
     def _record(self, span: Span) -> None:
         with self._lock:
+            self._live.pop(span.span_id, None)
             self._done.append(span)
 
     def spans_for(self, trace_id: int) -> list[dict]:
+        now = time.time()
         with self._lock:
-            return [
-                {"trace_id": s.trace_id, "span_id": s.span_id,
-                 "parent_id": s.parent_id, "name": s.name,
-                 "service": s.service, "start": s.start, "end": s.end,
-                 "dur_ms": round((s.end - s.start) * 1000, 3),
-                 "tags": dict(s.tags)}
-                for s in self._done if s.trace_id == trace_id]
+            spans = [s for s in self._done if s.trace_id == trace_id]
+            spans += [s for s in self._live.values()
+                      if s.trace_id == trace_id]
+        return [_span_dict(s, now) for s in spans]
 
     def dump(self, trace_id: int | None = None) -> list[dict]:
         if trace_id is not None:
             return self.spans_for(trace_id)
+        now = time.time()
         with self._lock:
-            return [{"trace_id": s.trace_id, "span_id": s.span_id,
-                     "parent_id": s.parent_id, "name": s.name,
-                     "service": s.service, "dur_ms":
-                     round((s.end - s.start) * 1000, 3),
-                     "tags": dict(s.tags)} for s in self._done]
+            spans = list(self._done) + list(self._live.values())
+        return [_span_dict(s, now) for s in spans]
 
 
 def build_tree(spans: list[dict]) -> list[dict]:

@@ -89,6 +89,33 @@ class OpTracker:
             return [o.dump() for o in self._inflight.values()
                     if o.age() >= self._slow_threshold]
 
-    @property
+    def dump_historic_slow_ops(self) -> list[dict]:
+        """Completed ops whose total duration crossed the complaint
+        threshold (the reference's dump_historic_slow_ops verb — the
+        history entry's age_seconds was fixed at finish time, so it IS
+        the op's duration)."""
+        with self._lock:
+            return [d for d in self._history
+                    if d["age_seconds"] >= self._slow_threshold]
+
     def slow_op_count(self) -> int:
-        return self._slow_count
+        """Cumulative count of ops that finished past the threshold."""
+        with self._lock:
+            return self._slow_count
+
+    def slow_summary(self, max_ops: int = 3) -> dict:
+        """The health-mux feed: currently-blocked slow ops (these drive
+        — and clear — HEALTH_WARN SLOW_OPS), the cumulative count, and
+        the worst in-flight offenders by age."""
+        with self._lock:
+            slow = sorted((o for o in self._inflight.values()
+                           if o.age() >= self._slow_threshold),
+                          key=lambda o: o.start)
+            return {
+                "inflight": len(slow),
+                "total": self._slow_count,
+                "complaint_time": self._slow_threshold,
+                "worst": [{"description": o.desc,
+                           "age_seconds": round(o.age(), 3)}
+                          for o in slow[:max_ops]],
+            }

@@ -1,0 +1,142 @@
+"""trace_tool: waterfall rendering, per-stage self-time aggregation,
+and the asok collector — the analysis half of the tracing story."""
+
+import numpy as np
+
+from ceph_tpu.tools.trace_tool import (format_stage_table, merge_spans,
+                                       self_times, stage_stats,
+                                       waterfall)
+from ceph_tpu.utils.tracer import Tracer
+
+
+def _span(span_id, parent_id, name, start, end, trace_id=1, **tags):
+    return {"trace_id": trace_id, "span_id": span_id,
+            "parent_id": parent_id, "name": name, "service": "osd.0",
+            "start": start, "end": end,
+            "dur_ms": (end - start) * 1000, "tags": dict(tags)}
+
+
+def _trace(t0=100.0):
+    # op [0, 10ms] -> encode [2, 8ms] -> {wait [2, 5ms], flush [5, 8ms]}
+    return [
+        _span(1, 0, "osd-op write", t0, t0 + 0.010),
+        _span(2, 1, "ec-encode", t0 + 0.002, t0 + 0.008),
+        _span(3, 2, "ec-batch-wait", t0 + 0.002, t0 + 0.005,
+              flush_span=4),
+        _span(4, 3, "ec-flush", t0 + 0.005, t0 + 0.008, n_ops=2),
+    ]
+
+
+def test_merge_spans_dedups():
+    spans = _trace()
+    merged = merge_spans([spans, spans[:2]])
+    assert len(merged) == len(spans)
+
+
+def test_self_times_subtract_children():
+    rows = {r["name"]: r for r in self_times(_trace())}
+    assert abs(rows["osd-op write"]["dur_ms"] - 10.0) < 1e-3
+    # op self = 10 - 6 (encode child)
+    assert abs(rows["osd-op write"]["self_ms"] - 4.0) < 1e-3
+    # encode self = 6 - 3 (wait child; the flush nests under the wait)
+    assert abs(rows["ec-encode"]["self_ms"] - 3.0) < 1e-3
+    # the wait span's time is all in its flush child
+    assert abs(rows["ec-batch-wait"]["self_ms"] - 0.0) < 1e-3
+    # leaves: self == dur
+    assert abs(rows["ec-flush"]["self_ms"] - 3.0) < 1e-3
+
+
+def test_stage_stats_percentiles():
+    traces = []
+    for i in range(100):
+        t0 = 100.0 + i
+        spans = [_span(10 * i + 1, 0, "osd-op write", t0,
+                       t0 + 0.001 * (i + 1), trace_id=i + 1)]
+        traces.append(spans)
+    stats = stage_stats(traces)
+    s = stats["osd-op write"]
+    assert s["count"] == 100
+    assert 45.0 <= s["p50_ms"] <= 56.0
+    assert s["p99_ms"] >= 95.0
+    assert s["self_p50_ms"] == s["p50_ms"]  # leaves: self == total
+    table = format_stage_table(stats)
+    assert "osd-op write" in table and "p99_ms" in table.splitlines()[0]
+
+
+def test_waterfall_renders_tree_and_bars():
+    out = waterfall(_trace())
+    lines = out.splitlines()
+    assert "4 spans" in lines[0]
+    assert any("osd-op write" in ln and "#" in ln for ln in lines)
+    # children indent under parents, in start order
+    names = [ln.split("|")[0].rstrip() for ln in lines[1:]]
+    assert names[0].startswith("osd-op")
+    assert names[1].strip().startswith("ec-encode")
+    assert names[1].startswith("  ")  # indented
+    # the cross-trace fan-in tag surfaces
+    assert "->flush:" in out
+
+
+def test_waterfall_in_flight_span():
+    spans = _trace()
+    spans[3] = dict(spans[3], end=0.0, in_flight=True)
+    out = waterfall(spans)
+    assert "(in flight)" in out
+
+
+def test_stage_stats_from_real_tracer():
+    """End-to-end with real Tracer spans (the shapes bench --trace and
+    the asok collector feed in)."""
+    import time
+
+    tracer = Tracer("bench")
+    traces = []
+    for i in range(5):
+        root = tracer.start("ec-op")
+        with tracer.start("stage-a", parent=root.ctx):
+            time.sleep(0.001)
+        root.finish()
+        traces.append(tracer.spans_for(root.trace_id))
+    stats = stage_stats(traces)
+    assert stats["ec-op"]["count"] == 5
+    assert stats["stage-a"]["p50_ms"] >= 1.0
+    assert stats["ec-op"]["p50_ms"] >= stats["stage-a"]["p50_ms"]
+
+
+def test_collect_from_asok(tmp_path):
+    """The operator-facing collector: spans merged over real admin
+    sockets, dead/mon sockets skipped."""
+    from ceph_tpu.tools.trace_tool import collect_from_asok
+    from ceph_tpu.utils.admin_socket import AdminSocketServer
+
+    t_a, t_b = Tracer("osd.0"), Tracer("osd.1")
+    root = t_a.start("osd-op write")
+    child = t_b.start("sub-write", parent=root.ctx)
+    child.finish()
+    root.finish()
+
+    servers = [
+        AdminSocketServer(str(tmp_path / "osd.0.asok"),
+                          lambda prefix, _t=t_a, **kw:
+                          _t.dump(kw.get("trace_id"))),
+        AdminSocketServer(str(tmp_path / "osd.1.asok"),
+                          lambda prefix, _t=t_b, **kw:
+                          _t.dump(kw.get("trace_id"))),
+        # a verb-less daemon must not break the merge
+        AdminSocketServer(str(tmp_path / "mon.0.asok"),
+                          lambda prefix, **kw:
+                          (_ for _ in ()).throw(ValueError(prefix))),
+        # a mon command handler answers unknown verbs with an
+        # (errno, detail) LIST — must not be mistaken for spans
+        AdminSocketServer(str(tmp_path / "mon.1.asok"),
+                          lambda prefix, **kw:
+                          [-22, {"error": f"unknown {prefix!r}"}]),
+    ]
+    try:
+        spans = collect_from_asok(str(tmp_path), root.trace_id)
+    finally:
+        for s in servers:
+            s.stop()
+    assert {s["name"] for s in spans} == {"osd-op write", "sub-write"}
+    assert np.isclose(
+        sum(1 for s in spans if s["service"] == "osd.1"), 1)

@@ -102,3 +102,156 @@ def test_tracing_off_no_spans(cluster):
     assert client.tracer.dump() == []
     for osd in cluster.osds.values():
         assert osd.tracer.dump() == []
+
+
+def test_ec_encode_stage_span(cluster):
+    """The encode stage is its own span under the osd op — the anchor
+    the batcher's wait/flush children decompose (per-op path here:
+    numpy backend, so ec-encode has no batcher children but the stage
+    time is still carved out of the op)."""
+    client = cluster.client()
+    client.tracing = True
+    client.create_pool("p", kind="ec", pg_num=1,
+                       ec_profile={"plugin": "jerasure", "k": "2",
+                                   "m": "1", "backend": "numpy"})
+    client.write_full("p", "obj", b"stage" * 4096)
+    root = next(s for s in client.tracer.dump()
+                if s["name"] == "client-op write_full")
+    uniq = {s["span_id"]: s for s in
+            cluster.collect_trace(root["trace_id"]) +
+            client.tracer.spans_for(root["trace_id"])}
+    tree = build_tree(list(uniq.values()))
+    osd_ops = _find(tree, "osd-op")
+    assert osd_ops
+    encs = _find(osd_ops[-1]["children"], "ec-encode")
+    assert len(encs) == 1, encs
+    enc = encs[0]
+    assert enc["end"] >= enc["start"]
+    # the stage nests INSIDE the op span
+    osd_op = osd_ops[-1]
+    assert enc["start"] >= osd_op["start"]
+
+
+def test_dump_includes_in_flight_spans():
+    """Tracer.dump() without a trace id now shares spans_for's shape
+    (start/end present — build_tree's start-sort works on both) and
+    surfaces unfinished spans tagged in_flight, so hung ops are
+    visible."""
+    t = Tracer("svc")
+    root = t.start("op")
+    child = t.start("hung-stage", parent=root.ctx)
+    root.finish()
+    dumped = t.dump()
+    assert {s["name"] for s in dumped} == {"op", "hung-stage"}
+    for s in dumped:
+        assert "start" in s and "end" in s  # one shape, both paths
+    hung = next(s for s in dumped if s["name"] == "hung-stage")
+    assert hung["in_flight"] and hung["end"] == 0
+    assert hung["dur_ms"] >= 0
+    done = next(s for s in dumped if s["name"] == "op")
+    assert "in_flight" not in done and done["end"] >= done["start"]
+    # the in-flight span participates in tree assembly
+    tree = build_tree(t.spans_for(root.trace_id))
+    assert tree[0]["name"] == "op"
+    assert tree[0]["children"][0]["name"] == "hung-stage"
+    child.finish()
+    assert all("in_flight" not in s for s in t.dump())
+
+
+def test_batched_ec_write_trace_vertical(cluster):
+    """The full vertical of the decomposition: a traced write through a
+    jax-backed pool with batching forced on yields a collector-merged
+    tree where the batcher stages — ec-batch-wait and the flush it
+    cross-tags — sit under the op's ec-encode span."""
+    client = cluster.client()
+    client.tracing = True
+    client.create_pool("p", kind="ec", pg_num=1,
+                       ec_profile={"plugin": "tpu", "k": "2", "m": "1",
+                                   "backend": "jax", "batch": "on"})
+    client.write_full("p", "obj", b"deep" * 4096)
+    root = next(s for s in client.tracer.dump()
+                if s["name"] == "client-op write_full")
+    uniq = {s["span_id"]: s for s in
+            cluster.collect_trace(root["trace_id"]) +
+            client.tracer.spans_for(root["trace_id"])}
+    tree = build_tree(list(uniq.values()))
+    encs = _find(tree, "ec-encode")
+    assert len(encs) == 1, encs
+    enc = encs[0]
+    waits = _find(enc["children"], "ec-batch-wait")
+    assert len(waits) == 1, "the op's slot in the folded launch"
+    wait = waits[0]
+    flushes = _find(enc["children"], "ec-flush")
+    assert len(flushes) == 1, "this op led its launch: flush in-trace"
+    fl = flushes[0]
+    assert wait["tags"]["flush_span"] == fl["span_id"]
+    assert fl["tags"]["n_ops"] >= 1
+    assert fl["tags"]["n_shard"] >= 1
+    assert 0.0 <= fl["tags"]["pad_waste"] < 1.0
+    # the stages account for the encode time: wait+flush nest inside
+    # ec-encode and cover (almost) all of it
+    assert enc["start"] <= wait["start"] and fl["end"] <= enc["end"]
+    stage_ms = (wait["dur_ms"] + fl["dur_ms"])
+    assert stage_ms <= enc["dur_ms"] * 1.05 + 1.0
+    assert stage_ms >= enc["dur_ms"] * 0.5, (stage_ms, enc["dur_ms"])
+
+
+def test_batcher_coalesced_ops_trace_spans():
+    """The tentpole's batcher seam: coalesced ops each get an
+    ec-batch-wait span, the flush ONE shared ec-flush span with the
+    launch-shape tags, and the wait spans cross-tag the flush span id
+    so the collector reconstructs the fan-in across traces."""
+    import threading
+    import numpy as np
+    from ceph_tpu import ec
+    from ceph_tpu.ec.batcher import ECBatcher
+
+    codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "jax"})
+    tracer = Tracer("osd.7")
+    b = ECBatcher(window_us=1_500_000)  # CI-safe coalescing window
+    rng = np.random.default_rng(3)
+    pays = [rng.integers(0, 256, (4, 1000), dtype=np.uint8)
+            for _ in range(2)]
+    roots = [tracer.start("op", i=i) for i in range(2)]
+    errors = []
+
+    def writer(i):
+        try:
+            b.encode(codec, pays[i], trace=(tracer, roots[i].ctx))
+            roots[i].finish()
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t0 = threading.Thread(target=writer, args=(0,))
+    t1 = threading.Thread(target=writer, args=(1,))
+    t0.start()
+    import time as _time
+    _time.sleep(0.1)  # let the leader enter its window
+    t1.start()
+    t0.join()
+    t1.join()
+    assert not errors, errors
+    waits, flushes = [], []
+    for r in roots:
+        spans = tracer.spans_for(r.trace_id)
+        waits += [s for s in spans if s["name"] == "ec-batch-wait"]
+        flushes += [s for s in spans if s["name"] == "ec-flush"]
+    assert len(waits) == 2, waits
+    assert len(flushes) == 1, "one SHARED flush span per launch"
+    fl = flushes[0]
+    assert fl["tags"]["n_ops"] == 2
+    assert fl["tags"]["reason"] == "window"
+    assert fl["tags"]["bucket"] == 1024  # bucket_len(1000)
+    assert fl["tags"]["n_shard"] == 1
+    # 2 ops of 1000 cols in a pow2-padded 2x1024 fold
+    assert abs(fl["tags"]["pad_waste"] - (1 - 2000 / 2048)) < 1e-4
+    assert fl["tags"]["sig"].startswith("enc/k4m2")
+    for w in waits:
+        assert w["tags"]["flush_span"] == fl["span_id"]
+        assert w["tags"]["flush_reason"] == "window"
+        assert w["end"] >= w["start"]
+    # the leader's trace carries the flush as a child of its wait span
+    lead_tree = build_tree(tracer.spans_for(fl["trace_id"]))
+    lead_waits = _find(lead_tree, "ec-batch-wait")
+    assert any(c["name"] == "ec-flush"
+               for w in lead_waits for c in w["children"])

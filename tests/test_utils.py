@@ -271,7 +271,22 @@ def test_op_tracker():
     assert hist and hist[0]["description"] == "client write"
     assert [e["event"] for e in hist[0]["events"]][:2] == [
         "initiated", "queued"]
-    assert tr.slow_op_count == 1
+    assert tr.slow_op_count() == 1
+    slow_hist = tr.dump_historic_slow_ops()
+    assert len(slow_hist) == 1
+    assert slow_hist[0]["description"] == "client write"
+    # the summary feed: nothing blocked NOW (the slow op finished), but
+    # the cumulative count remembers it
+    summary = tr.slow_summary()
+    assert summary["inflight"] == 0 and summary["total"] == 1
+    assert summary["worst"] == []
+    # an in-flight op past the threshold shows up as a worst offender
+    hung = tr.create("hung read")
+    time.sleep(0.02)
+    summary = tr.slow_summary()
+    assert summary["inflight"] == 1
+    assert summary["worst"][0]["description"] == "hung read"
+    hung.finish()
 
 
 def test_interval_map_buffer_values():
