@@ -308,6 +308,63 @@ def ec_batch_bench() -> int:
     return 0 if verified else 1
 
 
+def _recovery_progress_leg() -> dict:
+    """`--ec-recovery --progress`: drive a real MiniCluster through an
+    OSD kill + fresh-store revive and assert the cluster-visible
+    recovery story — the mgr progress item APPEARS, its percent
+    advances MONOTONICALLY to 100, and it CLEARS once the storm drains
+    (the acceptance face of the event-journal/progress layer; the
+    storm benches above only measure the data plane)."""
+    from ceph_tpu.tools.vstart import MiniCluster
+    from ceph_tpu.utils.config import default_config
+
+    cfg = default_config()
+    cfg.apply_dict({"osd_heartbeat_interval": 0.05,
+                    "osd_heartbeat_grace": 0.5,
+                    "ec_backend": "native",
+                    "ms_dispatch_workers": 2,
+                    "osd_op_num_shards": 2,
+                    # stretch the storm so the progress samples catch
+                    # intermediate percents, and report every op
+                    "osd_recovery_sleep": 0.005,
+                    "osd_recovery_max_active": 2,
+                    "osd_recovery_progress_interval": 0.0,
+                    "mgr_progress_linger": 1.0})
+    c = MiniCluster(n_osds=3, cfg=cfg).start()
+    seen: dict[str, list] = {}
+    cleared = False
+    try:
+        cl = c.client()
+        cl.create_pool("p", kind="ec", pg_num=2,
+                       ec_profile={"plugin": "jerasure", "k": "2",
+                                   "m": "1", "backend": "numpy"})
+        for i in range(24):
+            cl.write_full("p", f"o{i}", b"r" * 4096)
+        c.kill_osd(2)          # marked down -> map epoch, degradation
+        c.settle(0.3)
+        c.revive_osd(2)        # FRESH store: every shard rebuilds
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            for it in c.mon.progress.items():
+                seen.setdefault(it["id"], []).append(it["percent"])
+            if seen and not c.mon.progress.active() \
+                    and not c.mon.progress.percent_gauges():
+                cleared = True  # linger expired too: the gauge is GONE
+                break
+            time.sleep(0.02)
+    finally:
+        c.stop()
+    appeared = bool(seen)
+    monotonic = all(all(a <= b for a, b in zip(ps, ps[1:]))
+                    for ps in seen.values())
+    reached_100 = any(ps and ps[-1] == 100.0 for ps in seen.values())
+    return {"ok": appeared and monotonic and reached_100 and cleared,
+            "appeared": appeared, "monotonic": monotonic,
+            "reached_100": reached_100, "cleared": cleared,
+            "items": {k: {"samples": len(ps), "max_percent": max(ps)}
+                      for k, ps in seen.items()}}
+
+
 def ec_recovery_bench() -> int:
     """`--ec-recovery` mode: the PG-recovery-storm scenario — one OSD's
     shards drop and a burst of stripes decode-rebuilds through the
@@ -426,6 +483,10 @@ def ec_recovery_bench() -> int:
         }
     verified = all(v["ok"] for v in results.values()) and \
         all(v["ok"] for v in sweep.values())
+    progress = None
+    if "--progress" in sys.argv[1:]:
+        progress = _recovery_progress_leg()
+        verified = verified and progress["ok"]
     backend = "cpu" if on_cpu else "dev"
     gbps_b = results["batched"]["gbps"]
     gbps_u = results["unbatched"]["gbps"]
@@ -442,6 +503,7 @@ def ec_recovery_bench() -> int:
         "shard_devices": n_dev,
         "scenarios": results,
         "digest_verified": verified,
+        **({"progress": progress} if progress is not None else {}),
     }))
     return 0 if verified else 1
 

@@ -176,11 +176,18 @@ class ECBatcher:
     ADAPT_SHRINK = 0.7
     PROBE_EVERY = 16
 
+    #: adaptive-window resizes quieter than this ratio (vs the last
+    #: journaled value) and repeat fall-through notes inside the
+    #: debounce window stay out of the event journal — the journal
+    #: narrates regime changes, not every controller step
+    EVENT_RESIZE_RATIO = 1.5
+    EVENT_DEBOUNCE_S = 1.0
+
     def __init__(self, *, window_us: float = 500.0,
                  max_bytes: int = 8 << 20, perf=None,
                  adaptive: bool = False, target_ops: float = 4.0,
                  window_min_us: float = 50.0,
-                 window_max_us: float = 4000.0):
+                 window_max_us: float = 4000.0, events=None):
         self.window_us = float(window_us)
         self.max_bytes = int(max_bytes)
         # adaptive coalescing window: resize window_us from the observed
@@ -208,6 +215,11 @@ class ECBatcher:
                       "sharded_launches": 0,
                       FLUSH_WINDOW: 0, FLUSH_SIZE: 0, FLUSH_IDLE: 0}
         self._perf = perf
+        # optional event journal (utils/event_log.EventLog): adaptive
+        # window regime changes + sharded-pool fall-throughs, debounced
+        self._events = events
+        self._event_window = self.window_us
+        self._fallthrough_at = 0.0
         if perf is not None:
             perf.add_many(COUNTERS)
             from ..utils.perf import CounterType
@@ -467,6 +479,23 @@ class ECBatcher:
                 w = w * self.ADAPT_SHRINK
             w = min(self.window_max_us, max(self.window_min_us, w))
             self.window_us = w
+            # regime-change journaling INSIDE the cv: the decision must
+            # be atomic with the _event_window check-and-set (two
+            # racing flushers would double-journal one resize) AND the
+            # emit must happen in decision order, or concurrent resizes
+            # journal with an incoherent prev_us chain.  EventLog.emit
+            # is an O(1) ring append under its own leaf lock — holding
+            # the cv over it cannot stall a flush.
+            if self._events is not None and (
+                    w >= self._event_window * self.EVENT_RESIZE_RATIO
+                    or w <= self._event_window / self.EVENT_RESIZE_RATIO):
+                self._events.emit(
+                    "batch",
+                    f"ec batch window resized to {w:.0f}us",
+                    window_us=round(w, 1),
+                    prev_us=round(self._event_window, 1),
+                    ops_ewma=round(self._ops_ewma, 2))
+                self._event_window = w
         if self._perf is not None:
             # the CLAMPED value: the gauge must report the window the
             # batcher actually uses, not the controller's raw estimate
@@ -576,6 +605,22 @@ class ECBatcher:
                     o.parity = parity[:, i * L0: (i + 1) * L0].copy()
                     o.csums = csums[:, i].copy()
             else:
+                if (self._events is not None and sig[4] and ns > 1):
+                    # a checksummed burst on a sharded pool skips the
+                    # fused encode+CRC op (its CRC plan is single-
+                    # device): parity fans out, csums fall through to
+                    # the CPU sweep — journal it (debounced) so the
+                    # operator sees WHY a sharded pool's csum bursts
+                    # trail the single-device fused numbers
+                    now = time.monotonic()
+                    if now - self._fallthrough_at > self.EVENT_DEBOUNCE_S:
+                        self._fallthrough_at = now
+                        self._events.emit(
+                            "batch",
+                            "sharded flush fell through the fused "
+                            "csum path (CPU CRC sweep)",
+                            sig=self._sig_tag(sig), n_ops=len(ops),
+                            n_shard=ns)
                 # mesh fan-out: the shard_pad stripe count splits sum L
                 # into whole per-device column slices (still a bounded
                 # shape set: pow2 rounded to the fan-out)

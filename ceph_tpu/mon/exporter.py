@@ -79,8 +79,19 @@ def render_metrics(mon=None) -> str:
                  {"daemon": f"osd.{i}"},
                  help_="ops currently slower than "
                        "osd_op_complaint_time", typ="gauge")
+        # progress gauges (the mgr progress module's exporter face):
+        # one series per derived item, present while the item is live
+        # (or lingering complete), GONE once it clears
+        prog = getattr(mon, "progress", None)
+        if prog is not None:
+            for item_id, pct in sorted(prog.percent_gauges().items()):
+                emit("progress_percent", pct, {"item": item_id},
+                     help_="recovery/backfill progress percent "
+                           "(mgr progress item)", typ="gauge")
     # per-daemon perf counters (the MMgrReport/DaemonMetricCollector feed)
-    for daemon, counters in global_perf().dump().items():
+    for daemon, reg in sorted(global_perf().registries().items()):
+        counters = reg.dump()
+        gauges = reg.gauge_names()
         for cname, val in counters.items():
             base = f"daemon_{_sanitize(cname)}"
             if isinstance(val, dict):
@@ -90,19 +101,33 @@ def render_metrics(mon=None) -> str:
                              {"daemon": daemon},
                              help_=f"perf counter {cname} {sub}",
                              typ="counter")
-                # pow-2 histograms (e.g. the EC batcher's ops-per-launch
-                # distribution): one labeled series per occupied bucket,
-                # bucket b covering values in [2^(b-1), 2^b)
-                for b, n in sorted(val.get("buckets_pow2", {}).items()):
-                    emit(f"{base}_bucket", n,
-                         {"daemon": daemon, "pow2": b},
-                         help_=f"perf histogram {cname} pow-2 buckets",
+                if "buckets_pow2" in val:
+                    # pow-2 histograms rendered as CUMULATIVE le-labeled
+                    # buckets (bucket b covers [2^(b-1), 2^b), so its
+                    # upper bound is 2^b) + the +Inf total — the shape
+                    # histogram_quantile() consumes, which is what the
+                    # prom_rules.py recording rules are built on.  The
+                    # +Inf series is emitted even for an empty histogram
+                    # so the metric NAME exists in every scrape (the
+                    # recording rules reference a stable schema).
+                    acc = 0
+                    for b, n in sorted(val["buckets_pow2"].items()):
+                        acc += n
+                        emit(f"{base}_bucket", acc,
+                             {"daemon": daemon, "le": str(2 ** b)},
+                             help_=f"perf histogram {cname} cumulative "
+                                   "pow-2 buckets",
+                             typ="counter")
+                    emit(f"{base}_bucket", val.get("count", acc),
+                         {"daemon": daemon, "le": "+Inf"},
+                         help_=f"perf histogram {cname} cumulative "
+                               "pow-2 buckets",
                          typ="counter")
             elif isinstance(val, (int, float)):
-                # settable gauges (the adaptive EC-batch window, any
-                # future *_now values) must not be typed counter —
-                # rate() over a value that moves both ways is nonsense
-                typ = "gauge" if cname.endswith("_now") else "counter"
+                # settable (U64) counters move both ways: typing them
+                # counter would make rate() nonsense — the registry's
+                # own type decides, not a naming convention
+                typ = "gauge" if cname in gauges else "counter"
                 emit(base, val, {"daemon": daemon},
                      help_=f"perf counter {cname}", typ=typ)
     lines: list[str] = []

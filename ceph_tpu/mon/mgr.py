@@ -29,6 +29,177 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 _MODULES: dict[str, type] = {}
 
 
+class ProgressTracker:
+    """Derives operator-facing progress items from the recovery channel
+    of the cluster event journal (the pybind/mgr/progress module role:
+    `ceph progress` — "Recovery pg 1.3a: 40% (ETA 12s)").
+
+    One item per recovery STORM — keyed (daemon, pg, start_ts), so a
+    later wave on the same PG opens a fresh item and every item's
+    percent is monotonic by construction.  recovery_start opens it,
+    recovery_progress updates done/total (percent, an ops/s EWMA and
+    the ETA derive from the deltas), recovery_done completes it at 100;
+    completed items linger (visible in `progress ls` and the
+    ``progress_percent`` gauge) for ``linger`` seconds, then drop — the
+    "clears when the storm drains" contract.
+
+    The monitor owns one instance and feeds it as stats reports land
+    (under the mon lock); readers (mgr digest, exporter scrape threads)
+    come from elsewhere, so state is guarded by its own lock."""
+
+    RATE_ALPHA = 0.3  # EWMA weight of the newest ops/s sample
+    KEEP_DONE = 64    # completed items retained (pre-linger-expiry cap)
+
+    def __init__(self, linger: float = 5.0, stale_after: float = 60.0):
+        self.linger = float(linger)
+        # an active item whose daemon died mid-storm never sends
+        # recovery_done: past this silence it is marked stale-complete
+        # so it lingers and CLEARS instead of freezing sub-100 forever
+        # (the reference progress module's staleness timeout)
+        self.stale_after = float(stale_after)
+        self._lock = threading.Lock()
+        self._active: dict[tuple, dict] = {}
+        self._done: list[dict] = []
+        self._count = 0  # item-id sequence: one id per STORM, ever
+
+    @staticmethod
+    def _key(ev: dict) -> tuple:
+        f = ev.get("fields") or {}
+        return (ev.get("daemon", "?"), f.get("pg", "?"),
+                round(float(f.get("start_ts") or ev.get("ts") or 0), 6))
+
+    def on_event(self, ev: dict) -> None:
+        """Consume one recovery-channel journal event (other channels,
+        unrecognized recovery events, and events with junk counters are
+        ignored — a malformed report must never take the tracker down
+        with it)."""
+        try:
+            self._on_event(ev)
+        except (TypeError, ValueError, KeyError):
+            pass
+
+    def _on_event(self, ev: dict) -> None:
+        f = ev.get("fields") or {}
+        kind = f.get("event")
+        if kind not in ("recovery_start", "recovery_progress",
+                        "recovery_done"):
+            return
+        key = self._key(ev)
+        now = float(ev.get("ts") or time.time())
+        with self._lock:
+            it = self._active.get(key)
+            if it is None:
+                if kind == "recovery_done" or key in \
+                        {i["key"] for i in self._done}:
+                    # a straggling duplicate of a completed storm —
+                    # never resurrect it as a 0% item
+                    it = next((i for i in self._done
+                               if i["key"] == key), None)
+                    if it is None and kind != "recovery_done":
+                        return
+                if it is None:
+                    self._count += 1
+                    # the storm ordinal keeps ids UNIQUE across waves:
+                    # a later storm on the same PG is a fresh item, and
+                    # its gauge series must not splice into (and zigzag
+                    # under) the finished one's
+                    it = {"key": key,
+                          "id": f"recovery/{f.get('pg', '?')}/"
+                                f"{ev.get('daemon', '?')}"
+                                f"#{self._count}",
+                          "message": f"Recovery pg {f.get('pg', '?')} "
+                                     f"({ev.get('daemon', '?')})",
+                          "started": now, "updated": now,
+                          "done": 0, "total": 0, "percent": 0.0,
+                          "rate_eps": 0.0, "eta_seconds": None,
+                          "completed": None}
+                    self._active[key] = it
+            done = int(f.get("done", it["done"]))
+            total = int(f.get("total", it["total"]))
+            # journal delivery is at-least-once orderly per daemon, but
+            # belt-and-braces: progress never walks backwards
+            it["total"] = max(it["total"], total)
+            if done > it["done"]:
+                dt = max(now - it["updated"], 1e-6)
+                inst = (done - it["done"]) / dt
+                a = self.RATE_ALPHA
+                it["rate_eps"] = (a * inst + (1 - a) * it["rate_eps"]
+                                  if it["rate_eps"] else inst)
+                it["done"] = done
+            it["updated"] = now
+            if it["total"]:
+                it["percent"] = max(
+                    it["percent"],
+                    round(100.0 * it["done"] / it["total"], 1))
+            remaining = it["total"] - it["done"]
+            it["eta_seconds"] = (round(remaining / it["rate_eps"], 1)
+                                 if it["rate_eps"] > 0 and remaining > 0
+                                 else (0.0 if not remaining else None))
+            if kind == "recovery_done" and it["completed"] is None:
+                it["percent"] = 100.0
+                it["eta_seconds"] = 0.0
+                it["completed"] = time.time()
+                self._active.pop(key, None)
+                self._done.append(it)
+                del self._done[: max(0,
+                                     len(self._done) - self.KEEP_DONE)]
+
+    def _gc_locked(self, now: float) -> None:
+        for key, it in list(self._active.items()):
+            if now - it["updated"] > self.stale_after:
+                it["completed"] = now
+                it["stale"] = True
+                it["eta_seconds"] = None
+                self._active.pop(key, None)
+                self._done.append(it)
+        del self._done[: max(0, len(self._done) - self.KEEP_DONE)]
+        self._done = [i for i in self._done
+                      if now - i["completed"] <= self.linger]
+
+    @staticmethod
+    def _public(it: dict) -> dict:
+        return {k: v for k, v in it.items() if k != "key"}
+
+    def active(self) -> list[dict]:
+        # GC here too: the mon `status` verb serves this directly, and
+        # without the sweep a daemon that died mid-storm would show a
+        # frozen sub-100 item in status forever (nothing else may be
+        # polling items()/percent_gauges() to trigger it)
+        now = time.time()
+        with self._lock:
+            self._gc_locked(now)
+            return [self._public(i) for i in self._active.values()]
+
+    def items(self) -> list[dict]:
+        """Active items plus completed ones still inside the linger
+        window (the `progress ls` document)."""
+        now = time.time()
+        with self._lock:
+            self._gc_locked(now)
+            return ([self._public(i) for i in self._active.values()]
+                    + [self._public(i) for i in self._done])
+
+    def ls(self) -> dict:
+        """The active/completed split BOTH verb surfaces serve (the mon
+        `progress` command and the mgr progress module)."""
+        items = self.items()
+        return {"active": [i for i in items if i["completed"] is None],
+                "completed": [i for i in items
+                              if i["completed"] is not None]}
+
+    def percent_gauges(self) -> dict[str, float]:
+        """item id -> percent for the exporter's ``progress_percent``
+        gauge: active + lingering-completed items; an item past its
+        linger stops being exported — the gauge CLEARS."""
+        now = time.time()
+        with self._lock:
+            self._gc_locked(now)
+            out = {}
+            for i in list(self._active.values()) + self._done:
+                out[i["id"]] = i["percent"]
+            return out
+
+
 def register_module(name: str):
     def deco(cls):
         cls.NAME = name
@@ -116,6 +287,7 @@ class StatusModule(MgrModule):
             # same health mux `ceph status` serves: OSD_DOWN + SLOW_OPS
             checks = self.mgr.mon._health_checks(
                 self.mgr.mon.osdmap.up_osds())
+        progress = getattr(self.mgr.mon, "progress", None)
         return {
             "epoch": epoch,
             "osds": {"total": len(osds),
@@ -126,6 +298,10 @@ class StatusModule(MgrModule):
             "bytes_used": used,
             "health": "HEALTH_WARN" if checks else "HEALTH_OK",
             "checks": checks,
+            # the progress module's face in `ceph status` (the
+            # "progress:" block): derived recovery items, percent+ETA
+            "progress": (progress.items() if progress is not None
+                         else []),
         }
 
 
@@ -146,6 +322,21 @@ class PrometheusModule(MgrModule):
     def shutdown(self) -> None:
         if self._exporter is not None:
             self._exporter.stop()
+
+
+@register_module("progress")
+class ProgressModule(MgrModule):
+    """Surface the monitor's ProgressTracker (the pybind/mgr/progress
+    command face): the derivation itself runs on the mon as recovery
+    journal events land — this module is the operator verb surface."""
+
+    def command(self, cmd: str, **kw):
+        tracker = getattr(self.mgr.mon, "progress", None)
+        if tracker is None:
+            return {"active": [], "completed": []}
+        if cmd in ("ls", "status"):
+            return tracker.ls()
+        raise KeyError(cmd)
 
 
 @register_module("balancer")

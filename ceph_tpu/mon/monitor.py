@@ -50,8 +50,10 @@ from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..msg.wire import decode_frame, encode_frame
 from ..ops import native
 from ..utils.config import Config, default_config
+from ..utils.event_log import ClusterLog, make_event
 from ..utils.log import dout
 from .maps import OSDMap, PoolSpec
+from .mgr import ProgressTracker
 
 _FORWARDED = (MOSDBoot, MMonCommand, MFailureReport, MStatsReport,
               MOSDPGTemp)
@@ -470,6 +472,22 @@ class MonitorLite(Dispatcher):
         self._boot_times: dict[int, float] = {}
         self._lock = threading.RLock()
         self._osd_stats: dict[int, dict] = {}
+        # cluster event journal (LogMonitor role): daemon journals ride
+        # the stats reports and merge here; the mon adds its own map /
+        # lifecycle / health-transition events.  Served by the
+        # `dump_cluster_log` verb, tailed by tools/event_tool.py.
+        self.cluster_log = ClusterLog(
+            keep=self.cfg["mon_cluster_log_size"])
+        # progress items derived from the recovery event channel (the
+        # mgr progress module's engine lives monitor-side so the
+        # exporter and `status` see it without a running MgrDaemon)
+        self.progress = ProgressTracker(
+            linger=self.cfg["mgr_progress_linger"])
+        self._last_health: dict[str, str] = {}  # check -> severity
+        # per-daemon highest journal lseq merged: daemons RE-SHIP their
+        # pending window with every report (silent wire drops make a
+        # delivery signal untrustworthy), so the log dedupes here
+        self._event_lseq: dict[int, int] = {}
         # quorum state (single mon = permanent leader, zero overhead).
         # term + vote resume from the durable store: a restarted mon
         # must not vote twice in a term it already voted in
@@ -1100,6 +1118,8 @@ class MonitorLite(Dispatcher):
             inc_b, base = None, None
         self._prev_map = self.osdmap.deepcopy()
         dout("mon", 3)("epoch %d: %s", v, desc)
+        self._clog("osdmap", f"osdmap e{v}: {desc}", epoch=v)
+        self._note_health()
         if not self.peers:
             self.store.commit("osdmap", raw, desc)
             self._publish_map(v, base, inc_b, raw)
@@ -1204,6 +1224,11 @@ class MonitorLite(Dispatcher):
             # subscribe the ENTITY, not its transport address (addr is a
             # host:port on wire transports)
             self._subscribers.add(f"osd.{m.osd_id}")
+            # a rebooted daemon restarts its journal sequence at 1: the
+            # dedup cursor must follow or every new event looks old
+            self._event_lseq.pop(m.osd_id, None)
+            self._clog("cluster", f"osd.{m.osd_id} boot (host "
+                                  f"{m.host})", osd=m.osd_id)
             self._commit_map(f"osd.{m.osd_id} boot")
 
     def _handle_subscribe(self, conn, m: MMonSubscribe) -> None:
@@ -1300,6 +1325,10 @@ class MonitorLite(Dispatcher):
                 del self._failure_reports[m.target]
                 self._osd_stats.pop(m.target, None)  # no stale usage
                 self._subscribers.discard(f"osd.{m.target}")
+                self._clog("cluster",
+                           f"osd.{m.target} marked down "
+                           f"({distinct} reporters)", severity="warn",
+                           osd=m.target, reporters=distinct)
                 self._commit_map(
                     f"osd.{m.target} down ({distinct} reporters)")
 
@@ -1308,7 +1337,8 @@ class MonitorLite(Dispatcher):
     # verbs need full caps (MonCap "allow *" semantics), every other
     # mutation needs w
     _READONLY_CMDS = frozenset({"status", "osd dump", "osd stats",
-                                "auth list"})
+                                "auth list", "dump_cluster_log",
+                                "progress"})
 
     def _mon_cmd_denied(self, m: MMonCommand):
         """(errno, detail) if the command must be refused, else None.
@@ -1415,6 +1445,9 @@ class MonitorLite(Dispatcher):
                 # re-boots (a dead host's stale addr must not stall
                 # future commits behind connect timeouts)
                 self._subscribers.discard(f"osd.{target}")
+                self._clog("cluster", f"osd.{target} marked down "
+                                      f"(operator)", severity="warn",
+                           osd=target)
                 self._commit_map(f"osd.{target} down (forced)")
             return 0, {}
         if prefix == "osd out":
@@ -1566,10 +1599,20 @@ class MonitorLite(Dispatcher):
                                   "role": self._role},
                        "health": ("HEALTH_WARN" if checks
                                   else "HEALTH_OK"),
-                       "checks": checks}
+                       "checks": checks,
+                       "progress": self.progress.active()}
         if prefix == "osd stats":
             return 0, {f"osd.{i}": dict(s)
                        for i, s in sorted(self._osd_stats.items())}
+        if prefix == "dump_cluster_log":
+            # the merged journal (`ceph log last` / `ceph -W` source):
+            # channel filter + since-seq cursor for follow mode
+            return 0, self.cluster_log.dump(
+                channel=cmd.get("channel"),
+                since=int(cmd.get("since", 0) or 0),
+                max_events=int(cmd.get("max", 0) or 0))
+        if prefix == "progress":
+            return 0, self.progress.ls()
         if prefix.startswith("auth"):
             return self._auth_command(prefix, cmd)
         return -22, {"error": f"unknown command {prefix!r}"}
@@ -1705,9 +1748,58 @@ class MonitorLite(Dispatcher):
                 "detail": slow_daemons}
         return checks
 
+    def _clog(self, channel: str, message: str, severity: str = "info",
+              **fields) -> None:
+        """The mon's own journal entries (map commits, daemon
+        lifecycle, health transitions) go straight into the merged
+        cluster log — no shipping hop."""
+        self.cluster_log.append(
+            make_event(self.name, channel, message, severity, **fields))
+
+    def _note_health(self) -> None:
+        """Journal health-check TRANSITIONS (raised / cleared) — the
+        cluster-log narrative of what `ceph status` only shows as
+        current state.  Caller holds _lock."""
+        checks = self._health_checks(self.osdmap.up_osds())
+        cur = {name: c.get("severity", "HEALTH_WARN")
+               for name, c in checks.items()}
+        for name, sev in cur.items():
+            if self._last_health.get(name) != sev:
+                self._clog("health",
+                           f"{sev} {name}: "
+                           f"{checks[name].get('summary', '')}",
+                           severity="warn", check=name, status=sev)
+        for name in self._last_health:
+            if name not in cur:
+                self._clog("health", f"{name} cleared",
+                           check=name, status="HEALTH_OK")
+        self._last_health = cur
+
     def _handle_stats(self, conn, m: MStatsReport) -> None:
+        stats = dict(m.stats)
+        # journal entries rode along (LogClient piggyback): merge them
+        # into the cluster log IN ORDER and feed the recovery channel
+        # to the progress tracker; they must not linger in _osd_stats
+        # (the `osd stats` / aggregation surfaces are numeric)
+        events = stats.pop("events", None) or []
         with self._lock:
-            self._osd_stats[m.osd_id] = dict(m.stats)
+            self._osd_stats[m.osd_id] = stats
+            seen = self._event_lseq.get(m.osd_id, 0)
+            for ev in events:
+                if not isinstance(ev, dict):
+                    continue
+                lseq = ev.get("lseq")
+                if isinstance(lseq, int):
+                    if lseq <= seen:
+                        continue  # re-shipped window: already merged
+                    seen = lseq
+                # feed the NORMALIZED copy append() returns — the raw
+                # report dict may carry junk a tracker should not see
+                norm = self.cluster_log.append(ev)
+                if norm["channel"] == "recovery":
+                    self.progress.on_event(norm)
+            self._event_lseq[m.osd_id] = seen
+            self._note_health()
 
     def _pool_by_name(self, name: str):
         for p in self.osdmap.pools.values():

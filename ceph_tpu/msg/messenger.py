@@ -27,7 +27,16 @@ import zlib
 from dataclasses import dataclass, field
 
 from ..utils.log import dout
+from ..utils.perf import CounterType, global_perf
 from ..utils.throttle import Throttle
+
+#: perf counters every messenger registers (schema is stable even for
+#: idle endpoints, so scrapes see one shape across the cluster)
+MSG_COUNTERS = ("msg_dispatched", "msg_drop_wire",
+                "msg_drop_backpressure")
+MSG_HISTOGRAMS = ("msg_dispatch_us",)
+MSG_TIMES = ("msg_throttle_wait_time",)
+MSG_GAUGES = ("msg_queue_depth",)
 
 
 @dataclass
@@ -82,7 +91,30 @@ class Network:
         self.latency = 0.0
         self._partitions: set[frozenset[str]] = set()
         self._rng = random.Random(seed)
+        # drop accounting, split by cause: a lossy-wire drop (fault
+        # injection / partition) and a receive-side backpressure drop
+        # (lossy server past its message cap) are different operator
+        # stories — `dropped` stays as the conflated total for the
+        # thrasher tests that only care that SOMETHING was dropped
         self.dropped = 0
+        self.dropped_wire = 0
+        self.dropped_backpressure = 0
+
+    def note_wire_drop(self, dst: str) -> None:
+        """Account one lossy-wire drop (transport-level _blocked hit),
+        attributed to the destination endpoint's perf registry when it
+        is local."""
+        self.dropped += 1
+        self.dropped_wire += 1
+        target = self.lookup(dst)
+        if target is not None:
+            target.perf.inc("msg_drop_wire")
+
+    def note_backpressure_drop(self) -> None:
+        """Account one receive-side backpressure drop (the messenger
+        increments its own perf counter itself)."""
+        self.dropped += 1
+        self.dropped_backpressure += 1
 
     # -- registry ----------------------------------------------------------
     def register(self, m: "Messenger") -> None:
@@ -144,7 +176,7 @@ class LocalNetwork(Network):
         if target is None or target._stopped:
             return False
         if self._blocked(src, dst):
-            self.dropped += 1
+            self.note_wire_drop(dst)
             dout("msg", 10)("dropped %s -> %s: %s", src, dst,
                             type(msg).__name__)
             return True  # silently dropped, like a lossy wire
@@ -180,6 +212,20 @@ class Messenger:
         # per-worker dispatch counters (perf evidence that connections
         # actually spread across the loops)
         self.worker_dispatched = [0] * self.workers
+        # messenger perf registry (the AsyncMessenger perf counters
+        # role, src/msg/async/AsyncMessenger.cc l_msgr_*): dispatch
+        # count + pow2-µs latency histogram, throttle-wait seconds,
+        # drops split by cause, live queue depth — per endpoint, under
+        # the process-wide collection so `perf dump` and the exporter
+        # see them with zero extra wiring
+        self.perf = global_perf().create(f"msg.{name}")
+        self.perf.add_many(MSG_COUNTERS)
+        for h in MSG_HISTOGRAMS:
+            self.perf.add(h, CounterType.HISTOGRAM)
+        for t in MSG_TIMES:
+            self.perf.add(t, CounterType.TIME)
+        for g in MSG_GAUGES:
+            self.perf.add(g, CounterType.U64)
         network.register(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -202,6 +248,29 @@ class Messenger:
         for t in self._threads:
             t.join(timeout=5)
         self.network.unregister(self.name)
+        # drop the perf registry: a long-lived process churns client
+        # endpoints, and dead registries would grow every `perf dump`
+        # and exporter scrape forever (frozen queue-depth gauges incl.)
+        global_perf().remove(f"msg.{self.name}")
+
+    # -- introspection -----------------------------------------------------
+    def queue_depths(self) -> list[int]:
+        """Per-worker queued-message counts (the dump_messenger /
+        stats-report face of the sharded loops)."""
+        return [q.qsize() for q in self._queues]
+
+    def dump_state(self) -> dict:
+        """The ``dump_messenger`` admin-verb document for this
+        endpoint: worker fan-out, per-worker dispatch/queue state,
+        throttle occupancy and the perf registry."""
+        out = {"name": self.name, "workers": self.workers,
+               "dispatched": list(self.worker_dispatched),
+               "queue_depths": self.queue_depths(),
+               "perf": self.perf.dump()}
+        if self._throttle is not None:
+            out["throttle"] = {"current": self._throttle.current,
+                               "max": self._throttle.max}
+        return out
 
     # -- sending -----------------------------------------------------------
     def connect(self, peer: str) -> Connection:
@@ -222,13 +291,26 @@ class Messenger:
     def _enqueue(self, src: str, msg) -> bool:
         if self._stopped:
             return False
-        if self._throttle and not self._throttle.try_get():
-            # backpressure: lossy servers drop, lossless block briefly
-            if self.policy.lossy:
-                self.network.dropped += 1
+        throttled = False
+        if self._throttle:
+            if self._throttle.try_get():
+                throttled = True
+            elif self.policy.lossy:
+                # backpressure: lossy servers drop, lossless block
+                self.perf.inc("msg_drop_backpressure")
+                self.network.note_backpressure_drop()
                 return True
-            self._throttle.get(1, timeout=5)
-        self._queues[self.shard_of(src)].put((src, msg))
+            else:
+                t0 = time.perf_counter()
+                # a timed-out get() took NO unit: the message still
+                # enqueues (lossless peers never drop), but the worker
+                # must not put() back a unit that was never acquired —
+                # that would silently widen the cap under overload
+                throttled = self._throttle.get(1, timeout=5)
+                self.perf.tinc("msg_throttle_wait_time",
+                               time.perf_counter() - t0)
+        self.perf.inc("msg_queue_depth")
+        self._queues[self.shard_of(src)].put((src, msg, throttled))
         return True
 
     def _dispatch_loop(self, worker: int) -> None:
@@ -237,8 +319,9 @@ class Messenger:
             item = q.get()
             if item is None:
                 break
-            src, msg = item
+            src, msg, throttled = item
             conn = Connection(self, src)
+            t0 = time.perf_counter()
             try:
                 for d in self._dispatchers:
                     if d.ms_dispatch(conn, msg):
@@ -251,5 +334,9 @@ class Messenger:
                                self.name, type(msg).__name__, src, e)
             finally:
                 self.worker_dispatched[worker] += 1
-                if self._throttle:
+                self.perf.inc("msg_dispatched")
+                self.perf.hinc("msg_dispatch_us",
+                               (time.perf_counter() - t0) * 1e6)
+                self.perf.inc("msg_queue_depth", -1)
+                if self._throttle and throttled:
                     self._throttle.put()

@@ -642,7 +642,7 @@ class TcpNetwork(Network):
         # same-process shortcut ONLY to detect stopped local targets the
         # way LocalNetwork does; data still rides the socket
         if self._blocked(src, dst):
-            self.dropped += 1
+            self.note_wire_drop(dst)
             dout("msg", 10)("dropped %s -> %s: %s", src, dst,
                             type(msg).__name__)
             return True  # silently dropped, like a lossy wire

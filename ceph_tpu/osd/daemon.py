@@ -53,6 +53,7 @@ from ..utils.reserver import AsyncReserver
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
+from ..utils.event_log import EventLog
 from ..utils.log import dout
 from ..utils.perf import CounterType, global_perf
 from ..utils.tracked_op import OpTracker
@@ -252,6 +253,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             history_size=self.cfg["osd_op_history_size"],
             slow_op_seconds=self.cfg["osd_op_complaint_time"])
         self.tracer = Tracer(self.name)
+        # cluster event journal (LogClient role): PG state transitions,
+        # recovery progress, scrub results and batcher regime changes
+        # emitted here ride the stats reports to the mon, which merges
+        # them into the cluster log (`dump_cluster_log` / event_tool)
+        self.events = EventLog(self.name,
+                               keep=self.cfg["osd_event_log_size"])
+        # per-PG recovery storm accounting feeding the recovery channel
+        # (and, through the mon, the mgr progress module): ops scheduled
+        # vs completed since the storm opened; guarded by _pending_lock
+        self._rec_progress: dict[PgId, dict] = {}
         self._init_objops()
         self._init_snaps()
         self._handlers = {
@@ -302,7 +313,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             target_ops=self.cfg["ec_batch_target_ops"],
             window_min_us=self.cfg["ec_batch_window_min_us"],
             window_max_us=self.cfg["ec_batch_window_max_us"],
-            perf=self.perf)
+            perf=self.perf, events=self.events)
         # op scheduler (OpScheduler/mClockScheduler role): the messenger
         # thread classifies+enqueues; ONE dequeue worker executes
         # handlers, preserving single-threaded handler semantics while
@@ -386,6 +397,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if cmd == "dump_kernel_profile":
             from ..utils.perf import kernel_profiler
             return kernel_profiler().dump()
+        if cmd == "dump_events":
+            return self.events.recent(
+                n=int(kw["max"]) if kw.get("max") else None,
+                channel=kw.get("channel"))
+        if cmd == "dump_messenger":
+            return {"data": self.messenger.dump_state(),
+                    "hb": self.hb_messenger.dump_state()}
         if cmd == "config show":
             return self.cfg.dump()
         if cmd == "dump_op_queue":
@@ -2698,6 +2716,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # clear when the ops finish); the cumulative count and the worst
         # offenders ride along for the per-daemon health detail
         slow = self.op_tracker.slow_summary()
+        # messenger summary (monotonic counters only: queue depth moves
+        # both ways and the mon's cluster_* aggregation types counters)
+        mperf = self.messenger.perf
+        # ship the pending journal WINDOW, not a drained batch: a
+        # partition/lossy wire drops reports SILENTLY (deliver()=True),
+        # so events re-ship with every report until they age out and
+        # the mon dedupes by per-daemon lseq — at-least-once across
+        # any outage shorter than osd_event_resend_s
+        events = self.events.pending()
         self.messenger.send_message(
             self.mon,
             MStatsReport(self.osd_id,
@@ -2711,7 +2738,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           "scrub_errors": self.perf.get("scrub_errors"),
                           "slow_ops": slow["inflight"],
                           "slow_ops_total": slow["total"],
-                          "slow_ops_worst": slow["worst"]}))
+                          "slow_ops_worst": slow["worst"],
+                          "msg_dispatched": mperf.get("msg_dispatched"),
+                          "msg_drop_wire": mperf.get("msg_drop_wire"),
+                          "msg_drop_backpressure":
+                              mperf.get("msg_drop_backpressure"),
+                          # journal entries ride along (the LogClient
+                          # piggyback); the mon merges + dedupes them
+                          # into the cluster log
+                          "events": events}))
+        self.events.prune(self.cfg["osd_event_resend_s"])
 
     def _handle_ping(self, conn, m: MOSDPing) -> None:
         conn.send(MOSDPingReply(self.osd_id, m.stamp))
@@ -2733,11 +2769,28 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _recovery_op(self, pgid: PgId, target: int | None, thunk) -> None:
         prio = self._recovery_prio(pgid)
+        storm_opened = False
         with self._pending_lock:
             self._recovery_pg_ops[pgid] = \
                 self._recovery_pg_ops.get(pgid, 0) + 1
+            # recovery-storm journal accounting: ops scheduled vs done
+            # since the storm opened (the progress module's feed).  A
+            # storm closes when the in-flight count drains to zero; a
+            # later wave opens a NEW storm (its own progress item).
+            rp = self._rec_progress.get(pgid)
+            if rp is None:
+                rp = self._rec_progress[pgid] = {
+                    "total": 0, "done": 0, "emitted": 0.0,
+                    "start_ts": time.time()}
+                storm_opened = True
+            rp["total"] += 1
             self._local_waiting.setdefault(pgid, []).append(
                 lambda: self._remote_gate(pgid, target, prio, thunk))
+        if storm_opened:
+            self.events.emit(
+                "recovery", f"pg {self._pgstr(pgid)} recovery start",
+                event="recovery_start", pg=self._pgstr(pgid),
+                done=0, total=rp["total"], start_ts=rp["start_ts"])
         self._local_reserver.request(
             pgid, prio, lambda: self._flush_local_waiting(pgid))
         if self._local_reserver.held(pgid):
@@ -2796,6 +2849,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     f"osd.{m.from_osd}",
                     MRecoveryReserve(m.pgid, self.osd_id, "release"))
                 return
+            self.events.emit(
+                "recovery",
+                f"pg {self._pgstr(m.pgid)} remote reservation granted "
+                f"by osd.{m.from_osd}",
+                event="reservation_grant", pg=self._pgstr(m.pgid),
+                target=m.from_osd, waiting_ops=len(thunks))
             for t in thunks:
                 self._recovery_enqueue(m.pgid, t)
         elif m.action == "release":
@@ -2835,16 +2894,38 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _recovery_op_done(self, pgid: PgId) -> None:
         release_local = False
         targets: list[tuple] = []
+        ev = None
+        now = time.time()
         with self._pending_lock:
             n = self._recovery_pg_ops.get(pgid, 1) - 1
+            rp = self._rec_progress.get(pgid)
+            if rp is not None:
+                rp["done"] += 1
             if n <= 0:
                 self._recovery_pg_ops.pop(pgid, None)
                 release_local = True
                 targets = [k for k in self._remote_held if k[0] == pgid]
                 for k in targets:
                     self._remote_held.discard(k)
+                if rp is not None:
+                    self._rec_progress.pop(pgid, None)
+                    ev = ("recovery_done", dict(rp))
             else:
                 self._recovery_pg_ops[pgid] = n
+                if rp is not None and now - rp["emitted"] >= \
+                        self.cfg["osd_recovery_progress_interval"]:
+                    rp["emitted"] = now
+                    ev = ("recovery_progress", dict(rp))
+        if ev is not None:
+            kind, rp = ev
+            self.events.emit(
+                "recovery",
+                f"pg {self._pgstr(pgid)} "
+                f"{'recovery done' if kind == 'recovery_done' else 'recovering'}"
+                f" ({rp['done']}/{rp['total']} ops)",
+                event=kind, pg=self._pgstr(pgid), done=rp["done"],
+                total=rp["total"], remaining=rp["total"] - rp["done"],
+                start_ts=rp["start_ts"])
         if release_local:
             self._local_reserver.release(pgid)
             for pg, target in targets:
@@ -2878,6 +2959,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _osd_alive(self, osd: int) -> bool:
         info = self.osdmap.osds.get(osd) if self.osdmap else None
         return info is not None and info.up
+
+    @staticmethod
+    def _pgstr(pgid: PgId) -> str:
+        """Journal/operator-facing PG name (the pool.seed-hex form the
+        mon's commit descriptions already use)."""
+        return f"{pgid.pool}.{pgid.seed:x}"
 
     def _peer_query_set(self, pgid: PgId, up) -> set[int]:
         """Who a peering round must hear from: the up members PLUS
@@ -2940,6 +3027,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             peers = self._peer_query_set(pgid, up)
             if peers:
                 self._peering[pgid] = set(peers)
+                self.events.emit(
+                    "pg", f"pg {self._pgstr(pgid)} peering start",
+                    pg=self._pgstr(pgid), state="peering",
+                    epoch=self.osdmap.epoch, peers=len(peers),
+                    down=-1 in peers)
             else:
                 self._peering.pop(pgid, None)
                 # trivially peered (no peers to hear from): fence now
@@ -3136,6 +3228,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if block:
             self._fence_round.pop(pgid, None)
             self._peering[pgid] = set(peers)
+            self.events.emit(
+                "pg", f"pg {self._pgstr(pgid)} peering start (re-peer)",
+                pg=self._pgstr(pgid), state="peering",
+                epoch=self.osdmap.epoch, peers=len(peers), repeer=True)
         else:
             self._fence_round[pgid] = set(peers)
         # queries go out DIRECTLY: _requery_pg's debounce could swallow
@@ -3344,6 +3440,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             # one full post-split round has closed: lean peering is
             # trustworthy again
             self._split_fresh.discard(m.pgid)
+            self.events.emit(
+                "pg", f"pg {self._pgstr(m.pgid)} peering done",
+                pg=self._pgstr(m.pgid),
+                state="degraded" if stale else "active",
+                epoch=self._peering_epoch.get(m.pgid, 0),
+                stale_objects=len(stale))
         if (done_peering or fence_done) and not stale:
             # every member (incl. prior-interval holders) answered a
             # round that closed with no fork and nothing known-missing:

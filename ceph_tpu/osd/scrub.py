@@ -130,6 +130,14 @@ class ScrubMixin:
         if ps.repair and issues:
             repaired = self._scrub_repair(ps, issues)
         self.perf.inc("scrubs")
+        self.events.emit(
+            "scrub",
+            f"pg {self._pgstr(ps.pgid)} "
+            f"{'deep-' if ps.deep else ''}scrub done"
+            + (f": {len(issues)} inconsistencies" if issues else ""),
+            severity="warn" if issues else "info",
+            pg=self._pgstr(ps.pgid), deep=ps.deep,
+            errors=len(issues), repaired=repaired)
         if issues:
             self.perf.inc("scrub_errors", len(issues))
             dout("osd", 1)("%s: scrub %s found %d inconsistencies",

@@ -1,0 +1,75 @@
+"""Prometheus recording rules for the exporter's pow-2 latency
+histograms.
+
+The exporter renders every pow-2 histogram as cumulative ``le``-labeled
+``_bucket`` series (mon/exporter.py), which is exactly the shape
+``histogram_quantile()`` consumes — so p50/p99 recording rules are one
+expression per quantile.  This tool emits the rule file a real scrape
+stack loads (the ROADMAP "histogram-quantile recording rules" item):
+
+    python -m ceph_tpu.tools.prom_rules > ceph_tpu_rules.yml
+
+The generated rules reference ONLY metric names the exporter actually
+emits — pinned by tests/test_prom_rules.py against a live
+render_metrics() pass, so a histogram rename can never silently strand
+a dashboard on a dead series.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+PREFIX = "ceph_tpu"
+
+#: the pow2-µs latency histograms worth standing quantile series for:
+#: the EC kernel decomposition (compile cliffs / device compute / host
+#: sync) and the messenger dispatch latency
+HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
+              "msg_dispatch_us")
+QUANTILES = (0.50, 0.99)
+
+
+def recording_rules(histograms=HISTOGRAMS, quantiles=QUANTILES,
+                    window: str = "5m") -> list[dict]:
+    """One rule per (histogram, quantile): aggregate the cumulative
+    le-buckets across daemons and take the quantile of the rate."""
+    rules = []
+    for h in histograms:
+        metric = f"{PREFIX}_daemon_{h}_bucket"
+        for q in quantiles:
+            rules.append({
+                "record": f"{PREFIX}:daemon_{h}:p{int(q * 100):02d}",
+                "expr": (f"histogram_quantile({q}, "
+                         f"sum by (daemon, le) "
+                         f"(rate({metric}[{window}])))"),
+            })
+    return rules
+
+
+def referenced_metrics(rules: list[dict]) -> set[str]:
+    """Every exporter metric name a rule expression reads (record:
+    names are products, not references)."""
+    out: set[str] = set()
+    for r in rules:
+        out |= set(re.findall(rf"{PREFIX}_[a-z0-9_]+", r["expr"]))
+    return out
+
+
+def render(rules: list[dict], group: str = "ceph_tpu_latency") -> str:
+    """Prometheus rule-file YAML (hand-rendered: the values are plain
+    identifiers and exprs with no YAML-hostile characters)."""
+    lines = ["groups:", f"- name: {group}", "  rules:"]
+    for r in rules:
+        lines.append(f"  - record: {r['record']}")
+        lines.append(f"    expr: {r['expr']}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv=None) -> int:
+    print(render(recording_rules()), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -298,6 +298,32 @@ OPTIONS: list[Option] = [
            "(ms_async_op_threads role): peers pin to one worker so "
            "per-peer ordering holds while different peers dispatch "
            "concurrently", min=1),
+    # cluster event journal + progress (LogClient/LogMonitor + mgr
+    # progress module roles)
+    Option("osd_event_log_size", int, 1024, OptionLevel.ADVANCED,
+           "events retained in a daemon's local journal ring AND the "
+           "cap on events pending shipment to the mon (oldest pending "
+           "shed past it — an unreachable mon must never wedge the "
+           "heartbeat thread)", min=16, max=1 << 20,
+           see_also=("mon_cluster_log_size",)),
+    Option("mon_cluster_log_size", int, 4096, OptionLevel.ADVANCED,
+           "merged events the monitor's cluster log ring retains "
+           "(dump_cluster_log / event_tool window)", min=16,
+           max=1 << 20, see_also=("osd_event_log_size",)),
+    Option("osd_event_resend_s", float, 10.0, OptionLevel.ADVANCED,
+           "seconds a journal event stays pending (re-shipping with "
+           "every stats report, mon dedupes by sequence): transient "
+           "partitions/lossy wires inside this window lose nothing",
+           min=0.0, max=3600.0, see_also=("osd_event_log_size",)),
+    Option("osd_recovery_progress_interval", float, 0.2,
+           OptionLevel.ADVANCED,
+           "min seconds between recovery_progress journal events per "
+           "PG (debounce: a storm emits progress at this cadence, not "
+           "per op)", min=0.0, max=60.0),
+    Option("mgr_progress_linger", float, 5.0, OptionLevel.ADVANCED,
+           "seconds a completed progress item stays visible (in "
+           "progress ls / the progress_percent gauge) before it is "
+           "dropped", min=0.0, max=3600.0),
     Option("mgr_autoscaler_objects_per_pg", int, 100, OptionLevel.BASIC,
            "pg_autoscaler: grow a pool's pg_num once its logical "
            "objects-per-PG estimate exceeds this target", min=1),

@@ -106,6 +106,14 @@ class PerfCounters:
         c = self._get(name)
         return c.sum / c.count if c.count else 0.0
 
+    def gauge_names(self) -> set[str]:
+        """Names of settable (U64) counters — values that move both
+        ways, which an exporter must type `gauge`, never `counter`
+        (rate() over a two-way value is nonsense)."""
+        with self._lock:
+            return {n for n, c in self._counters.items()
+                    if c.type == CounterType.U64}
+
     def get(self, name: str):
         return self._get(name).value
 
@@ -153,6 +161,12 @@ class PerfCountersCollection:
         with self._lock:
             regs = dict(self._registries)
         return {n: r.dump() for n, r in sorted(regs.items())}
+
+    def registries(self) -> dict[str, PerfCounters]:
+        """Snapshot of the live registries (exporter rendering needs
+        per-counter TYPE information the flat dump() strips)."""
+        with self._lock:
+            return dict(self._registries)
 
 
 _GLOBAL = PerfCountersCollection()

@@ -49,11 +49,16 @@ class Span:
         return self
 
     def finish(self) -> None:
-        if self.end:
-            return  # idempotent: async completions can race teardown
-        self.end = time.time()
+        """Idempotent: async completions can race teardown.  The
+        check-and-set must be ATOMIC with the ring append — two racing
+        finishers both passing a bare `if self.end` check would each
+        _record() the span and double-append it to the ring — so a
+        tracer-owned span delegates the whole close to the tracer,
+        under its lock."""
         if self._tracer is not None:
-            self._tracer._record(self)
+            self._tracer._finish(self)
+        elif not self.end:
+            self.end = time.time()
 
     def __enter__(self) -> "Span":
         return self
@@ -114,8 +119,14 @@ class Tracer:
                 self._live.pop(next(iter(self._live)))
         return span
 
-    def _record(self, span: Span) -> None:
+    def _finish(self, span: Span) -> None:
+        """Atomic close: end-stamp check-and-set + ring append under
+        ONE lock hold, so racing finishers record the span exactly
+        once (Span.finish docstring has the failure mode)."""
         with self._lock:
+            if span.end:
+                return
+            span.end = time.time()
             self._live.pop(span.span_id, None)
             self._done.append(span)
 

@@ -1,0 +1,227 @@
+"""Cluster event journal + progress derivation (utils/event_log.py,
+mon/mgr.py ProgressTracker): per-daemon journal bounds and shipping
+semantics, mon-side sequencing/filtering, and the recovery-event ->
+progress-item derivation (percent, rate, ETA, linger-then-clear)."""
+
+import time
+
+from ceph_tpu.mon.mgr import ProgressTracker
+from ceph_tpu.utils.event_log import ClusterLog, EventLog, make_event
+
+
+# --------------------------------------------------------- EventLog
+def test_event_log_emit_recent_and_channel_filter():
+    log = EventLog("osd.7", keep=8)
+    log.emit("pg", "pg 1.0 peering start", pg="1.0", epoch=3)
+    log.emit("recovery", "pg 1.0 recovery start", severity="info")
+    log.emit("scrub", "pg 1.0 scrub done", severity="warn", errors=2)
+    evs = log.recent()
+    assert [e["channel"] for e in evs] == ["pg", "recovery", "scrub"]
+    assert evs[0]["daemon"] == "osd.7"
+    assert evs[0]["fields"] == {"pg": "1.0", "epoch": 3}
+    assert evs[2]["severity"] == "warn"
+    assert log.recent(channel="recovery") == [evs[1]]
+    assert log.recent(n=1) == [evs[2]]
+
+
+def test_event_log_shipping_window_and_bounds():
+    """At-least-once shipping: pending() is a SNAPSHOT (events re-ship
+    until prune() ages them out — a silently-dropped report loses
+    nothing inside the resend window), lseq is per-daemon monotonic,
+    and the keep bound sheds oldest with an accurate loss count."""
+    log = EventLog("osd.1", keep=4)
+    for i in range(10):
+        log.emit("pg", f"e{i}", i=i)
+    # the local ring keeps the newest `keep`
+    assert [e["fields"]["i"] for e in log.recent()] == [6, 7, 8, 9]
+    assert [e["lseq"] for e in log.recent()] == [7, 8, 9, 10]
+    # pending sheds oldest past the bound, counting every loss
+    assert log.dropped == 6
+    first = log.pending()
+    assert [e["fields"]["i"] for e in first] == [6, 7, 8, 9]
+    # NOT consumed: the next report re-ships the same window + newer
+    log.emit("pg", "new", i=10)
+    again = log.pending()
+    assert [e["fields"]["i"] for e in again] == [7, 8, 9, 10]
+    # aging prunes the window; fresh events survive
+    log.prune(max_age=3600.0)
+    assert len(log.pending()) == 4
+    log.prune(max_age=0.0, now=time.time() + 1)
+    assert log.pending() == []
+    assert [e["fields"]["i"] for e in log.recent()][-1] == 10  # ring kept
+
+
+def test_mon_dedupes_reshipped_event_windows():
+    """The mon merges a re-shipped pending window exactly once (lseq
+    cursor per daemon), and a daemon reboot resets the cursor."""
+    from ceph_tpu.mon.monitor import MonitorLite
+    from ceph_tpu.msg.messenger import LocalNetwork
+    from ceph_tpu.msg.messages import MStatsReport
+
+    net = LocalNetwork()
+    mon = MonitorLite(net, "mon.77")
+    try:
+        e1 = dict(make_event("osd.5", "pg", "one"), lseq=1)
+        e2 = dict(make_event("osd.5", "pg", "two"), lseq=2)
+        e3 = dict(make_event("osd.5", "pg", "three"), lseq=3)
+        mon._handle_stats(None, MStatsReport(5, 1, {"events": [e1, e2]}))
+        # the re-shipped window carries old + new: only "three" merges
+        mon._handle_stats(None, MStatsReport(5, 1,
+                                             {"events": [e1, e2, e3]}))
+        msgs = [e["message"] for e in mon.cluster_log.dump()["events"]
+                if e["channel"] == "pg"]
+        assert msgs == ["one", "two", "three"]
+        # a rebooted daemon restarts lseq at 1: cursor must reset too
+        mon._event_lseq.pop(5, None)  # what _handle_boot does
+        mon._handle_stats(None, MStatsReport(
+            5, 2, {"events": [dict(make_event("osd.5", "pg", "fresh"),
+                                   lseq=1)]}))
+        msgs = [e["message"] for e in mon.cluster_log.dump()["events"]
+                if e["channel"] == "pg"]
+        assert msgs == ["one", "two", "three", "fresh"]
+    finally:
+        mon.stop()
+
+
+# -------------------------------------------------------- ClusterLog
+def test_cluster_log_sequencing_and_dump_filters():
+    clog = ClusterLog(keep=16)
+    for i in range(3):
+        clog.append(make_event("osd.0", "pg", f"pg e{i}", i=i))
+    clog.append(make_event("mon.0", "osdmap", "osdmap e9", epoch=9))
+    clog.append({"bogus": True})  # foreign dict is normalized, not fatal
+    d = clog.dump()
+    seqs = [e["seq"] for e in d["events"]]
+    assert seqs == [1, 2, 3, 4, 5] and d["last_seq"] == 5
+    # channel filter + since cursor (the event_tool follow contract)
+    d = clog.dump(channel="pg", since=2)
+    assert [e["fields"]["i"] for e in d["events"]] == [2]
+    assert d["last_seq"] == 5  # cursor advances past filtered events
+    d = clog.dump(max_events=2)
+    assert [e["seq"] for e in d["events"]] == [4, 5]
+    # ring bound: oldest events fall off, seq keeps climbing
+    small = ClusterLog(keep=16)  # floor-clamped keep in config; raw here
+    small.keep = 16
+    for i in range(40):
+        small.append(make_event("osd.0", "pg", f"e{i}"))
+    d = small.dump()
+    assert len(d["events"]) == 16 and d["events"][-1]["seq"] == 40
+
+
+# --------------------------------------------------- ProgressTracker
+def _rev(daemon, kind, pg="1.0", done=0, total=0, start_ts=None,
+         ts=None):
+    # synthetic stamps must stay near the wall clock AT TEST TIME (not
+    # module import: the staleness GC measures event-updated age
+    # against time.time(), and a full-suite run imports minutes early)
+    if start_ts is None:
+        start_ts = time.time()
+    return make_event(daemon, "recovery", f"pg {pg} {kind}", ts=ts,
+                      event=kind, pg=pg, done=done, total=total,
+                      start_ts=start_ts)
+
+
+def test_progress_tracker_derives_percent_rate_and_eta():
+    t0 = time.time()
+    pt = ProgressTracker(linger=60.0)
+    pt.on_event(_rev("osd.1", "recovery_start", total=10,
+                     start_ts=t0, ts=t0))
+    items = pt.items()
+    assert len(items) == 1
+    it = items[0]
+    assert it["percent"] == 0.0 and it["completed"] is None
+    assert it["id"] == "recovery/1.0/osd.1#1"
+    pt.on_event(_rev("osd.1", "recovery_progress", done=4, total=10,
+                     start_ts=t0, ts=t0 + 2.0))
+    it = pt.items()[0]
+    assert it["percent"] == 40.0
+    assert it["rate_eps"] > 0            # 4 ops over 2s -> ~2/s EWMA
+    assert it["eta_seconds"] is not None and it["eta_seconds"] > 0
+    # percent never walks backwards even if a stale report says so
+    pt.on_event(_rev("osd.1", "recovery_progress", done=3, total=10,
+                     start_ts=t0, ts=t0 + 2.5))
+    assert pt.items()[0]["percent"] == 40.0
+    pt.on_event(_rev("osd.1", "recovery_done", done=10, total=10,
+                     start_ts=t0, ts=t0 + 4.0))
+    it = pt.items()[0]
+    assert it["percent"] == 100.0 and it["completed"] is not None
+    assert it["eta_seconds"] == 0.0
+    assert pt.active() == []
+    # a straggling duplicate done must not resurrect a live 0% item
+    pt.on_event(_rev("osd.1", "recovery_done", done=10, total=10,
+                     start_ts=t0, ts=t0 + 4.5))
+    assert pt.active() == [] and len(pt.items()) == 1
+
+
+def test_progress_tracker_lingers_then_clears():
+    pt = ProgressTracker(linger=0.05)
+    t0 = time.time()  # one storm = one start_ts across its events
+    pt.on_event(_rev("osd.2", "recovery_start", total=2, start_ts=t0))
+    pt.on_event(_rev("osd.2", "recovery_done", done=2, total=2,
+                     start_ts=t0))
+    assert pt.percent_gauges() == {"recovery/1.0/osd.2#1": 100.0}
+    deadline = time.time() + 5
+    while time.time() < deadline and pt.percent_gauges():
+        time.sleep(0.01)
+    assert pt.percent_gauges() == {}   # the gauge CLEARS
+    assert pt.items() == []
+
+
+def test_progress_tracker_new_storm_is_new_item():
+    """A later wave on the same PG (fresh start_ts) opens a FRESH item
+    — per-item percent stays monotonic by construction."""
+    pt = ProgressTracker(linger=60.0)
+    pt.on_event(_rev("osd.1", "recovery_start", total=4, start_ts=1.0))
+    pt.on_event(_rev("osd.1", "recovery_done", done=4, total=4,
+                     start_ts=1.0))
+    pt.on_event(_rev("osd.1", "recovery_start", total=8, start_ts=2.0))
+    items = pt.items()
+    assert len(items) == 2
+    active = pt.active()
+    assert len(active) == 1 and active[0]["percent"] == 0.0
+
+
+def test_progress_tracker_stale_storm_clears():
+    """A daemon that dies mid-storm never sends recovery_done: past
+    stale_after the item is marked stale-complete, lingers, and CLEARS
+    — never a frozen sub-100%% gauge (the reference progress module's
+    staleness timeout)."""
+    pt = ProgressTracker(linger=0.05, stale_after=0.05)
+    t0 = time.time()
+    pt.on_event(_rev("osd.3", "recovery_start", total=10, start_ts=t0))
+    pt.on_event(_rev("osd.3", "recovery_progress", done=4, total=10,
+                     start_ts=t0, ts=time.time()))
+    assert pt.active() and pt.percent_gauges()
+    deadline = time.time() + 5
+    while time.time() < deadline and pt.percent_gauges():
+        time.sleep(0.01)
+    assert pt.active() == []
+    assert pt.percent_gauges() == {}
+    # inside the window the stale item is visible AND flagged
+    pt2 = ProgressTracker(linger=60.0, stale_after=0.01)
+    pt2.on_event(_rev("osd.3", "recovery_start", total=10))
+    time.sleep(0.05)
+    items = pt2.items()
+    assert len(items) == 1 and items[0]["stale"] \
+        and items[0]["completed"] is not None
+
+
+def test_malformed_events_never_poison_log_or_tracker():
+    """A junk report entry degrades to defaults in the cluster log and
+    is ignored by the tracker — later events still land (the mon's
+    event loop must never die mid-report)."""
+    clog = ClusterLog(keep=8)
+    norm = clog.append({"channel": "recovery", "fields": ["not", "a",
+                                                          "dict"],
+                        "ts": "yesterday"})
+    assert norm["fields"] == {} and norm["seq"] == 1
+    assert isinstance(norm["ts"], float) and norm["ts"] > 0
+    pt = ProgressTracker()
+    pt.on_event(norm)                                  # no event kind
+    pt.on_event(make_event("osd.1", "recovery", "x",
+                           event="recovery_start", pg="1.0",
+                           done="junk", total="junk", start_ts="junk"))
+    assert pt.items() == []                            # swallowed
+    # and a good event afterwards still tracks
+    pt.on_event(_rev("osd.1", "recovery_start", total=2))
+    assert len(pt.items()) == 1

@@ -120,6 +120,108 @@ def test_worker_counters_spread():
         m.shutdown()
 
 
+def test_messenger_perf_dispatch_metrics():
+    """The messenger perf registry (tentpole schema): every dispatched
+    message lands in msg_dispatched AND the msg_dispatch_us pow2
+    histogram, and the queue-depth gauge drains back to zero."""
+    net = LocalNetwork()
+    m = Messenger(net, "perf-srv", workers=2)
+    rec = _Recorder()
+    m.add_dispatcher(rec)
+    m.start()
+    try:
+        for i in range(20):
+            assert net.deliver(f"client.{i}", "perf-srv", i)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if m.perf.get("msg_dispatched") == 20:
+                break
+            time.sleep(0.01)
+        d = m.perf.dump()
+        assert d["msg_dispatched"] == 20
+        assert d["msg_dispatch_us"]["count"] == 20
+        assert d["msg_dispatch_us"]["sum"] > 0
+        assert d["msg_queue_depth"] == 0  # enqueued == dispatched
+        assert m.queue_depths() == [0, 0]
+        st = m.dump_state()
+        assert st["workers"] == 2 and sum(st["dispatched"]) == 20
+        assert d["msg_drop_wire"] == 0
+        assert d["msg_drop_backpressure"] == 0
+    finally:
+        m.shutdown()
+
+
+def test_drop_counters_split_by_cause():
+    """The conflated-drop satellite: a lossy-WIRE drop and a
+    receive-side BACKPRESSURE drop account separately (network totals
+    and per-messenger perf), while network.dropped stays the sum."""
+    from ceph_tpu.msg.messenger import Policy
+
+    net = LocalNetwork()
+    # backpressure: a lossy server capped at 1 message whose dispatch
+    # is wedged — the 2nd..nth deliveries drop at the throttle
+    srv = Messenger(net, "bp-srv", Policy.stateless_server(cap=1),
+                    workers=1)
+    rec = _Recorder()
+    rec.block = "client.a"
+    srv.add_dispatcher(rec)
+    srv.start()
+    try:
+        assert net.deliver("client.a", "bp-srv", "wedge")
+        assert rec.blocked.wait(5)
+        # the throttle unit is held by the wedged message: these drop
+        for i in range(3):
+            assert net.deliver("client.a", "bp-srv", f"over-{i}")
+        assert net.dropped_backpressure == 3
+        assert srv.perf.get("msg_drop_backpressure") == 3
+        assert net.dropped_wire == 0
+        # wire drops: fault injection takes every delivery
+        net.drop_rate = 1.0
+        for i in range(4):
+            assert net.deliver("client.b", "bp-srv", f"wire-{i}")
+        net.drop_rate = 0.0
+        assert net.dropped_wire == 4
+        assert srv.perf.get("msg_drop_wire") == 4
+        # the legacy conflated total is still the sum
+        assert net.dropped == 7
+    finally:
+        rec.gate.set()
+        srv.shutdown()
+
+
+def test_throttle_wait_time_accounted():
+    """A LOSSLESS peer past the message cap blocks in the throttle —
+    the wait lands in msg_throttle_wait_time (seconds + samples)."""
+    from ceph_tpu.msg.messenger import Policy
+
+    net = LocalNetwork()
+    srv = Messenger(net, "tw-srv",
+                    Policy(lossy=False, throttler_cap=1), workers=1)
+    rec = _Recorder()
+    rec.block = "client.a"
+    srv.add_dispatcher(rec)
+    srv.start()
+    try:
+        assert net.deliver("client.a", "tw-srv", "wedge")
+        assert rec.blocked.wait(5)
+
+        def late_open():
+            time.sleep(0.1)
+            rec.gate.set()  # dispatch finishes -> throttle unit freed
+
+        t = threading.Thread(target=late_open)
+        t.start()
+        # blocks in _enqueue until the wedged dispatch completes
+        assert net.deliver("client.a", "tw-srv", "queued")
+        t.join()
+        tw = srv.perf.dump()["msg_throttle_wait_time"]
+        assert tw["count"] == 1
+        assert tw["sum_seconds"] >= 0.05
+    finally:
+        rec.gate.set()
+        srv.shutdown()
+
+
 def test_cluster_daemons_run_sharded_messengers():
     cfg = make_cfg(ms_dispatch_workers=2)
     c = MiniCluster(n_osds=3, cfg=cfg).start()

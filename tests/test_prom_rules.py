@@ -1,0 +1,83 @@
+"""Recording-rule generation (tools/prom_rules.py): the generated
+p50/p99 histogram_quantile rules must reference ONLY metric names the
+exporter actually emits — a renamed histogram must fail here, not
+silently strand a dashboard on a dead series."""
+
+import re
+
+from ceph_tpu.mon.exporter import render_metrics
+from ceph_tpu.msg.messenger import LocalNetwork, Messenger
+from ceph_tpu.tools.prom_rules import (recording_rules, referenced_metrics,
+                                       render)
+from ceph_tpu.utils.perf import kernel_profiler
+
+
+def _emitted_metric_names(body: str) -> set[str]:
+    names = set()
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        names.add(line.rsplit(" ", 1)[0].split("{", 1)[0])
+    return names
+
+
+def test_rules_reference_only_emitted_metrics():
+    # materialize the registries the rules read: the kernel profiler
+    # (ec_kernels: kernel_*_us) and one messenger (msg_dispatch_us) —
+    # the exporter emits every histogram's +Inf bucket even at zero
+    # samples, so the schema exists without traffic
+    kernel_profiler()
+    net = LocalNetwork()
+    m = Messenger(net, "prom-rules-probe")
+    try:
+        body = render_metrics(None)
+    finally:
+        m.shutdown()
+    emitted = _emitted_metric_names(body)
+    rules = recording_rules()
+    refs = referenced_metrics(rules)
+    assert refs, "rules reference no metrics at all"
+    missing = refs - emitted
+    assert not missing, \
+        f"rules reference metrics the exporter never emits: {missing}"
+
+
+def test_rules_shape_and_rendering():
+    rules = recording_rules()
+    # one rule per (histogram, quantile), records namespaced
+    assert len(rules) == 8
+    assert all(r["record"].startswith("ceph_tpu:") for r in rules)
+    assert all("histogram_quantile(" in r["expr"] for r in rules)
+    assert all("by (daemon, le)" in r["expr"] for r in rules)
+    quantiles = {r["record"].rsplit(":", 1)[1] for r in rules}
+    assert quantiles == {"p50", "p99"}
+    text = render(rules)
+    assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
+    assert text.count("  - record: ") == 8
+    assert text.count("    expr: ") == 8
+
+
+def test_exporter_histogram_buckets_are_cumulative_le():
+    """The rule expressions only work over CUMULATIVE le-labeled
+    buckets — pin the exporter's rendering contract."""
+    from ceph_tpu.utils.perf import global_perf
+    pc = global_perf().create("bucket_probe")
+    from ceph_tpu.utils.perf import CounterType
+    pc.add("lat_us", CounterType.HISTOGRAM)
+    for v in (3, 3, 10, 300):
+        pc.hinc("lat_us", v)
+    try:
+        body = render_metrics(None)
+    finally:
+        global_perf().remove("bucket_probe")
+    rows = {}
+    for line in body.splitlines():
+        m = re.match(r'ceph_tpu_daemon_lat_us_bucket\{daemon="'
+                     r'bucket_probe",le="([^"]+)"\} (\d+)', line)
+        if m:
+            rows[m.group(1)] = int(m.group(2))
+    # 3 -> bucket 2 (le 4), 10 -> bucket 4 (le 16), 300 -> bucket 9
+    # (le 512); counts accumulate and +Inf carries the total
+    assert rows == {"4": 2, "16": 3, "512": 4, "+Inf": 4}
+    assert 'ceph_tpu_daemon_lat_us_count{daemon="bucket_probe"} 4' \
+        in body

@@ -153,3 +153,37 @@ def test_extent_cache_serves_overlapping_partial_writes():
         assert client.read("ec", "hot") == bytes(shadow)
     finally:
         c.stop()
+
+
+def test_heartbeat_map_grace_accounting_details():
+    """Timeout/grace arithmetic the watchdog health report is built on:
+    stalled_for measures from the LAST touch, the boundary (== grace)
+    is still healthy, an unregistered worker is NOT healthy, and a
+    remove during a stall silences its report without firing suicide."""
+    clock = [50.0]
+    doomed = []
+    hb = HeartbeatMap(on_suicide=doomed.append, clock=lambda: clock[0])
+    hb.add_worker("a", grace=2.0, suicide_grace=8.0)
+    hb.add_worker("b", grace=4.0)
+    clock[0] += 1.5
+    hb.touch("b")                       # b's window restarts at 51.5
+    clock[0] += 2.0                     # a stalled 3.5s, b 2.0s
+    bad = hb.unhealthy_workers()
+    assert [w["name"] for w in bad] == ["a"]
+    assert bad[0]["stalled_for"] == 3.5 and bad[0]["grace"] == 2.0
+    # exactly AT the grace boundary is still healthy (<=, not <)
+    hb.touch("a")
+    clock[0] += 2.0
+    assert hb.is_healthy("a")
+    assert hb.unhealthy_workers() == []
+    # unknown/unregistered worker is unhealthy, never healthy-by-absence
+    assert not hb.is_healthy("ghost")
+    # removing a stalled worker silences it before the suicide sweep
+    clock[0] += 100.0
+    hb.remove_worker("a")
+    assert hb.check() == [] or all(w["name"] != "a"
+                                   for w in hb.check())
+    assert doomed == []                 # "a" left before the sweep
+    assert not hb.is_healthy()          # "b" stalled through the jump...
+    hb.touch("b")
+    assert hb.is_healthy()              # ...and a touch clears the map

@@ -255,3 +255,56 @@ def test_batcher_coalesced_ops_trace_spans():
     lead_waits = _find(lead_tree, "ec-batch-wait")
     assert any(c["name"] == "ec-flush"
                for w in lead_waits for c in w["children"])
+
+
+def test_span_finish_race_records_once():
+    """Regression (Span.finish race): the end-stamp idempotency check
+    used to run OUTSIDE the tracer lock, so two finishers interleaving
+    between check and set both _record()ed the span — double-appending
+    it to the ring.  Widen the check->set window deterministically (a
+    clock that sleeps before answering) and hammer each span with
+    simultaneous finishers: exactly one ring entry must survive."""
+    import threading
+    import time as real_time
+
+    import ceph_tpu.utils.tracer as tracer_mod
+
+    class SlowClock:
+        """time-module stand-in whose time() dawdles: pre-fix, every
+        racer passes the unlocked `if self.end` check while the first
+        is still inside time.time(); post-fix the lock serializes."""
+
+        @staticmethod
+        def time():
+            real_time.sleep(0.005)
+            return real_time.time()
+
+    tracer = Tracer("race")
+    spans = [tracer.start("contended") for _ in range(8)]
+    saved = tracer_mod.time
+    tracer_mod.time = SlowClock()
+    try:
+        for span in spans:
+            barrier = threading.Barrier(4)
+
+            def fin(span=span, barrier=barrier):
+                barrier.wait()
+                span.finish()
+
+            threads = [threading.Thread(target=fin) for _ in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+    finally:
+        tracer_mod.time = saved
+    dumped = tracer.dump()
+    assert len(dumped) == 8, "a racing finish double-recorded a span"
+    assert not any(s.get("in_flight") for s in dumped)
+    # sequential double-finish stays idempotent and keeps the first end
+    s = tracer.start("twice")
+    s.finish()
+    end = s.end
+    s.finish()
+    assert s.end == end
+    assert sum(1 for d in tracer.dump() if d["name"] == "twice") == 1

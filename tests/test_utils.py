@@ -328,3 +328,36 @@ def test_interval_map_buffer_values():
     m3.erase(2, 0)
     m3.erase(2, -5)
     assert m3.get(0, 4) == [(0, 4, b"GOOD")]
+
+
+def test_throttle_timeout_reset_max_and_midpoint():
+    """The wait/wakeup seams the messenger backpressure path leans on:
+    a timed-out get returns False WITHOUT taking units, reset_max wakes
+    blocked waiters into the new budget, and past_midpoint flags the
+    half-full watermark."""
+    t = Throttle("caps", 2)
+    assert t.get(2, timeout=1)
+    assert t.past_midpoint()
+    # cap full: a timed get fails fast and leaves the count untouched
+    t0 = time.monotonic()
+    assert not t.get(1, timeout=0.05)
+    assert time.monotonic() - t0 < 1.0
+    assert t.current == 2
+    # a blocked waiter wakes when the cap GROWS past its request
+    released = []
+
+    def waiter():
+        released.append(t.get(2, timeout=5))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    assert released == []          # still blocked at max=2
+    t.reset_max(4)
+    th.join(timeout=5)
+    assert released == [True] and t.current == 4
+    assert t.past_midpoint()
+    # put() floors at zero rather than going negative
+    t.put(100)
+    assert t.current == 0
+    assert not t.past_midpoint()
