@@ -508,12 +508,245 @@ def ec_recovery_bench() -> int:
     return 0 if verified else 1
 
 
+def ec_read_bench() -> int:
+    """`--ec-read` mode: the client-facing EC read fan-out under an
+    8-reader burst through a real MiniCluster — the coalesced read
+    pipeline (per-peer MSubReadN aggregation + duplicate-fetch
+    collapse + batched degraded decode) vs the per-op baseline (one
+    MSubRead per shard per op, pass-through decode).
+
+    Three legs on each cluster: HEALTHY whole-object reads, RANGED
+    reads, and DEGRADED reads (one OSD killed on a spare-less k+m
+    pool, so every read of its shard's PGs decodes).  A hot-object
+    sub-leg has all 8 readers hammer ONE object to exercise the
+    duplicate-read collapse.  Reports messenger sub-read messages per
+    read, folded decode launches per degraded read, and p50/p99 read
+    latency; EVERY payload is byte-verified against what was written.
+    value = coalesced healthy reads/s; vs_baseline = coalesced /
+    per-op.  `--trace` adds the read-stage decomposition table
+    (ec-subread-fanout / ec-read-wait / ec-read-flush / ec-decode /
+    ec-batch-wait / ec-flush)."""
+    import threading
+
+    import numpy as np
+
+    from ceph_tpu.tools.vstart import MiniCluster
+    from ceph_tpu.utils.config import default_config
+
+    K_, M_ = 4, 2
+    n_objects, readers, obj_bytes = 24, 8, 32 * 1024
+
+    def build(coalesce: bool):
+        cfg = default_config()
+        cfg.apply_dict({
+            "osd_heartbeat_interval": 0.05,
+            "osd_heartbeat_grace": 0.5,
+            "ec_backend": "native",
+            "ms_dispatch_workers": 2,
+            "osd_op_num_shards": 2,
+            "ec_read_coalesce": "on" if coalesce else "off",
+            "ec_read_window_us": 400.0,
+            # decode coalescing rides the same comparison: batched
+            # window vs strict pass-through (window 0 still counts one
+            # launch per decode, so launches-per-op stays comparable)
+            "ec_batch": "on",
+            "ec_batch_adaptive": "off",
+            "ec_batch_window_us": 1500.0 if coalesce else 0.0,
+        })
+        # k+m == n_osds: no spare devices, so the degraded leg STAYS
+        # degraded (a spare would absorb the rebuilt shards and the
+        # late reads would stop decoding)
+        c = MiniCluster(n_osds=K_ + M_, cfg=cfg).start()
+        cl = c.client()
+        cl.create_pool("ecr", kind="ec", pg_num=8,
+                       ec_profile={"plugin": "jerasure", "k": str(K_),
+                                   "m": str(M_), "backend": "numpy"})
+        return c, cl
+
+    def counters(c):
+        tot: dict[str, float] = {}
+        for osd in c.osds.values():
+            for k, v in osd.perf.dump().items():
+                if isinstance(v, (int, float)):
+                    tot[k] = tot.get(k, 0) + v
+            st = osd._ec_batcher.stats
+            tot["decode_launches"] = (tot.get("decode_launches", 0)
+                                      + st["launches"])
+        return tot
+
+    def burst(c, clients, payloads, *, ranged=False, hot=None):
+        """8 readers sweep the object set (or hammer `hot`); returns
+        (sorted latencies, wall seconds, ok, msgs_per_op,
+        launches_per_op)."""
+        names = [hot] * n_objects if hot else sorted(payloads)
+        lat: list[list[float]] = [[] for _ in range(readers)]
+        ok = [True]
+        before = counters(c)
+        barrier = threading.Barrier(readers + 1)
+        rng = np.random.default_rng(11)
+        ranges = [(int(o), int(ln)) for o, ln in zip(
+            rng.integers(0, obj_bytes - 4096, n_objects),
+            rng.integers(1, 4096, n_objects))]
+
+        def reader(r):
+            cl_r = clients[r]
+            barrier.wait()
+            for i, name in enumerate(names):
+                t0 = time.perf_counter()
+                try:
+                    if ranged:
+                        off, ln = ranges[i]
+                        got = cl_r.read("ecr", name, offset=off,
+                                        length=ln)
+                        want = payloads[name][off:off + ln]
+                    else:
+                        got = cl_r.read("ecr", name)
+                        want = payloads[name]
+                except Exception:  # noqa: BLE001 - counted as failure
+                    ok[0] = False
+                    continue
+                lat[r].append(time.perf_counter() - t0)
+                if got != want:
+                    ok[0] = False
+
+        threads = [threading.Thread(target=reader, args=(r,))
+                   for r in range(readers)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        after = counters(c)
+        n_reads = readers * len(names)
+        # sub-read wire messages, honestly counted on BOTH paths: every
+        # served sub-read bumps subop_r (once per plain MSubRead, once
+        # per MSubReadN item), so plain messages = subop_r - fetches
+        # (recovery paths still send direct MSubReads even when client
+        # reads coalesce) and N-messages ride ec_read_msgs; on the
+        # per-op path the coalescer terms are zero
+        def delta(name):
+            return after.get(name, 0) - before.get(name, 0)
+        msgs = max(0, delta("ec_read_msgs")
+                   + delta("subop_r") - delta("ec_read_fetches"))
+        launches = (after["decode_launches"]
+                    - before["decode_launches"])
+        flat = sorted(x for row in lat for x in row)
+        deltas = {k: after.get(k, 0) - before.get(k, 0)
+                  for k in after}
+        return (flat, wall, ok[0], msgs / max(1, n_reads),
+                launches / max(1, n_reads), deltas)
+
+    def pcts(flat):
+        if not flat:
+            return {"p50_ms": None, "p99_ms": None}
+        return {"p50_ms": round(flat[len(flat) // 2] * 1e3, 3),
+                "p99_ms": round(flat[min(len(flat) - 1,
+                                         int(len(flat) * 0.99))] * 1e3,
+                                3)}
+
+    rng = np.random.default_rng(9)
+    results: dict[str, dict] = {}
+    verified = True
+    trace_stages = None
+    for mode in ("coalesced", "perop"):
+        c, cl = build(coalesce=mode == "coalesced")
+        try:
+            payloads = {}
+            for i in range(n_objects):
+                data = rng.integers(0, 256, obj_bytes,
+                                    dtype=np.uint8).tobytes()
+                payloads[f"o{i:02d}"] = data
+                cl.write_full("ecr", f"o{i:02d}", data)
+            # one client per reader, created HERE (client creation
+            # binds entity names and is not thread-safe)
+            clients = [c.client() for _ in range(readers)]
+            legs = {}
+            flat, wall, ok, mpo, _l, _d = burst(c, clients, payloads)
+            verified &= ok
+            legs["healthy"] = dict(pcts(flat), msgs_per_op=round(mpo, 2),
+                                   reads_per_s=round(
+                                       readers * n_objects / wall, 1))
+            flat, _w, ok, mpo, _l, dd = burst(c, clients, payloads,
+                                              hot="o00")
+            verified &= ok
+            # THIS leg's collapses only (deltas, not cumulative)
+            legs["hot_object"] = dict(
+                pcts(flat), msgs_per_op=round(mpo, 2),
+                dup_hits=int(dd.get("ec_read_dup_hits", 0)),
+                union_merges=int(dd.get("ec_read_union_merges", 0)))
+            flat, _w, ok, mpo, _l, _d = burst(c, clients, payloads,
+                                              ranged=True)
+            verified &= ok
+            legs["ranged"] = dict(pcts(flat), msgs_per_op=round(mpo, 2))
+            # degraded: kill one OSD; with zero spares every PG it held
+            # a data shard for decodes on read
+            c.kill_osd(K_ + M_ - 1)
+            c.settle(1.0)
+            flat, wall, ok, mpo, lpo, _d = burst(c, clients, payloads)
+            verified &= ok
+            legs["degraded"] = dict(
+                pcts(flat), msgs_per_op=round(mpo, 2),
+                decode_launches_per_op=round(lpo, 3),
+                reads_per_s=round(readers * n_objects / wall, 1))
+            if mode == "coalesced" and "--trace" in sys.argv[1:]:
+                from ceph_tpu.tools.trace_tool import (
+                    format_stage_table, stage_stats)
+                tcl = c.client()
+                tcl.tracing = True
+                roots = []
+                for i in range(min(8, n_objects)):
+                    tcl.read("ecr", f"o{i:02d}")
+                for s in tcl.tracer.dump():
+                    if s["parent_id"] == 0:
+                        roots.append(s["trace_id"])
+                traces = [c.collect_trace(tid)
+                          + tcl.tracer.spans_for(tid) for tid in roots]
+                trace_stages = stage_stats(traces)
+                print("bench: read-stage latency decomposition "
+                      f"({len(roots)} traced degraded reads):",
+                      file=sys.stderr)
+                print(format_stage_table(trace_stages), file=sys.stderr)
+            results[mode] = legs
+        finally:
+            c.stop()
+
+    co, po = results["coalesced"], results["perop"]
+    v = co["healthy"]["reads_per_s"]
+    base = po["healthy"]["reads_per_s"]
+    print(json.dumps({
+        "metric": (f"EC coalesced read pipeline reads/s (k={K_},m={M_}, "
+                   f"{obj_bytes // 1024}KiB objects, {readers}-reader "
+                   f"burst, MSubReadN window 400us, byte-verified)"),
+        "value": v,
+        "unit": "reads/s",
+        "vs_baseline": round(v / base, 3) if base else None,
+        "coalesced": co,
+        "perop": po,
+        "msgs_per_op_healthy": {"coalesced": co["healthy"]["msgs_per_op"],
+                                "perop": po["healthy"]["msgs_per_op"]},
+        "msgs_per_op_degraded": {
+            "coalesced": co["degraded"]["msgs_per_op"],
+            "perop": po["degraded"]["msgs_per_op"]},
+        "decode_launches_per_op": {
+            "coalesced": co["degraded"]["decode_launches_per_op"],
+            "perop": po["degraded"]["decode_launches_per_op"]},
+        "digest_verified": verified,
+        **({"trace_stages": trace_stages}
+           if trace_stages is not None else {}),
+    }))
+    return 0 if verified else 1
+
+
 def main() -> int:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     if "--ec-batch" in sys.argv[1:]:
         return ec_batch_bench()
     if "--ec-recovery" in sys.argv[1:]:
         return ec_recovery_bench()
+    if "--ec-read" in sys.argv[1:]:
+        return ec_read_bench()
     cpu = cpu_baseline_gbps()
     print(f"bench: cpu single-thread baseline {cpu:.2f} GB/s", file=sys.stderr)
     dev = tpu_gbps()

@@ -48,9 +48,13 @@ linear code, so the padding is sliced away without affecting bytes.
 
 Checksums: a launch whose ops all want csums and share one exact chunk
 length rides the fused encode+CRC32C device pass (``Checksummer.h:13``
-role — one launch produces parity AND every per-chunk digest); mixed
-lengths fall back to the same CPU CRC sweep the non-jax backends use,
-still over a single folded parity launch.
+role — one launch produces parity AND every per-chunk digest); on a
+sharded pool the fused op itself shards over the mesh (the CRC tree
+reduction is per chunk and stripes align to device slices, so the
+fan-out carries the digests too — ``make_folded_csum``); mixed lengths
+(or a sharded fused op not yet compiled) fall back to the same CPU CRC
+sweep the non-jax backends use, still over a single folded parity
+launch.
 
 Tracing: an op submitted with ``trace=(tracer, parent_ctx)`` gets an
 ``ec-batch-wait`` span covering queued -> flushed, and each flush emits
@@ -577,16 +581,30 @@ class ECBatcher:
             # its csums ride the CPU sweep while parity fans out.
             L0 = ops[0].length
             op_fn = None
-            if (ns == 1 and sig[4]  # every op in the group wants csums
+            fused_shard = 1
+            if (sig[4]  # every op in the group wants csums
                     and getattr(codec, "_backend", None) == "jax"
                     and all(o.length == L0 for o in ops)
                     and L0 % 4 == 0):
-                op_fn = codec._csum_op_if_ready(L0, n2 * L0)
+                if ns == 1:
+                    op_fn = codec._csum_op_if_ready(L0, n2 * L0)
+                else:
+                    # sharded pool: ask for the MESH-SHARDED fused op —
+                    # the CRC tree reduction shards with the encode
+                    # (shard_pad already padded the stripe count to a
+                    # multiple of the fan-out, so every device owns
+                    # whole chunks and the digests stay byte-identical
+                    # to the native sweep)
+                    op_fn = codec._csum_op_if_ready(L0, n2s * L0,
+                                                    n_shard=ns)
+                    if op_fn is not None:
+                        fused_shard = ns
             if op_fn is not None:
                 # ONE device pass: parity + per-chunk CRC32C for every
                 # stripe in the launch (csums (k+m, n2), one per stripe)
-                padded_cols = n2 * L0
-                folded = np.zeros((k, n2 * L0), dtype=np.uint8)
+                n_str = n2 if fused_shard == 1 else n2s
+                padded_cols = n_str * L0
+                folded = np.zeros((k, n_str * L0), dtype=np.uint8)
                 for i, o in enumerate(ops):
                     folded[:, i * L0: (i + 1) * L0] = o.streams
                 # the fused launch rides the same profiled path as the
@@ -596,9 +614,12 @@ class ECBatcher:
                 # path's compute to the sync bucket
                 dev_parity, dev_csums = codec._profiled_launch(
                     op_fn, folded,
-                    f"csum/{codec.m}x{k}/L{L0}x{n2 * L0}")
+                    f"csum/{codec.m}x{k}/L{L0}x{n_str * L0}"
+                    + (f"/s{fused_shard}" if fused_shard > 1 else ""))
                 parity = codec.host_sync(dev_parity)
                 csums = codec.host_sync(dev_csums)
+                if fused_shard > 1:
+                    shard_bytes = folded.nbytes // fused_shard
                 for i, o in enumerate(ops):
                     # copy out of the launch buffer: a retained per-op
                     # result must not pin the whole (m, n2*L) fold
@@ -606,12 +627,14 @@ class ECBatcher:
                     o.csums = csums[:, i].copy()
             else:
                 if (self._events is not None and sig[4] and ns > 1):
-                    # a checksummed burst on a sharded pool skips the
-                    # fused encode+CRC op (its CRC plan is single-
-                    # device): parity fans out, csums fall through to
+                    # a checksummed burst on a sharded pool whose
+                    # MESH-SHARDED fused encode+CRC op is not (yet)
+                    # compiled: parity fans out, csums fall through to
                     # the CPU sweep — journal it (debounced) so the
-                    # operator sees WHY a sharded pool's csum bursts
-                    # trail the single-device fused numbers
+                    # operator sees WHY this pool's csum bursts trail
+                    # the fused numbers (once the sharded op is warm
+                    # the fused branch above engages and this event
+                    # stops firing)
                     now = time.monotonic()
                     if now - self._fallthrough_at > self.EVENT_DEBOUNCE_S:
                         self._fallthrough_at = now

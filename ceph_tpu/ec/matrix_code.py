@@ -283,34 +283,58 @@ class MatrixErasureCode(ErasureCode):
                           for row in stack], dtype=np.uint32)
         return parity, csums
 
-    def _csum_op(self, nbytes: int):
+    def _csum_op(self, nbytes: int, n_shard: int = 1):
         """Fused encode+CRC32C device op for chunk length ``nbytes``:
         fn((k, batch*nbytes) data) -> (parity (m, batch*nbytes),
         csums (k+m, batch)) — parity and every per-chunk digest leave
         the device together (Checksummer.h:13 role).  Cached per
-        (matrix, nbytes) alongside the plain matmul kernels."""
+        (matrix, nbytes[, fan-out]) alongside the plain matmul kernels.
+
+        ``n_shard > 1`` builds the MESH-SHARDED variant: the length
+        axis (and with it the per-chunk CRC tree reduction) fans over
+        a flat device mesh (parallel/distributed.make_folded_csum), so
+        a checksummed burst on a sharded pool keeps its fan-out.
+        Returns None when the mesh cannot be built — callers fall back
+        to the single-device/CPU-sweep path rather than raising off
+        the IO path (same contract as _jax_matmul_sharded)."""
         def build():
             import jax
 
+            if n_shard > 1:
+                from ..parallel.distributed import make_folded_csum
+                from ..parallel.mesh import make_flat_mesh
+                try:
+                    mesh = make_flat_mesh(n_shard)
+                except (ValueError, RuntimeError):
+                    return None
+                return jax.jit(make_folded_csum(
+                    self.k, self.m, self.matrix, nbytes, mesh))
             from ..models.stripe_codec import StripeCodec
             codec = StripeCodec.__new__(StripeCodec)
             codec.k, codec.m = self.k, self.m
             codec.matrix = self.matrix
             return jax.jit(codec.encode_csum_graph(nbytes))
 
-        return self._jax_op_cached(self._csum_key(nbytes), build)
+        return self._jax_op_cached(self._csum_key(nbytes, n_shard),
+                                   build)
 
-    def _csum_key(self, nbytes: int) -> bytes:
+    def _csum_key(self, nbytes: int, n_shard: int = 1) -> bytes:
         """Kernel-LRU key of the fused encode+CRC op for this chunk
         length — ONE definition, shared by the cache insert (_csum_op),
         the eviction ready-set purge, and the warm thread's
-        still-cached check, which silently diverge otherwise."""
-        return (b"csum" + self.matrix.tobytes()
+        still-cached check, which silently diverge otherwise.  The
+        chunk length stays in the LAST 8 bytes for every variant: the
+        eviction purge recovers it from the key tail."""
+        shard = (b"" if n_shard == 1
+                 else b"s" + n_shard.to_bytes(4, "little"))
+        return (b"csum" + shard + self.matrix.tobytes()
                 + nbytes.to_bytes(8, "little"))
 
-    def _csum_op_if_ready(self, nbytes: int, total: int):
+    def _csum_op_if_ready(self, nbytes: int, total: int,
+                          n_shard: int = 1):
         """Non-blocking fused-op lookup for input width ``total`` (a
-        batch of ``total // nbytes`` chunks).
+        batch of ``total // nbytes`` chunks; ``n_shard > 1`` asks for
+        the mesh-sharded variant).
 
         On a real TPU backend the op is returned directly (the
         persistent XLA compile cache absorbs the one-time cost — the
@@ -325,8 +349,9 @@ class MatrixErasureCode(ErasureCode):
         import jax  # the caller is jax-backend, so this is loaded
 
         if jax.default_backend() == "tpu":
-            return self._csum_op(nbytes)
-        shape = (nbytes, total)
+            return self._csum_op(nbytes, n_shard)
+        shape = ((nbytes, total) if n_shard == 1
+                 else (nbytes, total, n_shard))
         with self._cache_lock:
             if shape in self._csum_ready:
                 ready = True
@@ -338,18 +363,21 @@ class MatrixErasureCode(ErasureCode):
                 self._csum_building.add(shape)
                 ready = False
         if ready:
-            return self._csum_op(nbytes)
+            return self._csum_op(nbytes, n_shard)
 
         def warm():
             try:
-                op = self._csum_op(nbytes)
+                op = self._csum_op(nbytes, n_shard)
+                if op is None:  # sharded variant: mesh unavailable
+                    return
                 t0 = time.perf_counter()
                 op(np.zeros((self.k, total), dtype=np.uint8))  # compile
                 kernel_profiler().note(
                     "compile",
-                    f"csum/{self.m}x{self.k}/L{nbytes}x{total}",
+                    f"csum/{self.m}x{self.k}/L{nbytes}x{total}"
+                    + (f"/s{n_shard}" if n_shard > 1 else ""),
                     time.perf_counter() - t0)
-                key = self._csum_key(nbytes)
+                key = self._csum_key(nbytes, n_shard)
                 with self._cache_lock:
                     # the compile ran for seconds outside the lock: if
                     # cache churn evicted the op meanwhile, its ready-set

@@ -212,6 +212,36 @@ class MSubReadReply:
     attrs: dict = field(default_factory=dict)
 
 
+@dataclass
+class MSubReadN:
+    """Primary -> shard OSD: MANY coalesced sub-reads of ONE pg in one
+    message (the read-pipeline counterpart of the ECBatcher's folded
+    launches: concurrent MSubReads headed to the same peer merge into
+    one wire message instead of one per op).  Each item is one wire
+    fetch — (fetch_id, oid, shard, extents) with MSubRead's extents
+    semantics — and the peer answers ALL of them in one
+    MSubReadReplyN.  fetch_id is an aggregator-local cookie: several
+    pending reads (tids) may wait on one fetch (duplicate collapse),
+    so the reply routes by fetch, not tid.  pgid rides the MESSAGE so
+    the peer's sharded op queue serializes the whole batch with that
+    pg's write applies, exactly like a plain MSubRead — which is why
+    one message never mixes pgs."""
+
+    items: list  # [(fetch_id, oid, shard, extents|None)]
+    pgid: PgId | None = None
+
+
+@dataclass
+class MSubReadReplyN:
+    """Shard OSD -> primary: the vectorized reply — one (fetch_id,
+    result, data, attrs) per MSubReadN item, slices concatenated and
+    zero-padded exactly as MSubReadReply would carry them."""
+
+    from_osd: int
+    items: list  # [(fetch_id, shard, result, data, attrs)]
+    pgid: PgId | None = None
+
+
 # ------------------------------------------------------- health / heartbeat
 @dataclass
 class MOSDPing:

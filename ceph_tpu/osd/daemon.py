@@ -47,13 +47,15 @@ from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
                             MPGPush, MPGQuery, MPGRollback,
                             MRecoveryReserve, MStatsReport,
                             MSubDelta, MSubPartialWrite, MSubRead,
-                            MSubReadReply, MSubWrite, MSubWriteReply,
+                            MSubReadN, MSubReadReply, MSubReadReplyN,
+                            MSubWrite, MSubWriteReply,
                             PgId)
 from ..utils.reserver import AsyncReserver
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
 from ..ops.native import crc32c as native_crc32c
 from ..utils.config import Config, default_config
 from ..utils.event_log import EventLog
+from ..utils.interval import IntervalSet
 from ..utils.log import dout
 from ..utils.perf import CounterType, global_perf
 from ..utils.tracked_op import OpTracker
@@ -133,6 +135,444 @@ class _ClientConn:
 
     def send(self, msg) -> bool:
         return self._daemon.messenger.send_message(self._client, msg)
+
+
+#: perf counters the sub-read aggregator maintains on the OSD's
+#: registry — ALWAYS registered (zeroed) even when read coalescing is
+#: off, so `perf dump` and the exporter expose one stable schema
+READ_AGG_COUNTERS = ("ec_read_msgs", "ec_read_fetches",
+                     "ec_read_coalesced_subreads", "ec_read_dup_hits",
+                     "ec_read_union_merges", "ec_read_stale_rejects",
+                     "ec_read_flush_window", "ec_read_flush_size",
+                     "ec_read_flush_idle")
+READ_AGG_HISTOGRAMS = ("ec_read_fetches_per_msg",
+                       "ec_read_subreads_per_msg")
+
+
+class _ReadFetch:
+    """One wire fetch riding an MSubReadN: a (pgid, oid, shard,
+    extents) store read on the peer, possibly shared by several
+    pending reads (duplicate collapse / union-range merge)."""
+
+    __slots__ = ("fid", "pgid", "oid", "shard", "extents", "waiters",
+                 "tspans", "fspan_id", "stamp", "marker")
+
+    def __init__(self, fid, pgid, oid, shard, extents, marker=0):
+        self.fid = fid
+        self.pgid = pgid
+        self.oid = oid
+        self.shard = shard
+        self.extents = extents      # None (whole shard) or merged
+        # union: tuple of disjoint sorted (off, len)
+        self.waiters: list = []     # [(tid, requested extents|None)]
+        self.tspans: list = []      # ec-read-wait spans (traced ops)
+        self.fspan_id = 0           # flush span id once sent
+        self.stamp = time.time()
+        # read barrier: the daemon's object-write sequence observed at
+        # creation — a later read may ride this fetch IN FLIGHT only if
+        # its object saw no acked write since (read-after-write)
+        self.marker = marker
+
+
+def _merge_extents(a: tuple, b: tuple) -> tuple:
+    """Union of two interval sets: overlapping/touching (off, len)
+    ranges coalesce, so N small reads of one hot shard object become
+    ONE store read covering them all."""
+    iv = IntervalSet()
+    for off, ln in (*a, *b):
+        iv.insert(off, ln)
+    return tuple((s, e - s) for s, e in iv)
+
+
+def _extents_cover(union: tuple | None, want: tuple | None) -> bool:
+    """Whether a fetch for `union` can serve a request for `want`
+    (whole-shard fetches serve anything; a ranged fetch serves ranges
+    fully inside its merged intervals)."""
+    if union is None:
+        return True
+    if want is None:
+        return False
+    iv = IntervalSet((off, off + ln) for off, ln in union)
+    return all(iv.contains(off, ln) for off, ln in want)
+
+
+def _carve_extents(union: tuple | None, data: bytes,
+                   want: tuple | None) -> bytes:
+    """Slice one waiter's requested extents out of the fetch's reply
+    buffer.  The peer zero-pads every requested slice to its length
+    (absent tail bytes of a padded stripe row are zeros), so carving
+    from the union buffer is byte-identical to a direct ranged read."""
+    if want == union or want is None:
+        return data
+    parts = []
+    if union is None:
+        # whole-shard buffer: direct offsets, zero-padded per slice
+        for off, ln in want:
+            seg = data[off:off + ln]
+            if len(seg) < ln:
+                seg += b"\0" * (ln - len(seg))
+            parts.append(seg)
+        return b"".join(parts)
+    bases = []  # start offset of each union interval in the buffer
+    pos = 0
+    for io, il in union:
+        bases.append(pos)
+        pos += il
+    for off, ln in want:
+        for (io, il), base in zip(union, bases):
+            if io <= off and off + ln <= io + il:
+                parts.append(data[base + off - io: base + off - io + ln])
+                break
+        else:  # cannot happen: waiters are merged into the union
+            parts.append(b"\0" * ln)
+    return b"".join(parts)
+
+
+class SubReadAggregator:
+    """Per-(peer, pg) MSubRead coalescing (the message half of the EC
+    read pipeline; same spirit as the ECBatcher's folded launches).
+
+    Concurrent sub-reads headed to the same OSD for the same pg queue
+    here for a small window (``ec_read_window_us``) and leave as ONE
+    ``MSubReadN``; the peer answers every item in one
+    ``MSubReadReplyN``.  Lanes split by pg — not just peer — because
+    the vectorized message carries its pgid for the peer's sharded op
+    queue: the whole batch executes on that pg's scheduler shard,
+    serialized against the pg's write applies exactly like a plain
+    ``MSubRead`` (a pg-less message would land on the default shard
+    and could read a stripe mid-apply).  Two further
+    collapses ride the queue: a read identical to (or covered by) an
+    in-flight fetch of the same ``(pgid, oid, shard)`` attaches as a
+    waiter instead of refetching (duplicate collapse), and overlapping
+    extents for one shard object merge into a union range so N small
+    reads of a hot object become one store read.  ``window_us == 0``
+    is pass-through — the daemon sends plain per-op ``MSubRead``s,
+    bit-identical to the unbatched path.
+
+    Unlike the ECBatcher no submitter blocks: the fan-out is already
+    async (replies route through ``_on_shard_read``), so flushing is
+    driven by a per-peer one-shot timer (armed by the first queued
+    fetch) or a size threshold (``ec_read_max_items``).
+
+    Tracing: a traced op's sub-reads get ``ec-read-wait`` spans
+    (queued -> flushed, ``flush_span``/``flush_reason``/``dup``
+    cross-tags) and each flush ONE shared ``ec-read-flush`` span —
+    the same fan-in reconstruction contract as the batcher's
+    ``ec-batch-wait``/``ec-flush`` pair."""
+
+    def __init__(self, daemon: "OSDDaemon", *, window_us: float = 150.0,
+                 max_items: int = 64, perf=None):
+        self._daemon = daemon
+        self.window_us = float(window_us)
+        self.max_items = int(max_items)
+        self._perf = perf
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._fids = itertools.count(1)
+        # lane = (peer, pgid): one MSubReadN never mixes pgs
+        self._queued: dict[tuple, list[_ReadFetch]] = {}
+        self._qindex: dict[tuple, _ReadFetch] = {}
+        # lane -> monotonic flush deadline, drained by ONE persistent
+        # flusher thread (started lazily on the first submit): a
+        # threading.Timer per lane-window costs a thread spawn per
+        # flush, which on a loaded box dwarfs the window itself
+        self._deadlines: dict[tuple, float] = {}
+        self._flusher: threading.Thread | None = None
+        self._inflight: dict[int, _ReadFetch] = {}
+        self._inflight_keys: dict[tuple, list[_ReadFetch]] = {}
+        # persistent completion pool (lazily created): multi-delivery
+        # replies fan their completions here so same-signature decodes
+        # coalesce in the ECBatcher — a thread spawn per completion
+        # costs more than the window on a loaded box
+        self._pool = None
+        self._stopped = False
+
+    @staticmethod
+    def _key(peer, pgid, oid, shard, whole: bool) -> tuple:
+        return (peer, pgid, oid, shard, whole)
+
+    def _inc(self, name: str, n: int = 1) -> None:
+        if self._perf is not None:
+            self._perf.inc(name, n)
+
+    # ------------------------------------------------------------ submit
+    def submit(self, peer: str, tid: int, pgid, oid: str, shard: int,
+               extents: list | None, trace: tuple | None = None) -> None:
+        """Queue one sub-read for `peer`; the reply reaches the
+        daemon's _on_shard_read exactly as a plain MSubReadReply
+        would."""
+        want = (None if extents is None
+                else tuple((int(o), int(ln)) for o, ln in extents))
+        key = self._key(peer, pgid, oid, shard, want is None)
+        tspan = None
+        if trace is not None:
+            tracer, ctx = trace
+            tspan = tracer.start("ec-read-wait", parent=ctx, peer=peer,
+                                 shard=shard)
+        # a ranged read can also ride a WHOLE-shard fetch of the same
+        # shard object (the whole stream covers any slice; recovery
+        # whole-reads and client range reads of one hot object meet
+        # here), so ranged lookups consult the whole-shard key too
+        keys = (key,) if want is None else (
+            key, self._key(peer, pgid, oid, shard, True))
+        flush_peer = False
+        with self._lock:
+            if self._stopped:
+                if tspan is not None:
+                    tspan.tag("stopped", 1)
+                    tspan.finish()
+                return
+            # duplicate collapse vs an IN-FLIGHT fetch: the wire read
+            # is already on its way — ride its reply.  Read barrier:
+            # the fetch may predate an acked write (its reply could
+            # carry pre-write bytes for ALL k shards and pass version
+            # agreement), so a read only rides if the object saw no
+            # acked write since the fetch was created — otherwise it
+            # pays for a fresh wire fetch, exactly like the per-op path
+            for k in keys:
+                for f in self._inflight_keys.get(k, ()):
+                    if not _extents_cover(f.extents, want):
+                        continue
+                    if self._daemon._obj_written_since((pgid, oid),
+                                                      f.marker):
+                        self._inc("ec_read_stale_rejects")
+                        continue
+                    f.waiters.append((tid, want))
+                    self._inc("ec_read_dup_hits")
+                    if tspan is not None:
+                        tspan.tag("dup", 1)
+                        if f.fspan_id:
+                            tspan.tag("flush_span", f.fspan_id)
+                        tspan.finish()
+                    return
+            f = None
+            for k in keys:
+                f = self._qindex.get(k)
+                if f is not None:
+                    break
+            if f is not None:
+                # queued fetch for the same shard object: collapse —
+                # identical/covered extents are a pure dup hit, others
+                # merge into a union range (one store read on the peer)
+                if want is not None and not _extents_cover(f.extents,
+                                                           want):
+                    f.extents = _merge_extents(f.extents, want)
+                    self._inc("ec_read_union_merges")
+                else:
+                    self._inc("ec_read_dup_hits")
+                f.waiters.append((tid, want))
+                if tspan is not None:
+                    f.tspans.append(tspan)
+            else:
+                f = _ReadFetch(next(self._fids), pgid, oid, shard, want,
+                               marker=self._daemon._obj_write_marker())
+                f.waiters.append((tid, want))
+                if tspan is not None:
+                    f.tspans.append(tspan)
+                lane = (peer, pgid)
+                q = self._queued.setdefault(lane, [])
+                q.append(f)
+                self._qindex[key] = f
+                if len(q) >= self.max_items:
+                    self._deadlines.pop(lane, None)
+                    flush_peer = True
+                elif len(q) == 1:
+                    self._deadlines[lane] = (time.monotonic()
+                                             + self.window_us * 1e-6)
+                    if self._flusher is None:
+                        self._flusher = threading.Thread(
+                            target=self._flush_loop, daemon=True,
+                            name=f"ec-read-agg-{self._daemon.name}")
+                        self._flusher.start()
+                    self._cv.notify_all()
+        if flush_peer:
+            self._flush((peer, pgid), reason="size")
+
+    def _flush_loop(self) -> None:
+        """The single flusher: sleeps to the EARLIEST lane deadline,
+        flushes every due lane, repeats.  One thread per aggregator —
+        never one per window."""
+        while True:
+            due = []
+            with self._cv:
+                while not self._stopped:
+                    if not self._deadlines:
+                        self._cv.wait()
+                        continue
+                    now = time.monotonic()
+                    due = [ln for ln, d in self._deadlines.items()
+                           if d <= now]
+                    if due:
+                        for ln in due:
+                            self._deadlines.pop(ln, None)
+                        break
+                    self._cv.wait(min(self._deadlines.values()) - now)
+                if self._stopped:
+                    return
+            for lane in due:
+                self._flush(lane)
+
+    # ------------------------------------------------------------- flush
+    def _flush(self, lane: tuple, reason: str | None = None) -> None:
+        peer, pgid = lane
+        with self._lock:
+            self._deadlines.pop(lane, None)
+            fetches = self._queued.pop(lane, [])
+            for f in fetches:
+                self._qindex.pop(
+                    self._key(peer, f.pgid, f.oid, f.shard,
+                              f.extents is None), None)
+                self._inflight[f.fid] = f
+                self._inflight_keys.setdefault(
+                    self._key(peer, f.pgid, f.oid, f.shard,
+                              f.extents is None), []).append(f)
+        if not fetches:
+            return
+        n_subreads = sum(len(f.waiters) for f in fetches)
+        if reason is None:
+            reason = ("window" if len(fetches) > 1 or n_subreads > 1
+                      else "idle")
+        fspan = None
+        tops = [sp for f in fetches for sp in f.tspans]
+        if tops:
+            lead = tops[0]
+            fspan = lead._tracer.start(
+                "ec-read-flush", parent=lead.ctx, peer=peer,
+                n_items=len(fetches), n_subreads=n_subreads,
+                reason=reason)
+            for f in fetches:
+                f.fspan_id = fspan.span_id
+                for sp in f.tspans:
+                    sp.tag("flush_span", fspan.span_id)
+                    sp.tag("flush_reason", reason)
+                    sp.finish()
+                f.tspans = []
+        self._inc("ec_read_msgs")
+        self._inc("ec_read_fetches", len(fetches))
+        self._inc("ec_read_coalesced_subreads", n_subreads)
+        self._inc(f"ec_read_flush_{reason}")
+        if self._perf is not None:
+            self._perf.hinc("ec_read_fetches_per_msg", len(fetches))
+            self._perf.hinc("ec_read_subreads_per_msg", n_subreads)
+        items = [(f.fid, f.oid, f.shard,
+                  None if f.extents is None else list(f.extents))
+                 for f in fetches]
+        try:
+            sent = self._daemon.messenger.send_message(
+                peer, MSubReadN(items, pgid))
+        except Exception:  # noqa: BLE001 - racing daemon shutdown
+            sent = False
+        if fspan is not None:
+            fspan.tag("sent", bool(sent))
+            fspan.finish()
+        if not sent:
+            # peer gone: no reply will ever come — drop the fetches now
+            # (the pending reads complete from the surviving shards or
+            # expire through the normal sweep, same as a dropped
+            # MSubRead)
+            with self._lock:
+                for f in fetches:
+                    self._drop_locked(peer, f)
+
+    def _drop_locked(self, peer: str, f: _ReadFetch) -> None:
+        self._inflight.pop(f.fid, None)
+        key = self._key(peer, f.pgid, f.oid, f.shard, f.extents is None)
+        lst = self._inflight_keys.get(key)
+        if lst is not None:
+            if f in lst:
+                lst.remove(f)
+            if not lst:
+                self._inflight_keys.pop(key, None)
+
+    # ------------------------------------------------------------- reply
+    def on_reply(self, peer: str, items: list) -> None:
+        """Route one MSubReadReplyN: resolve each fetch, carve every
+        waiter's slices out of the union buffer, and deliver through
+        the daemon's normal shard-read completion.  When one reply
+        completes MANY pending reads their completions run on their
+        own threads, so degraded decodes triggered by the same wire
+        message coalesce in the ECBatcher instead of serializing
+        behind each other's batch windows."""
+        resolved = []  # (fetch, shard, result, data, attrs)
+        with self._lock:
+            for fid, shard, result, data, attrs in items:
+                f = self._inflight.get(fid)
+                if f is None:
+                    continue
+                self._drop_locked(peer, f)
+                resolved.append((f, shard, result, data, attrs))
+        # carve OUTSIDE the lock: the per-waiter slice copies are the
+        # expensive part and must not stall concurrent submit()/flush
+        # traffic on this OSD (a dropped fetch's waiter list is ours
+        # alone once it leaves the in-flight index)
+        deliveries = []  # (tid, shard, result, data, attrs)
+        for f, shard, result, data, attrs in resolved:
+            for tid, want in f.waiters:
+                payload = (_carve_extents(f.extents, data, want)
+                           if result == 0 else data)
+                deliveries.append((tid, shard, result, payload, attrs))
+        if len(deliveries) <= 1:
+            for d in deliveries:
+                self._daemon._on_shard_read(*d)
+            return
+        # fan the completions out without blocking the dispatch worker
+        # (a degraded completion sits out the decode batch window), on
+        # persistent pool threads so same-signature decodes triggered
+        # by ONE wire message coalesce in the ECBatcher instead of
+        # serializing
+        pool = self._pool
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            with self._lock:
+                if self._stopped:
+                    pool = None  # shutting down: never recreate
+                else:
+                    if self._pool is None:
+                        self._pool = ThreadPoolExecutor(
+                            max_workers=8,
+                            thread_name_prefix=(
+                                f"ec-read-{self._daemon.name}"))
+                    pool = self._pool
+        if pool is None:
+            for d in deliveries:
+                self._daemon._on_shard_read(*d)
+            return
+        for d in deliveries:
+            try:
+                pool.submit(self._daemon._on_shard_read, *d)
+            except RuntimeError:
+                # stop() shut the pool down between our read of
+                # self._pool and this submit: deliver inline so no
+                # pending read silently hangs until the sweep
+                self._daemon._on_shard_read(*d)
+
+    # ---------------------------------------------------------- lifecycle
+    def pending(self) -> int:
+        with self._lock:
+            return (sum(len(q) for q in self._queued.values())
+                    + len(self._inflight))
+
+    def sweep(self, now: float, max_age: float) -> None:
+        """Heartbeat-thread GC: drop in-flight fetches whose peer died
+        after the send (their waiters' pending reads expire through
+        the daemon's own sweep; this only frees the fetch state)."""
+        with self._lock:
+            for fid, f in list(self._inflight.items()):
+                if now - f.stamp > max_age:
+                    self._inflight.pop(fid, None)
+            for key, lst in list(self._inflight_keys.items()):
+                lst[:] = [f for f in lst if f.fid in self._inflight]
+                if not lst:
+                    self._inflight_keys.pop(key, None)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._deadlines.clear()
+            pool, self._pool = self._pool, None
+            self._cv.notify_all()
+        if pool is not None:
+            pool.shutdown(wait=False)
 
 
 class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
@@ -228,6 +668,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # per-object write serialization for multi-phase EC ops (the obc
         # lock / ECExtentCache ordering role): queued thunks per key
         self._obj_locks: dict[tuple, object] = {}
+        # read barrier for the sub-read aggregator's in-flight dup
+        # collapse: last acked-write sequence per (pgid, oid), bounded
+        # LRU; _obj_wfloor upper-bounds every evicted entry so a miss
+        # stays conservative (see _obj_written_since)
+        self._wbar_lock = threading.Lock()
+        self._obj_wseq = 0
+        self._obj_wlast: collections.OrderedDict = collections.OrderedDict()
+        self._obj_wfloor = 0
         self._requery_at: dict[tuple, float] = {}
         self._requery_timers: dict[tuple, object] = {}
         self._pending_scrubs: dict = {}
@@ -276,7 +724,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MSubDelta: self._handle_sub_delta,
             MSubWriteReply: self._handle_sub_write_reply,
             MSubRead: self._handle_sub_read,
+            MSubReadN: self._handle_sub_read_n,
             MSubReadReply: self._handle_sub_read_reply,
+            MSubReadReplyN: self._handle_sub_read_reply_n,
             MPGList: self._handle_pg_list,
             MOSDPing: self._handle_ping,
             MOSDPingReply: self._handle_ping_reply,
@@ -314,6 +764,19 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             window_min_us=self.cfg["ec_batch_window_min_us"],
             window_max_us=self.cfg["ec_batch_window_max_us"],
             perf=self.perf, events=self.events)
+        # sub-read aggregator (the message half of the EC read
+        # pipeline): concurrent MSubReads headed to one peer coalesce
+        # into MSubReadN wire messages within ec_read_window_us, with
+        # duplicate in-flight fetches collapsed and overlapping hot-
+        # object extents merged into union ranges.  Engaged per pool by
+        # _ec_read_coalesce_on; counters registered zeroed regardless,
+        # one stable perf/exporter schema.
+        self.perf.add_many(READ_AGG_COUNTERS)
+        for h in READ_AGG_HISTOGRAMS:
+            self.perf.add(h, CounterType.HISTOGRAM)
+        self._read_agg = SubReadAggregator(
+            self, window_us=self.cfg["ec_read_window_us"],
+            max_items=self.cfg["ec_read_max_items"], perf=self.perf)
         # op scheduler (OpScheduler/mClockScheduler role): the messenger
         # thread classifies+enqueues; ONE dequeue worker executes
         # handlers, preserving single-threaded handler semantics while
@@ -371,6 +834,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._requery_timers.clear()
         for t in timers:
             t.cancel()  # a dead daemon must not keep querying peers
+        self._read_agg.stop()
         self.messenger.shutdown()
         self.hb_messenger.shutdown()
         if self._use_mclock:
@@ -557,8 +1021,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     rescued.append((key[0],
                                     self._remote_waiting.pop(key)))
         for pgid, thunks in rescued:
-            for t in thunks:
-                self._recovery_enqueue(pgid, t)
+            for t, nb in thunks:
+                self._recovery_enqueue(pgid, t, nb)
 
     def _notify_demoted(self, old: OSDMap | None) -> None:
         """If I hold objects for PGs I am no longer an up member of, tell
@@ -792,6 +1256,39 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 del self._obj_locks[key]
         if nxt:
             self._run_locked_thunk(key, nxt)  # start the next queued write
+
+    # -- read barrier (aggregator in-flight dup collapse) ------------------
+    _OBJ_WLAST_CAP = 4096
+
+    def _obj_write_marker(self) -> int:
+        """Current object-write sequence (racy read is fine: a stale
+        low value only makes _obj_written_since more conservative)."""
+        return self._obj_wseq
+
+    def _note_obj_write(self, key: tuple | None) -> None:
+        """Record an acked (or torn) write to `key` = (pgid, oid).
+        MUST run before the client sees the ack: an aggregator fetch
+        created before this point may carry pre-write bytes, so reads
+        issued after the ack must not ride it."""
+        if key is None:
+            return
+        with self._wbar_lock:
+            self._obj_wseq += 1
+            self._obj_wlast[key] = self._obj_wseq
+            self._obj_wlast.move_to_end(key)
+            while len(self._obj_wlast) > self._OBJ_WLAST_CAP:
+                _, seq = self._obj_wlast.popitem(last=False)
+                if seq > self._obj_wfloor:
+                    self._obj_wfloor = seq
+
+    def _obj_written_since(self, key: tuple, marker: int) -> bool:
+        """Whether (pgid, oid) saw an acked write after sequence
+        `marker`.  An evicted entry answers via the floor — possibly a
+        false positive (rejecting a safe ride), never a false negative
+        (4096 distinct objects must be written within one fetch's
+        lifetime for the floor to pass a clean fetch's marker)."""
+        with self._wbar_lock:
+            return self._obj_wlast.get(key, self._obj_wfloor) > marker
 
     def _next_version(self, pgid: PgId) -> int:
         # reachable from the dispatch thread AND the heartbeat sweep (via
@@ -1880,12 +2377,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         pr = _PendingRead(None, 0, pgid.pool, m.oid,
                           total_shards=len(per_shard), on_done=on_old)
         self._pending_reads[tid] = pr
+        coalesce = self._ec_read_coalesce_on(pgid.pool)
+        span = getattr(m, "_span", None)
+        trace = (self.tracer, span.ctx) if span is not None else None
         for shard, exts in per_shard.items():
             osd = up[shard]
             want = [(soff, ln) for soff, ln, _ro in exts]
             if osd == self.osd_id:
                 self._deliver_local_shard_read(tid, pgid, m.oid, shard,
                                                want)
+            elif coalesce:
+                self._read_agg.submit(f"osd.{osd}", tid, pgid, m.oid,
+                                      shard, want, trace=trace)
             else:
                 self.messenger.send_message(
                     f"osd.{osd}", MSubRead(tid, pgid, m.oid, shard, want))
@@ -2156,16 +2659,49 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           row_base=row_base, row_len=row_len)
         pr.span = getattr(m, "_span", None)
         self._pending_reads[tid] = pr
-        self._fan_shard_reads(tid, pgid, m.oid, up, extents=extents)
+        if pr.span is not None:
+            # the fan-out stage of a traced read: local shard reads run
+            # inside it, remote sub-reads queue their ec-read-wait
+            # spans under it (the READ counterpart of ec-encode)
+            with self.tracer.start("ec-subread-fanout",
+                                   parent=pr.span.ctx, oid=m.oid) as sp:
+                self._fan_shard_reads(tid, pgid, m.oid, up,
+                                      extents=extents,
+                                      trace=(self.tracer, sp.ctx))
+        else:
+            self._fan_shard_reads(tid, pgid, m.oid, up, extents=extents)
+
+    def _ec_read_coalesce_on(self, pool_id: int) -> bool:
+        """Whether this pool's remote sub-reads route through the
+        per-peer aggregator: pool ec-profile key 'read_coalesce' wins,
+        then the ec_read_coalesce option; 'auto' engages under the
+        sharded mclock scheduler (reads fan out async, so concurrent
+        bursts overlap and the window buys message fan-in; a 0 window
+        is always pass-through)."""
+        if self._read_agg.window_us <= 0:
+            return False
+        codec = self._pool_codec(pool_id)
+        mode = str(codec.profile.get(
+            "read_coalesce", self.cfg["ec_read_coalesce"])).lower()
+        if mode in ("on", "true", "1", "yes"):
+            return True
+        if mode in ("off", "false", "0", "no"):
+            return False
+        return self._use_mclock
 
     def _fan_shard_reads(self, tid: int, pgid: PgId, oid: str,
-                         up: list, extents: list | None = None) -> None:
+                         up: list, extents: list | None = None,
+                         trace: tuple | None = None) -> None:
+        coalesce = self._ec_read_coalesce_on(pgid.pool)
         for shard, osd in enumerate(up):
             if osd is None:
                 continue
             if osd == self.osd_id:
                 self._deliver_local_shard_read(tid, pgid, oid, shard,
                                                extents)
+            elif coalesce:
+                self._read_agg.submit(f"osd.{osd}", tid, pgid, oid,
+                                      shard, extents, trace=trace)
             else:
                 self.messenger.send_message(
                     f"osd.{osd}", MSubRead(tid, pgid, oid, shard, extents))
@@ -2186,52 +2722,74 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             parts.append(seg)
         return b"".join(parts)
 
-    def _deliver_local_shard_read(self, tid, pgid, oid, shard,
-                                  extents: list | None = None) -> None:
+    #: shard attrs a RANGED client sub-read ships: the verification /
+    #: assembly set only (version agreement, whole-object length, the
+    #: stored digest, whiteout) — user attrs, SnapSets and the rest of
+    #: the attr dict stay home.  Whole-shard recovery reads keep the
+    #: full dict + omap (a rebuilt shard must land WITH its metadata).
+    _RANGED_READ_ATTRS = ("v", "len", "d", "dcsum", "wh")
+
+    def _read_one_sub(self, pgid: PgId, oid: str, shard: int,
+                      extents: list | None):
+        """Serve one sub-read against the local store: (result, data,
+        attrs) with MSubReadReply semantics — shared by the per-op and
+        vectorized handlers and the local fast path."""
         cid = CollectionId(pgid.pool, pgid.seed)
-        obj = to_oid(oid, shard)  # vname-aware: clones read their gen
+        obj = to_oid(oid, shard)  # vname-aware (clone shards)
         try:
             data = self._read_shard_slices(cid, obj, extents)
             attrs = dict(self.store.getattrs(cid, obj))
-            if extents is None:  # recovery read: omap rides along
+            if extents is None:
+                # whole-shard reads serve recovery: the object's
+                # replicated omap rides along so a rebuilt shard lands
+                # WITH metadata (ECOmapJournal recovery contract)
                 omap = self.store.omap_get(cid, obj)
                 if omap:
                     attrs["_omap"] = omap
-            result = 0
+            else:
+                # ranged client reads ship only the shard-verification
+                # attrs, not the whole dict
+                attrs = {k: attrs[k] for k in self._RANGED_READ_ATTRS
+                         if k in attrs}
+            return 0, data, attrs
         except NoSuchObject:
-            data, attrs, result = b"", {}, ENOENT
+            return ENOENT, b"", {}
         except StoreError:
-            # checksum-poisoned shard (FileStore csum verify): report EIO
-            # promptly so decode proceeds from the remaining shards
-            data, attrs, result = b"", {}, EIO
+            # checksum-poisoned shard (FileStore csum verify): report
+            # EIO promptly so decode proceeds from the remaining shards
+            return EIO, b"", {}
+
+    def _deliver_local_shard_read(self, tid, pgid, oid, shard,
+                                  extents: list | None = None) -> None:
+        result, data, attrs = self._read_one_sub(pgid, oid, shard,
+                                                 extents)
         self._on_shard_read(tid, shard, result, data, attrs)
 
     def _handle_sub_read(self, conn, m: MSubRead) -> None:
         self.perf.inc("subop_r")
-        cid = CollectionId(m.pgid.pool, m.pgid.seed)
-        obj = to_oid(m.oid, m.shard)  # vname-aware (clone shards)
-        try:
-            data = self._read_shard_slices(cid, obj, m.extents)
-            attrs = dict(self.store.getattrs(cid, obj))
-            # whole-shard reads serve recovery: the object's replicated
-            # omap rides along so a rebuilt shard lands WITH metadata
-            # (ECOmapJournal recovery contract); ranged client reads
-            # skip it
-            if m.extents is None:
-                omap = self.store.omap_get(cid, obj)
-                if omap:
-                    attrs["_omap"] = omap
-            conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
-                                    self.osd_id, 0, data, attrs))
-        except NoSuchObject:
-            conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
-                                    self.osd_id, ENOENT))
-        except StoreError:
-            conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
-                                    self.osd_id, EIO))
+        result, data, attrs = self._read_one_sub(m.pgid, m.oid, m.shard,
+                                                 m.extents)
+        conn.send(MSubReadReply(m.tid, m.pgid, m.oid, m.shard,
+                                self.osd_id, result, data, attrs))
+
+    def _handle_sub_read_n(self, conn, m: MSubReadN) -> None:
+        """Vectorized sub-read: serve every coalesced fetch and answer
+        them all in ONE MSubReadReplyN.  Runs on m.pgid's scheduler
+        shard (every item shares the pg), serialized against the pg's
+        write applies like a plain MSubRead."""
+        replies = []
+        for fid, oid, shard, extents in m.items:
+            self.perf.inc("subop_r")
+            result, data, attrs = self._read_one_sub(m.pgid, oid, shard,
+                                                     extents)
+            replies.append((fid, shard, result, data, attrs))
+        conn.send(MSubReadReplyN(self.osd_id, replies, m.pgid))
 
     def _handle_sub_read_reply(self, conn, m: MSubReadReply) -> None:
         self._on_shard_read(m.tid, m.shard, m.result, m.data, m.attrs)
+
+    def _handle_sub_read_reply_n(self, conn, m: MSubReadReplyN) -> None:
+        self._read_agg.on_reply(f"osd.{m.from_osd}", m.items)
 
     def _on_shard_read(self, tid, shard, result, data, attrs) -> None:
         with self._pending_lock:
@@ -2579,6 +3137,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 return
             self._pending_writes.pop(m.tid, None)
         result = EIO if pw.failed else (EAGAIN if pw.retry else 0)
+        # even a failed write may have mutated some shards (torn):
+        # fence the aggregator's in-flight dup collapse either way,
+        # BEFORE the client can observe the outcome
+        self._note_obj_write(pw.lock_key)
         if result != 0 and pw.lock_key is not None:
             # a failed/torn write leaves cached extents untrustworthy
             self._ec_cache.invalidate(*pw.lock_key)
@@ -2678,6 +3240,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self._pending_reads.pop(tid, None)
                     expired_r.append(pr)
         for pw in expired_w:
+            self._note_obj_write(pw.lock_key)  # possibly-torn write
             if pw.lock_key is not None:
                 self._ec_cache.invalidate(*pw.lock_key)
             self.messenger.send_message(
@@ -2686,6 +3249,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._obj_unlock(pw.lock_key)
         for pr in expired_r:
             self._finish_ec_read(pr)  # decodes if >= k arrived, else err
+        self._read_agg.sweep(now, max_age)
         self._sweep_notifies(now, max_age)
         self._sweep_reservations(now)
 
@@ -2767,30 +3331,54 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # client IO blocked on missing objects = forced-recovery urgency
         return 255 if self._stale_objects.get(pgid) else 180
 
-    def _recovery_op(self, pgid: PgId, target: int | None, thunk) -> None:
+    def _rec_weight(self, pgid: PgId, name: str) -> int:
+        """Byte weight of one recovery op on `name` (the ROADMAP
+        backfill-vs-recovery split): progress items weight by object
+        BYTES rather than op count, so ETAs stay accurate when object
+        sizes are skewed (one 4 MiB object vs a thousand 4 KiB ones).
+        Falls back to weight 1 when no local copy knows the length."""
+        cid = CollectionId(pgid.pool, pgid.seed)
+        try:  # replicated: the object IS the data
+            return max(1, int(self.store.stat(cid,
+                                              to_oid(name))["size"]))
+        except (NoSuchObject, StoreError):
+            pass
+        try:  # EC: any local shard's whole-object len attr
+            n = self._ec_object_len(pgid, name)
+        except Exception:  # noqa: BLE001 - weighting must never block
+            n = None
+        return max(1, int(n)) if n else 1
+
+    def _recovery_op(self, pgid: PgId, target: int | None, thunk,
+                     nbytes: int = 0) -> None:
         prio = self._recovery_prio(pgid)
+        nbytes = max(1, int(nbytes))  # byte weight; 1 = size unknown
         storm_opened = False
         with self._pending_lock:
             self._recovery_pg_ops[pgid] = \
                 self._recovery_pg_ops.get(pgid, 0) + 1
             # recovery-storm journal accounting: ops scheduled vs done
-            # since the storm opened (the progress module's feed).  A
-            # storm closes when the in-flight count drains to zero; a
-            # later wave opens a NEW storm (its own progress item).
+            # since the storm opened (the progress module's feed),
+            # byte-weighted alongside the raw op counts.  A storm
+            # closes when the in-flight count drains to zero; a later
+            # wave opens a NEW storm (its own progress item).
             rp = self._rec_progress.get(pgid)
             if rp is None:
                 rp = self._rec_progress[pgid] = {
-                    "total": 0, "done": 0, "emitted": 0.0,
-                    "start_ts": time.time()}
+                    "total": 0, "done": 0, "total_b": 0, "done_b": 0,
+                    "emitted": 0.0, "start_ts": time.time()}
                 storm_opened = True
             rp["total"] += 1
+            rp["total_b"] += nbytes
             self._local_waiting.setdefault(pgid, []).append(
-                lambda: self._remote_gate(pgid, target, prio, thunk))
+                lambda: self._remote_gate(pgid, target, prio, thunk,
+                                          nbytes))
         if storm_opened:
             self.events.emit(
                 "recovery", f"pg {self._pgstr(pgid)} recovery start",
                 event="recovery_start", pg=self._pgstr(pgid),
-                done=0, total=rp["total"], start_ts=rp["start_ts"])
+                done=0, total=rp["total_b"], done_ops=0,
+                total_ops=rp["total"], start_ts=rp["start_ts"])
         self._local_reserver.request(
             pgid, prio, lambda: self._flush_local_waiting(pgid))
         if self._local_reserver.held(pgid):
@@ -2804,9 +3392,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             t()
 
     def _remote_gate(self, pgid: PgId, target: int | None, prio: int,
-                     thunk) -> None:
+                     thunk, nbytes: int = 0) -> None:
         if target is None or target == self.osd_id:
-            self._recovery_enqueue(pgid, thunk)
+            self._recovery_enqueue(pgid, thunk, nbytes)
             return
         key = (pgid, target)
         with self._pending_lock:
@@ -2815,12 +3403,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             else:
                 held = False
                 w = self._remote_waiting.setdefault(key, [])
-                w.append(thunk)
+                w.append((thunk, nbytes))
                 first = len(w) == 1
                 if first:
                     self._remote_pending_at[key] = time.time()
         if held:
-            self._recovery_enqueue(pgid, thunk)
+            self._recovery_enqueue(pgid, thunk, nbytes)
         elif first:
             self.messenger.send_message(
                 f"osd.{target}",
@@ -2855,14 +3443,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 f"by osd.{m.from_osd}",
                 event="reservation_grant", pg=self._pgstr(m.pgid),
                 target=m.from_osd, waiting_ops=len(thunks))
-            for t in thunks:
-                self._recovery_enqueue(m.pgid, t)
+            for t, nb in thunks:
+                self._recovery_enqueue(m.pgid, t, nb)
         elif m.action == "release":
             self._remote_reserver.release(key)
 
-    def _recovery_enqueue(self, pgid: PgId, thunk) -> None:
+    def _recovery_enqueue(self, pgid: PgId, thunk,
+                          nbytes: int = 0) -> None:
         with self._pending_lock:
-            self._recovery_q.append((pgid, thunk))
+            self._recovery_q.append((pgid, thunk, nbytes))
         self._pump_recovery()
 
     def _pump_recovery(self) -> None:
@@ -2874,7 +3463,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         or not self._recovery_q):
                     return
                 self._recovery_inflight += 1
-                pgid, thunk = self._recovery_q.popleft()
+                pgid, thunk, nbytes = self._recovery_q.popleft()
             self._sub_epoch.v = 0  # fresh epoch pin per recovery op
             try:
                 thunk()
@@ -2884,14 +3473,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             finally:
                 with self._pending_lock:
                     self._recovery_inflight -= 1
-                self._recovery_op_done(pgid)
+                self._recovery_op_done(pgid, nbytes)
             if sleep > 0:
                 t = threading.Timer(sleep, self._pump_recovery)
                 t.daemon = True
                 t.start()
                 return
 
-    def _recovery_op_done(self, pgid: PgId) -> None:
+    def _recovery_op_done(self, pgid: PgId, nbytes: int = 0) -> None:
         release_local = False
         targets: list[tuple] = []
         ev = None
@@ -2901,6 +3490,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             rp = self._rec_progress.get(pgid)
             if rp is not None:
                 rp["done"] += 1
+                rp["done_b"] += max(1, int(nbytes))
             if n <= 0:
                 self._recovery_pg_ops.pop(pgid, None)
                 release_local = True
@@ -2918,13 +3508,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     ev = ("recovery_progress", dict(rp))
         if ev is not None:
             kind, rp = ev
+            # done/total ride BYTE-weighted (the progress tracker's
+            # percent/ETA feed); the raw op counts stay alongside
             self.events.emit(
                 "recovery",
                 f"pg {self._pgstr(pgid)} "
                 f"{'recovery done' if kind == 'recovery_done' else 'recovering'}"
-                f" ({rp['done']}/{rp['total']} ops)",
-                event=kind, pg=self._pgstr(pgid), done=rp["done"],
-                total=rp["total"], remaining=rp["total"] - rp["done"],
+                f" ({rp['done']}/{rp['total']} ops, "
+                f"{rp['done_b']}/{rp['total_b']} weighted bytes)",
+                event=kind, pg=self._pgstr(pgid), done=rp["done_b"],
+                total=rp["total_b"],
+                remaining=rp["total_b"] - rp["done_b"],
+                done_ops=rp["done"], total_ops=rp["total"],
                 start_ts=rp["start_ts"])
         if release_local:
             self._local_reserver.release(pgid)
@@ -2946,8 +3541,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self._remote_held.add(key)
                     expired.append((key, self._remote_waiting.pop(key, [])))
         for (pgid, _t), thunks in expired:
-            for t in thunks:
-                self._recovery_enqueue(pgid, t)
+            for t, nb in thunks:
+                self._recovery_enqueue(pgid, t, nb)
         if self.osdmap is not None:
             for key in self._remote_reserver.keys():
                 _pg, requester = key
@@ -3544,7 +4139,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self._recovery_op(
                         pgid, peer,
                         lambda name=name, shard=shard, v=v:
-                        self._rebuild_shard(pgid, name, shard, peer, v))
+                        self._rebuild_shard(pgid, name, shard, peer, v),
+                        nbytes=self._rec_weight(pgid, name))
         elif peer != self.osd_id:
             def push_delta(pgid=pgid, peer=peer, names=dict(names)):
                 cid = CollectionId(pgid.pool, pgid.seed)
@@ -3564,7 +4160,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self.messenger.send_message(
                         f"osd.{peer}", MPGPush(pgid, -1, push))
 
-            self._recovery_op(pgid, peer, push_delta)
+            self._recovery_op(pgid, peer, push_delta,
+                              nbytes=sum(self._rec_weight(pgid, n)
+                                         for n in names))
 
     def _recover_replicated(self, pgid, up, peer, peer_inv, my_inv,
                             dead) -> int:
@@ -3616,17 +4214,22 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self.messenger.send_message(
                         f"osd.{peer}", MPGPush(pgid, -1, out, deletes))
 
-            self._recovery_op(pgid, peer, push_objs)
+            self._recovery_op(pgid, peer, push_objs,
+                              nbytes=sum(self._rec_weight(pgid, n)
+                                         for n, _s in push))
         if pull:
             # the primary itself is behind (e.g. revived empty): pull,
             # and ask the mon to keep the caught-up peer serving in the
             # meantime (pg_temp — clients follow the acting set).
             # Pulls unblock client IO, so they ride the reservation
             # queue at forced priority (stale objects exist by now).
+            # pulled objects have no local copy to size: weight by name
+            # count (1 each) rather than pretending to know their bytes
             self._recovery_op(
                 pgid, peer,
                 lambda pull=list(pull): self.messenger.send_message(
-                    f"osd.{peer}", MPGPull(pgid, pull)))
+                    f"osd.{peer}", MPGPull(pgid, pull)),
+                nbytes=len(pull))
             if peer_is_member:
                 temp = [peer] + [u for u in up
                                  if u is not None and u != peer]
@@ -3694,7 +4297,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     pgid, holder,
                     lambda name=name, shard=shard, v=v, holder=holder:
                     self._fetch_and_push(pgid, name, shard, peer,
-                                         holder, v))
+                                         holder, v),
+                    nbytes=self._rec_weight(pgid, name))
                 scheduled += 1
             return scheduled
         for shard, osd in enumerate(up):
@@ -3706,7 +4310,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         pgid, peer,
                         lambda name=name, shard=shard, version=version:
                         self._rebuild_shard(pgid, name, shard, peer,
-                                            version))
+                                            version),
+                        nbytes=self._rec_weight(pgid, name))
                     scheduled += 1
             elif osd == self.osd_id:
                 # the peer's inventory may reveal objects where MY OWN
@@ -3718,7 +4323,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         pgid, None,
                         lambda name=name, shard=shard, version=version:
                         self._rebuild_shard(pgid, name, shard,
-                                            self.osd_id, version))
+                                            self.osd_id, version),
+                        nbytes=self._rec_weight(pgid, name))
                     scheduled += 1
         return scheduled
 

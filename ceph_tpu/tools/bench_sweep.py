@@ -102,6 +102,11 @@ def configs() -> list[dict]:
                 "argv": ["--ec-batch"]})
     out.append({"id": "ec_recovery_storm", "tool": "bench_root",
                 "argv": ["--ec-recovery"]})
+    # 7. the client-facing read pipeline: coalesced MSubReadN fan-out +
+    # batched degraded decode vs the per-op baseline (8-reader burst
+    # through a real MiniCluster; healthy/hot/ranged/degraded legs)
+    out.append({"id": "ec_read_burst", "tool": "bench_root",
+                "argv": ["--ec-read"]})
     return out
 
 

@@ -55,6 +55,11 @@ def message_samples() -> dict:
         M.MSubRead: M.MSubRead(6, pg, "o", 0, [(4096, 8192)]),
         M.MSubReadReply: M.MSubReadReply(7, pg, "o", 0, 1, 0, b"bytes",
                                          {"v": 3, "len": 50}),
+        M.MSubReadN: M.MSubReadN([(1, "o", 0, [(4096, 8192)]),
+                                  (2, "p", 2, None)], pg),
+        M.MSubReadReplyN: M.MSubReadReplyN(
+            1, [(1, 0, 0, b"bytes", {"v": 3, "len": 50}),
+                (2, 2, -2, b"", {})], pg),
         M.MOSDPing: M.MOSDPing(1, 5, 123.25),
         M.MOSDPingReply: M.MOSDPingReply(1, 123.25),
         M.MFailureReport: M.MFailureReport(2, 1, 5, 3.5),

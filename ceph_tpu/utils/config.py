@@ -236,6 +236,28 @@ OPTIONS: list[Option] = [
     Option("ec_batch_window_max_us", float, 4000.0, OptionLevel.ADVANCED,
            "adaptive-window ceiling (microseconds)", min=1.0,
            max=1_000_000.0, see_also=("ec_batch_adaptive",)),
+    Option("ec_read_coalesce", str, "auto", OptionLevel.ADVANCED,
+           "coalesce the EC read fan-out: concurrent MSubReads headed "
+           "to the same peer OSD merge into one MSubReadN wire message "
+           "within a small window, duplicate in-flight shard fetches "
+           "collapse onto one wire read, and overlapping extents of "
+           "one hot shard object merge into a union range.  'auto' "
+           "engages under the sharded mclock scheduler (fifo runs "
+           "client ops inline on one dispatch thread, but reads fan "
+           "out async so bursts still overlap — auto stays "
+           "conservative); per-pool override via ec profile key "
+           "'read_coalesce'", enum_values=("auto", "on", "off"),
+           see_also=("ec_read_window_us", "ec_read_max_items")),
+    Option("ec_read_window_us", float, 150.0, OptionLevel.ADVANCED,
+           "microseconds the sub-read aggregator holds a peer's first "
+           "queued fetch open for company before flushing the "
+           "MSubReadN (0 = pass-through: one MSubRead per shard per "
+           "op, bit-identical to the unbatched read path)",
+           min=0.0, max=1_000_000.0, see_also=("ec_read_coalesce",)),
+    Option("ec_read_max_items", int, 64, OptionLevel.ADVANCED,
+           "wire fetches queued per peer that force an immediate "
+           "MSubReadN flush before the window expires", min=1,
+           max=65536, see_also=("ec_read_coalesce",)),
     Option("osd_ec_stripe_unit", int, 4096, OptionLevel.ADVANCED,
            "EC chunk size (bytes per shard per stripe row); must be a "
            "multiple of 4096 (the EC_ALIGN_SIZE page-alignment contract, "
