@@ -22,6 +22,22 @@ from ..utils.perf import kernel_profiler
 from .interface import ChunkMap, ErasureCode, ErasureCodeError, Flags
 
 
+_DONATE_OK: bool | None = None
+
+
+def _donation_supported() -> bool:
+    """Whether the default jax backend can actually ALIAS a donated
+    input (TPU/GPU).  CPU XLA cannot — donation there still deletes the
+    buffer and emits a 'donated buffers were not usable' warning per
+    compiled shape, all cost and no aliasing — so the donated kernel
+    variants only engage off-CPU."""
+    global _DONATE_OK
+    if _DONATE_OK is None:
+        import jax
+        _DONATE_OK = jax.default_backend() != "cpu"
+    return _DONATE_OK
+
+
 def _pick_backend(name: str) -> str:
     if name == "auto":
         return "native" if native.available() else "numpy"
@@ -110,9 +126,13 @@ class MatrixErasureCode(ErasureCode):
         mesh (parallel/distributed.make_folded_matmul) — the multi-chip
         fan-out for folded (k, sum L) launches.  Cached in the same
         kernel LRU as the single-device ops, keyed by (matrix, fan-out).
-        Returns None when the mesh cannot be built (fewer devices than
-        requested appeared since resolution) so callers fall back to the
-        single-device launch rather than raising off the IO path."""
+        Returns ``(op, mesh)`` — the mesh rides along so the call site
+        can pre-stage a HOST fold straight into its sharding
+        (distributed.stage_folded: one h2d slice per device, no
+        device-0 landing + on-mesh reshard) — or None when the mesh
+        cannot be built (fewer devices than requested appeared since
+        resolution) so callers fall back to the single-device launch
+        rather than raising off the IO path."""
         def build():
             import jax  # deferred: jax import is heavy
 
@@ -122,7 +142,7 @@ class MatrixErasureCode(ErasureCode):
                 mesh = make_flat_mesh(n_shard)
             except (ValueError, RuntimeError):
                 return None
-            return jax.jit(make_folded_matmul(M, mesh))
+            return (jax.jit(make_folded_matmul(M, mesh)), mesh)
 
         key = (b"shard" + n_shard.to_bytes(4, "little")
                + M.tobytes() + bytes(M.shape))
@@ -165,7 +185,7 @@ class MatrixErasureCode(ErasureCode):
 
     # -- region multiply through the selected backend ----------------------
     def _matmul_device(self, M: np.ndarray, rows: np.ndarray, *,
-                       n_shard: int = 1):
+                       n_shard: int = 1, donate: bool = False):
         """Backend-resident region multiply: on the jax backend the
         result STAYS a device array (no np.asarray sync), so callers
         folding many stripes into one launch — the ECBatcher, the fused
@@ -175,19 +195,39 @@ class MatrixErasureCode(ErasureCode):
         ``n_shard > 1`` fans the launch over a flat device mesh, length
         axis sharded (make_folded_matmul) — engaged only when the column
         count splits into whole uint32 lanes per device; anything else
-        falls through to the single-device launch, byte-identical."""
+        falls through to the single-device launch, byte-identical.
+
+        ``donate=True`` (single-device jax only) runs the DONATED
+        kernel variant: the caller owns ``rows`` exclusively (a flush's
+        folded scratch) and XLA may alias it for the output instead of
+        allocating — the buffer is deleted afterwards.  The sharded
+        path ignores the flag: resharding onto the mesh makes the
+        original buffer un-aliasable (jax silently skips the donation),
+        so plumbing it there would only pretend."""
         if self._backend == "native":
             return native.encode_region(M, rows)
         if self._backend == "jax":
             if n_shard > 1 and rows.shape[-1] % (4 * n_shard) == 0:
-                op = self._jax_matmul_sharded(M, n_shard)
-                if op is not None:
+                ent = self._jax_matmul_sharded(M, n_shard)
+                if ent is not None:
+                    op, mesh = ent
+                    if isinstance(rows, np.ndarray):
+                        # host fold: land it pre-sharded (one metered
+                        # h2d, a column slice per device) instead of a
+                        # device-0 landing + on-mesh reshard
+                        from ..parallel.distributed import stage_folded
+                        rows = stage_folded(rows, mesh)
                     return self._profiled_launch(
                         op, rows,
                         f"matmul/{M.shape[0]}x{M.shape[1]}"
                         f"/L{rows.shape[-1]}/s{n_shard}")
+            op = self._jax_matmul(M)
+            if (donate and not isinstance(rows, np.ndarray)
+                    and _donation_supported()):
+                import functools
+                op = functools.partial(op, donate=True)
             return self._profiled_launch(
-                self._jax_matmul(M), rows,
+                op, rows,
                 f"matmul/{M.shape[0]}x{M.shape[1]}/L{rows.shape[-1]}")
         return gf256.encode_region(M, rows)
 
@@ -235,6 +275,70 @@ class MatrixErasureCode(ErasureCode):
         out = np.asarray(dev)
         kernel_profiler().note("sync", sig, time.perf_counter() - t0)
         return out
+
+    def host_sync_bulk(self, devs, sig: str | None = None) -> list:
+        """Materialize SEVERAL device results as ONE metered
+        device->host copy event (utils/staging.fetch_recorded): the
+        flush-plane contract — a folded launch's outputs (parity, or
+        parity + csums, or a decode's stacked rows) leave the device
+        together, booked as one ``ec_stage_d2h`` copy.  Numpy inputs
+        pass through untimed, same as host_sync."""
+        from ..utils import staging
+        return staging.fetch_recorded(devs, sig=sig)
+
+    def decode_folded_device(self, want: Sequence[int],
+                             avail: Sequence[int], stacked, *,
+                             n_shard: int = 1):
+        """Device-resident folded decode: ``stacked`` is a
+        ``(len(avail), N)`` uint8 DEVICE array whose rows are the
+        survivor chunks in ``avail`` (sorted) order — the ECBatcher's
+        folded decode fold.  Returns a ``(len(want), N)`` DEVICE array
+        of the reconstructed rows in ``want`` order, with NO host
+        sync: the caller carves every waiter's slice out of one bulk
+        host_sync_bulk copy per launch instead of one per matmul.
+
+        Math is identical to decode_chunks (same decode-matrix cache,
+        same single-row fast path, same parity-from-data product), so
+        the bytes are identical to the per-op host path."""
+        import jax.numpy as jnp
+
+        avail = [i for i in avail if i < self.chunk_count]
+        if len(avail) < self.k:
+            raise ErasureCodeError(
+                f"cannot decode: only {len(avail)} of {self.k} chunks")
+        want = list(want)
+        use = avail[: self.k]
+        stack = stacked[: self.k]
+        want_data = [i for i in want if i < self.k]
+        want_parity = [i for i in want if i >= self.k]
+        rows: dict[int, object] = {}
+        data_full = None
+        missing_data = [i for i in range(self.k) if i not in avail]
+        if not missing_data:
+            # all k data rows present: the first k sorted survivors ARE
+            # the data rows in order (decode_chunks' no-inversion path)
+            data_full = stack
+            for i in want_data:
+                rows[i] = stack[i]
+        else:
+            D = self._get_decode_matrix(use)
+            if want_parity or len(missing_data) > 1:
+                data_full = self._matmul_device(D, stack,
+                                                n_shard=n_shard)
+                for i in want_data:
+                    rows[i] = data_full[i]
+            else:
+                sub = self._matmul_device(D[want_data], stack,
+                                          n_shard=n_shard)
+                for r, i in enumerate(want_data):
+                    rows[i] = sub[r]
+        if want_parity:
+            par = self._matmul_device(
+                self.matrix[[i - self.k for i in want_parity]],
+                data_full, n_shard=n_shard)
+            for r, i in enumerate(want_parity):
+                rows[i] = par[r]
+        return jnp.stack([jnp.asarray(rows[i]) for i in want])
 
     def _matmul(self, M: np.ndarray, rows: np.ndarray, *,
                 n_shard: int = 1) -> np.ndarray:

@@ -181,7 +181,7 @@ class RegionMatmul:
             if fn is None:
                 kind, n4 = key
                 fn = (self._build_u32(n4) if kind == "u32"
-                      else self._build_u8(n4))
+                      else self._build_u8(n4, donate=kind == "u8d"))
                 if len(self._shape_cache) >= 16:
                     self._shape_cache.pop(next(iter(self._shape_cache)))
             self._shape_cache[key] = fn
@@ -221,14 +221,19 @@ class RegionMatmul:
     def _build_u32(self, n4: int):
         return jax.jit(self._lanes_op(n4))
 
-    def _build_u8(self, n4: int):
+    def _build_u8(self, n4: int, donate: bool = False):
+        # donate=True builds the DONATED variant (jax donate_argnums,
+        # SNIPPETS [1] idiom): XLA may alias the input buffer for the
+        # output instead of allocating, so a flush's folded scratch
+        # tensor costs no extra HBM and no copy.  Callers must own the
+        # input exclusively — donation deletes it (__call__ donate flag)
+        dargs = (0,) if donate else ()
         if not self._use_pallas:
             # identical math as a plain jnp graph — shared with
             # gf_matmul_graph so the lane-packing logic lives once
-            return jax.jit(gf_matmul_graph(self.M))
+            return jax.jit(gf_matmul_graph(self.M), donate_argnums=dargs)
         run, r, c = self._lanes_op(n4), self.r, self.c
 
-        @jax.jit
         def fn(data_u8):
             x32 = jax.lax.bitcast_convert_type(
                 data_u8.reshape(c, n4, 4), jnp.uint32)
@@ -236,7 +241,7 @@ class RegionMatmul:
             return jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(
                 r, n4 * 4)
 
-        return fn
+        return jax.jit(fn, donate_argnums=dargs)
 
     def _quantum(self, L: int) -> int:
         # uint32 tiling wants multiples of 128 lanes (512 bytes); beyond one
@@ -258,7 +263,11 @@ class RegionMatmul:
                 f"n4 % {self.BLOCK} == 0; got {n4}")
         return self._compiled(("u32", n4))(x32)
 
-    def __call__(self, data) -> jax.Array:
+    def __call__(self, data, *, donate: bool = False) -> jax.Array:
+        """``donate=True`` runs the donated-input variant: the caller
+        asserts exclusive ownership of ``data`` (a flush's folded
+        scratch buffer, never an arena/cache-held array) and XLA may
+        alias it for the output — the buffer is DELETED afterwards."""
         if (isinstance(data, np.ndarray) and data.dtype == np.uint8
                 and data.ndim == 2 and data.shape[0] == self.c
                 and data.shape[1] > 0):
@@ -282,5 +291,6 @@ class RegionMatmul:
         pad = (-L) % self._quantum(L)
         if pad:
             data = jnp.pad(data, ((0, 0), (0, pad)))
-        out = self._compiled(("u8", (L + pad) // 4))(data)
+        kind = "u8d" if donate else "u8"
+        out = self._compiled((kind, (L + pad) // 4))(data)
         return out[:, :L] if pad else out

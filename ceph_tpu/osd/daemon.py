@@ -63,6 +63,7 @@ from ..utils.tracer import Tracer
 from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
+from ..ec.arena import DeviceArena
 from .extent_cache import ECExtentCache
 from .intervals import INTERVALS_KEY, Interval, LES_KEY, PastIntervals
 from .objops import ObjOpsMixin
@@ -643,8 +644,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._peer_lcs: dict[PgId, dict[int, int]] = {}
         self._reconcile_at: dict[PgId, float] = {}
         # hot shard extents for the partial-write pipeline
-        # (ECExtentCache role): serves the delta path's old-byte reads
-        self._ec_cache = ECExtentCache()
+        # (ECExtentCache role): serves the delta path's old-byte reads,
+        # the rmw row reads and hot-object client reads.  The attached
+        # DeviceArena is the device half of the stripe plane: runs a
+        # jax-pool read feeds back into a folded launch stay HBM-
+        # resident under ec_arena_max_bytes instead of re-staging per
+        # op (the BENCH_SWEEP staging wall), and every invalidation
+        # path below evicts the device copy with the host one
+        self._ec_arena = DeviceArena(self.cfg["ec_arena_max_bytes"])
+        self._ec_cache = ECExtentCache(arena=self._ec_arena)
         self._hb_last: dict[int, float] = {}
         self._last_map = time.time()  # osd_beacon staleness clock
         self._hb_thread: threading.Thread | None = None
@@ -743,7 +751,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             "subop_r", "recovery_push", "recovery_delta",
                             "rollbacks", "failure_reports",
                             "scrubs", "scrub_errors", "ec_cache_hit",
-                            "ec_cache_miss", "map_inc", "map_full",
+                            "ec_cache_miss", "ec_read_cache_hit",
+                            "ec_rmw_cache_serves", "map_inc", "map_full",
                             "snap_trims"])
         self.perf.add("op_lat", CounterType.TIME)
         # cross-op EC batching (ec/batcher.py): concurrent stripe
@@ -2068,6 +2077,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         streams = si.ro_scatter(m.data)
         parity, csums = self._ec_encode(codec, streams, with_csums=True,
                                         m=m)
+        # write-through data AND parity streams at the new version: the
+        # rewrite just produced the authoritative bytes, so hot-object
+        # reads, rmw old-byte reads and the delta path's old-parity
+        # reads all serve from cache (the failure paths — local below,
+        # remote ack drain — invalidate)
+        for shard in range(codec.chunk_count):
+            if up[shard] is not None:
+                chunk = streams[shard] if shard < codec.k \
+                    else parity[shard - codec.k]
+                self._ec_cache.write(pgid, m.oid, shard, 0,
+                                     chunk.tobytes(), version=version,
+                                     length=len(m.data))
         attrs = {"v": version, "len": len(m.data)}
         if self._ec_whiteout(pgid, m.oid):
             attrs["wh"] = 0  # write resurrects a whiteout'd head
@@ -2100,17 +2121,25 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                               shard=shard)
                        if rider is not None else None)
                 tctx = self._tctx(m)
-                if tctx:
-                    with self.tracer.start("sub-write write",
-                                           parent=tctx, shard=shard,
-                                           oid=m.oid) as sp, \
-                            self.tracer.start("store-commit",
-                                              parent=sp.ctx):
+                try:
+                    if tctx:
+                        with self.tracer.start("sub-write write",
+                                               parent=tctx, shard=shard,
+                                               oid=m.oid) as sp, \
+                                self.tracer.start("store-commit",
+                                                  parent=sp.ctx):
+                            self._apply_write(pgid, m.oid, shard, data,
+                                              attrs, pre_tx=pre)
+                    else:
                         self._apply_write(pgid, m.oid, shard, data,
                                           attrs, pre_tx=pre)
-                else:
-                    self._apply_write(pgid, m.oid, shard, data, attrs,
-                                      pre_tx=pre)
+                except BaseException:
+                    # the write-through above published these bytes at
+                    # the new version; a failed local apply must not
+                    # leave them serveable (the lock is released by
+                    # _run_locked_thunk's unwind)
+                    self._ec_cache.invalidate(pgid, m.oid)
+                    raise
             else:
                 self.messenger.send_message(
                     f"osd.{osd}",
@@ -2190,6 +2219,24 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             # computes the true result
             pw.failed += local_failed
             pw.retry += local_retry
+        # write-through the freshly encoded rows, parity included (the
+        # device-resident stripe plane's hot-read feed: the next
+        # overlapping read or rmw of these rows serves from cache —
+        # device-side on a jax pool — instead of fanning to the
+        # stores); failure paths invalidate (below for local-only
+        # writes, the sub-write ack drain for remote ones).  This MUST
+        # precede the sends: a remote shard can fail and drain every
+        # ack (invalidating) before this thread resumes, and a
+        # write-through landing after that invalidation would re-
+        # publish the failed write's bytes with no one left to drop
+        # them.
+        for shard in range(codec.chunk_count):
+            if up[shard] is not None:
+                chunk = streams[shard] if shard < codec.k \
+                    else parity[shard - codec.k]
+                self._ec_cache.write(pgid, m.oid, shard, base,
+                                     chunk.tobytes(), version=version,
+                                     length=new_len)
         for shard, osd in enumerate(up):
             if osd is None or osd == self.osd_id:
                 continue
@@ -2206,6 +2253,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                  trace=self._tctx(m)))
         if remote == 0:
             result = EIO if local_failed else (EAGAIN if local_retry else 0)
+            if result != 0:
+                self._ec_cache.invalidate(pgid, m.oid)
             conn.send(MOSDOpReply(m.tid, result,
                                   version=version, epoch=self.osdmap.epoch))
             self._obj_unlock(lock_key)
@@ -2306,6 +2355,23 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if pw is not None:
                 pw.failed += local_failed
                 pw.retry += local_retry
+            # cache maintenance BEFORE any send (a remote failure can
+            # drain every ack — invalidating — before this thread
+            # resumes; a write-through landing after that would re-
+            # publish the failed bytes): drop cached PARITY runs (the
+            # deltas are applied shard-locally by the parity holders,
+            # so the primary never sees the resulting parity — cached
+            # parity bytes from an earlier full/row write would be
+            # stale at the advanced version), then refill the data-
+            # shard runs just written (the next overlapping overwrite
+            # skips the read fan); failure paths invalidate
+            self._ec_cache.drop_shards(
+                pgid, m.oid, range(codec.k, codec.chunk_count))
+            for shard, lst in news.items():
+                for soff, nb in lst:
+                    self._ec_cache.write(pgid, m.oid, shard, soff, nb,
+                                         version=version,
+                                         length=new_len)
             # data shards: new bytes (touched) or version bump (untouched)
             for shard, osd in enumerate(up):
                 if osd is None or osd == self.osd_id:
@@ -2330,13 +2396,6 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                   epoch=self._entry_epoch(),
                                   snap=rider or {},
                                   trace=self._tctx(m)))
-            # refill the extent cache with the bytes just written (the
-            # next overlapping overwrite skips the read fan); failure
-            # paths invalidate
-            for shard, lst in news.items():
-                for soff, nb in lst:
-                    self._ec_cache.write(pgid, m.oid, shard, soff, nb,
-                                         version=version)
             if remote_n == 0:
                 result = EIO if local_failed \
                     else (EAGAIN if local_retry else 0)
@@ -2464,6 +2523,29 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                 prev_version=vmax, lock_key=lock_key,
                                 rider=rider)
 
+        # extent-cache serve (the stripe plane's rmw feed): the touched
+        # rows' old bytes were written through by the previous write,
+        # so a hot-object rmw skips the k-wide read fan-out entirely —
+        # on_read sees a synthetic version-agreed k-set and proceeds
+        # straight to merge + re-encode (whose encode input then stages
+        # once in the batcher's device ingest)
+        cver = self._ec_cache.version(pgid, m.oid)
+        if cver is not None:
+            cached: dict[int, np.ndarray] = {}
+            for shard in range(codec.k):
+                b = self._ec_cache.read(pgid, m.oid, shard,
+                                        row0 * si.chunk_size, want_len)
+                if b is None:
+                    break
+                cached[shard] = np.frombuffer(b, dtype=np.uint8)
+            else:
+                self.perf.inc("ec_rmw_cache_serves")
+                served = _PendingRead(None, 0, pgid.pool, m.oid,
+                                      total_shards=codec.k)
+                served.chunks = cached
+                served.shard_vers = {s: cver for s in cached}
+                on_read(served)
+                return
         pr = _PendingRead(None, 0, pgid.pool, m.oid,
                           total_shards=sum(1 for u in up if u is not None),
                           on_done=on_read)
@@ -2643,6 +2725,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if target != m.oid:
             import dataclasses
             m = dataclasses.replace(m, oid=target)
+        elif not getattr(m, "snapid", 0) and \
+                self._ec_read_serve_cached(conn, m, pgid, si):
+            return  # hot-object read served from the extent cache
         tid = next(self._tids)
         extents = None
         row_base = row_len = 0
@@ -2670,6 +2755,110 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                       trace=(self.tracer, sp.ctx))
         else:
             self._fan_shard_reads(tid, pgid, m.oid, up, extents=extents)
+
+    def _ec_read_serve_cached(self, conn, m: MOSDOp, pgid: PgId,
+                              si: StripeInfo) -> bool:
+        """Serve a head-object client read entirely from the extent
+        cache (the device-resident stripe plane's hot-read path): when
+        every data shard's covering stream is cached at a known
+        version, the read never fans to the stores or the wire — and
+        on a jax pool the shard rows assemble IN HBM from the arena's
+        device mirrors, leaving as one metered d2h copy.  Returns
+        False (caller fans out) on any gap; the invalidation contract
+        (recovery pushes, rollbacks, removes, map changes, failed
+        writes) keeps a True serve byte-identical to the store path."""
+        if str(self.cfg["ec_read_cache_serve"]).lower() in (
+                "off", "false", "0", "no"):
+            return False
+        with self._pending_lock:
+            if self._obj_locks.get((pgid, m.oid)):
+                # a write/remove is in flight on the object: its
+                # write-through populated the cache at the NEW version
+                # before the shard acks drained, and serving that would
+                # expose bytes the client was never acked (a failed
+                # drain invalidates them away again).  Fall out to the
+                # store path, which the sharded op queue serializes
+                # with the applies.
+                return False
+        total = self._ec_cache.object_len(pgid, m.oid)
+        if self._ec_cache.version(pgid, m.oid) is None or not total:
+            return False
+        codec = self._pool_codec(pgid.pool)
+        if m.length:
+            row0, nrows = si.rows_of_range(m.offset, m.length)
+            soff, slen = row0 * si.chunk_size, nrows * si.chunk_size
+            row_base = row0 * si.stripe_width
+        else:
+            soff, slen = 0, si.object_chunk_size(total)
+            row_base = 0
+        span = getattr(m, "_span", None)
+        with (self.tracer.start("ec-cache-serve", parent=span.ctx,
+                                oid=m.oid)
+              if span is not None else contextlib.nullcontext()):
+            ro = self._ec_cached_ro(codec, si, pgid, m.oid, soff, slen)
+        if ro is None:
+            return False
+        with self._pending_lock:
+            if self._obj_locks.get((pgid, m.oid)):
+                # TOCTOU re-check: a write that registered AFTER the
+                # guard above may have invalidated + written through
+                # its (unacked) new version while we assembled — the
+                # assembled bytes are only guaranteed committed if no
+                # write appeared during assembly.  (A write registering
+                # after THIS check hasn't touched the cache yet, so the
+                # assembled bytes are the committed pre-write state.)
+                return False
+        self.perf.inc("ec_read_cache_hit")
+        if m.length:
+            # identical trimming to _finish_ec_read's range leg
+            limit = max(0, min(len(ro), total - row_base))
+            start = m.offset - row_base
+            payload = ro[:limit][start:start + m.length]
+        else:
+            payload = ro[:total]
+            if m.offset:
+                payload = payload[m.offset:]
+        conn.send(MOSDOpReply(m.tid, 0, data=payload,
+                              epoch=self.osdmap.epoch))
+        return True
+
+    def _ec_cached_ro(self, codec, si: StripeInfo, pgid: PgId, oid: str,
+                      soff: int, slen: int) -> bytes | None:
+        """The k data-shard streams [soff, soff+slen) interleaved back
+        into ro bytes, from the extent cache only — device-assembled
+        (zero-copy HBM views, one metered d2h for the payload) when
+        every stream is arena-resident on a jax pool, host-assembled
+        otherwise.  None = not fully cached."""
+        if getattr(codec, "_backend", None) == "jax":
+            devs = []
+            for shard in range(codec.k):
+                d = self._ec_cache.read_device(pgid, oid, shard, soff,
+                                               slen)
+                if d is None:
+                    devs = None
+                    break
+                devs.append(d)
+            if devs is not None:
+                try:
+                    import jax.numpy as jnp
+
+                    from ..utils import staging
+                    rows = slen // si.chunk_size
+                    ro_dev = jnp.stack(devs).reshape(
+                        codec.k, rows, si.chunk_size).transpose(
+                        1, 0, 2).reshape(-1)
+                    (ro,) = staging.fetch_recorded(
+                        [ro_dev], sig="sync/cache-read")
+                    return ro.tobytes()
+                except Exception:  # noqa: BLE001 - host fall-through
+                    pass
+        parts = []
+        for shard in range(codec.k):
+            b = self._ec_cache.read(pgid, oid, shard, soff, slen)
+            if b is None:
+                return None
+            parts.append(np.frombuffer(b, dtype=np.uint8))
+        return si.ro_assemble(parts).tobytes()
 
     def _ec_read_coalesce_on(self, pool_id: int) -> bool:
         """Whether this pool's remote sub-reads route through the

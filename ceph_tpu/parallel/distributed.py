@@ -39,6 +39,33 @@ from ..models.stripe_codec import StripeCodec
 from ..ops.ec_kernels import gf_matmul_graph
 
 
+def stage_folded(rows: np.ndarray, mesh: Mesh, axis: str = "shard"):
+    """Stage a host fold DIRECTLY into its mesh sharding: ``device_put``
+    with the folded launch's NamedSharding moves one column slice per
+    device, instead of landing the whole tensor on device 0 and paying
+    an on-mesh reshard when the jitted shard_map consumes it — the
+    sharded half of the device-resident stripe plane's single-h2d
+    contract.  The copy is metered on the ``ec_stage_h2d_*`` staging
+    counters (one copy event: the slices leave the host together).
+    Device-resident inputs pass through untouched — the jit reshards
+    them on-device."""
+    if not isinstance(rows, np.ndarray):
+        return rows
+    import time
+
+    from jax.sharding import NamedSharding
+
+    from ..utils import staging
+    t0 = time.perf_counter()
+    dev = jax.device_put(rows, NamedSharding(mesh, P(None, axis)))
+    # latency only on the synchronous CPU backend — an async device_put
+    # returns at dispatch and would book dispatch time as the copy
+    staging.note_h2d(rows.nbytes,
+                     time.perf_counter() - t0
+                     if staging.backend_is_cpu() else None)
+    return dev
+
+
 def make_folded_matmul(M: np.ndarray, mesh: Mesh, axis: str = "shard"):
     """Mesh-sharded folded region multiply: fn(rows (c, N) uint8) ->
     (r, N) uint8 computing M @ rows over GF(2^8) with the LENGTH axis

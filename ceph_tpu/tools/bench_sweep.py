@@ -107,6 +107,19 @@ def configs() -> list[dict]:
     # through a real MiniCluster; healthy/hot/ranged/degraded legs)
     out.append({"id": "ec_read_burst", "tool": "bench_root",
                 "argv": ["--ec-read"]})
+    # 8. the device-resident stripe-plane regression gate (ISSUE 6):
+    # kernel / staging / e2e GB/s and the e2e:kernel share per run,
+    # plus the one-d2h-copy-per-flush contract — the compact row
+    # future PRs must not regress
+    out.append({"id": "ec_e2e_ratio", "tool": "bench_root",
+                "argv": ["--ec-batch"],
+                "extract": ["kernel_gbps", "kernel_leg_gbps",
+                            "staging_h2d_gbps", "e2e_gbps",
+                            "e2e_chunk_kib", "e2e_device_share",
+                            "e2e_vs_kernel_quiet",
+                            "e2e_within_2x_kernel",
+                            "d2h_copies_per_flush",
+                            "single_d2h_per_flush", "digest_verified"]})
     return out
 
 
@@ -132,6 +145,10 @@ def run_config(cfg: dict, timeout: float, env: dict) -> dict:
         result = json.loads(proc.stdout.strip().splitlines()[-1])
     except (json.JSONDecodeError, IndexError):
         return {"error": f"bad output: {proc.stdout[-300:]}"}
+    if cfg.get("extract"):
+        # compact regression-gate rows: keep only the named keys so
+        # the sweep table stays scannable across rounds
+        result = {key: result.get(key) for key in cfg["extract"]}
     result["wall_s"] = round(time.time() - t0, 1)
     return {"result": result}
 

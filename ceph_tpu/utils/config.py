@@ -236,6 +236,24 @@ OPTIONS: list[Option] = [
     Option("ec_batch_window_max_us", float, 4000.0, OptionLevel.ADVANCED,
            "adaptive-window ceiling (microseconds)", min=1.0,
            max=1_000_000.0, see_also=("ec_batch_adaptive",)),
+    Option("ec_read_cache_serve", str, "on", OptionLevel.ADVANCED,
+           "serve whole client EC reads from the primary's extent "
+           "cache when every data shard's rows are cached at a known "
+           "version (the device-resident stripe plane's hot-read "
+           "path): no store or wire fan-out, byte-identical to the "
+           "store path under the cache invalidation contract.  'off' "
+           "always fans reads out (the read-pipeline tests do this to "
+           "exercise the sub-read aggregator)",
+           enum_values=("on", "off"), see_also=("ec_arena_max_bytes",)),
+    Option("ec_arena_max_bytes", int, 64 << 20, OptionLevel.ADVANCED,
+           "HBM byte budget of the per-OSD device arena backing the "
+           "device-resident stripe plane (ec/arena.py): extent-cache "
+           "runs staged to the device stay resident under this budget "
+           "and evict LRU beyond it.  Eviction drops only the device "
+           "copy — the host extent cache re-stages on the next device "
+           "read, so an undersized arena degrades to per-op staging "
+           "instead of losing bytes", min=1 << 20,
+           see_also=("ec_batch",)),
     Option("ec_read_coalesce", str, "auto", OptionLevel.ADVANCED,
            "coalesce the EC read fan-out: concurrent MSubReads headed "
            "to the same peer OSD merge into one MSubReadN wire message "

@@ -52,6 +52,13 @@ class PerfCounters:
         with self._lock:
             self._counters[name] = _Counter(name, ctype, desc)
 
+    def has(self, name: str) -> bool:
+        """Whether the counter is already registered — re-adding an
+        existing counter RESETS it, so late registrants (the staging
+        plane, arenas) must check before add."""
+        with self._lock:
+            return name in self._counters
+
     def add_many(self, names: Iterable[str],
                  ctype: CounterType = CounterType.COUNTER) -> None:
         for n in names:

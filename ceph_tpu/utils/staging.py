@@ -1,0 +1,146 @@
+"""Host<->device staging plane: the one device_put/landing helper and
+the ``ec_stage_*`` accounting every staged byte rides through.
+
+The BENCH_SWEEP_CPU numbers that motivated the device-resident stripe
+plane (kernel 1.27 GB/s vs e2e 0.25 GB/s — arXiv:1709.05365's
+pipeline-overhead wall) are a data-movement story, so the movement
+itself must be observable: every batcher/arena host->device ingest and
+every flush's single device->host copy lands here as bytes + copies +
+a pow2-microsecond histogram on the process-wide ``ec_kernels``
+registry (next to the KernelProfiler's compile/device/sync slices, so
+``dump_kernel_profile`` scrapes and the exporter see the whole
+decomposition with zero extra wiring).
+
+Scope note: these counters meter the BATCHER/ARENA staging plane
+specifically — ``ec_stage_d2h_copies`` divided by the batcher's launch
+count is the "one device->host copy per flush" contract the bench
+asserts.  Codec-internal per-op syncs (pass-through paths, non-batched
+callers) keep riding KernelProfiler's ``sync`` slice instead.
+
+``device_put_landed`` is the landing idiom tools/bench_tpu.py used to
+hand-copy at three sites: ``jax.device_put`` + a one-element fetch,
+because over the axon remote backend ``block_until_ready`` returns
+before the transfer has actually landed and a naive timing loop
+measures dispatch, not the copy.  The hot ingest path skips the
+forcing fetch (``force=False``) — it would be a per-op round-trip —
+and lets the flush's launch force everything at once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .perf import CounterType, PerfCounters, global_perf
+
+#: registered (zeroed) on the ``ec_kernels`` registry at first use, so
+#: perf dump / the exporter expose one stable schema whether or not the
+#: device-resident plane ever engaged
+COUNTERS = ("ec_stage_h2d_bytes", "ec_stage_h2d_copies",
+            "ec_stage_d2h_bytes", "ec_stage_d2h_copies")
+HISTOGRAMS = ("ec_stage_h2d_us", "ec_stage_d2h_us")
+
+_REG_LOCK = threading.Lock()
+_CPU_BACKEND: bool | None = None
+
+
+def backend_is_cpu() -> bool:
+    """Whether the default jax backend is host CPU.  Cached: the
+    ingest plane asks per op.  On CPU every host->device copy is a
+    real memcpy over the same memory bus the kernel reads — per-op
+    staging + an XLA concat costs ~3x the one host fold it replaces
+    (measured: 23ms vs 7ms per 8 MiB flush), so the ingest plane only
+    engages on real accelerators, where the DMA overlaps compute and
+    the fold assembles at HBM bandwidth."""
+    global _CPU_BACKEND
+    if _CPU_BACKEND is None:
+        import jax
+        _CPU_BACKEND = jax.default_backend() == "cpu"
+    return _CPU_BACKEND
+
+
+def stage_perf() -> PerfCounters:
+    """The ``ec_kernels`` registry with the staging schema ensured —
+    idempotent (PerfCounters.add RESETS an existing counter, so the
+    late registrants here must check first)."""
+    pc = global_perf().create("ec_kernels")
+    with _REG_LOCK:
+        for n in COUNTERS:
+            if not pc.has(n):
+                pc.add(n)
+        for h in HISTOGRAMS:
+            if not pc.has(h):
+                pc.add(h, CounterType.HISTOGRAM)
+    return pc
+
+
+def note_h2d(nbytes: int, seconds: float | None = None) -> None:
+    """``seconds=None`` books bytes + the copy count but NOT latency:
+    an unforced ``device_put`` on an async backend returns at dispatch,
+    so timing it would pollute the histogram (and any bandwidth
+    derived from it) with numbers far above the real transfer."""
+    pc = stage_perf()
+    pc.inc("ec_stage_h2d_bytes", int(nbytes))
+    pc.inc("ec_stage_h2d_copies")
+    if seconds is not None:
+        pc.hinc("ec_stage_h2d_us", seconds * 1e6)
+
+
+def note_d2h(nbytes: int, seconds: float) -> None:
+    pc = stage_perf()
+    pc.inc("ec_stage_d2h_bytes", int(nbytes))
+    pc.inc("ec_stage_d2h_copies")
+    pc.hinc("ec_stage_d2h_us", seconds * 1e6)
+
+
+def device_put_landed(host: np.ndarray, *, force: bool = True,
+                      record: bool = True):
+    """Stage a host buffer to the default device and (optionally) force
+    it to actually LAND — a one-element fetch, because over the axon
+    tunnel ``block_until_ready`` returns before the transfer completes
+    (tools/bench_tpu.py methodology).  ``record=True`` books the copy
+    against the ``ec_stage_h2d_*`` counters; benches that time the
+    transfer themselves still record (the counters are cumulative
+    telemetry, not the bench's own clock)."""
+    import jax
+
+    t0 = time.perf_counter()
+    dev = jax.device_put(host)
+    if force:
+        idx = (0,) * getattr(dev, "ndim", 0)
+        _ = np.asarray(dev[idx]) if idx else np.asarray(dev)
+    if record:
+        # latency is only meaningful when the transfer was forced to
+        # land (or the backend is synchronous CPU): an unforced put on
+        # an async backend times DISPATCH, not the copy
+        dt = (time.perf_counter() - t0
+              if force or backend_is_cpu() else None)
+        note_h2d(getattr(host, "nbytes", len(host)), dt)
+    return dev
+
+
+def fetch_recorded(devs, *, sig: str | None = None):
+    """Materialize one or more device buffers on the host as ONE
+    metered device->host copy event (the flush-plane "exactly one copy
+    per flush" contract: a fused launch's parity AND csums leave the
+    device together, so they are booked together).  Returns a list of
+    numpy arrays in input order.  Numpy inputs pass through unmetered —
+    they never left the host."""
+    devs = list(devs)
+    if all(isinstance(d, np.ndarray) for d in devs):
+        return devs
+    from .perf import kernel_profiler
+
+    t0 = time.perf_counter()
+    out = [d if isinstance(d, np.ndarray) else np.asarray(d)
+           for d in devs]
+    dt = time.perf_counter() - t0
+    nbytes = sum(o.nbytes for o, d in zip(out, devs)
+                 if not isinstance(d, np.ndarray))
+    note_d2h(nbytes, dt)
+    if sig is None:
+        sig = "sync/bulk"
+    kernel_profiler().note("sync", sig, dt)
+    return out

@@ -13,6 +13,7 @@ import time
 import numpy as np
 
 from ceph_tpu import ec
+from ceph_tpu.msg.messages import PgId
 from ceph_tpu.ec.batcher import (ECBatcher, FLUSH_IDLE, FLUSH_SIZE,
                                  FLUSH_WINDOW, bucket_len)
 from ceph_tpu.ops import gf256, native
@@ -353,3 +354,65 @@ def test_non_matrix_codec_passes_through():
     parity, _ = b.encode(clay, data)
     assert np.array_equal(np.asarray(parity), clay.encode_chunks(data))
     assert b.stats[FLUSH_IDLE] == 1
+
+
+# -------------------------------------- device-resident stripe plane e2e
+def test_device_cache_serves_and_invalidation_forces_reread():
+    """E2E leg for the device-resident extent cache (ISSUE 6): on a
+    jax pool the primary's write-through populates the host cache +
+    HBM arena, a hot-object client read serves straight from it
+    (ec_read_cache_hit, byte-identical to the store path), and the
+    invalidation contract holds end to end — an overwrite serves the
+    NEW bytes, an osdmap change evicts the device copy (arena drains
+    for remapped PGs), and a remove leaves no cached version behind."""
+    from ceph_tpu.tools.vstart import MiniCluster
+    from tests.test_cluster import make_cfg
+
+    c = MiniCluster(n_osds=6, cfg=make_cfg()).start()
+    try:
+        client = c.client()
+        client.create_pool("plane", kind="ec", pg_num=1,
+                           ec_profile={"plugin": "tpu", "k": "4",
+                                       "m": "2", "backend": "jax"})
+        payload = RNG.integers(0, 256, 120_000, dtype=np.uint8).tobytes()
+        client.write_full("plane", "hot", payload)
+        pool_id = client._pool_id("plane")
+        seed = c.mon.osdmap.object_to_pg(pool_id, "hot")
+        up = c.mon.osdmap.pg_to_up_osds(pool_id, seed)
+        prim = c.osds[up[0]]
+        hits0 = prim.perf.get("ec_read_cache_hit")
+        assert client.read("plane", "hot") == payload
+        assert prim.perf.get("ec_read_cache_hit") == hits0 + 1
+        assert prim._ec_arena.nbytes > 0  # shard rows live in the arena
+        # ranged read off the cached rows stays byte-identical too
+        assert client.read("plane", "hot", offset=4096,
+                           length=10_000) == payload[4096:14096]
+        # overwrite: write-through replaces the cached rows at the new
+        # version — the cached serve must produce the NEW bytes
+        payload2 = RNG.integers(0, 256, 120_000,
+                                dtype=np.uint8).tobytes()
+        client.write_full("plane", "hot", payload2)
+        assert client.read("plane", "hot") == payload2
+        # osdmap change remapping the PG: the primary's cache AND its
+        # arena mirrors for that PG evict; the next read re-fans to
+        # the stores (degraded) and still returns the right bytes
+        epoch = c.mon.osdmap.epoch
+        victim = next(o for o in up[1:] if o is not None)
+        c.kill_osd(victim)
+        c.wait_for_epoch(epoch + 1)
+        c.settle(0.6)
+        pgid = PgId(pool_id, seed)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                prim._ec_cache.version(pgid, "hot") is not None:
+            time.sleep(0.05)  # primary still draining the new map
+        assert prim._ec_cache.version(pgid, "hot") is None
+        assert client.read("plane", "hot") == payload2
+        # remove: the cached version must not survive the object
+        client.remove("plane", "hot")
+        c.settle(0.3)
+        pg = next(iter(prim._ec_cache.pgids()), None)
+        if pg is not None:
+            assert prim._ec_cache.version(pg, "hot") is None
+    finally:
+        c.stop()

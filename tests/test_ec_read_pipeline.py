@@ -367,6 +367,9 @@ def _cfg(**over):
                     "osd_op_num_shards": 2,
                     "ms_dispatch_workers": 2,
                     "ec_read_coalesce": "on",
+                    # these tests exercise the sub-read aggregator: the
+                    # extent-cache serve would shortcut the wire fan-out
+                    "ec_read_cache_serve": "off",
                     "ec_read_window_us": 500.0, **over})
     return cfg
 
