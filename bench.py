@@ -117,7 +117,7 @@ def _force_bench_cpu() -> bool:
     return True
 
 
-def ec_batch_bench() -> int:
+def ec_batch_bench(trace: bool = False) -> int:
     """`--ec-batch` mode: cross-op batched vs per-op encode under a
     simulated multi-client write burst (8 writer threads submitting
     full-stripe encodes through an ECBatcher), same one-line JSON
@@ -331,7 +331,7 @@ def ec_batch_bench() -> int:
     # launch incl. host sync) — the stage table every later perf PR is
     # graded against
     trace_stages = None
-    if "--trace" in sys.argv[1:]:
+    if trace:
         from ceph_tpu.tools.trace_tool import (format_stage_table,
                                                stage_stats)
         from ceph_tpu.utils.tracer import Tracer
@@ -525,7 +525,7 @@ def _recovery_progress_leg() -> dict:
                       for k, ps in seen.items()}}
 
 
-def ec_recovery_bench() -> int:
+def ec_recovery_bench(progress: bool = False) -> int:
     """`--ec-recovery` mode: the PG-recovery-storm scenario — one OSD's
     shards drop and a burst of stripes decode-rebuilds through the
     batcher (ROADMAP "recovery-burst batching").  8 reader threads each
@@ -643,9 +643,8 @@ def ec_recovery_bench() -> int:
         }
     verified = all(v["ok"] for v in results.values()) and \
         all(v["ok"] for v in sweep.values())
-    progress = None
-    if "--progress" in sys.argv[1:]:
-        progress = _recovery_progress_leg()
+    progress = _recovery_progress_leg() if progress else None
+    if progress is not None:
         verified = verified and progress["ok"]
     backend = "cpu" if on_cpu else "dev"
     gbps_b = results["batched"]["gbps"]
@@ -668,7 +667,7 @@ def ec_recovery_bench() -> int:
     return 0 if verified else 1
 
 
-def ec_read_bench() -> int:
+def ec_read_bench(trace: bool = False) -> int:
     """`--ec-read` mode: the client-facing EC read fan-out under an
     8-reader burst through a real MiniCluster — the coalesced read
     pipeline (per-peer MSubReadN aggregation + duplicate-fetch
@@ -850,7 +849,7 @@ def ec_read_bench() -> int:
                 pcts(flat), msgs_per_op=round(mpo, 2),
                 decode_launches_per_op=round(lpo, 3),
                 reads_per_s=round(readers * n_objects / wall, 1))
-            if mode == "coalesced" and "--trace" in sys.argv[1:]:
+            if mode == "coalesced" and trace:
                 from ceph_tpu.tools.trace_tool import (
                     format_stage_table, stage_stats)
                 tcl = c.client()
@@ -899,14 +898,76 @@ def ec_read_bench() -> int:
     return 0 if verified else 1
 
 
-def main() -> int:
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    if "--ec-batch" in sys.argv[1:]:
-        return ec_batch_bench()
-    if "--ec-recovery" in sys.argv[1:]:
-        return ec_recovery_bench()
-    if "--ec-read" in sys.argv[1:]:
-        return ec_read_bench()
+def saturate_bench(args) -> int:
+    """`--saturate` mode: the many-client QoS regression gate — a
+    multi-process load generator (ceph_tpu.load) drives simulated
+    clients through librados over TCP against a 4-OSD MiniCluster,
+    through ramp-to-saturation, steady-saturation and thrash-while-
+    loaded legs, across >= 3 mclock recovery reservation/limit
+    settings.  ONE JSON row: client p50/p99 per op class, achieved vs
+    offered rate, recovery ETA/rates, msgs/op, SLOW_OPS trips — gated
+    on STRUCTURAL invariants (no deadlock, bounded queues, recovery
+    completes, QoS ordering holds), never absolute throughput (the CI
+    box is a 2-core high-variance machine).  Exit nonzero on any
+    invariant failure.  --smoke runs one tier-1-safe point (tens of
+    clients, seconds-bounded) with no cross-point QoS gate."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ceph_tpu.load.scenarios import (ScenarioConfig,
+                                         default_sweep_points,
+                                         run_sweep)
+    if args.smoke:
+        base = ScenarioConfig(
+            profile=args.profile, procs=args.procs,
+            clients=min(args.clients, 12), objects=16,
+            ramp_rates=(40.0,), ramp_leg_s=1.0, steady_s=2.0,
+            thrash_s=4.0, kill_after_s=0.6, recovery_deadline_s=30.0)
+        points = [{"id": "smoke", "osd_mclock_recovery_res": 16.0,
+                   "osd_mclock_recovery_lim": 32.0}]
+    else:
+        base = ScenarioConfig(
+            profile=args.profile, procs=args.procs,
+            clients=args.clients, objects=args.objects,
+            ramp_rates=(50.0, 150.0, 450.0), ramp_leg_s=1.5,
+            steady_s=args.steady_s, thrash_s=args.thrash_s,
+            kill_after_s=1.0, recovery_deadline_s=45.0)
+        points = default_sweep_points()
+    row = run_sweep(points=points, base=base)
+    mid = row["points"][len(row["points"]) // 2]
+    steady = mid["steady"]
+    value = steady.get("achieved_per_s", 0.0)
+    offered = steady.get("offered_per_s", 0.0)
+    print(json.dumps({
+        "metric": (f"saturation client ops/s ({base.profile} profile, "
+                   f"{base.procs}-proc x {base.clients}-client burst, "
+                   f"ec k=2 m=1 over TCP, mclock sweep "
+                   f"{[p['id'] for p in points]}, "
+                   "structural-invariant gated)"),
+        "value": value,
+        "unit": "ops/s",
+        "vs_baseline": (round(value / offered, 3) if offered else None),
+        "profile": base.profile,
+        "procs": base.procs,
+        "clients": base.clients,
+        "saturation_knee_per_s": mid["ramp"]["saturation_knee_per_s"],
+        "client_read_p50_ms": steady.get("read", {}).get("p50_ms"),
+        "client_read_p99_ms": steady.get("read", {}).get("p99_ms"),
+        "client_write_p50_ms": steady.get("write", {}).get("p50_ms"),
+        "client_write_p99_ms": steady.get("write", {}).get("p99_ms"),
+        "recovery_eta_s": mid["recovery"].get("eta_s"),
+        "recovery_wall_s": mid["recovery"].get("wall_s"),
+        "msgs_per_op": mid["msgs_per_op"],
+        "slow_ops_trips": sum(p["slow_ops_trips"]
+                              for p in row["points"]),
+        "qos": row["qos"],
+        "invariants": {p["id"]: p["invariants"]
+                       for p in row["points"]},
+        "points": row["points"],
+        "ok": row["ok"],
+    }))
+    return 0 if row["ok"] else 1
+
+
+def headline_bench() -> int:
     cpu = cpu_baseline_gbps()
     print(f"bench: cpu single-thread baseline {cpu:.2f} GB/s", file=sys.stderr)
     dev = tpu_gbps()
@@ -951,6 +1012,65 @@ def main() -> int:
         "vs_baseline": round(value / cpu, 3) if cpu > 0 else None,
     }))
     return 0
+
+
+def main() -> int:
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="bench.py",
+        description="ceph_tpu benchmark driver: headline EC kernel "
+                    "GB/s by default, or one focused mode.  Every "
+                    "mode prints ONE JSON row and exits nonzero when "
+                    "its acceptance gate fails.")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--ec-batch", action="store_true",
+                      help="cross-op batched vs per-op encode burst "
+                           "(+ sharded, adaptive-window and device-"
+                           "plane legs)")
+    mode.add_argument("--ec-recovery", action="store_true",
+                      help="PG-recovery-storm decode burst (batched "
+                           "vs unbatched vs sharded, max_bytes sweep)")
+    mode.add_argument("--ec-read", action="store_true",
+                      help="coalesced EC read pipeline vs per-op "
+                           "baseline through a MiniCluster")
+    mode.add_argument("--saturate", action="store_true",
+                      help="many-client saturation harness with the "
+                           "mclock QoS reservation sweep (the SLO "
+                           "regression gate)")
+    ap.add_argument("--trace", action="store_true",
+                    help="with --ec-batch/--ec-read: print the per-"
+                         "stage latency decomposition table")
+    ap.add_argument("--progress", action="store_true",
+                    help="with --ec-recovery: drive a MiniCluster "
+                         "kill/revive and gate on the mgr progress "
+                         "story")
+    sat = ap.add_argument_group("saturate options")
+    sat.add_argument("--smoke", action="store_true",
+                     help="one tier-1-safe point: tens of clients, "
+                          "seconds-bounded, no cross-point QoS gate")
+    sat.add_argument("--procs", type=int, default=2,
+                     help="load-generator worker processes")
+    sat.add_argument("--clients", type=int, default=16,
+                     help="cluster-wide simulated client concurrency")
+    sat.add_argument("--objects", type=int, default=48,
+                     help="preloaded object working set")
+    sat.add_argument("--profile", default="small_mixed",
+                     help="workload profile (ceph_tpu.load.profiles)")
+    sat.add_argument("--steady-s", type=float, default=4.0,
+                     help="steady-saturation leg seconds")
+    sat.add_argument("--thrash-s", type=float, default=8.0,
+                     help="thrash-while-loaded leg seconds")
+    args = ap.parse_args()
+    if args.ec_batch:
+        return ec_batch_bench(trace=args.trace)
+    if args.ec_recovery:
+        return ec_recovery_bench(progress=args.progress)
+    if args.ec_read:
+        return ec_read_bench(trace=args.trace)
+    if args.saturate:
+        return saturate_bench(args)
+    return headline_bench()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,472 @@
+"""Scenario runner: saturation legs + thrash-while-loaded + QoS sweep.
+
+Composes the load generator into the scenarios the ROADMAP's "heavy
+traffic" frontier names, against a real multi-OSD ``MiniCluster`` over
+TCP with the mclock scheduler as the experiment variable:
+
+- **ramp** — open-loop offered-rate steps on the healthy cluster; the
+  saturation knee is the last step that still achieves >= KNEE_RATIO of
+  its offered rate.
+- **steady** — closed-loop saturation at full client concurrency.
+- **thrash** — same load while an OSD is killed and revived with a
+  FRESH store mid-leg: a full rebuild storm competes with client
+  traffic, scored by the mon's progress/event stack (recovery ETA,
+  completion) and the SLOW_OPS health tripwire.
+
+A sweep runs >= 3 mclock recovery-reservation/limit settings and gates
+on STRUCTURAL invariants, not absolute throughput (the CI box is a
+2-core high-variance machine): no deadlock (every worker exits, every
+leg makes progress), no unbounded queue growth (scheduler depths drain
+to zero; drops are accounted), recovery completes, and QoS ordering
+holds — raising the recovery reservation must speed recovery up and
+must not worsen client p99 beyond the sweep's monotone envelope.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+
+from .generator import LoadGenerator
+from .profiles import LegSpec
+
+#: a ramp step "keeps up" while achieved/offered stays above this
+KNEE_RATIO = 0.85
+#: envelope tolerances (generous: 2-core CI-box variance) — recovery
+#: rates must be non-decreasing in reservation order within REC_SLACK
+#: (monotone_within); client p99 across the sweep must stay inside a
+#: bounded spread, max <= min * P99_SLACK (bounded_spread): raising
+#: the recovery reservation may cost clients, but not beyond the
+#: envelope — and a low-reservation point starving clients an order
+#: of magnitude worse than the high ones trips it too.  The p99 slack
+#: is wide because every point's thrash p99 carries the kill
+#: transient (rpc timeout + map propagation) on top of the QoS
+#: competition being gated.
+REC_SLACK = 1.6
+P99_SLACK = 8.0
+
+
+@dataclass
+class ScenarioConfig:
+    """One saturation point: cluster shape + legs + mclock setting."""
+
+    point_id: str = "default"
+    profile: str = "small_mixed"
+    procs: int = 2
+    clients: int = 16            # cluster-wide closed-loop concurrency
+    n_osds: int = 4
+    objects: int = 48
+    obj_bytes: int = 8192
+    pg_num: int = 8
+    ramp_rates: tuple = (50.0, 150.0, 450.0)  # cluster ops/s steps
+    ramp_leg_s: float = 1.5
+    steady_s: float = 4.0
+    thrash_s: float = 8.0
+    kill_after_s: float = 1.0    # offset into the thrash leg
+    thrash: bool = True
+    recovery_deadline_s: float = 45.0
+    #: fixed measurement window after the kill for the sweep's
+    #: recovery-rate comparison: robust to recovery WAVES (concurrent
+    #: writes re-opening storms) and to slow points catching up later —
+    #: served-ops-in-window is what the reservation/limit knob shapes
+    qos_window_s: float = 3.0
+    mclock: dict = field(default_factory=dict)  # osd_mclock_* overrides
+    seed: int = 0
+
+    def legs(self) -> list[LegSpec]:
+        out = [LegSpec(name=f"ramp{i}", profile=self.profile,
+                       duration_s=self.ramp_leg_s, mode="open",
+                       rate=r, concurrency=self.clients)
+               for i, r in enumerate(self.ramp_rates)]
+        out.append(LegSpec(name="steady", profile=self.profile,
+                           duration_s=self.steady_s, mode="closed",
+                           concurrency=self.clients))
+        if self.thrash:
+            out.append(LegSpec(name="thrash", profile=self.profile,
+                               duration_s=self.thrash_s, mode="closed",
+                               concurrency=self.clients))
+        return out
+
+
+def _build_cluster(cfg: ScenarioConfig, admin_dir: str):
+    from ..tools.vstart import MiniCluster
+    from ..utils.config import default_config
+    conf = default_config()
+    conf.apply_dict({
+        "osd_heartbeat_interval": 0.05,
+        "osd_heartbeat_grace": 0.5,
+        "ec_backend": "native",
+        "ms_dispatch_workers": 2,
+        "osd_op_num_shards": 2,
+        # SLOW_OPS as a live tripwire at bench timescales (default 30s
+        # would never fire inside a seconds-long leg)
+        "osd_op_complaint_time": 2.0,
+        # recovery pacing off: the mclock reservation/limit must be the
+        # binding constraint the sweep turns, not the sleep throttle
+        "osd_recovery_sleep": 0.0,
+        "osd_recovery_max_active": 8,
+        "osd_recovery_progress_interval": 0.0,
+        "mgr_progress_linger": 1.0,
+        **cfg.mclock})
+    c = MiniCluster(n_osds=cfg.n_osds, cfg=conf, transport="tcp",
+                    admin_dir=admin_dir).start()
+    cl = c.client()
+    cl.create_pool("sat", kind="ec", pg_num=cfg.pg_num,
+                   ec_profile={"plugin": "jerasure", "k": "2",
+                               "m": "1", "backend": "numpy"})
+    payload = b"\xa5" * cfg.obj_bytes
+    for i in range(cfg.objects):
+        cl.write_full("sat", f"o{i:04d}", payload)
+    return c
+
+
+def _pcts(hist) -> dict:
+    p50 = hist.quantile(0.50)
+    p99 = hist.quantile(0.99)
+    return {"p50_ms": round(p50 / 1e3, 3) if p50 is not None else None,
+            "p99_ms": round(p99 / 1e3, 3) if p99 is not None else None,
+            "ops": hist.count}
+
+
+def _leg_row(leg_res, duration: float) -> dict:
+    wall = leg_res.wall_s or duration
+    return {"offered_per_s": round(leg_res.offered / wall, 1),
+            "achieved_per_s": round(leg_res.achieved / wall, 1),
+            "errors": leg_res.errors,
+            **{k: _pcts(h) for k, h in sorted(leg_res.hists.items())}}
+
+
+def _cluster_counters(c) -> dict:
+    """The counter snapshot the per-point deltas come from."""
+    out = {"msg_dispatched": 0, "recovery_served": 0,
+           "client_served": 0, "dropped": {}}
+    # list(): the thrash thread kills/revives OSDs while samplers read
+    for osd in list(c.osds.values()):
+        out["msg_dispatched"] += osd.messenger.perf.get("msg_dispatched")
+        out["recovery_served"] += osd.scheduler.served.get("recovery", 0)
+        out["client_served"] += osd.scheduler.served.get("client", 0)
+        for k, v in osd.scheduler.dropped.items():
+            out["dropped"][k] = out["dropped"].get(k, 0) + v
+    return out
+
+
+def _slow_ops_trips(c) -> int:
+    """SLOW_OPS raise transitions from the mon's merged cluster log,
+    fetched over the SHARED admin-socket resolver (the operator path a
+    real deployment scrapes, not a private attribute)."""
+    try:
+        log = c.admin("mon.0", "dump_cluster_log", channel="health")
+    except (OSError, RuntimeError):
+        return 0
+    return sum(1 for ev in log.get("events", [])
+               if (ev.get("fields") or {}).get("check") == "SLOW_OPS"
+               and (ev.get("fields") or {}).get("status")
+               == "HEALTH_WARN")
+
+
+def run_point(cfg: ScenarioConfig) -> dict:
+    """One saturation point: build the cluster, drive the legs, thrash
+    mid-traffic, score invariants.  Returns the per-point row."""
+    with tempfile.TemporaryDirectory(prefix="sat-asok-") as admin_dir:
+        c = _build_cluster(cfg, admin_dir)
+        try:
+            return _run_point_on(c, cfg)
+        finally:
+            c.stop()
+
+
+def _run_point_on(c, cfg: ScenarioConfig) -> dict:
+    gen = LoadGenerator(
+        c.network.addr_of("mon.0"), "sat", cfg.objects, cfg.legs(),
+        procs=cfg.procs, seed=cfg.seed, client_timeout=3.0)
+    base = _cluster_counters(c)
+    gen.launch()
+    times = gen.leg_times()
+
+    depth_samples: list[int] = []
+    stop_sampling = threading.Event()
+    # progress must be sampled WHILE the storm runs: completed items
+    # linger only mgr_progress_linger seconds, so a post-hoc poll after
+    # the workers drain would find an empty tracker and call a finished
+    # recovery "never happened"
+    mon_state = {"seen": {},          # item id -> max percent
+                 "eta_max": 0.0,
+                 "drain_t": None,     # first instant the storm drained
+                 "served_at": (0, 0.0),
+                 "kill_t": None,      # set by the thrash thread
+                 "kill_served": 0,
+                 "window_served": None}
+
+    def rec_busy() -> bool:
+        # the storm is live while ANY stage still holds work: the
+        # primaries' reservation/initiation queues, recovery-class
+        # items queued in ANY mclock shard (the stage the sweep's
+        # limit knob actually paces — progress items complete at the
+        # primary while pushes still sit here), or in-flight ops
+        for o in list(c.osds.values()):
+            if o._recovery_inflight > 0 or len(o._recovery_q) > 0:
+                return True
+            if o.scheduler.queue_depth("recovery") > 0:
+                return True
+        return False
+
+    def monitor() -> None:
+        while not stop_sampling.is_set():
+            depth_samples.append(sum(o.scheduler.queue_depth()
+                                     for o in list(c.osds.values())))
+            items = c.mon.progress.items()
+            for it in items:
+                iid = it.get("id", "?")
+                mon_state["seen"][iid] = max(
+                    mon_state["seen"].get(iid, 0.0),
+                    float(it.get("percent") or 0.0))
+                if it.get("eta_seconds"):
+                    mon_state["eta_max"] = max(
+                        mon_state["eta_max"],
+                        float(it["eta_seconds"]))
+            served = sum(o.scheduler.served.get("recovery", 0)
+                         for o in list(c.osds.values()))
+            if served != mon_state["served_at"][0]:
+                mon_state["served_at"] = (served, time.time())
+            if mon_state["kill_t"] is not None \
+                    and mon_state["window_served"] is None \
+                    and time.time() >= mon_state["kill_t"] \
+                    + cfg.qos_window_s:
+                mon_state["window_served"] = served
+            quiesced = time.time() - mon_state["served_at"][1] > 0.3
+            if mon_state["seen"] and not c.mon.progress.active() \
+                    and not rec_busy() and quiesced:
+                if mon_state["drain_t"] is None:
+                    mon_state["drain_t"] = mon_state["served_at"][1]
+            else:
+                mon_state["drain_t"] = None  # a fresh wave re-opened
+            stop_sampling.wait(0.05)
+
+    sampler = threading.Thread(target=monitor, daemon=True)
+    sampler.start()
+
+    thrash_info = {"killed": False, "revived": False,
+                   "kill_t": None, "victim": None}
+    pre_thrash = None
+    if cfg.thrash:
+        t_start, _t_end = times["thrash"]
+        kill_at = t_start + cfg.kill_after_s
+        if (d := kill_at - time.time()) > 0:
+            time.sleep(d)
+        victim = max(c.osds)  # deterministic: the highest-id OSD
+        pre_thrash = _cluster_counters(c)
+        # the kill destroys the victim's messenger registry and its
+        # scheduler's served dicts (revive starts both at zero), so
+        # post-thrash sums would silently lose its pre-kill counts —
+        # snapshot them now and fold them back into every later delta
+        thrash_info["lost"] = {
+            "msg_dispatched":
+                c.osds[victim].messenger.perf.get("msg_dispatched"),
+            "recovery_served":
+                c.osds[victim].scheduler.served.get("recovery", 0),
+        }
+        c.kill_osd(victim)
+        thrash_info.update(killed=True, kill_t=time.time(),
+                           victim=victim)
+        mon_state["kill_served"] = pre_thrash["recovery_served"] \
+            - thrash_info["lost"]["recovery_served"]
+        mon_state["kill_t"] = thrash_info["kill_t"]
+        time.sleep(0.3)
+        c.revive_osd(victim)  # FRESH store: every shard rebuilds
+        thrash_info["revived"] = True
+
+    merged = gen.collect(grace=60.0)
+
+    # recovery score: the mgr progress stack must see the storm reach
+    # 100% and CLEAR (the PR-4 acceptance face, now under client load)
+    recovery = {"completed": not cfg.thrash, "eta_s": None,
+                "wall_s": None, "served_per_s": None}
+    if cfg.thrash and thrash_info["killed"]:
+        deadline = thrash_info["kill_t"] + cfg.recovery_deadline_s
+        while time.time() < deadline:
+            if mon_state["drain_t"] is not None \
+                    and time.time() - mon_state["drain_t"] > 0.5:
+                break  # drained and STAYED drained (no fresh wave)
+            time.sleep(0.05)
+        drained_at = mon_state["drain_t"]
+        seen = dict(mon_state["seen"])
+        recovery["completed"] = bool(seen) and drained_at is not None
+        recovery["items"] = len(seen)
+        recovery["wall_s"] = round(
+            (drained_at or time.time()) - thrash_info["kill_t"], 2)
+        recovery["eta_s"] = round(mon_state["eta_max"], 2) \
+            if mon_state["eta_max"] else None
+        after = _cluster_counters(c)
+        rec_ops = after["recovery_served"] \
+            - (pre_thrash["recovery_served"]
+               - thrash_info["lost"]["recovery_served"])
+        recovery["served_ops"] = rec_ops
+        recovery["served_per_s"] = round(
+            rec_ops / max(1e-3, (drained_at or time.time())
+                          - thrash_info["kill_t"]), 1)
+        win = mon_state["window_served"]
+        recovery["window_s"] = cfg.qos_window_s
+        recovery["window_ops"] = (win - mon_state["kill_served"]
+                                  if win is not None else rec_ops)
+        recovery["window_rate_per_s"] = round(
+            recovery["window_ops"] / cfg.qos_window_s, 1)
+
+    # queue drain: depths must return to zero once load + storm stop
+    drained = False
+    drain_deadline = time.time() + 10.0
+    while time.time() < drain_deadline:
+        if sum(o.scheduler.queue_depth()
+               for o in list(c.osds.values())) == 0:
+            drained = True
+            break
+        time.sleep(0.1)
+    stop_sampling.set()
+    sampler.join(timeout=2.0)
+
+    after = _cluster_counters(c)
+    legs = merged["legs"]
+    achieved_total = sum(r.achieved for r in legs.values())
+    lost_msgs = (thrash_info.get("lost") or {}).get("msg_dispatched", 0)
+    msgs_per_op = round(
+        (after["msg_dispatched"] + lost_msgs - base["msg_dispatched"])
+        / max(1, achieved_total), 2)
+    dropped = {k: after["dropped"].get(k, 0) - base["dropped"].get(k, 0)
+               for k in after["dropped"]}
+
+    ramp = {"rates_per_s": list(cfg.ramp_rates), "achieved_ratio": []}
+    for i, r in enumerate(cfg.ramp_rates):
+        leg = legs[f"ramp{i}"]
+        ramp["achieved_ratio"].append(
+            round(leg.achieved / max(1, leg.offered), 3))
+    knee = None
+    for r, ratio in zip(cfg.ramp_rates, ramp["achieved_ratio"]):
+        if ratio >= KNEE_RATIO:
+            knee = r
+    ramp["saturation_knee_per_s"] = knee
+
+    # only CLOSED legs gate progress: an open-loop ramp step offered
+    # far past the knee may legitimately achieve ~nothing inside its
+    # bounded window — that is the saturation signal, not a deadlock
+    closed_progressed = all(
+        legs[l.name].achieved > 0 for l in cfg.legs()
+        if l.mode == "closed")
+    invariants = {
+        "no_deadlock": merged["ok"] and closed_progressed,
+        "queues_bounded": drained,
+        "recovery_completes": recovery["completed"],
+    }
+    row = {
+        "id": cfg.point_id,
+        "mclock": dict(cfg.mclock),
+        "ramp": ramp,
+        "steady": _leg_row(legs["steady"], cfg.steady_s),
+        "max_queue_depth": max(depth_samples, default=0),
+        "sched_dropped": dropped,
+        "msgs_per_op": msgs_per_op,
+        "slow_ops_trips": _slow_ops_trips(c),
+        "recovery": recovery,
+        "invariants": invariants,
+        "worker_errors": merged["worker_errors"],
+    }
+    if cfg.thrash:
+        row["thrash"] = _leg_row(legs["thrash"], cfg.thrash_s)
+    return row
+
+
+def monotone_within(seq: list[float], slack: float) -> bool:
+    """Non-decreasing up to a slack factor: for i<j,
+    seq[j] * slack >= seq[i].  The recovery-rate ordering check —
+    strict monotonicity is unfalsifiable on a 2-core box."""
+    vals = [v for v in seq if v is not None]
+    return all(vals[j] * slack >= vals[i]
+               for i in range(len(vals)) for j in range(i + 1,
+                                                        len(vals)))
+
+
+def bounded_spread(seq: list[float], slack: float) -> bool:
+    """max <= min * slack over the non-None values: the client-p99
+    envelope.  Two-sided by construction — raising the recovery
+    reservation must not WORSEN client p99 beyond the envelope, and a
+    low-reservation point must not sit an order of magnitude above the
+    high ones either (the starvation inversion)."""
+    vals = [v for v in seq if v is not None]
+    if not vals:
+        return True
+    return max(vals) <= min(vals) * slack
+
+
+def default_sweep_points() -> list[dict]:
+    """>= 3 recovery reservation/limit settings, ascending: the limit
+    doubles the reservation so the low point is crisply shaped (well
+    under the storm's natural drain rate) and the top point runs
+    recovery unthrottled.  Limits apply PER scheduler shard — a 4-OSD,
+    2-shard cluster's aggregate ceiling is 8x the per-shard number."""
+    return [
+        {"id": "rec_res4", "osd_mclock_recovery_res": 4.0,
+         "osd_mclock_recovery_lim": 8.0},
+        {"id": "rec_res16", "osd_mclock_recovery_res": 16.0,
+         "osd_mclock_recovery_lim": 32.0},
+        {"id": "rec_res128", "osd_mclock_recovery_res": 128.0,
+         "osd_mclock_recovery_lim": 0.0},
+    ]
+
+
+def run_sweep(points: list[dict] | None = None,
+              base: ScenarioConfig | None = None) -> dict:
+    """The `bench.py --saturate` engine: one point per mclock setting,
+    then the cross-point QoS ordering checks.  Returns the full JSON
+    row; ``row["ok"]`` is the exit-code gate."""
+    base = base or ScenarioConfig()
+    points = points if points is not None else default_sweep_points()
+    rows = []
+    for i, pt in enumerate(points):
+        cfg = ScenarioConfig(**{
+            **{k: v for k, v in vars(base).items()},
+            "point_id": pt.get("id", f"pt{i}"),
+            "mclock": {k: v for k, v in pt.items() if k != "id"},
+            "seed": base.seed + i,
+        })
+        row = run_point(cfg)
+        if not all(row["invariants"].values()):
+            # one fresh-cluster retry: a mid-write kill occasionally
+            # lands the cluster in a slow reconcile churn (a
+            # convergence pathology of the data plane, not of the QoS
+            # setting under test) — a GATE must not false-alarm on it,
+            # and two consecutive failures remain a real trip
+            cfg.seed += 1000
+            row = run_point(cfg)
+            row["retried"] = True
+        rows.append(row)
+
+    # the gated recovery metric is the WINDOWED rate (served recovery
+    # ops in the fixed post-kill window): shaped directly by the knob,
+    # robust to recovery waves and to slow points catching up later
+    rec_rates = [r["recovery"].get("window_rate_per_s") for r in rows]
+    p99s = []
+    for r in rows:
+        leg = r.get("thrash") or r["steady"]
+        cls = leg.get("write") or leg.get("read") or {}
+        p99s.append(cls.get("p99_ms"))
+    qos = {
+        "recovery_window_rate_per_s": rec_rates,
+        "client_p99_ms": p99s,
+        "recovery_monotone": monotone_within(
+            [v for v in rec_rates if v is not None], REC_SLACK),
+        "p99_envelope_holds": bounded_spread(p99s, P99_SLACK),
+        "tradeoff_direction_ok": True,
+    }
+    real_rates = [v for v in rec_rates if v is not None]
+    if len(real_rates) >= 2 and base.thrash:
+        # the sweep must actually MOVE recovery: the unthrottled top
+        # point beats the tightly-limited bottom one
+        qos["tradeoff_direction_ok"] = \
+            real_rates[-1] >= real_rates[0] * 1.1
+    qos["ordering_holds"] = (qos["recovery_monotone"]
+                             and qos["p99_envelope_holds"]
+                             and qos["tradeoff_direction_ok"])
+
+    invariants_ok = all(all(r["invariants"].values()) for r in rows) \
+        and (qos["ordering_holds"] if len(rows) >= 2 else True)
+    return {"points": rows, "qos": qos, "ok": invariants_ok}

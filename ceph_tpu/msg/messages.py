@@ -191,13 +191,21 @@ class MSubRead:
     """Primary -> shard OSD read (ECSubRead role).  extents=None reads
     the whole shard stream; otherwise the reply carries the concatenation
     of the requested [(shard_off, len)] slices, each zero-padded to its
-    requested length (absent tail bytes of a padded stripe are zeros)."""
+    requested length (absent tail bytes of a padded stripe are zeros).
+
+    klass is the mclock scheduler class the SERVING peer should queue
+    this read under (the reference tags replica ops with the
+    originating op's QoS class): client fan-outs ride "client",
+    recovery shard fetches ride "recovery" so a rebuild storm's reads
+    are shaped by the same reservation/limit knobs as its pushes.
+    Appended with a default — old archived bytes decode compatibly."""
 
     tid: int
     pgid: PgId
     oid: str
     shard: int
     extents: list | None = None
+    klass: str = "client"
 
 
 @dataclass

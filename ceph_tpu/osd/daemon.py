@@ -795,31 +795,46 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # throttling it would be a priority inversion (the reference
         # serves peering at immediate priority).  Recovery QoS shapes
         # the BULK payload movement: pushes and pulls.
+        # end-to-end class tagging: client sub-reads queue as client
+        # work on the serving peer, recovery shard fetches (MSubRead
+        # klass="recovery") and pushes/pulls as recovery — so a rebuild
+        # storm's READS are shaped by the same knobs as its pushes.
+        # Sub-writes and replies stay system: they complete client ops
+        # already admitted under the client class, and double-queueing
+        # the commit path behind a limit would just inflate latency.
         self._op_classes = {
             MOSDOp: "client",
+            MSubRead: "client", MSubReadN: "client",
             MPGPush: "recovery", MPGPull: "recovery",
             MScrubRequest: "scrub", MScrubShard: "scrub",
             MScrubMap: "scrub",
         }
         self._use_mclock = self.cfg["osd_op_queue"] == "mclock"
+        # always constructed (zeroed QoS counter schema even under
+        # fifo); per-class served/dropped/depth/qwait land on self.perf
         self.scheduler = ShardedScheduler(
-            self._run_scheduled,
-            {
-                "client": ClassParams(self.cfg["osd_mclock_client_res"],
-                                      self.cfg["osd_mclock_client_wgt"],
-                                      self.cfg["osd_mclock_client_lim"]),
-                "recovery": ClassParams(
-                    self.cfg["osd_mclock_recovery_res"],
-                    self.cfg["osd_mclock_recovery_wgt"],
-                    self.cfg["osd_mclock_recovery_lim"]),
-                "scrub": ClassParams(self.cfg["osd_mclock_scrub_res"],
-                                     self.cfg["osd_mclock_scrub_wgt"],
-                                     self.cfg["osd_mclock_scrub_lim"]),
-                # system (maps, sub-ops, replies): effectively unthrottled
-                "system": ClassParams(1e9, 1e6, 0.0),
-            },
+            self._run_scheduled, self._mclock_params(),
             shards=self.cfg["osd_op_num_shards"],
-            name=f"mclock-{self.name}")
+            name=f"mclock-{self.name}", perf=self.perf)
+
+    def _mclock_params(self) -> dict[str, ClassParams]:
+        """Current (R, W, L) per QoS class from config — built at
+        construction and re-read by the `reset_mclock` verb so a
+        reservation sweep can retune a LIVE daemon."""
+        return {
+            "client": ClassParams(self.cfg["osd_mclock_client_res"],
+                                  self.cfg["osd_mclock_client_wgt"],
+                                  self.cfg["osd_mclock_client_lim"]),
+            "recovery": ClassParams(
+                self.cfg["osd_mclock_recovery_res"],
+                self.cfg["osd_mclock_recovery_wgt"],
+                self.cfg["osd_mclock_recovery_lim"]),
+            "scrub": ClassParams(self.cfg["osd_mclock_scrub_res"],
+                                 self.cfg["osd_mclock_scrub_wgt"],
+                                 self.cfg["osd_mclock_scrub_lim"]),
+            # system (maps, sub-ops, replies): effectively unthrottled
+            "system": ClassParams(1e9, 1e6, 0.0),
+        }
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -883,7 +898,20 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return {"mode": "mclock" if self._use_mclock else "fifo",
                     "shards": len(self.scheduler.shards),
                     "depth": self.scheduler.queue_depth(),
-                    "served": dict(self.scheduler.served)}
+                    "depths": self.scheduler.queue_depths(),
+                    "served": dict(self.scheduler.served),
+                    "dropped": dict(self.scheduler.dropped)}
+        if cmd == "reset_mclock":
+            # re-read osd_mclock_* from config and retune the LIVE
+            # scheduler (the reservation-sweep knob: `config set` the
+            # new values, then this verb applies them without a restart)
+            params = self._mclock_params()
+            for klass, p in params.items():
+                self.scheduler.set_params(klass, p)
+            return {"applied": {k: {"reservation": p.reservation,
+                                    "weight": p.weight,
+                                    "limit": p.limit}
+                                for k, p in params.items()}}
         if cmd == "config set":
             self.cfg.set(kw["name"], kw["value"])
             return {"success": True}
@@ -907,7 +935,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._sub_epoch.v = 0  # fresh epoch pin per dispatched op
             handler(conn, msg)
             return True
-        klass = self._op_classes.get(type(msg), "system")
+        # a message-carried class wins (recovery-tagged MSubReads);
+        # the static table covers everything else
+        klass = getattr(msg, "klass", None) \
+            or self._op_classes.get(type(msg), "system")
+        if klass not in ("client", "recovery", "scrub", "system"):
+            klass = "system"  # never KeyError on a peer's future tag
         self.scheduler.enqueue(klass, (handler, conn, msg),
                                key=self._shard_key(msg))
         return True
@@ -2880,8 +2913,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _fan_shard_reads(self, tid: int, pgid: PgId, oid: str,
                          up: list, extents: list | None = None,
-                         trace: tuple | None = None) -> None:
-        coalesce = self._ec_read_coalesce_on(pgid.pool)
+                         trace: tuple | None = None,
+                         klass: str = "client") -> None:
+        # recovery fetches bypass the client-read aggregator AND carry
+        # their class on the wire: the serving peer queues them under
+        # its recovery reservation/limit, not in the client lane
+        coalesce = klass == "client" \
+            and self._ec_read_coalesce_on(pgid.pool)
         for shard, osd in enumerate(up):
             if osd is None:
                 continue
@@ -2893,7 +2931,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                       shard, extents, trace=trace)
             else:
                 self.messenger.send_message(
-                    f"osd.{osd}", MSubRead(tid, pgid, oid, shard, extents))
+                    f"osd.{osd}", MSubRead(tid, pgid, oid, shard,
+                                           extents, klass=klass))
 
     def _read_shard_slices(self, cid, obj, extents: list | None) -> bytes:
         """Whole shard stream, or the concatenation of the requested
@@ -4719,8 +4758,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         pr = _PendingRead(None, 0, pgid.pool, name, total_shards=1,
                           on_done=on_done)
         self._pending_reads[tid] = pr
-        self.messenger.send_message(f"osd.{src}",
-                                    MSubRead(tid, pgid, name, shard))
+        self.messenger.send_message(
+            f"osd.{src}",
+            MSubRead(tid, pgid, name, shard, klass="recovery"))
 
     def _rebuild_shard(self, pgid, name, shard, peer, version,
                        force: bool = False) -> None:
@@ -4811,7 +4851,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                            if u is not None),
                           on_done=on_done)
         self._pending_reads[tid] = pr
-        self._fan_shard_reads(tid, pgid, name, fan)
+        self._fan_shard_reads(tid, pgid, name, fan, klass="recovery")
 
     def _ec_meta_for(self, pgid: PgId, name: str):
         """(omap, user attrs) from MY shard copy of an EC object —

@@ -22,12 +22,31 @@ import threading
 import time
 from dataclasses import dataclass
 
+from ..utils.perf import CounterType, PerfCounters
+
 
 @dataclass
 class ClassParams:
     reservation: float  # guaranteed ops/sec (0 = none)
     weight: float       # proportional share when past reservation
     limit: float        # max ops/sec (0 = unlimited)
+
+
+def register_qos_counters(perf: PerfCounters, classes) -> None:
+    """Per-class QoS counters on a daemon registry — the exporter face
+    of the scheduler's Python dicts (served/dropped were invisible to a
+    live scrape before this).  Idempotent: shards share one registry,
+    and re-adding would RESET live counters (PerfCounters.has)."""
+    for c in classes:
+        for name in (f"mclock_served_{c}", f"mclock_dropped_{c}"):
+            if not perf.has(name):
+                perf.add(name)
+        if not perf.has(f"mclock_depth_{c}"):
+            perf.add(f"mclock_depth_{c}", CounterType.U64)
+        if not perf.has(f"mclock_qwait_us_{c}"):
+            # enqueue->service wait: the quantity QoS actually moves —
+            # prom_rules.py stands p50/p99 recording rules on these
+            perf.add(f"mclock_qwait_us_{c}", CounterType.HISTOGRAM)
 
 
 class MClockScheduler:
@@ -47,25 +66,47 @@ class MClockScheduler:
     QUEUE_CAP = 512
 
     def __init__(self, handler, classes: dict[str, ClassParams],
-                 name: str = "mclock", clock=time.monotonic):
+                 name: str = "mclock", clock=time.monotonic,
+                 perf: PerfCounters | None = None):
         self._handler = handler
         self._classes = {}
         for c, p in classes.items():
-            if p.limit > 0 and p.reservation > p.limit:
-                # limit is the hard upper bound: a reservation above it
-                # would silently exceed the configured cap
-                p = ClassParams(p.limit, p.weight, p.limit)
-            self._classes[c] = p
+            self._classes[c] = self._clamp(p)
         self._clock = clock
         self.dropped: dict[str, int] = {c: 0 for c in classes}
         self._queues: dict[str, collections.deque] = {
+            c: collections.deque() for c in classes}
+        # parallel enqueue stamps feeding the per-class wait histogram;
+        # tests that append to _queues directly simply record no stamp
+        self._stamps: dict[str, collections.deque] = {
             c: collections.deque() for c in classes}
         self._tags = {c: {"r": 0.0, "p": 0.0, "l": 0.0} for c in classes}
         self._cv = threading.Condition()
         self._stop = False
         self.served: dict[str, int] = {c: 0 for c in classes}
+        self._perf = perf
+        if perf is not None:
+            register_qos_counters(perf, classes)
         self._thread = threading.Thread(target=self._run, name=name,
                                         daemon=True)
+
+    @staticmethod
+    def _clamp(p: ClassParams) -> ClassParams:
+        if p.limit > 0 and p.reservation > p.limit:
+            # limit is the hard upper bound: a reservation above it
+            # would silently exceed the configured cap
+            return ClassParams(p.limit, p.weight, p.limit)
+        return p
+
+    def set_params(self, klass: str, p: ClassParams) -> None:
+        """Live QoS reconfiguration (the `config set osd_mclock_*` +
+        reset path): swap one class's (R, W, L) under the lock; queued
+        items keep their positions, tags re-pace from the next pick."""
+        with self._cv:
+            if klass not in self._classes:
+                raise KeyError(f"unknown scheduler class {klass!r}")
+            self._classes[klass] = self._clamp(p)
+            self._cv.notify_all()
 
     def start(self) -> None:
         self._thread.start()
@@ -73,14 +114,27 @@ class MClockScheduler:
     def shutdown(self) -> None:
         with self._cv:
             self._stop = True
+            # reconcile the depth gauges for items dying in the queues:
+            # the daemon's perf registry OUTLIVES a kill/revive cycle
+            # (global_perf().create returns the existing registry), so
+            # an unreconciled gauge would stay inflated forever on the
+            # revived daemon's scrapes
+            for c, q in self._queues.items():
+                if q and self._perf is not None:
+                    self._perf.inc(f"mclock_depth_{c}", -len(q))
+                q.clear()
+                self._stamps[c].clear()
             self._cv.notify_all()
-        self._thread.join(timeout=5)
+        if self._thread.ident is not None:  # never-started: no join
+            self._thread.join(timeout=5)
 
     def enqueue(self, klass: str, item) -> None:
         with self._cv:
             q = self._queues[klass]
             if len(q) >= self.QUEUE_CAP:
                 self.dropped[klass] += 1
+                if self._perf is not None:
+                    self._perf.inc(f"mclock_dropped_{klass}")
                 return  # lossy backpressure; senders retry/requery
             if not q:
                 # idle->busy: catch the proportional clock up to the
@@ -91,6 +145,9 @@ class MClockScheduler:
                     t = self._tags[klass]
                     t["p"] = max(t["p"], min(busy))
             q.append(item)
+            self._stamps[klass].append(self._clock())
+            if self._perf is not None:
+                self._perf.inc(f"mclock_depth_{klass}")
             self._cv.notify()
 
     def queue_depth(self, klass: str | None = None) -> int:
@@ -98,6 +155,10 @@ class MClockScheduler:
             if klass is not None:
                 return len(self._queues[klass])
             return sum(len(q) for q in self._queues.values())
+
+    def queue_depths(self) -> dict[str, int]:
+        with self._cv:
+            return {c: len(q) for c, q in self._queues.items()}
 
     # ------------------------------------------------------------ worker
     def _pick(self, now: float):
@@ -163,6 +224,16 @@ class MClockScheduler:
                         item = self._queues[klass].popleft()
                         self._account(klass, res, now)
                         self.served[klass] += 1
+                        if self._perf is not None:
+                            self._perf.inc(f"mclock_served_{klass}")
+                            self._perf.inc(f"mclock_depth_{klass}", -1)
+                            if self._stamps[klass]:
+                                self._perf.hinc(
+                                    f"mclock_qwait_us_{klass}",
+                                    max(0.0, now - self._stamps[klass]
+                                        .popleft()) * 1e6)
+                        elif self._stamps[klass]:
+                            self._stamps[klass].popleft()
                         break
                     timeout = None if res is None \
                         else max(0.001, res - now)
@@ -183,9 +254,12 @@ class ShardedScheduler:
     given key always lands on the same shard)."""
 
     def __init__(self, handler, classes: dict[str, ClassParams],
-                 shards: int = 2, name: str = "mclock"):
+                 shards: int = 2, name: str = "mclock",
+                 perf: PerfCounters | None = None):
+        # every shard increments the SAME per-class counters: the
+        # registry aggregates naturally, one schema per daemon
         self.shards = [MClockScheduler(handler, dict(classes),
-                                       name=f"{name}-s{i}")
+                                       name=f"{name}-s{i}", perf=perf)
                        for i in range(max(1, shards))]
 
     def start(self) -> None:
@@ -196,6 +270,10 @@ class ShardedScheduler:
         for s in self.shards:
             s.shutdown()
 
+    def set_params(self, klass: str, p: ClassParams) -> None:
+        for s in self.shards:
+            s.set_params(klass, p)
+
     def enqueue(self, klass: str, item, key=None) -> None:
         shard = self.shards[hash(key) % len(self.shards)] \
             if key is not None else self.shards[0]
@@ -203,6 +281,13 @@ class ShardedScheduler:
 
     def queue_depth(self, klass: str | None = None) -> int:
         return sum(s.queue_depth(klass) for s in self.shards)
+
+    def queue_depths(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for s in self.shards:
+            for c, n in s.queue_depths().items():
+                out[c] = out.get(c, 0) + n
+        return out
 
     @property
     def served(self) -> dict:

@@ -120,6 +120,20 @@ def configs() -> list[dict]:
                             "e2e_within_2x_kernel",
                             "d2h_copies_per_flush",
                             "single_d2h_per_flush", "digest_verified"]})
+    # 9. the many-client saturation harness (ISSUE 7): multi-process
+    # load through librados over TCP, mclock reservation sweep, gated
+    # on structural invariants — the compact SLO row ("millions of
+    # users" proxy) the trajectory tracks like ec_e2e_ratio
+    out.append({"id": "saturate_qos", "tool": "bench_root",
+                "argv": ["--saturate"],
+                "extract": ["value", "vs_baseline",
+                            "saturation_knee_per_s",
+                            "client_read_p50_ms", "client_read_p99_ms",
+                            "client_write_p50_ms",
+                            "client_write_p99_ms",
+                            "recovery_eta_s", "recovery_wall_s",
+                            "msgs_per_op", "slow_ops_trips",
+                            "qos", "ok"]})
     return out
 
 

@@ -9,6 +9,8 @@ CLI::
 
     python -m ceph_tpu.tools.event_tool --asok /tmp/asok/mon.0.asok
     python -m ceph_tpu.tools.event_tool --asok ... --channel recovery -f
+    python -m ceph_tpu.tools.event_tool --admin-dir /tmp/asok \
+        --daemon mon.0 -f     # resolved via the shared vstart resolver
 
 The library half (``fetch_events`` / ``format_event`` / ``tail``) is
 what the tests and any scripted consumer drive directly.
@@ -88,8 +90,16 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="dump or follow the monitor's merged cluster "
                     "event log (`ceph -W` role)")
-    p.add_argument("--asok", required=True,
+    p.add_argument("--asok", default=None,
                    help="mon admin socket (mon.N.asok)")
+    p.add_argument("--admin-dir", default=None,
+                   help="cluster admin-socket directory; combined "
+                        "with --daemon through the SHARED vstart "
+                        "resolver instead of hand-building the path")
+    p.add_argument("--daemon", default="mon.0",
+                   help="daemon name under --admin-dir (default "
+                        "mon.0 — only the mon serves "
+                        "dump_cluster_log)")
     p.add_argument("--channel", default=None,
                    help="filter to one channel (pg, recovery, scrub, "
                         "batch, health, osdmap, cluster)")
@@ -100,8 +110,14 @@ def main(argv=None) -> int:
     p.add_argument("--max-polls", type=int, default=None,
                    help="stop following after N polls (scripting/tests)")
     args = p.parse_args(argv)
+    asok = args.asok
+    if asok is None:
+        if args.admin_dir is None:
+            p.error("need --asok or --admin-dir")
+        from ..utils.admin_socket import asok_path
+        asok = asok_path(args.admin_dir, args.daemon)
     try:
-        tail(args.asok, channel=args.channel, follow=args.follow,
+        tail(asok, channel=args.channel, follow=args.follow,
              interval=args.interval, max_polls=args.max_polls)
     except KeyboardInterrupt:
         return 0

@@ -24,9 +24,13 @@ PREFIX = "ceph_tpu"
 
 #: the pow2-µs latency histograms worth standing quantile series for:
 #: the EC kernel decomposition (compile cliffs / device compute / host
-#: sync) and the messenger dispatch latency
+#: sync), the messenger dispatch latency, and the mclock scheduler's
+#: per-class queue-wait (the QoS quantity the saturation harness's
+#: reservation sweeps move — client vs recovery wait under load)
 HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
-              "msg_dispatch_us")
+              "msg_dispatch_us",
+              "mclock_qwait_us_client", "mclock_qwait_us_recovery",
+              "mclock_qwait_us_scrub")
 QUANTILES = (0.50, 0.99)
 
 

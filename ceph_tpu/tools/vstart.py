@@ -9,13 +9,17 @@ helpers shaped like the thrasher/ceph-helpers verbs.
 
 from __future__ import annotations
 
+import os
 import time
 
 from ..client.rados import RadosClient
 from ..mon.monitor import MonitorLite
 from ..msg.messenger import LocalNetwork
 from ..osd.daemon import OSDDaemon
+from ..utils.admin_socket import asok_path
 from ..utils.config import Config, default_config
+
+__all__ = ["MiniCluster", "asok_path"]
 
 
 class MiniCluster:
@@ -88,14 +92,32 @@ class MiniCluster:
                 lambda prefix, **kw: self.mons[0]._run_command(
                     dict(kw, prefix=prefix)))
 
+    def asok(self, name: str) -> str:
+        """Admin-socket path of one daemon (``mon.0``, ``osd.3``) —
+        the shared resolver every tool should go through."""
+        if not self._admin_dir:
+            raise ValueError("cluster started without admin_dir")
+        return asok_path(self._admin_dir, name)
+
+    def admin(self, name: str, prefix: str, **kw):
+        """One admin-socket round trip to a daemon by name (unwraps
+        the mon's (errno, data) verb shape)."""
+        from ..utils.admin_socket import admin_request
+        result = admin_request(self.asok(name), prefix, **kw)
+        if isinstance(result, list) and len(result) == 2 \
+                and isinstance(result[0], int):
+            if result[0] != 0:
+                raise RuntimeError(f"{name} {prefix}: {result[1]}")
+            result = result[1]
+        return result
+
     def _add_admin_socket(self, name: str, handler) -> None:
-        import os
         from ..utils.admin_socket import AdminSocketServer
         old = self.admin_sockets.pop(name, None)
         if old is not None:
             old.stop()  # revive: never leak the previous server
-        path = os.path.join(self._admin_dir, f"{name}.asok")
-        self.admin_sockets[name] = AdminSocketServer(path, handler)
+        self.admin_sockets[name] = AdminSocketServer(self.asok(name),
+                                                     handler)
 
     def _drop_admin_socket(self, name: str) -> None:
         old = self.admin_sockets.pop(name, None)
@@ -209,9 +231,7 @@ class MiniCluster:
         if bind_ip:
             argv += ["--bind-ip", bind_ip]
         if self._admin_dir:
-            argv += ["--admin-socket",
-                     os.path.join(self._admin_dir,
-                                  f"osd.{osd_id}.asok")]
+            argv += ["--admin-socket", self.asok(f"osd.{osd_id}")]
         if self._tcp_auth_secret is not None:
             argv += ["--auth-secret-hex", self._tcp_auth_secret.hex()]
         if self._tcp_compress != "none":

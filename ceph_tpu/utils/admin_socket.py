@@ -18,6 +18,16 @@ import threading
 from .log import dout
 
 
+def asok_path(admin_dir: str, name: str) -> str:
+    """THE admin-socket path convention — one resolver shared by the
+    cluster harness (which creates the sockets), the CLI tools
+    (event_tool, trace_tool) and the load harness, instead of each
+    re-deriving ``<dir>/<name>.asok`` by hand.  Lives here (not in
+    vstart) so a lightweight CLI can resolve a path without importing
+    the whole daemon stack."""
+    return os.path.join(admin_dir, f"{name}.asok")
+
+
 class AdminSocketServer:
     """Serve a daemon's admin_command(cmd, **kw) over a unix socket."""
 

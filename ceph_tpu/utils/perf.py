@@ -26,6 +26,15 @@ class CounterType(enum.Enum):
     HISTOGRAM = "histogram"    # pow-2 bucket counts
 
 
+def pow2_bucket(value: float) -> int:
+    """THE pow-2 histogram bucket function: bucket b covers
+    [2^(b-1), 2^b).  Shared by PerfCounters.hinc and the load
+    harness's worker-side Pow2Histogram so daemon-side and
+    client-side latency quantiles stay comparable by construction."""
+    return min(63, max(0, int(math.log2(value)) + 1)
+               if value >= 1 else 0)
+
+
 class _Counter:
     __slots__ = ("name", "type", "desc", "value", "sum", "count", "buckets")
 
@@ -103,7 +112,7 @@ class PerfCounters:
 
     def hinc(self, name: str, value: float) -> None:
         c = self._get(name)
-        b = min(63, max(0, int(math.log2(value)) + 1) if value >= 1 else 0)
+        b = pow2_bucket(value)
         with self._lock:
             c.buckets[b] += 1
             c.count += 1

@@ -202,7 +202,13 @@ def test_mon_stats_aggregation():
         usage = {}
         while time.time() < deadline:
             usage = client.status().get("usage", {})
-            if usage.get("objects", 0) >= 3:  # 3 replicas reported
+            # wait for EVERY asserted aggregate, not just the object
+            # count: a replica's report can land with the object
+            # applied but its byte stats one report cycle behind —
+            # breaking on objects alone flakes the bytes assert
+            if usage.get("objects", 0) >= 3 \
+                    and usage.get("bytes", 0) >= 30_000 \
+                    and usage.get("op_w", 0) >= 1:
                 break
             time.sleep(0.05)
         assert usage.get("objects", 0) >= 3

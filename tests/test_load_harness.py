@@ -1,0 +1,162 @@
+"""Saturation traffic harness (ceph_tpu.load): workload model units,
+the multi-process generator smoke leg, and the full QoS sweep e2e.
+
+The smoke leg is the tier-1-safe face of `bench.py --saturate` (tens
+of clients, seconds-bounded, one mclock point); the full 3-point
+reservation sweep is `slow` — it is the regression gate bench_sweep's
+``saturate_qos`` row tracks.
+"""
+
+import json
+import random
+
+import pytest
+
+from ceph_tpu.load.profiles import (PROFILES, LegResult, LegSpec,
+                                    Pow2Histogram, ZipfSampler,
+                                    get_profile)
+
+
+# ------------------------------------------------------- workload model
+def test_pow2_histogram_record_merge_quantile():
+    a = Pow2Histogram()
+    for v in (3, 3, 10, 300, 3000):
+        a.record(v)
+    b = Pow2Histogram()
+    for v in (70000, 70000):
+        b.record(v)
+    a.merge(b.to_dict())          # dict form: the cross-process path
+    assert a.count == 7
+    # quantiles are bucket upper bounds: p50 of {3,3,10,300,3000,70k,
+    # 70k} lands in 300's bucket (512), p99 in 70000's (131072)
+    assert a.quantile(0.5) == 512.0
+    assert a.quantile(0.99) == 131072.0
+    # round-trips through JSON (the worker -> parent wire)
+    c = Pow2Histogram.from_dict(json.loads(json.dumps(a.to_dict())))
+    assert c.count == a.count and c.quantile(0.5) == a.quantile(0.5)
+    assert Pow2Histogram().quantile(0.5) is None
+
+
+def test_zipf_sampler_skew_and_uniform():
+    rng = random.Random(7)
+    hot = ZipfSampler(100, 1.4, rng)
+    counts = [0] * 100
+    for _ in range(4000):
+        counts[hot.sample()] += 1
+    # rank 0 dominates under heavy skew
+    assert counts[0] > counts[10] > 0
+    assert counts[0] > 4000 * 0.15
+    uni = ZipfSampler(100, 0.0, rng)
+    counts = [0] * 100
+    for _ in range(4000):
+        counts[uni.sample()] += 1
+    assert max(counts) < 4000 * 0.05  # no hot head when alpha=0
+
+
+def test_profiles_registry_and_samplers():
+    assert {"small_mixed", "read_heavy", "write_burst",
+            "hot_object"} <= set(PROFILES)
+    with pytest.raises(KeyError):
+        get_profile("nope")
+    rng = random.Random(3)
+    prof = get_profile("small_mixed")
+    sizes = {prof.size_sampler(rng)() for _ in range(200)}
+    assert sizes == {4 * 1024, 16 * 1024}
+    mix = [prof.op_class(rng) for _ in range(400)]
+    assert 0.3 < mix.count("read") / len(mix) < 0.7
+    # write_burst never reads
+    wb = get_profile("write_burst")
+    assert all(wb.op_class(rng) == "write" for _ in range(50))
+
+
+def test_leg_result_merge_and_roundtrip():
+    a = LegResult(offered=10, achieved=8, errors=1, wall_s=2.0)
+    a.hist("read").record(100)
+    b = LegResult(offered=5, achieved=5, errors=0, wall_s=2.5)
+    b.hist("read").record(200)
+    b.hist("write").record(50)
+    a.merge(json.loads(json.dumps(b.to_dict())))
+    assert (a.offered, a.achieved, a.errors) == (15, 13, 1)
+    assert a.wall_s == 2.5
+    assert a.hist("read").count == 2
+    assert a.hist("write").count == 1
+    spec = LegSpec.from_dict(LegSpec(
+        name="x", profile="small_mixed", duration_s=1.5, mode="open",
+        rate=40.0, concurrency=4).to_dict())
+    assert spec.mode == "open" and spec.rate == 40.0
+
+
+def test_monotone_within_envelope():
+    from ceph_tpu.load.scenarios import bounded_spread, monotone_within
+    assert monotone_within([10, 20, 30], 1.1)
+    assert monotone_within([10, 9, 30], 1.5)       # dip inside slack
+    assert not monotone_within([30, 10, 31], 1.5)  # collapse beyond
+    assert monotone_within([], 1.5)
+    assert monotone_within([5, None, 7], 1.1)      # Nones skipped
+    # the p99 envelope is TWO-sided: worsening with reservation is
+    # bounded too, not just the starvation inversion
+    assert bounded_spread([100, 150, 300], 8.0)
+    assert not bounded_spread([5, 50, 5000], 8.0)   # catastrophic rise
+    assert not bounded_spread([5000, 50, 5], 8.0)   # inversion
+    assert bounded_spread([None, 80, 100], 2.0)
+    assert bounded_spread([], 8.0)
+
+
+# ----------------------------------------------------- harness e2e legs
+def test_saturate_smoke_point():
+    """The tier-1-safe smoke leg: a real multi-process generator burst
+    (2 workers, tens of simulated clients, seconds-bounded legs)
+    through librados over TCP against a 4-OSD cluster, one mclock
+    point, thrash included — every structural invariant must hold."""
+    from ceph_tpu.load.scenarios import ScenarioConfig, run_sweep
+    base = ScenarioConfig(
+        procs=2, clients=10, objects=16,
+        ramp_rates=(40.0,), ramp_leg_s=1.0, steady_s=2.0,
+        thrash_s=4.0, kill_after_s=0.6, recovery_deadline_s=30.0)
+    # run_sweep (not run_point): a single point still gets the
+    # fresh-cluster retry that keeps the kill-churn pathology from
+    # false-alarming the gate
+    sweep = run_sweep(points=[{"id": "smoke",
+                               "osd_mclock_recovery_res": 16.0,
+                               "osd_mclock_recovery_lim": 32.0}],
+                      base=base)
+    assert sweep["ok"], json.dumps(sweep["points"], indent=1)
+    row = sweep["points"][0]
+    assert row["invariants"] == {"no_deadlock": True,
+                                 "queues_bounded": True,
+                                 "recovery_completes": True}, row
+    # the burst really ran: both op classes measured on the steady leg
+    steady = row["steady"]
+    assert steady["achieved_per_s"] > 0
+    assert steady["read"]["ops"] > 0 and steady["write"]["ops"] > 0
+    assert steady["read"]["p99_ms"] is not None
+    # the ramp probed an open-loop rate and the knee is one of them
+    assert row["ramp"]["saturation_knee_per_s"] in (None, 40.0)
+    # thrash leg survived the kill/revive with ops flowing
+    assert row["thrash"]["achieved_per_s"] > 0
+    # the recovery storm was observed via the progress stack and its
+    # windowed rate is real
+    assert row["recovery"]["items"] > 0
+    assert row["recovery"]["window_rate_per_s"] > 0
+    assert row["msgs_per_op"] > 0
+
+
+@pytest.mark.slow
+def test_saturate_full_sweep_qos_ordering():
+    """The full `bench.py --saturate` gate: >= 3 recovery
+    reservation/limit settings; recovery's windowed service rate moves
+    the expected direction and the client-p99 monotone envelope
+    holds."""
+    from ceph_tpu.load.scenarios import ScenarioConfig, run_sweep
+    base = ScenarioConfig(procs=2, clients=12, objects=24,
+                          ramp_rates=(60.0,), ramp_leg_s=1.0,
+                          steady_s=2.5, thrash_s=6.0,
+                          kill_after_s=0.8, recovery_deadline_s=45.0)
+    row = run_sweep(base=base)
+    assert row["ok"], json.dumps(
+        {"qos": row["qos"],
+         "inv": [p["invariants"] for p in row["points"]]}, indent=1)
+    assert len(row["points"]) == 3
+    assert row["qos"]["ordering_holds"]
+    rates = row["qos"]["recovery_window_rate_per_s"]
+    assert rates[-1] >= rates[0] * 1.1

@@ -23,16 +23,25 @@ def _emitted_metric_names(body: str) -> set[str]:
 
 def test_rules_reference_only_emitted_metrics():
     # materialize the registries the rules read: the kernel profiler
-    # (ec_kernels: kernel_*_us) and one messenger (msg_dispatch_us) —
-    # the exporter emits every histogram's +Inf bucket even at zero
+    # (ec_kernels: kernel_*_us), one messenger (msg_dispatch_us) and
+    # the scheduler's per-class QoS counters (mclock_qwait_us_*) — the
+    # exporter emits every histogram's +Inf bucket even at zero
     # samples, so the schema exists without traffic
+    from ceph_tpu.osd.scheduler import ClassParams, register_qos_counters
+    from ceph_tpu.utils.perf import global_perf
     kernel_profiler()
     net = LocalNetwork()
     m = Messenger(net, "prom-rules-probe")
+    qos_probe = global_perf().create("qos_probe")
+    register_qos_counters(qos_probe, {
+        "client": ClassParams(0, 1, 0),
+        "recovery": ClassParams(0, 1, 0),
+        "scrub": ClassParams(0, 1, 0)})
     try:
         body = render_metrics(None)
     finally:
         m.shutdown()
+        global_perf().remove("qos_probe")
     emitted = _emitted_metric_names(body)
     rules = recording_rules()
     refs = referenced_metrics(rules)
@@ -45,7 +54,7 @@ def test_rules_reference_only_emitted_metrics():
 def test_rules_shape_and_rendering():
     rules = recording_rules()
     # one rule per (histogram, quantile), records namespaced
-    assert len(rules) == 8
+    assert len(rules) == 14
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     assert all("histogram_quantile(" in r["expr"] for r in rules)
     assert all("by (daemon, le)" in r["expr"] for r in rules)
@@ -53,8 +62,8 @@ def test_rules_shape_and_rendering():
     assert quantiles == {"p50", "p99"}
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 8
-    assert text.count("    expr: ") == 8
+    assert text.count("  - record: ") == 14
+    assert text.count("    expr: ") == 14
 
 
 def test_exporter_histogram_buckets_are_cumulative_le():

@@ -93,6 +93,117 @@ def test_idle_class_lets_others_run_full_speed():
     assert served["client"] >= 950           # full server capacity
 
 
+def test_saturation_limited_class_cannot_starve_reserved_class():
+    """Saturation unit (the --saturate harness's scheduler contract):
+    a class hammered far past its rate limit must not starve a
+    reserved class, and the per-class queue bound must DROP (not
+    buffer) the excess — with the drop accounting visible both in
+    dropped() and the exported perf counters."""
+    from ceph_tpu.utils.perf import PerfCounters
+    perf = PerfCounters("sat_probe")
+    clock = [100.0]
+    s = MClockScheduler(lambda k, i: None, {
+        "client": ClassParams(50.0, 10.0, 0.0),     # reserved floor
+        "recovery": ClassParams(0.0, 1000.0, 30.0),  # capped flood
+    }, clock=lambda: clock[0], perf=perf)
+    # flood recovery with far more than QUEUE_CAP: the bound must hold
+    flood = s.QUEUE_CAP * 3
+    for _ in range(flood):
+        s.enqueue("recovery", object())
+    assert s.queue_depth("recovery") == s.QUEUE_CAP
+    dropped = flood - s.QUEUE_CAP
+    assert s.dropped["recovery"] == dropped
+    assert perf.get("mclock_dropped_recovery") == dropped
+    assert perf.get("mclock_depth_recovery") == s.QUEUE_CAP
+    # steady client demand against the flood
+    for _ in range(2000):
+        s.enqueue("client", object())
+    served = drain(s, clock, 2.0)
+    # recovery is pinned to its 30/s limit; the client's 50/s
+    # reservation (plus its weight-phase wins) is untouched
+    assert 45 <= served["recovery"] <= 75            # ~2s * 30/s
+    assert served["client"] >= 2 * served["recovery"]
+    assert served["client"] >= 90                    # >= the floor
+
+
+def test_set_params_retunes_live_scheduler():
+    """The reservation-sweep knob: set_params swaps a class's (R,W,L)
+    under load — the next picks pace by the NEW limit."""
+    s, clock = make_sched({
+        "recovery": ClassParams(0.0, 1.0, 10.0),
+    })
+    for _ in range(1000):
+        s._queues["recovery"].append(object())
+    served = drain(s, clock, 1.0)
+    assert served["recovery"] <= 16                  # ~1s * 10/s
+    s.set_params("recovery", ClassParams(0.0, 1.0, 200.0))
+    served = drain(s, clock, 1.0)
+    assert served["recovery"] >= 150                 # ~1s * 200/s
+    with pytest.raises(KeyError):
+        s.set_params("nope", ClassParams(0, 1, 0))
+    # reservation above the limit clamps to it (constructor rule)
+    s.set_params("recovery", ClassParams(500.0, 1.0, 50.0))
+    assert s._classes["recovery"].reservation == 50.0
+
+
+def test_sharded_scheduler_exports_shared_perf_counters():
+    """All shards increment ONE per-class counter set on the daemon
+    registry — the exporter face satellite: served/dropped/depth move
+    with real traffic."""
+    import threading as _t
+
+    from ceph_tpu.osd.scheduler import ShardedScheduler
+    from ceph_tpu.utils.perf import PerfCounters
+    perf = PerfCounters("shard_probe")
+    done = _t.Event()
+    n_seen = [0]
+
+    def handler(klass, item):
+        n_seen[0] += 1
+        if n_seen[0] >= 60:
+            done.set()
+
+    s = ShardedScheduler(handler, {"client": ClassParams(0, 100, 0)},
+                         shards=3, name="probe", perf=perf)
+    s.start()
+    try:
+        for i in range(60):
+            s.enqueue("client", i, key=i % 6)
+        assert done.wait(10)
+        deadline = time.time() + 5
+        while perf.get("mclock_served_client") < 60 \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert perf.get("mclock_served_client") == 60
+        assert s.served["client"] == 60
+        # depth gauge returned to zero after the drain
+        deadline = time.time() + 5
+        while perf.get("mclock_depth_client") != 0 \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert perf.get("mclock_depth_client") == 0
+        assert perf.dump()["mclock_qwait_us_client"]["count"] == 60
+    finally:
+        s.shutdown()
+
+
+def test_shutdown_reconciles_depth_gauge():
+    """A kill with items still queued must not leave the depth gauge
+    inflated forever: the daemon's perf registry OUTLIVES a
+    kill/revive cycle, so shutdown() reconciles what dies queued."""
+    from ceph_tpu.utils.perf import PerfCounters
+    perf = PerfCounters("depth_probe")
+    s = MClockScheduler(lambda k, i: None,
+                        {"recovery": ClassParams(0, 1.0, 0)},
+                        perf=perf)
+    # never started: everything enqueued dies in the queue
+    for _ in range(17):
+        s.enqueue("recovery", object())
+    assert perf.get("mclock_depth_recovery") == 17
+    s.shutdown()
+    assert perf.get("mclock_depth_recovery") == 0
+
+
 def test_threaded_worker_serves_and_survives_errors():
     seen = []
 
