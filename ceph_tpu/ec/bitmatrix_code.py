@@ -28,6 +28,9 @@ relies on (a whole-object encode and a later row rmw must agree).
 
 from __future__ import annotations
 
+import threading
+import time
+
 import numpy as np
 
 from .interface import (ChunkMap, ErasureCode, ErasureCodeError, Flags,
@@ -181,14 +184,26 @@ class BitMatrixErasureCode(ErasureCode):
     bitmatrix: np.ndarray
 
     def _init_bitmatrix(self) -> None:
-        # validate the backend name like the matrix codes do; execution
-        # is the vectorized numpy XOR path for every backend this round
-        # (the MXU bit-matrix formulation of ops/ec_kernels.py is the
-        # acceleration seam)
+        # backend resolution mirrors the matrix codes: numpy/native run
+        # the vectorized host XOR path; the jax backend routes packet
+        # rows through the SHARED scheduled-XOR device kernel
+        # (ops/ec_kernels.ScheduledXor — the same bitxor executor the
+        # GF(2^8) auto-tuner races), so the liberation family touches
+        # the device path instead of staying numpy-only
         from .matrix_code import _pick_backend
         self._backend = _pick_backend(self.profile.get("backend", "auto"))
         self._granule = self.w * SIMD_ALIGN
         self._decode_cache: dict[tuple, np.ndarray] = {}
+        # matrix-bytes -> ScheduledXor, LRU-bounded; built lazily so
+        # non-jax deployments never pay the jax import
+        self._xor_ops: dict[bytes, object] = {}
+        self._xor_lock = threading.Lock()
+        self._xor_shapes_seen: set[tuple] = set()
+        # latched on the first device-path failure: a persistently
+        # broken path must not be re-attempted (and re-swallowed) per
+        # apply, and the fall-through must be VISIBLE, not silent —
+        # booked on the ec_kernels registry (ec_bitxor_host_fallback)
+        self._xor_device_broken = False
 
     def get_flags(self) -> Flags:
         # no PARITY_DELTA: a parity byte depends on data bytes at OTHER
@@ -221,8 +236,90 @@ class BitMatrixErasureCode(ErasureCode):
         return rows.reshape(g, n, self.w, SIMD_ALIGN) \
             .transpose(1, 0, 2, 3).reshape(n, g * self._granule)
 
+    def _xor_kernel(self, B: np.ndarray):
+        """The shared scheduled-XOR device op for bit-matrix ``B``
+        (LRU per matrix: the encode drive plus the decode combination
+        matrices of hot erasure signatures)."""
+        # NOT bytes(B.shape): bit-matrix dims reach 256+ (liber8tion
+        # k=32 is (16, 256)) and bytes() raises there
+        key = B.tobytes() + repr(B.shape).encode()
+        with self._xor_lock:
+            op = self._xor_ops.pop(key, None)
+            if op is not None:
+                self._xor_ops[key] = op  # LRU touch
+                return op
+        from ..ops.ec_kernels import ScheduledXor
+        op = ScheduledXor(B)
+        with self._xor_lock:
+            hit = self._xor_ops.pop(key, None)
+            if hit is not None:
+                op = hit
+            elif len(self._xor_ops) > 64:
+                self._xor_ops.pop(next(iter(self._xor_ops)))
+            self._xor_ops[key] = op
+        return op
+
+    def _apply_bits_device(self, B: np.ndarray,
+                           rows: np.ndarray) -> np.ndarray:
+        """jax-backend packet apply: granule-local (G, nr, S) rows
+        flatten to (nr, G*S) plane rows — XOR is positionwise, so the
+        re-layout is exact — and ONE scheduled-XOR launch produces
+        every output packet row.  Launches land in the kernel
+        profiler under ``bitxor/RxC/L...`` (first shape = compile)."""
+        from ..utils.perf import kernel_profiler
+        g, nr, s = rows.shape
+        flat = np.ascontiguousarray(
+            rows.transpose(1, 0, 2).reshape(nr, g * s))
+        op = self._xor_kernel(B)
+        sig = f"bitxor/{B.shape[0]}x{B.shape[1]}/L{g * s}"
+        t0 = time.perf_counter()
+        dev = op(flat)
+        dev = dev.block_until_ready() \
+            if hasattr(dev, "block_until_ready") else dev
+        dt = time.perf_counter() - t0
+        shape_key = (sig, flat.shape)
+        with self._xor_lock:
+            first = shape_key not in self._xor_shapes_seen
+            if first:
+                self._xor_shapes_seen.add(shape_key)
+        kernel_profiler().note("compile" if first else "device",
+                               sig, dt)
+        t0 = time.perf_counter()
+        out = np.asarray(dev)
+        kernel_profiler().note("sync", sig, time.perf_counter() - t0)
+        return out.reshape(B.shape[0], g, s).transpose(1, 0, 2)
+
+    #: below this many source bytes an apply stays on the host numpy
+    #: path even on the jax backend: a sub-ms vectorized XOR beats a
+    #: device launch + sync, and the bound also caps how many shapes
+    #: ever pay the (~0.1-0.2s on CPU-jax, measured) one-time jit
+    #: compile on the op thread — the same keep-cheap-work-cheap rule
+    #: the fused-csum warm gating applies to its (much larger) graphs
+    JAX_APPLY_MIN_BYTES = 1 << 16
+
+    def _note_device_broken(self) -> None:
+        """Book the device->host fall-through where operators look
+        (the ec_kernels registry the profiler lives on) and latch the
+        path off for this codec."""
+        self._xor_device_broken = True
+        try:
+            from ..utils.perf import CounterType, kernel_profiler
+            perf = kernel_profiler()._perf
+            if not perf.has("ec_bitxor_host_fallback"):
+                perf.add("ec_bitxor_host_fallback", CounterType.COUNTER)
+            perf.inc("ec_bitxor_host_fallback")
+        except Exception:  # noqa: BLE001 - accounting must not raise
+            pass
+
     def _apply_bits(self, B: np.ndarray, rows: np.ndarray) -> np.ndarray:
         """out[:, r] = XOR of rows[:, c] where B[r, c] — per granule."""
+        if (self._backend == "jax" and rows.shape[0] > 0
+                and not self._xor_device_broken
+                and rows.nbytes >= self.JAX_APPLY_MIN_BYTES):
+            try:
+                return self._apply_bits_device(B, rows)
+            except Exception:  # noqa: BLE001 - host path fall-through
+                self._note_device_broken()
         g, _nr, s = rows.shape
         out = np.zeros((g, B.shape[0], s), dtype=np.uint8)
         for r in range(B.shape[0]):

@@ -26,10 +26,28 @@ jerasure's XOR-schedule (cauchy_good) path performs on CPUs.
 The same trace builds three ways: a Pallas TPU kernel (data staged through
 VMEM in blocks), the identical jnp graph for CPU/debug, and Pallas
 interpret mode for CI coverage of the kernel itself.
+
+Kernel realizations (the auto-tuner's candidate set, KERNELS):
+
+- ``xla``    — the VPU bit-term chain above as a plain jnp graph;
+- ``pallas`` — the same chain as a Pallas kernel (TPU, or interpret);
+- ``mxu``    — GF(2) bit-matrix matmul on the systolic array
+  (gf_matmul_mxu_graph; needs 8c <= 256 for exact bf16 accumulation);
+- ``bitxor`` — XOR-scheduled GF(2) bitplanes (gf_bitxor_graph): unpack
+  each input byte row into 8 LSB-positioned planes ONCE per launch,
+  run the common-subexpression-eliminated XOR schedule built from the
+  bit-matrix (ops/xor_schedule.py, the arXiv:2108.02692 technique),
+  pack the output planes back — no integer multiplies, and shared
+  partial sums are computed once across all output bit-rows.
+
+``kernel_supports`` is the per-candidate viability predicate the
+runtime auto-selection (ec/matrix_code.py) consults so unsupported
+candidates are SKIPPED, never raised.
 """
 
 from __future__ import annotations
 
+import functools
 import threading
 
 import jax
@@ -37,8 +55,38 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import gf256
+from .xor_schedule import XorSchedule, build_schedule
 
 _MASK = 0x01010101  # low bit of each byte lane in a uint32
+
+#: kernel realizations the runtime auto-selection races (ec/matrix_code)
+KERNELS = ("xla", "pallas", "mxu", "bitxor")
+
+
+def kernel_supports(kernel: str, M: np.ndarray, shape=None, *,
+                    interpret: bool = False) -> bool:
+    """Whether candidate ``kernel`` can run matrix ``M`` (optionally at
+    input ``shape``) in this process — the auto-selection viability
+    guard: a False here means SKIP the candidate, never try-and-raise.
+
+    - ``mxu`` needs 8c <= 256 (exact bf16 accumulation bound of
+      gf_matmul_mxu_graph);
+    - ``pallas`` needs the TPU backend (or an explicit interpret=True,
+      the CI coverage mode — interpreter speed, honest label);
+    - ``xla`` and ``bitxor`` lower as plain graphs everywhere.
+    """
+    if kernel not in KERNELS:
+        return False
+    M = np.asarray(M)
+    if M.ndim != 2 or 0 in M.shape:
+        return False
+    if shape is not None and tuple(shape)[0] != M.shape[1]:
+        return False
+    if kernel == "mxu":
+        return 8 * M.shape[1] <= 256
+    if kernel == "pallas":
+        return interpret or jax.default_backend() == "tpu"
+    return True
 
 
 def _terms(M: np.ndarray) -> tuple[tuple[tuple[int, int, int], ...], ...]:
@@ -80,11 +128,221 @@ def _rows_op(x, terms_all):
     return jnp.concatenate([_accumulate_row(x, t) for t in terms_all], axis=0)
 
 
-def _pallas_region_kernel(terms_all):
+def _pallas_region_kernel(rows_op):
+    """Pallas kernel body around any (c, n) -> (r, n) uint32 rows op —
+    shared by the bit-term chain and the scheduled-XOR realization."""
     def kernel(x_ref, o_ref):
-        o_ref[...] = _rows_op(x_ref[...], terms_all)
+        o_ref[...] = rows_op(x_ref[...])
 
     return kernel
+
+
+# ---------------------------------------------------------------------------
+# bitxor: XOR-scheduled GF(2) bitplanes
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=128)
+def _cached_schedule(key: bytes, shape: tuple[int, int]) -> XorSchedule:
+    B = np.frombuffer(key, dtype=np.uint8).reshape(shape)
+    return build_schedule(B)
+
+
+def bitxor_schedule(M: np.ndarray) -> XorSchedule:
+    """The CSE'd XOR schedule of a GF(2^8) matrix's bit-matrix
+    expansion (gf256.bitmatrix), cached per matrix — schedule
+    construction is CPU work done once, the launches replay it."""
+    B = gf256.bitmatrix(np.asarray(M, dtype=np.uint8))
+    return _cached_schedule(B.tobytes(), B.shape)
+
+
+def _eval_schedule_nodes(sched: XorSchedule, nodes: list) -> list:
+    """Run the intermediate op chain in place (inputs pre-filled)."""
+    for dst, a, b in sched.ops:
+        nodes[dst] = nodes[a] ^ nodes[b]
+    return nodes
+
+
+def _combine_terms(nodes: list, terms: tuple[int, ...]):
+    acc = None
+    for t in terms:
+        acc = nodes[t] if acc is None else acc ^ nodes[t]
+    return acc
+
+
+def _bitxor_rows(x32, sched: XorSchedule):
+    """(c, n4) uint32 lanes -> (r, n4) via the scheduled GF(2) planes.
+
+    Input plane 8j+s is bit s of every byte of row j, kept in the low
+    bit of its byte lane (one shift+mask per USED plane, amortized over
+    all output rows — the existing bit-term chain re-extracts it per
+    term); output byte row i packs its 8 scheduled planes back with
+    shifts, no multiplies anywhere."""
+    c = x32.shape[0]
+    if sched.n_in != 8 * c:
+        raise ValueError(f"schedule wants {sched.n_in // 8} rows, got {c}")
+    nodes: list = [None] * (sched.n_in + len(sched.ops))
+    for p in sched.used_inputs:
+        xj = x32[p >> 3: (p >> 3) + 1, :]
+        s = p & 7
+        if s:
+            xj = xj >> jnp.uint32(s)
+        nodes[p] = xj & jnp.uint32(_MASK)
+    _eval_schedule_nodes(sched, nodes)
+    rows = []
+    for i in range(len(sched.outputs) // 8):
+        acc = None
+        for t in range(8):
+            q = _combine_terms(nodes, sched.outputs[8 * i + t])
+            if q is None:
+                continue
+            if t:
+                q = q << jnp.uint32(t)
+            acc = q if acc is None else acc ^ q
+        rows.append(acc if acc is not None
+                    else jnp.zeros_like(x32[0:1, :]))
+    return jnp.concatenate(rows, axis=0)
+
+
+def gf_bitxor_graph(M: np.ndarray):
+    """fn(data (c, L) uint8) -> (r, L) uint8 computing M @ data over
+    GF(2^8) as the XOR-scheduled bitplane program (L % 4 == 0); the
+    bitxor counterpart of gf_matmul_graph, byte-identical to the
+    oracle, embeddable in jit/shard_map bodies."""
+    sched = bitxor_schedule(M)
+    r, c = np.asarray(M).shape
+
+    def fn(data_u8):
+        if data_u8.shape[0] != c:
+            raise ValueError(f"expected {c} rows, got {data_u8.shape[0]}")
+        n4 = data_u8.shape[-1] // 4
+        x32 = jax.lax.bitcast_convert_type(
+            data_u8.reshape(c, n4, 4), jnp.uint32)
+        y32 = _bitxor_rows(x32, sched)
+        return jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(r, n4 * 4)
+
+    return fn
+
+
+def gf_region_graph(M: np.ndarray, kernel: str = "xla"):
+    """Byte-domain graph fn(data (c, L) u8) -> (r, L) u8 for a named
+    kernel realization — the builder shard_map bodies and fused passes
+    embed, so the sharded/fused paths ride the picked kernel unchanged.
+    ``pallas``/``auto`` lower to the same XLA graph here (Pallas is a
+    launch-level realization, not an embeddable sub-graph)."""
+    if kernel == "bitxor":
+        return gf_bitxor_graph(M)
+    if kernel == "mxu":
+        return gf_matmul_mxu_graph(M)
+    return gf_matmul_graph(M)
+
+
+def _sched_plane_rows(x32, sched: XorSchedule):
+    """(n_in, n4) uint32 plane rows -> (n_out, n4): the schedule applied
+    to rows that ARE the planes already (the bit-matrix code family's
+    packet rows) — no bit extraction, no packing."""
+    nodes: list = [None] * (sched.n_in + len(sched.ops))
+    for p in sched.used_inputs:
+        nodes[p] = x32[p: p + 1, :]
+    _eval_schedule_nodes(sched, nodes)
+    rows = []
+    for terms in sched.outputs:
+        acc = _combine_terms(nodes, terms)
+        rows.append(acc if acc is not None
+                    else jnp.zeros_like(x32[0:1, :]))
+    return jnp.concatenate(rows, axis=0)
+
+
+class ScheduledXor:
+    """out(R, L) = B(R, C) @ rows(C, L) over GF(2), executed as the
+    CSE'd XOR schedule on uint32 lanes — the shared bitxor executor for
+    the GF(2) bit-matrix code family (ec/bitmatrix_code.py routes its
+    jerasure-parity packet rows here on the jax backend) and any caller
+    already holding plane rows.  Pallas on TPU (or interpret for CI),
+    the identical jnp graph elsewhere; same 512-byte lane quantum and
+    per-shape jit LRU as RegionMatmul."""
+
+    # VMEM block: same lane quantum as RegionMatmul
+    BLOCK = 8192
+
+    def __init__(self, B: np.ndarray, *, interpret: bool = False):
+        self.B = np.ascontiguousarray(B, dtype=np.uint8) & 1
+        self.R, self.C = self.B.shape
+        self.sched = _cached_schedule(self.B.tobytes(), self.B.shape)
+        on_tpu = jax.default_backend() == "tpu"
+        self._interpret = interpret and not on_tpu
+        self._use_pallas = on_tpu or self._interpret
+        self._shape_cache: dict[int, object] = {}
+        self._cache_lock = threading.Lock()
+
+    def _rows_op(self, n4: int):
+        sched = self.sched
+        if not self._use_pallas:
+            return lambda x32: _sched_plane_rows(x32, sched)
+
+        from jax.experimental import pallas as pl
+
+        block = min(self.BLOCK, n4)
+        grid = (n4 // block,)
+        kernel = _pallas_region_kernel(
+            lambda x32: _sched_plane_rows(x32, sched))
+        R, C, interpret = self.R, self.C, self._interpret
+
+        def run(x32):
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct((R, n4), jnp.uint32),
+                grid=grid,
+                in_specs=[pl.BlockSpec((C, block), lambda g: (0, g))],
+                out_specs=pl.BlockSpec((R, block), lambda g: (0, g)),
+                interpret=interpret,
+            )(x32)
+
+        return run
+
+    def _compiled(self, n4: int):
+        with self._cache_lock:
+            fn = self._shape_cache.pop(n4, None)
+            if fn is None:
+                fn = jax.jit(self._rows_op(n4))
+                if len(self._shape_cache) >= 16:
+                    self._shape_cache.pop(next(iter(self._shape_cache)))
+            self._shape_cache[n4] = fn
+        return fn
+
+    def _quantum(self, L: int) -> int:
+        return 512 if L <= 4 * self.BLOCK else 4 * self.BLOCK
+
+    def __call__(self, rows) -> jax.Array:
+        """rows (C, L) uint8 -> (R, L) uint8 device array (no host
+        sync — callers np.asarray when they want the bytes)."""
+        if (isinstance(rows, np.ndarray) and rows.dtype == np.uint8
+                and rows.ndim == 2 and rows.shape[0] == self.C
+                and rows.shape[1] > 0):
+            L = rows.shape[1]
+            pad = (-L) % self._quantum(L)
+            if pad:
+                rows = np.pad(rows, ((0, 0), (0, pad)))
+            x32 = np.ascontiguousarray(rows).view(np.uint32)
+            y32 = self._compiled(x32.shape[-1])(x32)
+            out = jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(
+                self.R, L + pad)
+            return out[:, :L] if pad else out
+        rows = jnp.asarray(rows, dtype=jnp.uint8)
+        if rows.ndim != 2 or rows.shape[0] != self.C:
+            raise ValueError(f"expected ({self.C}, L) rows, got {rows.shape}")
+        L = rows.shape[1]
+        if L == 0:
+            return jnp.zeros((self.R, 0), dtype=jnp.uint8)
+        pad = (-L) % self._quantum(L)
+        if pad:
+            rows = jnp.pad(rows, ((0, 0), (0, pad)))
+        n4 = (L + pad) // 4
+        x32 = jax.lax.bitcast_convert_type(
+            rows.reshape(self.C, n4, 4), jnp.uint32)
+        y32 = self._compiled(n4)(x32)
+        out = jax.lax.bitcast_convert_type(y32, jnp.uint8).reshape(
+            self.R, L + pad)
+        return out[:, :L] if pad else out
 
 
 def gf_matmul_mxu_graph(M: np.ndarray):
@@ -156,16 +414,41 @@ class RegionMatmul:
     # VMEM block: BLOCK uint32 lanes per row (32 KiB/row at 8192)
     BLOCK = 8192
 
-    def __init__(self, M: np.ndarray, *, interpret: bool = False):
+    def __init__(self, M: np.ndarray, *, interpret: bool = False,
+                 kernel: str = "auto"):
         """``interpret=True`` forces the Pallas kernel in interpret mode
         (CI coverage of the kernel body off-TPU); otherwise the Pallas
-        path runs compiled on TPU and the identical jnp graph elsewhere."""
+        path runs compiled on TPU and the identical jnp graph elsewhere.
+
+        ``kernel`` picks the realization (KERNELS): ``auto`` keeps the
+        legacy per-platform choice (pallas on TPU, the xla graph
+        elsewhere); an explicit name pins it — ``pallas`` requires TPU
+        or interpret, ``mxu`` requires 8c <= 256 (both raise ValueError
+        here; runtime auto-selection guards with kernel_supports first),
+        ``bitxor`` runs the scheduled-bitplane program (Pallas-lowered
+        on TPU/interpret, fused XLA graph elsewhere)."""
         self.M = np.ascontiguousarray(M, dtype=np.uint8)
         self.r, self.c = self.M.shape
-        self._terms = _terms(self.M)
+        if kernel not in ("auto",) + KERNELS:
+            raise ValueError(f"unknown kernel {kernel!r}")
+        self.kernel = kernel
         on_tpu = jax.default_backend() == "tpu"
         self._interpret = interpret and not on_tpu
-        self._use_pallas = on_tpu or self._interpret
+        pallas_ok = on_tpu or self._interpret
+        if kernel == "pallas" and not pallas_ok:
+            raise ValueError(
+                "pallas kernel needs the TPU backend or interpret=True")
+        if kernel == "mxu" and 8 * self.c > 256:
+            raise ValueError("MXU path needs c <= 32 "
+                             "(exact bf16 accumulation)")
+        # xla pins the plain graph even on TPU; mxu is a dot graph, not
+        # a Pallas body; bitxor Pallas-lowers wherever pallas runs
+        self._use_pallas = pallas_ok and kernel in ("auto", "pallas",
+                                                    "bitxor")
+        self._terms = (_terms(self.M)
+                       if kernel in ("auto", "xla", "pallas") else None)
+        self._sched = bitxor_schedule(self.M) if kernel == "bitxor" \
+            else None
         self._shape_cache: dict[tuple, object] = {}
         # one matmul op serves many threads (OSD shard workers, batcher
         # flushers); the LRU touch and eviction must not interleave
@@ -195,15 +478,15 @@ class RegionMatmul:
         avoids the layout the compiler otherwise invents for the bitcast
         (minor-most rows axis, T(8,128)-padded 16x — enough to OOM HBM on
         multi-GiB batches)."""
-        terms_all = self._terms
+        core = self._rows_core()
         if not self._use_pallas:
-            return lambda x32: _rows_op(x32, terms_all)
+            return core
 
         from jax.experimental import pallas as pl
 
         block = min(self.BLOCK, n4)
         grid = (n4 // block,)
-        kernel = _pallas_region_kernel(terms_all)
+        kernel = _pallas_region_kernel(core)
         r, c, interpret = self.r, self.c, self._interpret
 
         def run(x32):
@@ -218,6 +501,27 @@ class RegionMatmul:
 
         return run
 
+    def _rows_core(self):
+        """The raw (c, n) -> (r, n) uint32 lanes computation of the
+        selected realization — what the Pallas kernel body, the jnp
+        graph, and interpret mode all share."""
+        if self.kernel == "mxu":
+            mxu = gf_matmul_mxu_graph(self.M)
+            r, c = self.r, self.c
+
+            def core(x32):
+                u8 = jax.lax.bitcast_convert_type(x32, jnp.uint8)
+                y8 = mxu(u8.reshape(c, 4 * x32.shape[-1]))
+                return jax.lax.bitcast_convert_type(
+                    y8.reshape(r, x32.shape[-1], 4), jnp.uint32)
+
+            return core
+        if self.kernel == "bitxor":
+            sched = self._sched
+            return lambda x32: _bitxor_rows(x32, sched)
+        terms_all = self._terms
+        return lambda x32: _rows_op(x32, terms_all)
+
     def _build_u32(self, n4: int):
         return jax.jit(self._lanes_op(n4))
 
@@ -229,9 +533,11 @@ class RegionMatmul:
         # input exclusively — donation deletes it (__call__ donate flag)
         dargs = (0,) if donate else ()
         if not self._use_pallas:
-            # identical math as a plain jnp graph — shared with
-            # gf_matmul_graph so the lane-packing logic lives once
-            return jax.jit(gf_matmul_graph(self.M), donate_argnums=dargs)
+            # identical math as a plain jnp graph — shared with the
+            # gf_region_graph builders so the lane-packing logic lives
+            # once per realization
+            return jax.jit(gf_region_graph(self.M, self.kernel),
+                           donate_argnums=dargs)
         run, r, c = self._lanes_op(n4), self.r, self.c
 
         def fn(data_u8):

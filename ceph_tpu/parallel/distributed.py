@@ -36,7 +36,6 @@ except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
 from ..models.stripe_codec import StripeCodec
-from ..ops.ec_kernels import gf_matmul_graph
 
 
 def stage_folded(rows: np.ndarray, mesh: Mesh, axis: str = "shard"):
@@ -66,7 +65,8 @@ def stage_folded(rows: np.ndarray, mesh: Mesh, axis: str = "shard"):
     return dev
 
 
-def make_folded_matmul(M: np.ndarray, mesh: Mesh, axis: str = "shard"):
+def make_folded_matmul(M: np.ndarray, mesh: Mesh, axis: str = "shard",
+                       kernel: str = "xla"):
     """Mesh-sharded folded region multiply: fn(rows (c, N) uint8) ->
     (r, N) uint8 computing M @ rows over GF(2^8) with the LENGTH axis
     sharded over `axis` — the multi-chip fan-out for the ECBatcher's
@@ -78,14 +78,21 @@ def make_folded_matmul(M: np.ndarray, mesh: Mesh, axis: str = "shard"):
     mesh encodes an n-writer burst in ~one chip-time.  Callers pad N to
     a multiple of n_devices * 4 (uint32 lanes per shard); zero columns
     encode to zero under a linear code, so padding slices away exact.
+
+    ``kernel`` selects the graph realization the body embeds
+    (ops/ec_kernels.gf_region_graph: xla bit-terms / bitxor scheduled
+    planes / mxu bit-matrix dot) — how a sharded pool rides the
+    auto-tuner's per-signature winner.
     """
-    g = gf_matmul_graph(np.ascontiguousarray(M, dtype=np.uint8))
+    from ..ops.ec_kernels import gf_region_graph
+    g = gf_region_graph(np.ascontiguousarray(M, dtype=np.uint8), kernel)
     return shard_map(g, mesh=mesh, in_specs=P(None, axis),
                      out_specs=P(None, axis))
 
 
 def make_folded_csum(k: int, m: int, M: np.ndarray, chunk_bytes: int,
-                     mesh: Mesh, axis: str = "shard"):
+                     mesh: Mesh, axis: str = "shard",
+                     kernel: str = "xla"):
     """Mesh-sharded fused encode+CRC32C: fn(data (k, N) uint8, N =
     batch*chunk_bytes) -> (parity (m, N), csums (k+m, batch) uint32)
     with the length axis sharded over `axis` — the multi-chip fan-out
@@ -103,7 +110,7 @@ def make_folded_csum(k: int, m: int, M: np.ndarray, chunk_bytes: int,
     codec = StripeCodec.__new__(StripeCodec)
     codec.k, codec.m = k, m
     codec.matrix = np.ascontiguousarray(M, dtype=np.uint8)
-    fn = codec.encode_csum_graph(chunk_bytes)
+    fn = codec.encode_csum_graph(chunk_bytes, kernel=kernel)
     return shard_map(fn, mesh=mesh, in_specs=P(None, axis),
                      out_specs=(P(None, axis), P(None, axis)))
 

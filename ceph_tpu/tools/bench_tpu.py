@@ -57,10 +57,12 @@ def main() -> int:
     p.add_argument("--reps", type=int, default=4)
     p.add_argument("--technique", default="reed_sol_van")
     p.add_argument("--kernel", default="auto",
-                   choices=["auto", "pallas", "xla", "mxu"],
+                   choices=["auto", "pallas", "xla", "mxu", "bitxor"],
                    help="pallas = VPU bit-term Pallas kernel; xla = same "
                         "math as a fused XLA graph; mxu = GF(2) bitmatrix "
-                        "matmul; auto = time all, keep the fastest")
+                        "matmul; bitxor = XOR-scheduled GF(2) bitplanes "
+                        "(CSE'd schedule, ops/xor_schedule.py); auto = "
+                        "time all, keep the fastest")
     p.add_argument("--skip-e2e", action="store_true",
                    help="skip the full-parity-fetch end-to-end rep "
                         "(slow over the tunnel)")
@@ -237,6 +239,12 @@ def main() -> int:
         except ValueError:
             if args.kernel == "mxu":
                 raise  # explicitly requested but unsupported (k > 32)
+    if args.kernel in ("auto", "bitxor"):
+        # XOR-scheduled GF(2) bitplane realization (lanes-domain core,
+        # same schedule the runtime bitxor candidate replays)
+        from ceph_tpu.ops.ec_kernels import _bitxor_rows, bitxor_schedule
+        sched = bitxor_schedule(W)
+        register("bitxor", lambda x32: _bitxor_rows(x32, sched))
 
     def progress(msg: str) -> None:
         print(f"bench_tpu: {msg}", file=sys.stderr, flush=True)

@@ -129,7 +129,9 @@ def test_jax_op_cache_true_lru():
     from ceph_tpu.ops import gf256 as gf
     codec = ec.factory("tpu", {"k": 4, "m": 2, "backend": "jax"})
     codec.JAX_OPS_CAP = 2
-    enc_key = codec.matrix.tobytes() + bytes(codec.matrix.shape)
+    # the key carries the picked kernel realization ("xla": the
+    # deterministic CPU pick) — _matmul_key is THE shared definition
+    enc_key = codec._matmul_key(codec.matrix, "xla")
     data = RNG.integers(0, 256, (4, 512), dtype=np.uint8)
     for erased in ((0, 5), (1, 5), (2, 5)):
         chunks = codec.encode(data.tobytes())

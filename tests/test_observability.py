@@ -123,8 +123,9 @@ def test_observability_verbs_end_to_end(obs_cluster):
     # are zero on the numpy backend — the schema is the contract)
     for a in asoks:
         prof = admin_request(a, "dump_kernel_profile")
-        assert set(prof) == {"signatures", "recent_compiles"}
+        assert set(prof) == {"signatures", "picks", "recent_compiles"}
         assert isinstance(prof["signatures"], dict)
+        assert isinstance(prof["picks"], dict)
         assert isinstance(prof["recent_compiles"], list)
 
 
