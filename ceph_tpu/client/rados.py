@@ -108,6 +108,10 @@ class RadosClient(Dispatcher):
         self._closed = False
         from ..utils.tracer import Tracer
         self.tracer = Tracer(name)
+        # tracing switches: `tracing` forces a span on EVERY op (the
+        # debugging mode); otherwise the tracer's sample_rate head-
+        # samples roots (trace_sample_rate — the always-on mode; the
+        # harness seeds it from config, tracer.set_sample_rate retunes)
         self.tracing = False  # per-client switch: ops carry spans
         self._aio_exec = None
         self._aio_init_lock = threading.Lock()
@@ -361,9 +365,15 @@ class RadosClient(Dispatcher):
     def _op(self, pool_name: str, oid: str, op: str, data: bytes = b"",
             offset: int = 0, length: int = 0, snapid: int = 0):
         pool_id = self._pool_id(pool_name)
-        root = (self.tracer.start(f"client-op {op}", oid=oid,
-                                  pool=pool_name)
-                if self.tracing else None)
+        if self.tracing:
+            root = self.tracer.start(f"client-op {op}", oid=oid,
+                                     pool=pool_name)
+        else:
+            # head sampling: None at zero cost when the rate is 0,
+            # a propagating span with probability sample_rate, or a
+            # local-only unsampled span (flight-recorder ring)
+            root = self.tracer.sample_root(f"client-op {op}", oid=oid,
+                                           pool=pool_name)
         try:
             return self._op_attempts(pool_id, pool_name, oid, op, data,
                                      offset, length, snapid, root)
@@ -380,7 +390,12 @@ class RadosClient(Dispatcher):
             tid = next(self._tids)
             m = MOSDOp(tid, self.name, pool_id, oid, op, offset, length,
                        data, self.osdmap.epoch, snapid=snapid,
-                       trace=root.ctx if root is not None else ())
+                       # the head decision rides the wire: only a
+                       # SAMPLED root propagates its context (one draw
+                       # covers the whole fan-out; unsampled spans
+                       # stay local for retroactive slow-op retention)
+                       trace=root.ctx if root is not None
+                       and root.sampled else ())
             if op in self._WRITE_OPS:
                 seq, snaps = self._snapc.get(pool_id, (0, []))
                 m.snap_seq, m.snaps = seq, list(snaps)

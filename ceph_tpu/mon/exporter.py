@@ -79,6 +79,17 @@ def render_metrics(mon=None) -> str:
                  {"daemon": f"osd.{i}"},
                  help_="ops currently slower than "
                        "osd_op_complaint_time", typ="gauge")
+        # metrics-history staleness (the in-cluster TSDB's liveness
+        # face): seconds since each daemon's newest merged snapshot —
+        # the gauge the prom recording rules alert on (a wedged
+        # sampler or partitioned daemon goes stale here first)
+        hist = getattr(mon, "metrics_history", None)
+        if hist is not None:
+            for daemon, age in sorted(hist.staleness().items()):
+                emit("metrics_history_staleness_s", age,
+                     {"daemon": daemon},
+                     help_="seconds since the daemon's newest merged "
+                           "metrics-history snapshot", typ="gauge")
         # progress gauges (the mgr progress module's exporter face):
         # one series per derived item, present while the item is live
         # (or lingering complete), GONE once it clears

@@ -339,6 +339,31 @@ class ProgressModule(MgrModule):
         raise KeyError(cmd)
 
 
+@register_module("metrics")
+class MetricsModule(MgrModule):
+    """The metrics-history verb surface (the in-cluster TSDB face of
+    the mgr): ``history`` dumps the monitor's merged snapshot rings,
+    ``query`` answers delta/rate/quantile questions over arbitrary
+    retrospective windows, ``staleness`` reports per-daemon sample
+    age.  The store itself lives monitor-side (merged from the stats
+    reports) — this module is the operator face, like progress."""
+
+    def command(self, cmd: str, **kw):
+        store = getattr(self.mgr.mon, "metrics_history", None)
+        if store is None:
+            return {"registries": {}, "keep": 0}
+        if cmd == "history":
+            return store.dump(registry=kw.get("registry"),
+                              max_samples=int(kw.get("max", 0) or 0))
+        if cmd == "query":
+            return store.query(kw["registry"], kw["counter"],
+                               since_s=float(kw.get("since_s", 60.0)),
+                               until_s=float(kw.get("until_s", 0.0)))
+        if cmd == "staleness":
+            return store.staleness()
+        raise KeyError(cmd)
+
+
 @register_module("balancer")
 class BalancerModule(MgrModule):
     """Automatic upmap balancing (pybind/mgr/balancer role): when

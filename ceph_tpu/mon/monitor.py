@@ -29,7 +29,9 @@ replication Paxos.cc, Elector.cc leader election, forwarded requests):
 
 from __future__ import annotations
 
+import collections
 import hmac as _hmac
+import json
 import os
 import queue
 import struct
@@ -52,6 +54,7 @@ from ..ops import native
 from ..utils.config import Config, default_config
 from ..utils.event_log import ClusterLog, make_event
 from ..utils.log import dout
+from ..utils.metrics_history import MetricsHistoryStore
 from .maps import OSDMap, PoolSpec
 from .mgr import ProgressTracker
 
@@ -476,8 +479,33 @@ class MonitorLite(Dispatcher):
         # the stats reports and merge here; the mon adds its own map /
         # lifecycle / health-transition events.  Served by the
         # `dump_cluster_log` verb, tailed by tools/event_tool.py.
+        # Journaled through the paxos store (key "clusterlog",
+        # debounced by mon_clog_persist_interval_s) so the log — and
+        # the slow_op flight-recorder events in it — survives a mon
+        # restart (LogMonitor parity).
         self.cluster_log = ClusterLog(
             keep=self.cfg["mon_cluster_log_size"])
+        if self.store.kv.get("clusterlog"):
+            try:
+                self.cluster_log.restore(
+                    json.loads(self.store.kv["clusterlog"].decode()))
+            except (ValueError, UnicodeDecodeError):
+                pass  # corrupt snapshot: start the ring fresh
+        self._clog_persisted_seq = self.cluster_log.last_seq
+        self._clog_persisted_at = 0.0
+        # mon-side merged metrics history (utils/metrics_history.py):
+        # per-daemon registry snapshots ride the stats reports and
+        # merge here, served by dump_metrics_history / metrics_query
+        # and the perf_history CLI; staleness feeds the exporter gauge
+        self.metrics_history = MetricsHistoryStore(
+            keep=self.cfg["mon_metrics_history_keep"])
+        # batch-thrash health feed: (merge-monotonic ts, daemon) per
+        # `batch` channel event while the check is ENABLED (nothing
+        # accumulates at the count=0 default), pruned to the warn
+        # window on every health evaluation; maxlen backstops a
+        # misconfigured window so the feed can never grow unbounded
+        self._batch_events: collections.deque = collections.deque(
+            maxlen=4096)
         # progress items derived from the recovery event channel (the
         # mgr progress module's engine lives monitor-side so the
         # exporter and `status` see it without a running MgrDaemon)
@@ -563,6 +591,15 @@ class MonitorLite(Dispatcher):
             for q in self._outqs.values():
                 q.put(None)
         self.messenger.shutdown()
+        # flush the cluster log through the store before it closes: a
+        # clean shutdown must not lose mon-side events journaled since
+        # the last debounced persist (crash windows stay bounded by
+        # the stats-report cadence)
+        try:
+            with self._lock:
+                self._maybe_persist_clog(force=True)
+        except Exception:  # noqa: BLE001 - closing store never blocks stop
+            pass
         self.store.close()
 
     @property
@@ -1097,6 +1134,14 @@ class MonitorLite(Dispatcher):
                 self._post(sub, push)
         elif key == "authdb" and self.key_server is not None:
             self.key_server.load_db(value)
+        elif key == "clusterlog":
+            # adopt the leader's journaled log when it is newer than
+            # ours (restore() refuses to roll the ring backwards) —
+            # a promoted follower then serves the same history
+            try:
+                self.cluster_log.restore(json.loads(value.decode()))
+            except (ValueError, UnicodeDecodeError):
+                pass
 
     # ------------------------------------------------------------ map flow
     INC_RING_KEEP = 128
@@ -1227,6 +1272,8 @@ class MonitorLite(Dispatcher):
             # a rebooted daemon restarts its journal sequence at 1: the
             # dedup cursor must follow or every new event looks old
             self._event_lseq.pop(m.osd_id, None)
+            # ...and its metrics-history sample seq likewise
+            self.metrics_history.reset_daemon(f"osd.{m.osd_id}")
             self._clog("cluster", f"osd.{m.osd_id} boot (host "
                                   f"{m.host})", osd=m.osd_id)
             self._commit_map(f"osd.{m.osd_id} boot")
@@ -1338,7 +1385,8 @@ class MonitorLite(Dispatcher):
     # mutation needs w
     _READONLY_CMDS = frozenset({"status", "osd dump", "osd stats",
                                 "auth list", "dump_cluster_log",
-                                "progress"})
+                                "progress", "dump_metrics_history",
+                                "metrics_query"})
 
     def _mon_cmd_denied(self, m: MMonCommand):
         """(errno, detail) if the command must be refused, else None.
@@ -1420,6 +1468,14 @@ class MonitorLite(Dispatcher):
             except Exception as e:  # noqa: BLE001 - must not kill mon
                 result, data = -22, {"error": repr(e)}
             post = self.store.accepted_version
+            # mon-originated journal entries (pool creates, mark-downs,
+            # health flips from the command path) must not wait for an
+            # OSD stats report to persist — an all-OSDs-down incident
+            # is exactly the narrative the durable log exists for.
+            # AFTER _run_command: any commit it staged has already
+            # claimed its version, so the debounced persist cannot
+            # steal one mid-flight.
+            self._maybe_persist_clog()
             reply = MMonCommandReply(m.tid, result, data)
             if result == 0 and post > self.store.version and post > pre \
                     and self.peers:
@@ -1613,6 +1669,25 @@ class MonitorLite(Dispatcher):
                 max_events=int(cmd.get("max", 0) or 0))
         if prefix == "progress":
             return 0, self.progress.ls()
+        if prefix == "dump_metrics_history":
+            # the merged in-cluster time series (perf_history source)
+            return 0, self.metrics_history.dump(
+                registry=cmd.get("registry"),
+                max_samples=int(cmd.get("max", 0) or 0))
+        if prefix == "metrics_query":
+            # delta/rate (+ pow-2 quantiles) of one counter over an
+            # arbitrary retrospective window — "what was mclock_qwait
+            # doing five minutes ago", answered in-cluster
+            if not cmd.get("registry") or not cmd.get("counter"):
+                return -22, {"error": "need registry + counter"}
+            return 0, self.metrics_history.query(
+                str(cmd["registry"]), str(cmd["counter"]),
+                since_s=float(cmd.get("since_s", 60.0)),
+                until_s=float(cmd.get("until_s", 0.0)),
+                start_ts=(float(cmd["start_ts"])
+                          if cmd.get("start_ts") is not None else None),
+                end_ts=(float(cmd["end_ts"])
+                        if cmd.get("end_ts") is not None else None))
         if prefix.startswith("auth"):
             return self._auth_command(prefix, cmd)
         return -22, {"error": f"unknown command {prefix!r}"}
@@ -1746,6 +1821,36 @@ class MonitorLite(Dispatcher):
                             f"{oldest:.1f}s, daemons "
                             f"{sorted(slow_daemons)}"),
                 "detail": slow_daemons}
+        # BATCH_THRASH: repeated batcher regime churn (adaptive-window
+        # resizes / fused-csum fall-throughs on the `batch` channel)
+        # promoted to a health warning when a daemon exceeds the
+        # config-gated threshold inside the sliding window.  Off by
+        # default (count=0) until real-chip numbers set the bar; the
+        # check self-clears as merge-stamped events age past the
+        # window on later reports.
+        warn_n = self.cfg["mon_batch_thrash_warn_count"]
+        # prune UNCONDITIONALLY: a live count->0 reconfigure must not
+        # strand the fed window in memory
+        cutoff = time.time() - \
+            self.cfg["mon_batch_thrash_warn_window_s"]
+        while self._batch_events and \
+                self._batch_events[0][0] < cutoff:
+            self._batch_events.popleft()
+        if warn_n > 0:
+            per_daemon: dict[str, int] = {}
+            for _ts, daemon in self._batch_events:
+                per_daemon[daemon] = per_daemon.get(daemon, 0) + 1
+            hot = {d: c for d, c in sorted(per_daemon.items())
+                   if c >= warn_n}
+            if hot:
+                checks["BATCH_THRASH"] = {
+                    "severity": "HEALTH_WARN",
+                    "summary": (f"EC batcher thrashing on "
+                                f"{sorted(hot)}: "
+                                f"{sum(hot.values())} regime events "
+                                f"in the last "
+                                f"{self.cfg['mon_batch_thrash_warn_window_s']:g}s"),
+                    "detail": hot}
         return checks
 
     def _clog(self, channel: str, message: str, severity: str = "info",
@@ -1782,9 +1887,15 @@ class MonitorLite(Dispatcher):
         # to the progress tracker; they must not linger in _osd_stats
         # (the `osd stats` / aggregation surfaces are numeric)
         events = stats.pop("events", None) or []
+        # metrics-history increments ride the same at-least-once
+        # window; the store dedupes by per-(daemon, registry) seq
+        metrics = stats.pop("metrics", None)
+        if metrics:
+            self.metrics_history.merge(f"osd.{m.osd_id}", metrics)
         with self._lock:
             self._osd_stats[m.osd_id] = stats
             seen = self._event_lseq.get(m.osd_id, 0)
+            now = time.time()
             for ev in events:
                 if not isinstance(ev, dict):
                     continue
@@ -1798,8 +1909,50 @@ class MonitorLite(Dispatcher):
                 norm = self.cluster_log.append(ev)
                 if norm["channel"] == "recovery":
                     self.progress.on_event(norm)
+                elif norm["channel"] == "batch" and \
+                        self.cfg["mon_batch_thrash_warn_count"] > 0:
+                    # batch-thrash health feed (merge-time stamps keep
+                    # the window monotone under clock skew); only fed
+                    # while the check is enabled — a live enable
+                    # starts counting from that moment
+                    self._batch_events.append((now, norm["daemon"]))
             self._event_lseq[m.osd_id] = seen
             self._note_health()
+            self._maybe_persist_clog()
+
+    def _maybe_persist_clog(self, force: bool = False) -> None:
+        """Journal the in-memory cluster log through the paxos store
+        (LogMonitor parity: dump_cluster_log — and the slow_op events
+        in it — survive a mon restart).  Debounced by
+        mon_clog_persist_interval_s and skipped when nothing new was
+        sequenced.  Caller holds _lock; leader only (followers adopt
+        the replicated snapshot in _apply_replicated).  NEVER called
+        from inside a map/auth commit — a nested commit would steal
+        the version the outer one already claimed."""
+        if not self.is_leader:
+            return
+        now = time.monotonic()
+        if not force and now - self._clog_persisted_at < \
+                self.cfg["mon_clog_persist_interval_s"]:
+            return
+        snap = self.cluster_log.snapshot(
+            max_events=self.cfg["mon_cluster_log_size"])
+        if snap["seq"] == self._clog_persisted_seq and not force:
+            return
+        self._clog_persisted_at = now
+        self._clog_persisted_seq = snap["seq"]
+        raw = json.dumps(snap).encode()
+        desc = f"clusterlog @{snap['seq']}"
+        if not self.peers:
+            self.store.commit("clusterlog", raw, desc)
+            return
+        v = self.store.accepted_version + 1
+        self.store.accept_at(v, self._term, "clusterlog", raw, desc)
+        self._pending_acks[v] = {self.name}
+        prop = MMonPropose(self._term, v, "clusterlog", raw, desc,
+                           pterm=self._term, commit=self.store.version)
+        for p in self.peers:
+            self._post(p, prop)
 
     def _pool_by_name(self, name: str):
         for p in self.osdmap.pools.values():

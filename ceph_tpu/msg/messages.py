@@ -632,7 +632,19 @@ class MNotifyAck:
 @dataclass
 class MStatsReport:
     """Daemon -> monitor: periodic usage/perf summary (the MMgrReport /
-    PGStats flow feeding `ceph status` and exporters)."""
+    PGStats flow feeding `ceph status` and exporters).
+
+    Two telemetry increments piggyback inside ``stats``, both shipped
+    at-least-once (re-sent every report for osd_event_resend_s, the
+    mon dedupes by per-daemon sequence):
+
+    - ``events``: the journal window (utils/event_log) — PG/recovery/
+      scrub/batch narrative plus the flight recorder's ``slow_op``
+      complaints, merged into the mon's paxos-journaled cluster log;
+    - ``metrics``: the metrics-history window
+      (utils/metrics_history) — {registry: [snapshot, ...]} rings the
+      mon merges into the store behind dump_metrics_history /
+      metrics_query."""
 
     osd_id: int
     epoch: int

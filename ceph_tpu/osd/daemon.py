@@ -57,6 +57,7 @@ from ..utils.config import Config, default_config
 from ..utils.event_log import EventLog
 from ..utils.interval import IntervalSet
 from ..utils.log import dout
+from ..utils.metrics_history import MetricsHistory
 from ..utils.perf import CounterType, global_perf
 from ..utils.tracked_op import OpTracker
 from ..utils.tracer import Tracer
@@ -704,11 +705,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self.inject = FaultInjection()
         # slow-op complaint threshold + historic ring are operator
         # knobs (the reference's osd_op_complaint_time /
-        # osd_op_history_size), not hardcoded tracker defaults
+        # osd_op_history_size), not hardcoded tracker defaults.  The
+        # on_slow hook is the flight recorder: an op crossing the
+        # complaint time (at finish or mid-flight via the tick sweep)
+        # journals a slow_op cluster event after its trace — sampled
+        # or retroactively promoted — is already retained.
         self.op_tracker = OpTracker(
             history_size=self.cfg["osd_op_history_size"],
-            slow_op_seconds=self.cfg["osd_op_complaint_time"])
-        self.tracer = Tracer(self.name)
+            slow_op_seconds=self.cfg["osd_op_complaint_time"],
+            on_slow=self._note_slow_op)
         # cluster event journal (LogClient role): PG state transitions,
         # recovery progress, scrub results and batcher regime changes
         # emitted here ride the stats reports to the mon, which merges
@@ -747,6 +752,30 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MNotifyAck: self._handle_notify_ack,
         }
         self.perf = global_perf().create(self.name)
+        # head-sampled distributed tracing: trace_sample_rate draws the
+        # root decision (config-LIVE via the observer — `config set`
+        # over the admin socket retunes a running daemon), and the
+        # trace_sampled/trace_dropped/trace_leaked counters land on
+        # this registry so the exporter and metrics history see them
+        self.tracer = Tracer(self.name,
+                             sample_rate=self.cfg["trace_sample_rate"],
+                             perf=self.perf)
+        self.cfg.observe("trace_sample_rate",
+                         lambda _n, v: self.tracer.set_sample_rate(v))
+        # recovery-storm root spans (per-PG, opened at storm start,
+        # finished at recovery_done) — guarded by _pending_lock
+        self._rec_spans: dict[PgId, object] = {}
+        # metrics history: periodic snapshots of this daemon's perf
+        # registries (its own + its messengers'), sampled on the
+        # heartbeat tick and shipped inside the stats reports for the
+        # mon to merge (utils/metrics_history.py)
+        self.metrics_history = MetricsHistory(
+            keep=self.cfg["metrics_history_keep"])
+        self._metrics_sampled_at = 0.0
+        # admin-socket directory for cross-daemon trace collection
+        # (the PR-7 shared resolver); set by the harness / osd_main
+        # when admin sockets exist
+        self.asok_dir: str | None = None
         self.perf.add_many(["op_w", "op_r", "op_rw_bytes", "subop_w",
                             "subop_r", "recovery_push", "recovery_delta",
                             "rollbacks", "failure_reports",
@@ -881,7 +910,40 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if cmd == "dump_slow_ops":
             return self.op_tracker.slow_ops()
         if cmd == "dump_historic_slow_ops":
-            return self.op_tracker.dump_historic_slow_ops()
+            # the flight-recorder face: each traced entry carries its
+            # full merged trace — local ring + every peer daemon's via
+            # the shared admin-socket resolver — so "what did this
+            # slow op actually do" is answerable after the fact.
+            # `max` tail-caps the entries; peers are queried ONCE for
+            # the whole trace-id set (a slow-op storm must not turn
+            # this verb into entries x peers serial round-trips)
+            entries = [dict(d)
+                       for d in self.op_tracker.dump_historic_slow_ops()]
+            cap = int(kw.get("max", 0) or 0)
+            if cap and len(entries) > cap:
+                entries = entries[-cap:]
+            if kw.get("traces", True):
+                tids = {int(d["trace_id"]) for d in entries
+                        if d.get("trace_id")}
+                index = self._collect_traces(tids) if tids else {}
+                for d in entries:
+                    tid = d.get("trace_id")
+                    if tid:
+                        d["trace"] = index.get(int(tid), [])
+            return entries
+        if cmd == "dump_metrics_history":
+            return self.metrics_history.dump(
+                registry=kw.get("registry"),
+                max_samples=int(kw.get("max", 0) or 0))
+        if cmd == "metrics_query":
+            return self.metrics_history.query(
+                kw.get("registry") or self.name, kw["counter"],
+                since_s=float(kw.get("since_s", 60.0)),
+                until_s=float(kw.get("until_s", 0.0)),
+                start_ts=(float(kw["start_ts"])
+                          if kw.get("start_ts") is not None else None),
+                end_ts=(float(kw["end_ts"])
+                        if kw.get("end_ts") is not None else None))
         if cmd == "dump_kernel_profile":
             from ..utils.perf import kernel_profiler
             return kernel_profiler().dump()
@@ -1202,10 +1264,22 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             # async stages the op spans.  Sub-ops fan out under its ctx.
             span = self.tracer.start(f"osd-op {m.op}", parent=m.trace,
                                      oid=m.oid, pg=str(pgid))
+        else:
+            # head sampling for context-less ops (a client that does
+            # not trace): None at zero cost when the rate is 0; a
+            # propagating root with probability trace_sample_rate; or
+            # an unsampled local span the flight recorder can promote
+            # retroactively if this op turns slow
+            span = self.tracer.sample_root(f"osd-op {m.op}", oid=m.oid,
+                                           pg=str(pgid))
+        # an unsampled span is op-owned (nothing else will close it);
+        # sampled spans close when the client reply leaves (_SpanConn)
+        own_span = span is not None and not span.sampled
+        if span is not None and span.sampled:
             m._span = span
             conn = _SpanConn(conn, span)
         self.perf.inc("op_rw_bytes", len(m.data))
-        with self.op_tracker.create(f"{m.op} {m.oid}") as op:
+        with self.op_tracker.create(f"{m.op} {m.oid}", span=span) as op:
             if pool.kind == "ec":
                 if m.op in ("write", "write_full"):
                     self.perf.inc("op_w")
@@ -1257,6 +1331,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     conn.send(MOSDOpReply(m.tid, EINVAL,
                                           epoch=self.osdmap.epoch))
             op.mark("dispatched")
+        if own_span:
+            # idempotent; a span promoted mid-dispatch (slow-op
+            # retention) closes into the done ring here
+            span.finish()
 
     # -- per-object write serialization ------------------------------------
     def _obj_lock(self, key: tuple, thunk) -> None:
@@ -3418,6 +3496,26 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if self.osdmap is None:
                 continue
             self._sweep_pending(now)
+            # flight recorder: an op that crossed the complaint time
+            # while STILL IN FLIGHT journals its slow_op event (and
+            # retains its trace) now — a wedged op may never finish
+            try:
+                self.op_tracker.note_inflight_slow()
+            except Exception as e:  # noqa: BLE001 - never kill the thread
+                dout("osd", 1)("%s: slow-op sweep failed: %r",
+                               self.name, e)
+            # metrics history: periodic snapshot of this daemon's perf
+            # registries into the fixed-budget ring (shipped with the
+            # stats reports, merged mon-side)
+            m_int = self.cfg["metrics_history_interval_s"]
+            if m_int > 0 and now - self._metrics_sampled_at >= m_int:
+                self._metrics_sampled_at = now
+                try:
+                    self.metrics_history.sample(
+                        self._metrics_registries(), ts=now)
+                except Exception as e:  # noqa: BLE001
+                    dout("osd", 1)("%s: metrics sample failed: %r",
+                                   self.name, e)
             ticks += 1
             # active pg_temp overrides I lead: keep peering rounds
             # turning until the real primary verifies in sync and the
@@ -3484,6 +3582,69 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._sweep_notifies(now, max_age)
         self._sweep_reservations(now)
 
+    # --------------------------------------- flight recorder / telemetry
+    def _note_slow_op(self, op) -> None:
+        """OpTracker on_slow hook (fires once per op, off the tracker
+        lock): journal the SLOW_OPS complaint as a slow_op cluster
+        event.  The op's trace — head-sampled or retroactively
+        promoted from the unsampled ring — is already retained by the
+        tracker, so the event's trace_id resolves via
+        dump_historic_slow_ops / dump_tracing."""
+        dur = round(op.age(), 3)
+        fields = {"desc": op.desc, "dur_s": dur, "done": bool(op.done)}
+        if op.span is not None:
+            fields["trace_id"] = op.span.trace_id
+            fields["trace_sampled"] = bool(op.span.sampled)
+        self.events.emit(
+            "slow_op",
+            f"slow op: {op.desc} blocked {dur:.3f}s (complaint time "
+            f"{self.cfg['osd_op_complaint_time']}s)",
+            severity="warn", **fields)
+
+    def _collect_traces(self, trace_ids: set) -> dict:
+        """Merged spans per trace id: this daemon's rings plus ONE
+        full-ring fetch per peer admin socket in asok_dir (the PR-7
+        shared resolver's directory) filtered against the whole id
+        set — the round-trip count is O(peers), independent of how
+        many slow ops are being resolved.  Deduped by span_id,
+        start-ordered per trace."""
+        by_tid: dict = {int(t): {} for t in trace_ids}
+
+        def take(spans) -> None:
+            for s in spans:
+                if not isinstance(s, dict):
+                    continue
+                m = by_tid.get(s.get("trace_id"))
+                if m is not None:
+                    m.setdefault(s.get("span_id"), s)
+
+        for tid in by_tid:
+            take(self.tracer.spans_for(tid))
+        if self.asok_dir and by_tid:
+            import glob as _glob
+            import os
+
+            from ..utils.admin_socket import admin_request
+            for path in sorted(_glob.glob(
+                    os.path.join(self.asok_dir, "*.asok"))):
+                if os.path.basename(path) == f"{self.name}.asok":
+                    continue  # our rings were read directly above
+                try:
+                    spans = admin_request(path, "dump_tracing")
+                except (OSError, RuntimeError):
+                    continue  # mon sockets / dead daemons: keep going
+                if isinstance(spans, list):
+                    take(spans)
+        return {tid: sorted(m.values(), key=lambda s: s["start"])
+                for tid, m in by_tid.items()}
+
+    def _metrics_registries(self) -> dict:
+        """The registries this daemon's metrics history snapshots: its
+        own perf counters (op/EC-batch/QoS/trace schema) and its data
+        messenger's (dispatch latency, drops)."""
+        return {self.name: self.perf,
+                self.messenger.perf.name: self.messenger.perf}
+
     def _report_stats(self, budget: float = 0.5) -> None:
         """Usage/perf summary to the monitor (MMgrReport/PGStats role).
         The store walk is time-budgeted; a partial walk reports what it
@@ -3541,7 +3702,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           # journal entries ride along (the LogClient
                           # piggyback); the mon merges + dedupes them
                           # into the cluster log
-                          "events": events}))
+                          "events": events,
+                          # metrics-history increments ride the same
+                          # at-least-once window (seq-deduped mon-side)
+                          "metrics": self.metrics_history.pending(
+                              self.cfg["osd_event_resend_s"])}))
         self.events.prune(self.cfg["osd_event_resend_s"])
 
     def _handle_ping(self, conn, m: MOSDPing) -> None:
@@ -3599,6 +3764,19 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     "total": 0, "done": 0, "total_b": 0, "done_b": 0,
                     "emitted": 0.0, "start_ts": time.time()}
                 storm_opened = True
+                # recovery storms are ROOT ops for the head sampler:
+                # one draw per storm, finished at recovery_done.  The
+                # draw + store happen INSIDE the lock that opened the
+                # storm — storing after release races a storm that
+                # drains to zero on another thread first, orphaning
+                # the span (a sampled orphan would sit in the live
+                # table until evicted with a FALSE leaked tag).  The
+                # tracer lock is a leaf; holding _pending_lock over
+                # it cannot deadlock.
+                rspan = self.tracer.sample_root(
+                    "recovery-storm", pg=self._pgstr(pgid))
+                if rspan is not None:
+                    self._rec_spans[pgid] = rspan
             rp["total"] += 1
             rp["total_b"] += nbytes
             self._local_waiting.setdefault(pgid, []).append(
@@ -3715,6 +3893,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         release_local = False
         targets: list[tuple] = []
         ev = None
+        rspan = None
         now = time.time()
         with self._pending_lock:
             n = self._recovery_pg_ops.get(pgid, 1) - 1
@@ -3723,6 +3902,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 rp["done"] += 1
                 rp["done_b"] += max(1, int(nbytes))
             if n <= 0:
+                rspan = self._rec_spans.pop(pgid, None)
                 self._recovery_pg_ops.pop(pgid, None)
                 release_local = True
                 targets = [k for k in self._remote_held if k[0] == pgid]
@@ -3752,6 +3932,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 remaining=rp["total_b"] - rp["done_b"],
                 done_ops=rp["done"], total_ops=rp["total"],
                 start_ts=rp["start_ts"])
+        if rspan is not None:
+            if ev is not None and ev[0] == "recovery_done":
+                rspan.tag("done_ops", ev[1]["done"])
+                rspan.tag("done_bytes", ev[1]["done_b"])
+            rspan.finish()
         if release_local:
             self._local_reserver.release(pgid)
             for pg, target in targets:

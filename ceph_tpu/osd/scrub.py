@@ -42,6 +42,7 @@ class _PendingScrub:
     repair: bool
     waiting_for: set = field(default_factory=set)
     maps: dict = field(default_factory=dict)  # osd -> scrub map
+    span: object = None  # head-sampled root span (finished at compare)
 
 
 class ScrubMixin:
@@ -80,6 +81,10 @@ class ScrubMixin:
         members = {u for u in up if u is not None}
         ps = _PendingScrub(m.client, m.tid, m.pgid, m.deep, m.repair,
                            waiting_for=set(members))
+        # scrubs are ROOT ops for the head sampler (trace_sample_rate):
+        # the span covers request -> shard maps -> compare/repair
+        ps.span = self.tracer.sample_root(
+            "scrub", pg=self._pgstr(m.pgid), deep=m.deep)
         self._pending_scrubs[tid] = ps
         for osd in members:
             if osd == self.osd_id:
@@ -130,6 +135,9 @@ class ScrubMixin:
         if ps.repair and issues:
             repaired = self._scrub_repair(ps, issues)
         self.perf.inc("scrubs")
+        if ps.span is not None:
+            ps.span.tag("errors", len(issues)).tag("repaired", repaired)
+            ps.span.finish()
         self.events.emit(
             "scrub",
             f"pg {self._pgstr(ps.pgid)} "

@@ -131,6 +131,15 @@ def configs() -> list[dict]:
                             "ec_kernel_candidates_gbps",
                             "ec_kernel_race_winner",
                             "digest_verified"]})
+    # 8c. always-on tracing overhead (ISSUE 9): sampled head rates
+    # 0 / 0.01 / 1.0 over the batched burst — the trajectory row that
+    # keeps the "zero cost when off, <=5% at 1%" claim honest across
+    # rounds (gated inside bench.py's exit code, recorded here)
+    out.append({"id": "trace_overhead", "tool": "bench_root",
+                "argv": ["--ec-batch"],
+                "extract": ["trace_overhead_gbps",
+                            "trace_overhead_pct_at_001",
+                            "trace_overhead_ok", "digest_verified"]})
     # 9. the many-client saturation harness (ISSUE 7): multi-process
     # load through librados over TCP, mclock reservation sweep, gated
     # on structural invariants — the compact SLO row ("millions of

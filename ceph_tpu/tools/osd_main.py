@@ -65,7 +65,13 @@ def main(argv=None) -> int:
 
     admin = None
     if args.admin_socket:
+        import os as _os
+
         from ..utils.admin_socket import AdminSocketServer
+        # peers' sockets share this directory (the asok convention):
+        # the flight recorder merges cross-daemon traces through it
+        osd.asok_dir = _os.path.dirname(_os.path.abspath(
+            args.admin_socket)) or None
         admin = AdminSocketServer(
             args.admin_socket,
             lambda prefix, **kw: osd.admin_command(prefix, **kw))

@@ -33,11 +33,23 @@ HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
               "mclock_qwait_us_scrub")
 QUANTILES = (0.50, 0.99)
 
+#: per-daemon tracer head-sampling counters (trace_sample_rate draws):
+#: standing rate series make the sampled:dropped ratio — and any
+#: sampler misconfiguration — visible on a dashboard without ad-hoc
+#: PromQL
+COUNTERS = ("trace_sampled", "trace_dropped")
+
+#: the metrics-history liveness gauge the exporter emits per daemon
+#: (seconds since the mon merged that daemon's newest snapshot); the
+#: max across daemons is the single alertable number
+STALENESS_GAUGE = "metrics_history_staleness_s"
+
 
 def recording_rules(histograms=HISTOGRAMS, quantiles=QUANTILES,
-                    window: str = "5m") -> list[dict]:
-    """One rule per (histogram, quantile): aggregate the cumulative
-    le-buckets across daemons and take the quantile of the rate."""
+                    counters=COUNTERS, window: str = "5m") -> list[dict]:
+    """One rule per (histogram, quantile) over the cumulative
+    le-buckets, one rate rule per tracer counter, plus the
+    metrics-history staleness max."""
     rules = []
     for h in histograms:
         metric = f"{PREFIX}_daemon_{h}_bucket"
@@ -48,6 +60,16 @@ def recording_rules(histograms=HISTOGRAMS, quantiles=QUANTILES,
                          f"sum by (daemon, le) "
                          f"(rate({metric}[{window}])))"),
             })
+    for c in counters:
+        rules.append({
+            "record": f"{PREFIX}:daemon_{c}:rate{window}",
+            "expr": (f"sum by (daemon) "
+                     f"(rate({PREFIX}_daemon_{c}[{window}]))"),
+        })
+    rules.append({
+        "record": f"{PREFIX}:{STALENESS_GAUGE}:max",
+        "expr": f"max({PREFIX}_{STALENESS_GAUGE})",
+    })
     return rules
 
 

@@ -152,12 +152,18 @@ def format_stage_table(stats: dict[str, dict]) -> str:
     return "\n".join(lines)
 
 
-def collect_from_asok(asok_dir: str, trace_id: int) -> list[dict]:
+def collect_from_asok(asok_dir: str, trace_id: int,
+                      skip: tuple = ()) -> list[dict]:
     """Query every daemon admin socket in the directory for its local
-    spans of one trace and merge (the operator-facing collector)."""
+    spans of one trace and merge (the operator-facing collector).
+    ``skip`` names socket basenames to leave out — a daemon collecting
+    a trace for its own flight recorder already has its local ring and
+    must not round-trip to itself."""
     from ..utils.admin_socket import admin_request
     dumps = []
     for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
+        if os.path.basename(path) in skip:
+            continue
         try:
             spans = admin_request(path, "dump_tracing",
                                   trace_id=trace_id)
@@ -171,16 +177,59 @@ def collect_from_asok(asok_dir: str, trace_id: int) -> list[dict]:
     return merge_spans(dumps)
 
 
+def slow_op_report(asok: str, max_ops: int = 0) -> list[dict]:
+    """The flight-recorder read side: fetch one OSD's
+    ``dump_historic_slow_ops`` (traces attached by the daemon via the
+    shared resolver) and return render-ready records — the historic
+    entry plus its span list."""
+    from ..utils.admin_socket import admin_request
+    entries = admin_request(asok, "dump_historic_slow_ops")
+    if not isinstance(entries, list):
+        return []
+    out = [e for e in entries if isinstance(e, dict)]
+    return out[-max_ops:] if max_ops else out
+
+
+def format_slow_ops(entries: list[dict], width: int = 40) -> str:
+    """Waterfall per historic slow op (the dump_historic_slow_ops ->
+    trace_tool workflow): op description + duration, then the merged
+    trace rendered like any other."""
+    if not entries:
+        return "(no historic slow ops)"
+    blocks = []
+    for e in entries:
+        head = (f"slow op: {e.get('description', '?')} "
+                f"({e.get('age_seconds', 0):.3f}s)")
+        spans = e.get("trace") or []
+        blocks.append(head + "\n" + (waterfall(spans, width=width)
+                                     if spans else "(no trace retained)"))
+    return "\n\n".join(blocks)
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description="merge per-daemon span rings for a trace id and "
-                    "print a waterfall + per-stage decomposition")
-    p.add_argument("--asok-dir", required=True,
+                    "print a waterfall + per-stage decomposition; or "
+                    "--slow-ops to replay an OSD's slow-op flight "
+                    "recorder")
+    p.add_argument("--asok-dir",
                    help="directory of daemon *.asok admin sockets")
-    p.add_argument("--trace-id", type=int, required=True)
+    p.add_argument("--trace-id", type=int)
+    p.add_argument("--slow-ops", metavar="ASOK",
+                   help="an OSD admin socket: print every historic "
+                        "slow op with its retained trace waterfall")
     p.add_argument("--json", action="store_true",
                    help="emit the merged spans + stage stats as JSON")
     args = p.parse_args(argv)
+    if args.slow_ops:
+        entries = slow_op_report(args.slow_ops)
+        if args.json:
+            print(json.dumps(entries, default=str))
+        else:
+            print(format_slow_ops(entries))
+        return 0 if entries else 1
+    if not args.asok_dir or args.trace_id is None:
+        p.error("--asok-dir and --trace-id required (or --slow-ops)")
     spans = collect_from_asok(args.asok_dir, args.trace_id)
     if not spans:
         print(f"no spans for trace {args.trace_id}", file=sys.stderr)

@@ -198,6 +198,9 @@ class MiniCluster:
         self.osds[osd_id] = osd
         osd.start()
         if self._admin_dir:
+            # flight recorder: the daemon resolves peer sockets through
+            # the shared asok convention to merge cross-daemon traces
+            osd.asok_dir = self._admin_dir
             self._add_admin_socket(
                 osd.name,
                 lambda prefix, _o=osd, **kw: _o.admin_command(prefix,
@@ -268,6 +271,10 @@ class MiniCluster:
         c = RadosClient(self.network, f"client.{idx}",
                         mons=self.mon_names, auth_entity=entity,
                         auth_key=key).connect()
+        # always-on head sampling: clients inherit the cluster's
+        # trace_sample_rate (the root-op draw that covers the whole
+        # client -> primary -> shard fan-out)
+        c.tracer.set_sample_rate(self.cfg["trace_sample_rate"])
         self.clients.append(c)
         return c
 

@@ -380,6 +380,56 @@ OPTIONS: list[Option] = [
            "seconds a completed progress item stays visible (in "
            "progress ls / the progress_percent gauge) before it is "
            "dropped", min=0.0, max=3600.0),
+    # always-on telemetry: head-sampled tracing + metrics history
+    Option("trace_sample_rate", float, 0.0, OptionLevel.ADVANCED,
+           "probability a ROOT op (client write/read, recovery storm, "
+           "scrub) starts a distributed trace; the head decision "
+           "propagates in the (trace_id, span_id) wire context so one "
+           "draw covers the whole client -> primary -> shard fan-out. "
+           "0 = off (zero per-op tracer cost); config-live via the "
+           "admin socket (`config set`).  Unsampled roots keep a "
+           "lightweight local span in a small ring so a SLOW_OPS "
+           "complaint can force-retain its evidence retroactively",
+           min=0.0, max=1.0,
+           see_also=("osd_op_complaint_time",)),
+    Option("metrics_history_interval_s", float, 1.0,
+           OptionLevel.ADVANCED,
+           "seconds between metrics-history snapshots of a daemon's "
+           "perf registries (sampled on the heartbeat tick; 0 "
+           "disables sampling)", min=0.0, max=3600.0,
+           see_also=("metrics_history_keep",)),
+    Option("metrics_history_keep", int, 600, OptionLevel.ADVANCED,
+           "snapshots retained per registry in a daemon's local "
+           "metrics-history ring (the fixed budget: keep x interval "
+           "= the retrospective window)", min=2, max=1 << 20,
+           see_also=("metrics_history_interval_s",
+                     "mon_metrics_history_keep")),
+    Option("mon_metrics_history_keep", int, 1200, OptionLevel.ADVANCED,
+           "snapshots retained per registry in the monitor's merged "
+           "metrics-history store (dump_metrics_history / "
+           "metrics_query window)", min=2, max=1 << 20,
+           see_also=("metrics_history_keep",)),
+    Option("mon_clog_persist_interval_s", float, 2.0,
+           OptionLevel.ADVANCED,
+           "min seconds between journaling the monitor's in-memory "
+           "cluster log through the paxos store (LogMonitor parity: "
+           "dump_cluster_log survives a mon restart); 0 persists on "
+           "every stats merge", min=0.0, max=3600.0,
+           see_also=("mon_cluster_log_size",)),
+    # batcher-thrash health promotion (off by default until real-chip
+    # numbers set the thresholds — the CPU CI box resizes legitimately)
+    Option("mon_batch_thrash_warn_count", int, 0, OptionLevel.ADVANCED,
+           "raise HEALTH_WARN BATCH_THRASH when one daemon journals "
+           "at least this many `batch` channel events (adaptive-window "
+           "resizes / fused-csum fall-throughs) within "
+           "mon_batch_thrash_warn_window_s; 0 = off", min=0,
+           see_also=("mon_batch_thrash_warn_window_s", "ec_batch_adaptive")),
+    Option("mon_batch_thrash_warn_window_s", float, 60.0,
+           OptionLevel.ADVANCED,
+           "sliding window (seconds) the batch-thrash health check "
+           "counts events over; the warning clears once the window "
+           "drains below the threshold", min=0.1, max=3600.0,
+           see_also=("mon_batch_thrash_warn_count",)),
     Option("mgr_autoscaler_objects_per_pg", int, 100, OptionLevel.BASIC,
            "pg_autoscaler: grow a pool's pg_num once its logical "
            "objects-per-PG estimate exceeds this target", min=1),

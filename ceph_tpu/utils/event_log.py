@@ -28,6 +28,9 @@ Channels (the `ceph -W <channel>` filter axis):
 - ``scrub``    scrub completions (errors counted)
 - ``batch``    EC batcher: adaptive-window resizes, shard fall-through
 - ``health``   health-check transitions (raised / cleared)
+- ``slow_op``  flight recorder: an op crossed osd_op_complaint_time
+  (fields carry the op description, duration and — when traced — the
+  trace_id whose merged spans dump_historic_slow_ops attaches)
 
 Journals are bounded on BOTH sides: a daemon that cannot reach the mon
 drops its oldest pending events (counted, never blocking the heartbeat
@@ -49,7 +52,7 @@ WARN = "warn"
 ERROR = "error"
 
 CHANNELS = ("cluster", "osdmap", "pg", "recovery", "scrub", "batch",
-            "health")
+            "health", "slow_op")
 
 
 def make_event(daemon: str, channel: str, message: str,
@@ -161,6 +164,36 @@ class ClusterLog:
             ev["seq"] = self._seq
             self._ring.append(ev)
         return ev
+
+    def snapshot(self, max_events: int = 0) -> dict:
+        """JSON-plain state for paxos-store journaling (LogMonitor
+        parity): the newest ``max_events`` ring entries (0 = all) plus
+        the sequence cursor, restorable after a mon restart."""
+        with self._lock:
+            evs = list(self._ring)
+            seq = self._seq
+        if max_events and len(evs) > int(max_events):
+            evs = evs[-int(max_events):]
+        return {"seq": seq, "events": evs}
+
+    def restore(self, snap: dict) -> bool:
+        """Adopt a journaled snapshot — only when it is NEWER than the
+        in-memory log (a follower with freshly merged entries must not
+        roll its ring back under a stale replication).  Returns True
+        when adopted."""
+        try:
+            seq = int(snap.get("seq", 0))
+            evs = [e for e in snap.get("events", ())
+                   if isinstance(e, dict)]
+        except (TypeError, ValueError, AttributeError):
+            return False
+        with self._lock:
+            if seq <= self._seq:
+                return False
+            self._ring.clear()
+            self._ring.extend(evs)
+            self._seq = seq
+        return True
 
     def dump(self, channel: str | None = None, since: int = 0,
              max_events: int = 0) -> dict:

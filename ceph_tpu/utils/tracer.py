@@ -15,11 +15,27 @@ shape jaeger assembles from per-service reports.
 
 Tracing is off unless the op carries a context (zero overhead on the
 hot path: one falsy check per handler).
+
+Head sampling (the always-on mode): a root op calls ``sample_root``
+instead of ``start`` — with ``sample_rate`` <= 0 it returns None at
+zero cost (no RNG draw, no allocation); otherwise the op is SAMPLED
+with that probability.  A sampled root is a normal span whose context
+propagates on the wire, so the one head decision covers the whole
+client -> primary -> shard fan-out (the OpenTelemetry parent-based
+sampler shape: a child traces iff the message carries a context).  An
+UNSAMPLED root still gets a lightweight local-only span (``sampled``
+False, context never propagated) held in a small bounded side ring —
+the flight-recorder feed: when the op later crosses the slow-op
+complaint threshold, ``promote()`` force-retains it retroactively into
+the ordinary rings, so SLOW_OPS evidence survives even at low sample
+rates.  ``trace_sampled`` / ``trace_dropped`` / ``trace_leaked``
+counters land on the owning daemon's perf registry when one is given.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 import threading
 import time
 from collections import deque
@@ -37,6 +53,10 @@ class Span:
     end: float = 0.0
     tags: dict = field(default_factory=dict)
     _tracer: "Tracer | None" = None
+    # head-sampling verdict: False = local-only flight-recorder span
+    # (context must NOT propagate; lives in the unsampled side ring
+    # until promoted or aged out)
+    sampled: bool = True
 
     @property
     def ctx(self) -> tuple[int, int]:
@@ -88,16 +108,37 @@ class Tracer:
     """Per-entity span factory + bounded finished-span ring."""
 
     KEEP = 2048  # finished spans retained (ring; ops tooling window)
+    UNSAMPLED_KEEP = 128  # recent unsampled roots (flight-recorder feed)
 
-    def __init__(self, service: str):
+    #: per-service sampling counters, registered on the daemon's perf
+    #: registry when one is supplied (idempotent: has-before-add)
+    PERF_COUNTERS = ("trace_sampled", "trace_dropped", "trace_leaked")
+
+    def __init__(self, service: str, sample_rate: float = 0.0,
+                 perf=None, rng: random.Random | None = None):
         self.service = service
+        self.sample_rate = max(0.0, min(1.0, float(sample_rate)))
         self._ids = itertools.count(1)
         self._seed = (hash(service) & 0xFFFF) << 32
         self._lock = threading.Lock()
+        self._rng = rng if rng is not None else random.Random()
         self._done: deque[Span] = deque(maxlen=self.KEEP)
         # started-but-unfinished spans, so dumps can show hung ops;
         # bounded like the ring (a leaked span must not grow it forever)
         self._live: dict[int, Span] = {}
+        # recent UNSAMPLED root spans: the retroactive-retention window
+        # the slow-op flight recorder promotes from (bounded — aged-out
+        # spans are simply gone, exactly like the dropped traces)
+        self._unsampled: deque[Span] = deque(maxlen=self.UNSAMPLED_KEEP)
+        self._perf = perf
+        if perf is not None:
+            for name in self.PERF_COUNTERS:
+                if not perf.has(name):
+                    perf.add(name)
+
+    def set_sample_rate(self, rate) -> None:
+        """Config-live knob (the trace_sample_rate observer target)."""
+        self.sample_rate = max(0.0, min(1.0, float(rate)))
 
     def _next_id(self) -> int:
         return self._seed | next(self._ids)
@@ -116,17 +157,76 @@ class Tracer:
         with self._lock:
             self._live[span.span_id] = span
             while len(self._live) > self.KEEP:
-                self._live.pop(next(iter(self._live)))
+                # overflow = leaked spans (owners that never finish):
+                # close them into the done ring tagged leaked=True —
+                # silently discarding them destroyed exactly the
+                # hung-op evidence the live table exists to keep
+                leaked = self._live.pop(next(iter(self._live)))
+                leaked.end = time.time()
+                leaked.tags["leaked"] = True
+                self._done.append(leaked)
+                if self._perf is not None:
+                    self._perf.inc("trace_leaked")
         return span
+
+    def sample_root(self, name: str, **tags) -> Span | None:
+        """Head-sampling entry point for ROOT ops (client writes/reads,
+        recovery storms, scrub).  Returns None at zero cost when
+        sampling is off; a normal propagating span (``sampled`` True,
+        counted trace_sampled) with probability ``sample_rate``; and
+        otherwise a local-only unsampled span (counted trace_dropped)
+        held in the bounded side ring for retroactive slow-op
+        retention.  Callers propagate ``span.ctx`` on the wire ONLY
+        when ``span.sampled`` — that is the one head decision covering
+        the whole fan-out."""
+        rate = self.sample_rate
+        if rate <= 0.0:
+            return None
+        if rate >= 1.0 or self._rng.random() < rate:
+            if self._perf is not None:
+                self._perf.inc("trace_sampled")
+            return self.start(name, **tags)
+        if self._perf is not None:
+            self._perf.inc("trace_dropped")
+        span = Span(self._next_id(), self._next_id(), 0, name,
+                    self.service, tags=dict(tags), _tracer=self,
+                    sampled=False)
+        with self._lock:
+            self._unsampled.append(span)
+        return span
+
+    def promote(self, span: Span) -> None:
+        """Force-retain an unsampled root span (the tail-based flight
+        recorder: the op it roots crossed the slow-op threshold, so
+        its evidence must survive the side ring's churn).  Idempotent;
+        a span that already aged out of the side ring is re-adopted
+        all the same."""
+        with self._lock:
+            if span.sampled:
+                return
+            span.sampled = True
+            span.tags["retained"] = True
+            try:
+                self._unsampled.remove(span)
+            except ValueError:
+                pass  # aged out of the side ring; adopt anyway
+            if span.end:
+                self._done.append(span)
+            else:
+                self._live[span.span_id] = span
 
     def _finish(self, span: Span) -> None:
         """Atomic close: end-stamp check-and-set + ring append under
         ONE lock hold, so racing finishers record the span exactly
-        once (Span.finish docstring has the failure mode)."""
+        once (Span.finish docstring has the failure mode).  An
+        unsampled span just gets end-stamped — it already sits in the
+        bounded side ring (or was promoted, flipping sampled)."""
         with self._lock:
             if span.end:
                 return
             span.end = time.time()
+            if not span.sampled:
+                return
             self._live.pop(span.span_id, None)
             self._done.append(span)
 

@@ -466,3 +466,247 @@ def test_prometheus_exporter_serves_metrics(obs_cluster):
     conn.request("GET", "/nope")
     assert conn.getresponse().status == 404
     conn.close()
+
+
+def test_slow_op_flight_recorder_and_metrics_history(tmp_path):
+    """ISSUE 9 acceptance, end to end on a live cluster with
+    trace_sample_rate=1.0: an injected dispatch stall produces a
+    historic slow-op entry whose ATTACHED cross-daemon trace spans at
+    least two services, journals a slow_op cluster event, and the
+    metrics history answers rate queries over two disjoint snapshot
+    windows that agree exactly with raw counter deltas."""
+    cfg = make_cfg(trace_sample_rate=1.0, osd_op_complaint_time=0.08,
+                   metrics_history_interval_s=0.1)
+    c = MiniCluster(n_osds=4, cfg=cfg,
+                    admin_dir=str(tmp_path / "asok")).start()
+    try:
+        client = c.client()
+        client.create_pool("p", kind="ec", pg_num=1,
+                           ec_profile={"plugin": "jerasure", "k": "2",
+                                       "m": "1", "backend": "numpy"})
+        client.write_full("p", "obj", b"a" * 4096)
+        pool_id = next(pid for pid, p in c.mon.osdmap.pools.items()
+                       if p.name == "p")
+        seed = c.mon.osdmap.object_to_pg(pool_id, "obj")
+        primary = next(o for o in
+                       c.mon.osdmap.pg_to_up_osds(pool_id, seed)
+                       if o is not None)
+        posd = c.osds[primary]
+
+        # --- flight recorder: stall the primary's EC write dispatch
+        orig = posd._ec_write
+
+        def stalled(*a, **kw):
+            time.sleep(0.2)
+            return orig(*a, **kw)
+
+        posd._ec_write = stalled
+        try:
+            client.write_full("p", "obj", b"b" * 8192)
+        finally:
+            posd._ec_write = orig
+        asok = str(tmp_path / "asok" / f"osd.{primary}.asok")
+        hist = admin_request(asok, "dump_historic_slow_ops")
+        entries = [d for d in hist if "obj" in d["description"]]
+        assert entries, f"no historic slow op recorded: {hist}"
+        entry = entries[-1]
+        assert entry.get("trace_id"), "slow op lost its trace id"
+        trace = entry.get("trace") or []
+        services = {s["service"] for s in trace}
+        assert len(services) >= 2, \
+            f"slow-op trace does not cross daemons: {services}"
+        # the op's own span names are in the merged evidence
+        assert any(s["name"].startswith("osd-op") for s in trace)
+        # ...and the complaint is journaled as a slow_op cluster event
+        mon_asok = str(tmp_path / "asok" / "mon.0.asok")
+        deadline = time.time() + 10
+        evs = []
+        while time.time() < deadline:
+            res, data = admin_request(mon_asok, "dump_cluster_log",
+                                      channel="slow_op")
+            assert res == 0, data
+            evs = data["events"]
+            if evs:
+                break
+            time.sleep(0.05)
+        assert evs, "slow_op event never reached the cluster log"
+        assert any(e["fields"].get("trace_id") == entry["trace_id"]
+                   for e in evs)
+
+        # --- metrics history: two disjoint windows vs raw deltas ----
+        # Boundaries are driven by MERGE COVERAGE, not fixed sleeps:
+        # the loaded CI box can starve the heartbeat sampler / stats
+        # shipping for long stretches, so each phase ends only once
+        # the mon's newest merged snapshot reflects the raw counters
+        # taken at that boundary (samples merge seq-ordered, so a
+        # newer sample covering the counter implies every earlier one
+        # is in too).
+        reg = f"osd.{primary}"
+
+        def newest(counter):
+            res, data = admin_request(mon_asok, "dump_metrics_history",
+                                      registry=reg, max=1)
+            assert res == 0, data
+            rows = data["registries"].get(reg) or []
+            return rows[-1]["counters"].get(counter) if rows else None
+
+        def wait_merged(counter, want, timeout=20):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                got = newest(counter)
+                if isinstance(got, dict):
+                    got = got.get("count")
+                if got == want:
+                    return
+                time.sleep(0.05)
+            raise AssertionError(
+                f"mon history never caught up: {counter} stuck at "
+                f"{newest(counter)!r}, want {want!r}")
+
+        def newest_ts():
+            res, data = admin_request(mon_asok, "dump_metrics_history",
+                                      registry=reg, max=1)
+            assert res == 0, data
+            rows = data["registries"].get(reg) or []
+            return float(rows[-1]["ts"]) if rows else 0.0
+
+        w0 = posd.perf.get("op_w")
+        q0 = posd.perf.dump()["mclock_qwait_us_client"]["count"]
+        wait_merged("op_w", w0)
+        t0 = time.time()
+        # window 1 (quiet) closes only once a sample taken INSIDE it
+        # has merged — the window query needs an in-window row
+        deadline = time.time() + 20
+        while newest_ts() <= t0 + 0.2:
+            assert time.time() < deadline, "sampler stalled mid-quiet"
+            time.sleep(0.05)
+        t1 = time.time()
+        w1 = posd.perf.get("op_w")
+        q1 = posd.perf.dump()["mclock_qwait_us_client"]["count"]
+        eb1 = posd.perf.get("ec_batch_coalesced_ops")
+        for i in range(6):                    # window 2: traffic
+            client.write_full("p", f"w{i}", b"c" * 2048)
+        posd.perf.inc("ec_batch_coalesced_ops", 9)  # ec_batch_* probe
+        w2 = posd.perf.get("op_w")
+        q2 = posd.perf.dump()["mclock_qwait_us_client"]["count"]
+        # wait until snapshots covering ALL the burst's counters merge
+        wait_merged("op_w", w2)
+        wait_merged("ec_batch_coalesced_ops", eb1 + 9)
+        wait_merged("mclock_qwait_us_client", q2)
+        t2 = time.time()
+        now = time.time()
+
+        def mon_query(counter, lo, hi):
+            # ABSOLUTE window edges: relative since/until re-anchor to
+            # the server clock at execution, and serial admin round
+            # trips on a loaded box drift the edges across the burst
+            # boundary (observed flake)
+            res, data = admin_request(mon_asok, "metrics_query",
+                                      registry=reg, counter=counter,
+                                      start_ts=lo, end_ts=hi)
+            assert res == 0, data
+            return data
+
+        quiet = mon_query("op_w", t0, t1)
+        busy = mon_query("op_w", t1, t2)
+        assert quiet["samples"] >= 2 and busy["samples"] >= 2
+        assert quiet["delta"] == w1 - w0 == 0
+        assert busy["delta"] == w2 - w1 == 6
+        # span_s is rounded for the wire; the rate agrees to within
+        # that rounding
+        assert abs(busy["rate_per_s"]
+                   - busy["delta"] / busy["span_s"]) < 1e-3
+        # ec_batch_* rides the same surface
+        eb = mon_query("ec_batch_coalesced_ops", t1, t2)
+        assert eb["delta"] == 9
+        # mclock_qwait histogram: count delta matches the raw registry
+        # and the window quantiles are well-formed
+        qq = mon_query("mclock_qwait_us_client", t1, t2)
+        assert qq["count_delta"] == q2 - q1 > 0
+        assert 0.0 <= qq["p50"] <= qq["p99"]
+        qquiet = mon_query("mclock_qwait_us_client", t0, t1)
+        assert qquiet["count_delta"] == q1 - q0 == 0
+        # the local daemon verb serves the same ring
+        local = admin_request(asok, "metrics_query", registry=reg,
+                              counter="op_w", start_ts=t1, end_ts=t2)
+        assert local["delta"] == 6
+        # perf_history CLI helpers read the same surfaces
+        from ceph_tpu.tools.perf_history import ls, show
+        regs = ls(mon_asok)
+        assert reg in regs and "op_w" in regs[reg]
+        text = show(mon_asok, reg, "op_w", since_s=now - t0)
+        assert "rate" in text
+    finally:
+        c.stop()
+
+
+def test_sampling_off_zero_tracer_cost(tmp_path):
+    """The zero-cost-when-off half of the acceptance: with
+    trace_sample_rate at its 0 default, a burst of real client IO
+    allocates NOTHING in any tracer — no spans, no unsampled ring
+    entries, no counter movement."""
+    c = MiniCluster(n_osds=3, cfg=make_cfg(),
+                    admin_dir=str(tmp_path / "asok")).start()
+    try:
+        client = c.client()
+        client.create_pool("p", size=2, pg_num=1)
+        for i in range(8):
+            client.write_full("p", f"o{i}", b"q" * 1024)
+            client.read("p", f"o{i}")
+        assert client.tracer.dump() == []
+        assert len(client.tracer._unsampled) == 0
+        for osd in c.osds.values():
+            assert osd.tracer.dump() == []
+            assert len(osd.tracer._unsampled) == 0
+            assert osd.perf.get("trace_sampled") == 0
+            assert osd.perf.get("trace_dropped") == 0
+    finally:
+        c.stop()
+
+
+def test_batch_thrash_health_warn_appears_and_clears(tmp_path):
+    """The config-gated BATCH_THRASH promotion: repeated batch-channel
+    events (adaptive-window resizes / fused-csum fall-throughs) from
+    one daemon cross the threshold -> HEALTH_WARN with per-daemon
+    detail; the window draining clears it without intervention."""
+    cfg = make_cfg(mon_batch_thrash_warn_count=3,
+                   mon_batch_thrash_warn_window_s=1.5)
+    c = MiniCluster(n_osds=2, cfg=cfg,
+                    admin_dir=str(tmp_path / "asok")).start()
+    try:
+        client = c.client()
+        assert client.status()["health"] == "HEALTH_OK"
+        # journal a resize storm on osd.0 (the batcher's emission
+        # shape); it rides the next stats reports to the mon
+        for i in range(4):
+            c.osds[0].events.emit(
+                "batch", f"ec batch window resized to {100 + i}us",
+                window_us=100.0 + i, prev_us=50.0, ops_ewma=1.0)
+        deadline = time.time() + 10
+        st = client.status()
+        while time.time() < deadline:
+            st = client.status()
+            if "BATCH_THRASH" in st.get("checks", {}):
+                break
+            time.sleep(0.05)
+        check = st.get("checks", {}).get("BATCH_THRASH")
+        assert check, f"BATCH_THRASH never raised: {st}"
+        assert check["detail"] == {"osd.0": 4}
+        assert "osd.0" in check["summary"]
+        # ...and the transition is narrated on the health channel
+        res, data = admin_request(
+            str(tmp_path / "asok" / "mon.0.asok"),
+            "dump_cluster_log", channel="health")
+        assert res == 0
+        assert any(e["fields"].get("check") == "BATCH_THRASH"
+                   for e in data["events"])
+        # the sliding window drains -> the warning clears on its own
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            st = client.status()
+            if "BATCH_THRASH" not in st.get("checks", {}):
+                break
+            time.sleep(0.1)
+        assert "BATCH_THRASH" not in st.get("checks", {}), st
+    finally:
+        c.stop()

@@ -21,14 +21,35 @@ def _emitted_metric_names(body: str) -> set[str]:
     return names
 
 
+class _StubMon:
+    """The minimal monitor surface render_metrics()'s mon branch
+    touches — enough to materialize the mon-side staleness gauge
+    without booting a cluster."""
+
+    def __init__(self, store):
+        import threading
+
+        from ceph_tpu.mon.maps import OSDMap
+        self._lock = threading.Lock()
+        self.osdmap = OSDMap()
+        self.is_leader = True
+        self._osd_stats = {}
+        self.progress = None
+        self.metrics_history = store
+
+
 def test_rules_reference_only_emitted_metrics():
     # materialize the registries the rules read: the kernel profiler
-    # (ec_kernels: kernel_*_us), one messenger (msg_dispatch_us) and
-    # the scheduler's per-class QoS counters (mclock_qwait_us_*) — the
+    # (ec_kernels: kernel_*_us), one messenger (msg_dispatch_us), the
+    # scheduler's per-class QoS counters (mclock_qwait_us_*), a tracer
+    # (trace_sampled/trace_dropped) and a mon-side metrics-history
+    # store with one merged sample (the staleness gauge) — the
     # exporter emits every histogram's +Inf bucket even at zero
     # samples, so the schema exists without traffic
     from ceph_tpu.osd.scheduler import ClassParams, register_qos_counters
+    from ceph_tpu.utils.metrics_history import MetricsHistoryStore
     from ceph_tpu.utils.perf import global_perf
+    from ceph_tpu.utils.tracer import Tracer
     kernel_profiler()
     net = LocalNetwork()
     m = Messenger(net, "prom-rules-probe")
@@ -37,8 +58,15 @@ def test_rules_reference_only_emitted_metrics():
         "client": ClassParams(0, 1, 0),
         "recovery": ClassParams(0, 1, 0),
         "scrub": ClassParams(0, 1, 0)})
+    Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
+    import time as _time
+    store = MetricsHistoryStore()
+    # a FRESH sample: the store expires silent daemons out of the
+    # staleness gauge, so an ancient ts would render nothing
+    store.merge("osd.0", {"osd.0": [
+        {"ts": _time.time(), "seq": 1, "counters": {"op_w": 0}}]})
     try:
-        body = render_metrics(None)
+        body = render_metrics(_StubMon(store))
     finally:
         m.shutdown()
         global_perf().remove("qos_probe")
@@ -53,17 +81,29 @@ def test_rules_reference_only_emitted_metrics():
 
 def test_rules_shape_and_rendering():
     rules = recording_rules()
-    # one rule per (histogram, quantile), records namespaced
-    assert len(rules) == 14
+    # one rule per (histogram, quantile) + one rate rule per tracer
+    # counter + the staleness max, records namespaced
+    assert len(rules) == 17
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
-    assert all("histogram_quantile(" in r["expr"] for r in rules)
-    assert all("by (daemon, le)" in r["expr"] for r in rules)
-    quantiles = {r["record"].rsplit(":", 1)[1] for r in rules}
+    hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
+    assert len(hist) == 14
+    assert all("by (daemon, le)" in r["expr"] for r in hist)
+    quantiles = {r["record"].rsplit(":", 1)[1] for r in hist}
     assert quantiles == {"p50", "p99"}
+    rates = [r for r in rules if ":rate" in r["record"]]
+    assert {r["record"] for r in rates} == {
+        "ceph_tpu:daemon_trace_sampled:rate5m",
+        "ceph_tpu:daemon_trace_dropped:rate5m"}
+    assert all("rate(" in r["expr"] and "by (daemon)" in r["expr"]
+               for r in rates)
+    stale = [r for r in rules
+             if r["record"] == "ceph_tpu:metrics_history_staleness_s:max"]
+    assert len(stale) == 1
+    assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 14
-    assert text.count("    expr: ") == 14
+    assert text.count("  - record: ") == 17
+    assert text.count("    expr: ") == 17
 
 
 def test_exporter_histogram_buckets_are_cumulative_le():

@@ -308,3 +308,139 @@ def test_span_finish_race_records_once():
     s.finish()
     assert s.end == end
     assert sum(1 for d in tracer.dump() if d["name"] == "twice") == 1
+
+
+def test_head_sampling_rates_and_counters():
+    """The always-on sampler's contract: rate 0 = None at zero cost
+    (no span, no draw, nothing retained), rate 1 = every root sampled,
+    mid rates split between propagating sampled spans and local-only
+    unsampled ones — with trace_sampled/trace_dropped booking every
+    draw on the supplied perf registry."""
+    import random
+
+    from ceph_tpu.utils.perf import PerfCounters
+
+    pc = PerfCounters("probe")
+    t = Tracer("svc", sample_rate=0.0, perf=pc)
+    assert t.sample_root("op") is None
+    assert t.dump() == [] and len(t._unsampled) == 0
+    assert pc.get("trace_sampled") == 0 and pc.get("trace_dropped") == 0
+    t.set_sample_rate(1.0)
+    s = t.sample_root("op")
+    assert s is not None and s.sampled
+    s.finish()
+    assert pc.get("trace_sampled") == 1
+    # deterministic mid-rate split (seeded RNG)
+    t.set_sample_rate(0.5)
+    t._rng = random.Random(7)
+    spans = [t.sample_root("op") for _ in range(40)]
+    sampled = [x for x in spans if x.sampled]
+    dropped = [x for x in spans if not x.sampled]
+    assert sampled and dropped, "seeded 0.5 rate produced no split"
+    assert pc.get("trace_sampled") == 1 + len(sampled)
+    assert pc.get("trace_dropped") == len(dropped)
+    # unsampled spans never reach the ordinary dump (they are dropped
+    # traces until a slow-op complaint promotes them)
+    dump_ids = {d["span_id"] for d in t.dump()}
+    assert not any(x.span_id in dump_ids for x in dropped)
+    # clamped setter (config validation is the first line; the tracer
+    # self-defends anyway)
+    t.set_sample_rate(7.5)
+    assert t.sample_rate == 1.0
+
+
+def test_unsampled_ring_promotion_and_bound():
+    """The flight recorder's retroactive retention: promote() moves an
+    unsampled root into the ordinary rings (in-flight or finished),
+    tagged retained; the side ring stays bounded so the unretained
+    tail ages out."""
+    import random
+
+    t = Tracer("svc", sample_rate=0.5, rng=random.Random(3))
+    spans = [t.sample_root(f"op{i}") for i in range(30)]
+    dropped = [s for s in spans if not s.sampled]
+    assert dropped
+    # promote one in flight: it must show up in dumps as in_flight
+    u = dropped[0]
+    t.promote(u)
+    d = next(x for x in t.dump() if x["span_id"] == u.span_id)
+    assert d["in_flight"] and d["tags"]["retained"]
+    u.finish()
+    d = next(x for x in t.dump() if x["span_id"] == u.span_id)
+    assert not d.get("in_flight")
+    # promote one already finished: lands straight in the done ring
+    v = dropped[1]
+    v.finish()
+    assert not any(x["span_id"] == v.span_id for x in t.dump())
+    t.promote(v)
+    assert any(x["span_id"] == v.span_id for x in t.dump())
+    # promotion is idempotent
+    t.promote(v)
+    assert sum(1 for x in t.dump() if x["span_id"] == v.span_id) == 1
+    # the side ring is bounded
+    t.set_sample_rate(0.0001)
+    t._rng = random.Random(9)
+    for i in range(t.UNSAMPLED_KEEP + 50):
+        t.sample_root(f"flood{i}")
+    assert len(t._unsampled) <= t.UNSAMPLED_KEEP
+
+
+def test_live_overflow_closes_leaked_spans():
+    """Regression (Tracer._live eviction): overflow eviction used to
+    silently DISCARD leaked spans — the hung-op evidence the live
+    table exists to keep.  Now an evicted span closes into the done
+    ring tagged leaked=True (and books trace_leaked)."""
+    from ceph_tpu.utils.perf import PerfCounters
+
+    pc = PerfCounters("leak-probe")
+    t = Tracer("svc", perf=pc)
+    t.KEEP = 8  # shrink the window so the test stays O(small)
+    leaked_candidates = [t.start(f"leak{i}") for i in range(8)]
+    # the 9th..12th starts evict the oldest live spans
+    for i in range(4):
+        t.start(f"new{i}")
+    leaked = [d for d in t.dump() if d["tags"].get("leaked")]
+    assert len(leaked) == 4
+    assert {d["name"] for d in leaked} == {"leak0", "leak1", "leak2",
+                                           "leak3"}
+    assert all(d["end"] for d in leaked)
+    assert pc.get("trace_leaked") == 4
+    # a late finish on an already-evicted span must NOT double-record
+    leaked_candidates[0].finish()
+    assert sum(1 for d in t.dump() if d["name"] == "leak0") == 1
+
+
+def test_slow_op_promotes_unsampled_trace():
+    """OpTracker + tracer integration: an op whose unsampled root
+    outlives the complaint threshold is force-retained retroactively
+    and fires on_slow exactly once (finish after a mid-flight sweep
+    must not re-fire)."""
+    import random
+    import time as _time
+
+    from ceph_tpu.utils.tracked_op import OpTracker
+
+    t = Tracer("osd.x", sample_rate=0.5, rng=random.Random(5))
+    slow_calls = []
+    tracker = OpTracker(slow_op_seconds=0.02,
+                        on_slow=slow_calls.append)
+    span = None
+    while span is None or span.sampled:
+        span = t.sample_root("osd-op write")
+    op = tracker.create("write obj", span=span)
+    _time.sleep(0.03)
+    # mid-flight sweep: promotes + fires on_slow
+    newly = tracker.note_inflight_slow()
+    assert [o.op_id for o in newly] == [op.op_id]
+    assert len(slow_calls) == 1 and slow_calls[0] is op
+    assert any(d["span_id"] == span.span_id for d in t.dump())
+    # finishing later must not double-fire or double-count
+    op.finish()
+    assert len(slow_calls) == 1
+    assert tracker.slow_op_count() == 1
+    hist = tracker.dump_historic_slow_ops()
+    assert hist and hist[-1]["trace_id"] == span.trace_id
+    # a fast op with a span records trace_id but never trips on_slow
+    op2 = tracker.create("write quick", span=t.start("osd-op quick"))
+    op2.finish()
+    assert len(slow_calls) == 1
