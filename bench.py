@@ -617,7 +617,220 @@ def _recovery_progress_leg() -> dict:
                       for k, ps in seen.items()}}
 
 
-def ec_recovery_bench(progress: bool = False) -> int:
+def wide_repair_matrix(full: bool = True, chunk: int = 8192,
+                       seed: int = 13) -> dict:
+    """The {rs, clay, lrc, shec} x {healthy, degraded, storm} wide-code
+    matrix: every cell runs THROUGH the ECBatcher (the PR 1-8 seam the
+    wide codes now ride) and byte-verifies against the unbatched numpy
+    oracle.
+
+    - healthy: 8-writer full-stripe encode burst (GB/s of source bytes)
+    - degraded: single-shard-lost degraded read — survivors decode the
+      lost data chunk (per-op p50/p99 ms + GB/s); for LRC/SHEC the
+      batcher's fold takes the narrow repair-equation rows
+    - storm: the recovery rebuild — each op fetches ONLY what the
+      codec's minimum_to_decode / repair-plane contract requires (the
+      OSD's osd_ec_repair_narrow fetch plan) and rebuilds the lost
+      shard, reporting repair-bytes-per-lost-byte alongside throughput:
+      RS reads k whole chunks (ratio k), LRC one locality group, SHEC
+      one shingle window, CLAY (d=k+m-1) alpha/q sub-chunks from each
+      of n-1 helpers (ratio (n-1)/q)
+
+    All four plugins run at the same (k, data+parity) storage point:
+    k=8 with 4 parity chunks.  ``full=False`` is the tier-1-sized
+    smoke leg (fewer readers/ops, same verification)."""
+    import threading
+
+    import numpy as np
+
+    from ceph_tpu import ec
+    from ceph_tpu.ec.batcher import ECBatcher
+
+    K_, M_ = 8, 4
+    plugins = {
+        "rs": ("tpu", {"k": str(K_), "m": str(M_)}),
+        "clay": ("clay", {"k": str(K_), "m": str(M_),
+                          "d": str(K_ + M_ - 1)}),
+        # 2 global RS parities + (8+2)/5 = 2 local XORs = 4 parity
+        # chunks total, the same 12-chunk footprint as the others
+        "lrc": ("lrc", {"k": str(K_), "m": "2", "l": "5"}),
+        "shec": ("shec", {"k": str(K_), "m": str(M_), "c": "3"}),
+    }
+    readers, ops_per = (8, 6) if full else (4, 2)
+    rng = np.random.default_rng(seed)
+
+    def burst(fn, n_threads, per):
+        try:
+            fn(0, 0)  # warm the cell's kernels/decode matrices
+        except Exception:  # noqa: BLE001 - the timed run will surface it
+            pass
+        lat = []
+        errs = []
+        lock = threading.Lock()
+        barrier = threading.Barrier(n_threads + 1)
+
+        def worker(r):
+            barrier.wait()
+            mine = []
+            try:
+                for i in range(per):
+                    t0 = time.perf_counter()
+                    fn(r, i)
+                    mine.append(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 - surfaced in cell
+                with lock:
+                    errs.append(repr(e))
+            with lock:
+                lat.extend(mine)
+
+        threads = [threading.Thread(target=worker, args=(r,))
+                   for r in range(n_threads)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lat.sort()
+        return lat, wall, errs
+
+    cells: dict = {}
+    ratios: dict = {}
+    degraded_p99: dict = {}
+    all_ok = True
+    for pname, (plugin, prof) in plugins.items():
+        codec = ec.factory(plugin, dict(prof, backend="jax"))
+        oracle = ec.factory(plugin, dict(prof, backend="numpy"))
+        n = codec.chunk_count
+        lost = 1  # a data shard (the downed OSD's position)
+        # pre-generate the cases + oracle truth off the clock
+        cases = []
+        for _ in range(readers * ops_per):
+            data = rng.integers(0, 256, (K_, chunk), dtype=np.uint8)
+            parity = oracle.encode_chunks(data)
+            chunks = {j: data[j] for j in range(K_)}
+            chunks.update({K_ + j: parity[j] for j in range(codec.m)})
+            cases.append((data, parity, chunks))
+        cell: dict = {}
+        oks = []
+
+        # -- healthy: full-stripe encode burst -------------------------
+        bat = ECBatcher(window_us=2000)
+        enc_out = [None] * len(cases)
+
+        def do_enc(r, i, bat=bat, out=enc_out):
+            idx = r * ops_per + i
+            p, _ = bat.encode(codec, cases[idx][0])
+            out[idx] = np.asarray(p)
+
+        lat, wall, errs = burst(do_enc, readers, ops_per)
+        ok = not errs and all(
+            np.array_equal(enc_out[i], cases[i][1])
+            for i in range(len(cases)))
+        oks.append(ok)
+        cell["healthy"] = {
+            "gbps": round(len(cases) * K_ * chunk / wall / 2**30, 3),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+            "ops_per_launch": round(len(cases)
+                                    / max(1, bat.stats["launches"]), 2),
+            "ok": ok, **({"errors": errs[:2]} if errs else {}),
+        }
+
+        # -- degraded: lost-shard read decode --------------------------
+        bat = ECBatcher(window_us=2000)
+        surv = [{s: c for s, c in ch.items() if s != lost}
+                for _d, _p, ch in cases]
+
+        def do_dec(r, i, bat=bat):
+            idx = r * ops_per + i
+            out = bat.decode(codec, [lost], dict(surv[idx]))
+            if not np.array_equal(np.asarray(out[lost]),
+                                  cases[idx][2][lost]):
+                raise AssertionError(f"degraded bytes diverge op {idx}")
+
+        lat, wall, errs = burst(do_dec, readers, ops_per)
+        ok = not errs
+        oks.append(ok)
+        p99 = lat[min(len(lat) - 1, int(len(lat) * 0.99))] if lat else 0
+        cell["degraded"] = {
+            "gbps": round(len(cases) * chunk / wall / 2**30, 3),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+            "p99_ms": round(p99 * 1e3, 3),
+            "ops_per_launch": round(len(cases)
+                                    / max(1, bat.stats["launches"]), 2),
+            "ok": ok, **({"errors": errs[:2]} if errs else {}),
+        }
+        degraded_p99[pname] = cell["degraded"]["p99_ms"]
+
+        # -- storm: minimum-fetch rebuild of the lost shard ------------
+        # what the OSD's narrow recovery path moves over the wire:
+        bat = ECBatcher(window_us=2000)
+        avail = [s for s in range(n) if s != lost]
+        sub_repair = (plugin == "clay"
+                      and getattr(codec, "q", None) == codec.m)
+        if sub_repair:
+            planes = codec.repair_planes(lost)
+            fetch_bytes = (n - 1) * len(planes) * (chunk // codec.alpha)
+            helper_sets = []
+            for _d, _p, ch in cases:
+                helper_sets.append({
+                    h: ch[h].reshape(codec.alpha,
+                                     chunk // codec.alpha)[planes]
+                    for h in avail})
+
+            def do_rebuild(r, i, bat=bat):
+                idx = r * ops_per + i
+                got = bat.repair(codec, lost, helper_sets[idx], chunk)
+                if not np.array_equal(np.asarray(got),
+                                      cases[idx][2][lost]):
+                    raise AssertionError(f"repair bytes diverge {idx}")
+        else:
+            need = codec.minimum_to_decode([lost], avail)
+            need = [s for s in need if s != lost]
+            fetch_bytes = len(need) * chunk
+
+            def do_rebuild(r, i, bat=bat, need=need):
+                idx = r * ops_per + i
+                out = bat.decode(codec, [lost],
+                                 {s: cases[idx][2][s] for s in need})
+                if not np.array_equal(np.asarray(out[lost]),
+                                      cases[idx][2][lost]):
+                    raise AssertionError(f"rebuild bytes diverge {idx}")
+
+        lat, wall, errs = burst(do_rebuild, readers, ops_per)
+        ok = not errs
+        oks.append(ok)
+        ratio = round(fetch_bytes / chunk, 3)
+        cell["storm"] = {
+            "gbps": round(len(cases) * fetch_bytes / wall / 2**30, 3),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 3) if lat else None,
+            "repair_bytes_per_lost_byte": ratio,
+            "ops_per_launch": round(len(cases)
+                                    / max(1, bat.stats["launches"]), 2),
+            "subchunk": sub_repair,
+            "ok": ok, **({"errors": errs[:2]} if errs else {}),
+        }
+        ratios[pname] = ratio
+        cells[pname] = cell
+        all_ok = all_ok and all(oks)
+
+    # the acceptance claim: locality/sub-chunk repair moves strictly
+    # fewer bytes per lost byte than plain RS at the same (k, m)
+    locality_wins = (ratios["lrc"] < ratios["rs"]
+                     and ratios["clay"] < ratios["rs"]
+                     and ratios["shec"] < ratios["rs"])
+    return {"cells": cells,
+            "repair_bytes_per_lost_byte": ratios,
+            "degraded_p99_ms": degraded_p99,
+            "chunk_bytes": chunk,
+            "k": K_, "parity_chunks": M_,
+            "locality_beats_rs": locality_wins,
+            "ok": all_ok and locality_wins}
+
+
+def ec_recovery_bench(progress: bool = False,
+                      wide: bool = True) -> int:
     """`--ec-recovery` mode: the PG-recovery-storm scenario — one OSD's
     shards drop and a burst of stripes decode-rebuilds through the
     batcher (ROADMAP "recovery-burst batching").  8 reader threads each
@@ -738,6 +951,13 @@ def ec_recovery_bench(progress: bool = False) -> int:
     progress = _recovery_progress_leg() if progress else None
     if progress is not None:
         verified = verified and progress["ok"]
+    # the wide/local-code matrix: {rs, clay, lrc, shec} x {healthy,
+    # degraded, storm}, every cell batched AND byte-verified against
+    # the numpy oracle, with the repair-bytes-per-lost-byte column
+    # (LRC/SHEC/CLAY strictly below plain RS gates the exit code)
+    wide_m = wide_repair_matrix(full=True) if wide else None
+    if wide_m is not None:
+        verified = verified and wide_m["ok"]
     backend = "cpu" if on_cpu else "dev"
     gbps_b = results["batched"]["gbps"]
     gbps_u = results["unbatched"]["gbps"]
@@ -755,6 +975,12 @@ def ec_recovery_bench(progress: bool = False) -> int:
         "scenarios": results,
         "digest_verified": verified,
         **({"progress": progress} if progress is not None else {}),
+        **({"wide_matrix": wide_m["cells"],
+            "wide_repair_bytes_per_lost_byte":
+                wide_m["repair_bytes_per_lost_byte"],
+            "wide_degraded_p99_ms": wide_m["degraded_p99_ms"],
+            "wide_locality_beats_rs": wide_m["locality_beats_rs"],
+            "wide_ok": wide_m["ok"]} if wide_m is not None else {}),
     }))
     return 0 if verified else 1
 
@@ -1137,6 +1363,10 @@ def main() -> int:
                     help="with --ec-recovery: drive a MiniCluster "
                          "kill/revive and gate on the mgr progress "
                          "story")
+    ap.add_argument("--no-wide", action="store_true",
+                    help="with --ec-recovery: skip the {rs, clay, lrc, "
+                         "shec} x {healthy, degraded, storm} wide-code "
+                         "matrix leg")
     sat = ap.add_argument_group("saturate options")
     sat.add_argument("--smoke", action="store_true",
                      help="one tier-1-safe point: tens of clients, "
@@ -1157,7 +1387,8 @@ def main() -> int:
     if args.ec_batch:
         return ec_batch_bench(trace=args.trace)
     if args.ec_recovery:
-        return ec_recovery_bench(progress=args.progress)
+        return ec_recovery_bench(progress=args.progress,
+                                 wide=not args.no_wide)
     if args.ec_read:
         return ec_read_bench(trace=args.trace)
     if args.saturate:

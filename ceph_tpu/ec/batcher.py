@@ -11,6 +11,15 @@ scattered back per op.  arXiv:1709.05365 measures online-EC throughput
 dominated by exactly this per-request coding overhead; arXiv:2108.02692
 locates the order-of-magnitude wins in batching/fusing region work.
 
+Wide/local codes ride the same seam: signatures carry the codec's
+``fold_sig()`` identity (two codecs sharing a matrix's bytes must not
+coalesce), LRC/SHEC decodes fold over the codec's ``fold_rows`` —
+narrow ``(|group|, sum L)`` repair-equation launches for single
+failures — and CLAY folds at sub-chunk granularity through its
+``*_chunks_folded`` entry points plus the ``repair`` op kind (one
+folded MSR repair pass per storm signature).  See ec/README.md
+"Wide & local codes".
+
 Mechanics (no background thread, so nothing can leak at shutdown):
 
 - a submitting thread appends its op to the queue for its signature and
@@ -282,24 +291,43 @@ class ECBatcher:
             data_chunks = np.ascontiguousarray(data_chunks,
                                                dtype=np.uint8)
         L = int(data_chunks.shape[-1]) if data_chunks.ndim else 0
-        foldable = (isinstance(codec, MatrixErasureCode)
-                    and type(codec).encode_chunks
-                    is MatrixErasureCode.encode_chunks
-                    and data_chunks.ndim == 2
-                    and data_chunks.shape[0] == codec.k  # bad shape:
-                    # per-op path raises the codec's own error without
-                    # poisoning coalesced neighbors
-                    and L > 0)
-        if self.window_us <= 0 or not foldable:
+        kind = (codec.encode_fold_kind()
+                if isinstance(codec, MatrixErasureCode) else None)
+        if not (data_chunks.ndim == 2
+                and data_chunks.shape[0] == codec.k  # bad shape:
+                # per-op path raises the codec's own error without
+                # poisoning coalesced neighbors
+                and L > 0):
+            kind = None
+        if kind == "subchunk" and (
+                L % codec.get_sub_chunk_count()
+                or _is_device(data_chunks)):
+            # sub-chunk codecs fold host bytes at plane granularity; a
+            # misaligned length takes the codec's own error per op
+            kind = None
+        if self.window_us <= 0 or kind is None:
             return self._passthrough_encode(codec, data_chunks,
                                             with_csums, callback)
-        sig = ("enc", codec.matrix.tobytes(), codec.k, codec.m,
-               bool(with_csums), bucket_len(L))
+        # codec identity/sub-chunk layout rides the signature: the rest
+        # is matrix-derived, and two codecs sharing a matrix's
+        # bytes+shape (a wide code vs a plain one, or two sub-chunk
+        # layouts) must not coalesce into one fold
+        if kind == "subchunk":
+            # exact-L folding: sub-chunk segments cannot pad inside an
+            # op (the plane reshape would cross real-byte boundaries)
+            sig = ("enc", codec.fold_sig(), codec.matrix.tobytes(),
+                   codec.k, codec.m, bool(with_csums), L)
+            flush = self._flush_encode_subchunk
+        else:
+            sig = ("enc", codec.fold_sig(), codec.matrix.tobytes(),
+                   codec.k, codec.m, bool(with_csums), bucket_len(L))
+            flush = self._flush_encode
         op = _PendingOp(codec, streams=data_chunks, length=L,
                         with_csums=with_csums, callback=callback)
-        self._stage_encode_op(op, sig[-1])
+        if kind == "plain":
+            self._stage_encode_op(op, sig[-1])
         self._trace_submit(op, trace, sig)
-        self._submit(sig, op, data_chunks.nbytes, self._flush_encode)
+        self._submit(sig, op, data_chunks.nbytes, flush)
         if op.error is not None:
             raise op.error
         return op.parity, op.csums
@@ -323,24 +351,43 @@ class ECBatcher:
                       else np.ascontiguousarray(c, dtype=np.uint8))
                   for i, c in chunks.items()}
         lengths = {int(c.shape[-1]) for c in arrays.values()}
-        foldable = (isinstance(codec, MatrixErasureCode)
-                    and type(codec).decode_chunks
-                    is MatrixErasureCode.decode_chunks
-                    and len(lengths) == 1
-                    and all(c.ndim == 1 for c in arrays.values())
-                    and 0 not in lengths)
-        if self.window_us <= 0 or not foldable:
+        kind = (codec.decode_fold_kind()
+                if isinstance(codec, MatrixErasureCode) else None)
+        if not (len(lengths) == 1
+                and all(c.ndim == 1 for c in arrays.values())
+                and 0 not in lengths):
+            kind = None
+        if self.window_us <= 0:  # pass-through: skip the fold-rows
+            # resolution (rank work) an inline op would never use
+            kind = None
+        avail = tuple(sorted(arrays))
+        if kind == "plain" and codec.fold_rows(need, avail) is None:
+            # this erasure cannot fold (not enough survivors / no
+            # invertible subset): the per-op path surfaces the codec's
+            # own error without poisoning coalesced neighbors
+            kind = None
+        if kind == "subchunk" and \
+                next(iter(lengths)) % codec.get_sub_chunk_count():
+            kind = None
+        if kind is None:
             return self._passthrough_decode(codec, want, chunks, callback)
         L = lengths.pop()
-        sig = ("dec", codec.matrix.tobytes(), codec.k, codec.m,
-               tuple(sorted(arrays)), tuple(need), bucket_len(L))
+        if kind == "subchunk":
+            sig = ("dec", codec.fold_sig(), codec.matrix.tobytes(),
+                   codec.k, codec.m, avail, tuple(need), L)
+            flush = self._flush_decode_subchunk
+        else:
+            sig = ("dec", codec.fold_sig(), codec.matrix.tobytes(),
+                   codec.k, codec.m, avail, tuple(need), bucket_len(L))
+            flush = self._flush_decode
         # the callback is fired below by THIS thread, after present
         # shards merge back in — not by the flusher
         op = _PendingOp(codec, chunks=arrays, want=need, length=L)
-        self._stage_decode_op(op, sig)
+        if kind == "plain":
+            self._stage_decode_op(op, sig)
         self._trace_submit(op, trace, sig)
         nbytes = sum(c.nbytes for c in arrays.values())
-        self._submit(sig, op, nbytes, self._flush_decode)
+        self._submit(sig, op, nbytes, flush)
         if op.error is not None:
             raise op.error
         out = dict(op.decoded)
@@ -353,6 +400,36 @@ class ECBatcher:
             if op.error is not None:
                 raise op.error
         return out
+
+    def repair(self, codec, lost: int, helper_subchunks: ChunkMap,
+               L: int, *, trace: tuple | None = None) -> np.ndarray:
+        """Batched sub-chunk repair (CLAY MSR): concurrent repairs of
+        the SAME lost chunk from the same helper set — the recovery-
+        storm shape, one downed OSD's shard rebuilt across many
+        objects — fold into one repair pass whose parity-check matmuls
+        run once over the whole launch (repair_chunk_folded).  Returns
+        the repaired chunk exactly as ``codec.repair_chunk`` would."""
+        foldable = (self.window_us > 0
+                    and hasattr(codec, "repair_chunk_folded")
+                    and L > 0
+                    and L % codec.get_sub_chunk_count() == 0)
+        if not foldable:
+            out = codec.repair_chunk(lost, helper_subchunks, L)
+            self._account(1, sum(np.asarray(c).nbytes
+                                 for c in helper_subchunks.values()),
+                          FLUSH_IDLE)
+            return out
+        sig = ("rep", codec.fold_sig(), lost,
+               tuple(sorted(helper_subchunks)), L)
+        op = _PendingOp(codec, chunks=dict(helper_subchunks),
+                        want=[lost], length=L)
+        self._trace_submit(op, trace, sig)
+        nbytes = sum(np.asarray(c).nbytes
+                     for c in helper_subchunks.values())
+        self._submit(sig, op, nbytes, self._flush_repair)
+        if op.error is not None:
+            raise op.error
+        return op.decoded
 
     def pending_ops(self) -> int:
         """Ops queued and not yet taken by a flusher (0 when quiescent)."""
@@ -410,12 +487,12 @@ class ECBatcher:
         if getattr(op.codec, "_backend", None) != "jax":
             return
         bucket = sig[-1]
-        # only the first k sorted survivors feed the decode (sorted
-        # order puts every present data shard there; matrix_code's
-        # decode_folded_device slices [:k]) — staging the parity tail
-        # beyond k would be pure h2d/HBM waste
-        ids = [s for s in sig[4]
-               if s < op.codec.chunk_count][: op.codec.k]
+        # only the codec's fold rows feed the decode (for MDS codes the
+        # first k sorted survivors — every present data shard is there;
+        # wide/local codes pick their repair-equation participants or
+        # an invertible subset) — staging any other survivor row would
+        # be pure h2d/HBM waste
+        ids = self._fold_rows_for(op.codec, sig)
         try:
             rows = [op.chunks[s] for s in ids]
             if all(isinstance(r, np.ndarray) for r in rows):
@@ -438,12 +515,26 @@ class ECBatcher:
         except Exception:  # noqa: BLE001 - host fold fall-through
             op.dev = None
 
+    @staticmethod
+    def _fold_rows_for(codec, sig: tuple) -> list[int]:
+        """Survivor rows a folded decode launch consumes, resolved
+        through the codec's fold protocol (decode() already verified
+        they exist for this signature)."""
+        rows = codec.fold_rows(list(sig[6]), sig[5])
+        if rows is None:  # cannot happen after decode()'s gate, but a
+            # flush must never crash the group on a protocol slip
+            rows = [s for s in sig[5]
+                    if s < codec.chunk_count][: codec.k]
+        return rows
+
     # ----------------------------------------------------------- tracing
     @staticmethod
     def _sig_tag(sig: tuple) -> str:
         """Human-readable batch-signature tag (the raw sig embeds the
-        whole coding matrix): kind/k.m/length-bucket."""
-        return f"{sig[0]}/k{sig[2]}m{sig[3]}/L{sig[-1]}"
+        whole coding matrix): kind/codec/k.m/length-bucket."""
+        if sig[0] == "rep":
+            return f"rep/{sig[1][0]}/lost{sig[2]}/L{sig[-1]}"
+        return f"{sig[0]}/{sig[1][0]}/k{sig[3]}m{sig[4]}/L{sig[-1]}"
 
     def _trace_submit(self, op: _PendingOp, trace: tuple | None,
                       sig: tuple) -> None:
@@ -769,7 +860,7 @@ class ECBatcher:
             L0 = ops[0].length
             op_fn = None
             fused_shard = 1
-            if (sig[4]  # every op in the group wants csums
+            if (sig[5]  # every op in the group wants csums
                     and getattr(codec, "_backend", None) == "jax"
                     and all(o.length == L0 for o in ops)
                     and L0 % 4 == 0):
@@ -827,7 +918,7 @@ class ECBatcher:
                     o.parity = parity[:, i * L0: (i + 1) * L0].copy()
                     o.csums = csums[:, i].copy()
             else:
-                if (self._events is not None and sig[4] and ns > 1):
+                if (self._events is not None and sig[5] and ns > 1):
                     # a checksummed burst on a sharded pool whose
                     # MESH-SHARDED fused encode+CRC op is not (yet)
                     # compiled: parity fans out, csums fall through to
@@ -914,7 +1005,7 @@ class ECBatcher:
                       reason: str) -> None:
         bucket = sig[-1]
         codec = ops[0].codec
-        avail, want = sig[4], list(sig[5])
+        avail, want = sig[5], list(sig[6])
         src_bytes = sum(sum(c.nbytes for c in o.chunks.values())
                         for o in ops)
         ns, shard_bytes = 1, 0
@@ -933,12 +1024,12 @@ class ECBatcher:
                 # per launch.  No donation: the stacked survivors feed
                 # both the decode product and the parity-from-data
                 # product.
-                # first k sorted survivors only — the exact rows
+                # the codec's fold rows only — the exact rows
                 # _stage_decode_op staged and decode_folded_device
-                # consumes (sorted order keeps every present data
-                # shard inside the first k)
-                avail_ids = [s for s in avail
-                             if s < codec.chunk_count][: codec.k]
+                # consumes (MDS: first k sorted survivors; wide/local
+                # codes: repair-equation participants or an invertible
+                # subset)
+                avail_ids = self._fold_rows_for(codec, sig)
                 with self._launch_ctx(codec):
                     if all(o.dev is not None for o in ops):
                         folded, _owned = self._fold_device(
@@ -993,3 +1084,121 @@ class ECBatcher:
                 src_cols=sum(o.length for o in ops),
                 padded_cols=padded_cols, n_shard=ns)
             self._complete(ops, src_bytes, reason, ns, shard_bytes)
+
+    # ------------------------------------------- sub-chunk codec flushes
+    # CLAY (and any REQUIRE_SUB_CHUNKS codec exposing *_chunks_folded)
+    # folds at plane granularity: the ops' exact-L segments fold on the
+    # HOST (the plane transpose is O(bytes) numpy), and the codec's
+    # folded entry point runs its coupling gathers once and its MDS
+    # plane matmuls as the same (k, sum L) folded device launches the
+    # plain flushes ride — sharded over the mesh when the pool fans out.
+
+    def _flush_encode_subchunk(self, sig: tuple, ops: list[_PendingOp],
+                               reason: str) -> None:
+        L = sig[-1]
+        codec = ops[0].codec
+        k = codec.k
+        src_bytes = sum(o.streams.nbytes for o in ops)
+        ns, shard_bytes = 1, 0
+        padded_cols = 0
+        fspan = self._trace_flush(sig, ops, reason)
+        try:
+            ns, n2 = self._shard_fanout(codec, _pow2(len(ops)))
+            padded_cols = n2 * L
+            with self._launch_ctx(codec):
+                folded = self._fold_host_rows(
+                    [np.asarray(o.streams) for o in ops],
+                    [L] * len(ops), L, k, n2)
+                # zero stripe slots encode to zero parity (linear code:
+                # zero data -> zero uncoupled planes -> zero parity),
+                # so the pow2 padding slices away clean
+                parity = codec.encode_chunks_folded(folded, n2, L,
+                                                    n_shard=ns)
+            shard_bytes = folded.nbytes // ns if ns > 1 else 0
+            for i, o in enumerate(ops):
+                o.parity = parity[:, i * L: (i + 1) * L].copy()
+                if o.with_csums:
+                    stack = np.concatenate(
+                        [np.asarray(o.streams), o.parity], axis=0)
+                    o.csums = np.array(
+                        [native.crc32c(row.tobytes()) for row in stack],
+                        dtype=np.uint32)
+            for o in ops:
+                if o.callback is not None:
+                    self._fire(o, o.callback, o.parity, o.csums)
+        except BaseException as e:
+            for o in ops:
+                o.error = e
+        finally:
+            self._trace_flush_done(
+                fspan, bucket=L, src_cols=sum(o.length for o in ops),
+                padded_cols=padded_cols, n_shard=ns)
+            self._complete(ops, src_bytes, reason, ns, shard_bytes)
+
+    def _flush_decode_subchunk(self, sig: tuple, ops: list[_PendingOp],
+                               reason: str) -> None:
+        L = sig[-1]
+        codec = ops[0].codec
+        avail = [s for s in sig[5] if s < codec.chunk_count]
+        want = list(sig[6])
+        src_bytes = sum(sum(c.nbytes for c in o.chunks.values())
+                        for o in ops)
+        ns, shard_bytes = 1, 0
+        padded_cols = 0
+        fspan = self._trace_flush(sig, ops, reason)
+        try:
+            ns, n2 = self._shard_fanout(codec, _pow2(len(ops)))
+            padded_cols = n2 * L
+            with self._launch_ctx(codec):
+                folded = np.empty((len(avail), n2 * L), dtype=np.uint8)
+                for i, o in enumerate(ops):
+                    c0 = i * L
+                    for j, s in enumerate(avail):
+                        folded[j, c0: c0 + L] = np.asarray(o.chunks[s])
+                if len(ops) < n2:
+                    folded[:, len(ops) * L:] = 0
+                out = codec.decode_chunks_folded(want, avail, folded,
+                                                 n2, L, n_shard=ns)
+            shard_bytes = folded.nbytes // ns if ns > 1 else 0
+            for i, o in enumerate(ops):
+                o.decoded = {
+                    s: out[j, i * L: (i + 1) * L].copy()
+                    for j, s in enumerate(want)}
+        except BaseException as e:
+            for o in ops:
+                o.error = e
+        finally:
+            self._trace_flush_done(
+                fspan, bucket=L, src_cols=sum(o.length for o in ops),
+                padded_cols=padded_cols, n_shard=ns)
+            self._complete(ops, src_bytes, reason, ns, shard_bytes)
+
+    def _flush_repair(self, sig: tuple, ops: list[_PendingOp],
+                      reason: str) -> None:
+        """Folded MSR repair flush: same lost chunk, same helper set,
+        same L — the whole group rides ONE repair_chunk_folded pass
+        (no stripe-count padding: the repair solve's shapes already
+        vary by plane count, and a zero segment would buy nothing)."""
+        L = sig[-1]
+        codec = ops[0].codec
+        lost = sig[2]
+        src_bytes = sum(sum(np.asarray(c).nbytes
+                            for c in o.chunks.values()) for o in ops)
+        ns = 1
+        fspan = self._trace_flush(sig, ops, reason)
+        try:
+            ns, _n2 = self._shard_fanout(codec, len(ops))
+            with self._launch_ctx(codec):
+                outs = codec.repair_chunk_folded(
+                    lost, [o.chunks for o in ops], L, n_shard=ns)
+            for o, chunk in zip(ops, outs):
+                o.decoded = chunk
+        except BaseException as e:
+            for o in ops:
+                o.error = e
+        finally:
+            self._trace_flush_done(
+                fspan, bucket=L, src_cols=len(ops) * L,
+                padded_cols=len(ops) * L, n_shard=ns)
+            self._complete(ops, src_bytes, reason, ns,
+                           src_bytes // ns if ns > 1 else 0)

@@ -73,6 +73,9 @@ class GeneralMatrixCode(MatrixErasureCode):
 
     def _init_general(self) -> None:
         self.matrix = np.ascontiguousarray(self.full[self.k:])
+        #: (want, rows) -> combination matrix R with wanted = R @ rows
+        #: (the folded-decode counterpart of _decode_cache, same LRU cap)
+        self._fold_cache: dict[tuple, np.ndarray] = {}
         self._init_matrix_backend()
 
     # -- chunk-space repair equations (the locality machinery) -------------
@@ -125,6 +128,104 @@ class GeneralMatrixCode(MatrixErasureCode):
         return ([i for i in avail if i < self.k]
                 + [i for i in avail if i >= self.k])
 
+    def repair_cost(self, chunk: int, available) -> int:
+        """Chunks read to repair a single failure (locality metric)."""
+        return len(self.minimum_to_decode([chunk],
+                                          [i for i in available
+                                           if i != chunk]))
+
+    def get_flags(self):
+        from .interface import Flags
+        return super().get_flags() & ~Flags.PARITY_DELTA_OPTIMIZATION
+
+    # -- batcher fold protocol (see MatrixErasureCode) ---------------------
+    def fold_sig(self) -> tuple:
+        # the FULL generator stack, not just the parity block: decode
+        # selection (locality equations, rank-greedy subsets) reads
+        # self.full, so two codes agreeing on [P] but not on the whole
+        # stack must not share a fold
+        return ("gen", type(self).__name__, self.full.shape,
+                self.full.tobytes())
+
+    def decode_fold_kind(self) -> str | None:
+        return "plain"
+
+    def fold_rows(self, want, avail) -> list[int] | None:
+        """Survivor rows a folded decode consumes: a single failure
+        takes its cheapest repair equation's participants (LRC's one
+        locality group, SHEC's shingle window — a narrow (|group|,
+        sum L) fold instead of a k-wide inversion); everything else
+        takes a rank-greedy invertible k-subset in the locality-first
+        candidate order.  None = this erasure cannot decode.  Cached:
+        the batcher resolves rows per op and per flush, and the
+        rank-greedy selection costs O(k^3) table work per miss."""
+        key = ("rows", tuple(want), tuple(avail))
+        with self._cache_lock:
+            hit = self._fold_cache.get(key)
+            if hit is not None:
+                return hit[0]
+        avail = [i for i in avail if i < self.chunk_count]
+        missing = [i for i in want if i not in avail]
+        rows = None
+        if len(missing) == 1:
+            eq = self._cheap_repair_eq(missing[0], set(avail))
+            if eq is not None:
+                rows = sorted(i for i in eq if i != missing[0])
+        if rows is None:
+            rows = independent_rows(
+                self.full, self._decode_candidates(want, avail), self.k)
+        with self._cache_lock:
+            if len(self._fold_cache) > self.DECODE_CACHE_CAP:
+                self._fold_cache.pop(next(iter(self._fold_cache)))
+            self._fold_cache[key] = (rows,)  # (None,) caches the miss too
+        return rows
+
+    def _fold_matrix(self, want: tuple, rows: tuple) -> np.ndarray:
+        """Combination matrix R (len(want), len(rows)) with
+        wanted_chunks = R @ stack(rows): ONE region matmul reconstructs
+        every wanted chunk of a folded launch.  Single failures use a
+        repair equation over exactly `rows` (R is one narrow row);
+        otherwise rows must be k independent survivors and
+        R = full[want] @ inv(full[rows]).  Cached LRU like the decode
+        matrices — erasure signatures repeat across a storm."""
+        key = (want, rows)
+        with self._cache_lock:
+            hit = self._fold_cache.pop(key, None)
+            if hit is not None:
+                self._fold_cache[key] = hit  # LRU touch
+                return hit
+        R = None
+        if len(want) == 1:
+            eq = self._cheap_repair_eq(want[0], set(rows))
+            if eq is not None and set(eq) - {want[0]} <= set(rows):
+                inv = int(gf256.inv_table()[eq[want[0]]])
+                R = np.zeros((1, len(rows)), dtype=np.uint8)
+                for j, r in enumerate(rows):
+                    if r in eq:
+                        R[0, j] = int(gf256.gf_mul(inv, eq[r]))
+        if R is None:
+            if len(rows) != self.k:
+                raise ErasureCodeError(
+                    f"cannot fold-decode {list(want)} from {list(rows)}")
+            D = gf256.gf_mat_inv(self.full[list(rows)])
+            R = gf256.gf_matmul(self.full[list(want)], D)
+        with self._cache_lock:
+            if len(self._fold_cache) > self.DECODE_CACHE_CAP:
+                self._fold_cache.pop(next(iter(self._fold_cache)))
+            self._fold_cache[key] = R
+        return R
+
+    def decode_folded_device(self, want, avail, stacked, *,
+                             n_shard: int = 1):
+        """Folded decode over the fold_rows() survivor stack: ONE
+        region matmul with the cached combination matrix — device-
+        resident on the jax backend (the caller carves waiters out of
+        one bulk d2h), numpy elsewhere."""
+        rows = [i for i in avail if i < self.chunk_count]
+        R = self._fold_matrix(tuple(want), tuple(rows))
+        return self._matmul_device(R, stacked[: len(rows)],
+                                   n_shard=n_shard)
+
     def minimum_to_decode(self, want, available):
         want_s, avail_s = set(want), set(available)
         if want_s <= avail_s:
@@ -142,7 +243,8 @@ class GeneralMatrixCode(MatrixErasureCode):
                 f"cannot decode {sorted(want_s)} from {sorted(avail_s)}")
         return sorted(set(rows) | (want_s & avail_s))
 
-    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
+    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap, *,
+                      n_shard: int = 1) -> ChunkMap:
         avail = [i for i in chunks if i < self.chunk_count]
         missing = [i for i in want if i not in chunks]
         if len(missing) == 1:
@@ -161,7 +263,7 @@ class GeneralMatrixCode(MatrixErasureCode):
         D = gf256.gf_mat_inv(sub)
         stack = np.stack([np.ascontiguousarray(chunks[i], dtype=np.uint8)
                           for i in rows])
-        data = self._matmul(D, stack)
+        data = self._matmul(D, stack, n_shard=n_shard)
         out: ChunkMap = {}
         for i in want:
             if i in chunks:
@@ -169,5 +271,6 @@ class GeneralMatrixCode(MatrixErasureCode):
             elif i < self.k:
                 out[i] = data[i]
             else:
-                out[i] = self._matmul(self.full[[i]], data)[0]
+                out[i] = self._matmul(self.full[[i]], data,
+                                      n_shard=n_shard)[0]
         return out

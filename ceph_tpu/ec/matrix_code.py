@@ -390,6 +390,45 @@ class MatrixErasureCode(ErasureCode):
                 Flags.OPTIMIZED_SUPPORTED | Flags.PARTIAL_READ_OPTIMIZATION |
                 Flags.PARTIAL_WRITE_OPTIMIZATION)
 
+    # -- batcher fold protocol ---------------------------------------------
+    # The ECBatcher folds concurrent same-signature ops into one
+    # (k, sum L) launch.  These hooks tell it HOW this codec folds:
+    #
+    # - fold_sig(): the codec-identity component of every flush
+    #   signature.  The raw signature is otherwise matrix-derived, and
+    #   two codecs sharing a matrix's bytes+shape need not share
+    #   DECODE/sub-chunk semantics (a wide code's locality selection, a
+    #   coupled-layer code's plane layout) — without this component
+    #   they would coalesce into one fold and one of them would get the
+    #   other's math.
+    # - encode_fold_kind()/decode_fold_kind(): "plain" = the op is one
+    #   region matmul against self.matrix / a decode-matrix product
+    #   (the PR 1-8 path), "subchunk" = the codec folds through its own
+    #   *_chunks_folded entry points (CLAY's coupled planes), None =
+    #   not foldable (pass-through).
+    # - fold_rows(): which survivor rows a folded "plain" decode
+    #   launch consumes, in stack order — the base class takes the
+    #   first k sorted survivors (every k-subset of an MDS code
+    #   decodes); non-MDS codes pick an invertible (or locality)
+    #   subset instead.  None = this erasure cannot fold (pass-through
+    #   surfaces the codec's own error per op).
+
+    def fold_sig(self) -> tuple:
+        return ("mat",)
+
+    def encode_fold_kind(self) -> str | None:
+        return ("plain" if type(self).encode_chunks
+                is MatrixErasureCode.encode_chunks else None)
+
+    def decode_fold_kind(self) -> str | None:
+        return ("plain" if type(self).decode_chunks
+                is MatrixErasureCode.decode_chunks else None)
+
+    def fold_rows(self, want: Sequence[int],
+                  avail: Sequence[int]) -> list[int] | None:
+        rows = [i for i in avail if i < self.chunk_count][: self.k]
+        return rows if len(rows) == self.k else None
+
     # -- region multiply through the selected backend ----------------------
     def _matmul_device(self, M: np.ndarray, rows: np.ndarray, *,
                        n_shard: int = 1, donate: bool = False):

@@ -140,7 +140,8 @@ class ClayCode(MatrixErasureCode):
 
     # -- core: recover erased C given alive C (also the encode) ------------
     def _decode_symbols(self, C: dict[int, np.ndarray],
-                        erased: list[int], L: int) -> dict[int, np.ndarray]:
+                        erased: list[int], L: int, *,
+                        n_shard: int = 1) -> dict[int, np.ndarray]:
         """C: alive INTERNAL node -> (alpha, L) sub-chunk array (virtual
         pads included as zeros).  Returns C for erased nodes.
 
@@ -256,8 +257,8 @@ class ClayCode(MatrixErasureCode):
                 for r, i in enumerate(use):
                     known[r] = U[i, Zs].reshape(-1)
             if D is not None:
-                known = self._matmul(D, known)
-            rec = self._matmul(F_er, known)
+                known = self._matmul(D, known, n_shard=n_shard)
+            rec = self._matmul(F_er, known, n_shard=n_shard)
             rec = rec.reshape(len(E), len(Zs), L)
             for r, node in enumerate(E):
                 U[node, Zs] = rec[r]
@@ -329,7 +330,8 @@ class ClayCode(MatrixErasureCode):
         return np.stack([parity[self.k_int + j].reshape(L)
                          for j in range(self.m)])
 
-    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap) -> ChunkMap:
+    def decode_chunks(self, want: Sequence[int], chunks: ChunkMap, *,
+                      n_shard: int = 1) -> ChunkMap:
         avail = {i: c for i, c in chunks.items() if i < self.chunk_count}
         missing = [i for i in want if i not in avail]
         if not missing:
@@ -342,11 +344,101 @@ class ClayCode(MatrixErasureCode):
         # all erased nodes must be recovered together (coupling crosses them)
         erased = [self._ext2int(i) for i in range(self.chunk_count)
                   if i not in avail]
-        rec = self._decode_symbols(C, erased, L // self.alpha)
+        rec = self._decode_symbols(C, erased, L // self.alpha,
+                                   n_shard=n_shard)
         out: ChunkMap = {}
         for i in want:
             out[i] = chunks[i] if i in avail \
                 else rec[self._ext2int(i)].reshape(L)
+        return out
+
+    # -- batcher fold protocol (see MatrixErasureCode) ---------------------
+    # CLAY ops fold at SUB-CHUNK granularity: an op's (rows, L) chunks
+    # are alpha consecutive sub-chunks of L/alpha bytes each, so a raw
+    # length-axis concat of two ops would interleave op bytes across
+    # plane boundaries.  Instead each op's rows reshape to (alpha, Ls)
+    # and the ops concatenate along Ls — the q x t coupled-layer planes
+    # become length-axis SEGMENTS of one (alpha, sum Ls) plane array per
+    # node, and every coupling gather and MDS plane matmul inside
+    # _decode_symbols runs ONCE over the whole fold (the matmuls are
+    # the same (k, sum L) folded launches the plain plugin's flushes
+    # ride, through the same kernel/mesh machinery).
+
+    def fold_sig(self) -> tuple:
+        # (k, m, d) pins the whole construction: grid, alpha, coupling
+        # pairs, and the plane-code matrix are all derived from it
+        return ("clay", self.k, self.m, self.d)
+
+    def encode_fold_kind(self) -> str | None:
+        return "subchunk"
+
+    def decode_fold_kind(self) -> str | None:
+        return "subchunk"
+
+    def _fold_planes(self, rows: np.ndarray, n_str: int,
+                     L: int) -> np.ndarray:
+        """(n_rows, n_str*L) op-major fold -> per-row (alpha, n_str*Ls)
+        plane-major arrays: ops become length-axis segments of each
+        plane."""
+        Ls = L // self.alpha
+        arr = np.ascontiguousarray(rows, dtype=np.uint8).reshape(
+            rows.shape[0], n_str, self.alpha, Ls)
+        return np.ascontiguousarray(arr.transpose(0, 2, 1, 3)).reshape(
+            rows.shape[0], self.alpha, n_str * Ls)
+
+    def _unfold_planes(self, planes: np.ndarray, n_str: int,
+                       L: int) -> np.ndarray:
+        """Inverse of _fold_planes for one node: (alpha, n_str*Ls) ->
+        (n_str, L) per-op chunks."""
+        Ls = L // self.alpha
+        return planes.reshape(self.alpha, n_str, Ls).transpose(
+            1, 0, 2).reshape(n_str, L)
+
+    def encode_chunks_folded(self, folded: np.ndarray, n_str: int,
+                             L: int, *, n_shard: int = 1) -> np.ndarray:
+        """Folded encode: ``folded`` is (k, n_str*L) with each op an
+        exact-L segment; returns (m, n_str*L) parity in the same
+        layout.  One _decode_symbols pass covers the whole launch."""
+        if L % self.alpha:
+            raise ErasureCodeError(
+                f"chunk length {L} not divisible by alpha={self.alpha}")
+        planes = self._fold_planes(folded, n_str, L)
+        C = {i: planes[i] for i in range(self.k)}
+        width = n_str * (L // self.alpha)
+        for v in range(self.k, self.k_int):  # shortened: virtual zeros
+            C[v] = np.zeros((self.alpha, width), dtype=np.uint8)
+        parity = self._decode_symbols(
+            C, list(range(self.k_int, self.n_int)), width,
+            n_shard=n_shard)
+        out = np.empty((self.m, n_str * L), dtype=np.uint8)
+        for j in range(self.m):
+            out[j] = self._unfold_planes(
+                parity[self.k_int + j], n_str, L).reshape(-1)
+        return out
+
+    def decode_chunks_folded(self, want: Sequence[int],
+                             avail: Sequence[int], folded: np.ndarray,
+                             n_str: int, L: int, *,
+                             n_shard: int = 1) -> np.ndarray:
+        """Folded decode: ``folded`` is (len(avail), n_str*L) survivor
+        rows in ``avail`` order; returns (len(want), n_str*L)
+        reconstructed rows in ``want`` order."""
+        if L % self.alpha:
+            raise ErasureCodeError(
+                f"chunk length {L} not divisible by alpha={self.alpha}")
+        avail = [i for i in avail if i < self.chunk_count]
+        planes = self._fold_planes(folded[: len(avail)], n_str, L)
+        C = {self._ext2int(i): planes[r] for r, i in enumerate(avail)}
+        width = n_str * (L // self.alpha)
+        for v in range(self.k, self.k_int):
+            C[v] = np.zeros((self.alpha, width), dtype=np.uint8)
+        erased = [self._ext2int(i) for i in range(self.chunk_count)
+                  if i not in avail]
+        rec = self._decode_symbols(C, erased, width, n_shard=n_shard)
+        out = np.empty((len(want), n_str * L), dtype=np.uint8)
+        for r, i in enumerate(want):
+            out[r] = self._unfold_planes(
+                rec[self._ext2int(i)], n_str, L).reshape(-1)
         return out
 
     # -- MSR repair (d = n-1): the sub-chunk bandwidth win -----------------
@@ -376,7 +468,7 @@ class ClayCode(MatrixErasureCode):
 
     def repair_chunk(self, lost: int,
                      helper_subchunks: dict[int, np.ndarray],
-                     L: int) -> np.ndarray:
+                     L: int, *, n_shard: int = 1) -> np.ndarray:
         """Repair one lost EXTERNAL chunk from helpers' alpha/q sub-chunk
         slices (each helper i supplies array (alpha/q, L/alpha) — its
         planes repair_planes(lost), in that order)."""
@@ -451,7 +543,9 @@ class ClayCode(MatrixErasureCode):
         Hoth = self.H[:, other_nodes]
         known = np.ascontiguousarray(
             U[other_nodes].reshape(len(other_nodes), P * Ls))
-        sol = self._matmul(Hinv, self._matmul(Hoth, known))
+        sol = self._matmul(Hinv, self._matmul(Hoth, known,
+                                              n_shard=n_shard),
+                           n_shard=n_shard)
         sol = sol.reshape(q, P, Ls)
         for r, node in enumerate(col_nodes):
             U[node] = sol[r]
@@ -481,3 +575,30 @@ class ClayCode(MatrixErasureCode):
             out[nd] = mt[ginv][Carr[helper_nodes, pidx]] ^ \
                 mt[c2][U[helper_nodes, pidx]]
         return out.reshape(alpha * Ls)
+
+    def repair_chunk_folded(self, lost: int,
+                            helpers_list: list[dict[int, np.ndarray]],
+                            L: int, *, n_shard: int = 1) -> list[np.ndarray]:
+        """Folded MSR repair: many concurrent repairs of the SAME lost
+        chunk (a recovery storm rebuilding one downed OSD's shard
+        across objects) fold into ONE repair pass — each op's (P, Ls)
+        helper slices become length-axis segments of a (P, n*Ls) plane
+        array, the column solve's parity-check matmul runs once over
+        the whole fold, and the per-op chunks carve back out.  Byte-
+        identical to per-op repair_chunk (the plane math never crosses
+        the Ls axis)."""
+        n = len(helpers_list)
+        if n == 1:
+            return [self.repair_chunk(lost, helpers_list[0], L,
+                                      n_shard=n_shard)]
+        P = len(self.repair_planes(lost))
+        Ls = L // self.alpha
+        folded: dict[int, np.ndarray] = {}
+        for h in helpers_list[0]:
+            folded[h] = np.ascontiguousarray(np.stack(
+                [np.asarray(hl[h], dtype=np.uint8).reshape(P, Ls)
+                 for hl in helpers_list], axis=1)).reshape(P, n * Ls)
+        flat = self.repair_chunk(lost, folded, n * L, n_shard=n_shard)
+        out = flat.reshape(self.alpha, n, Ls).transpose(
+            1, 0, 2).reshape(n, L)
+        return [out[i] for i in range(n)]

@@ -161,10 +161,6 @@ class LrcCode(GeneralMatrixCode):
                 f"layer plugin {plugin!r} is not a GF(2^8) matrix code")
         return np.asarray(inner.matrix, dtype=np.uint8)
 
-    def get_flags(self):
-        from .interface import Flags
-        return super().get_flags() & ~Flags.PARITY_DELTA_OPTIMIZATION
-
     def repair_equations(self):
         """Locality relations: per-layer equations (layers grammar) or
         group XORs (simple form) + the global parity relations."""
@@ -213,9 +209,3 @@ class LrcCode(GeneralMatrixCode):
         add(range(self.k, self.k + self.global_m))
         add(range(self.k + self.global_m, self.chunk_count))
         return order
-
-    def repair_cost(self, chunk: int, available) -> int:
-        """Chunks read to repair a single failure (locality metric)."""
-        return len(self.minimum_to_decode([chunk],
-                                          [i for i in available
-                                           if i != chunk]))

@@ -49,10 +49,6 @@ class ShecCode(GeneralMatrixCode):
         self.full = np.concatenate([np.eye(k, dtype=np.uint8), P])
         self._init_general()
 
-    def get_flags(self):
-        from .interface import Flags
-        return super().get_flags() & ~Flags.PARITY_DELTA_OPTIMIZATION
-
     def _covering_parities(self, data_chunk: int) -> list[int]:
         return [self.k + j for j in range(self.m)
                 if self.full[self.k + j, data_chunk]]
@@ -82,8 +78,3 @@ class ShecCode(GeneralMatrixCode):
         add(range(self.k))
         add(range(self.k, self.chunk_count))
         return order
-
-    def repair_cost(self, chunk: int, available) -> int:
-        return len(self.minimum_to_decode([chunk],
-                                          [i for i in available
-                                           if i != chunk]))

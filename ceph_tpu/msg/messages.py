@@ -421,6 +421,12 @@ class MPGPull:
     pgid: PgId
     names: list
     force: bool = False  # scrub repair: replace my same-version bad copy
+    # (trace_id, span_id) of the requesting storm's root span: the
+    # serving peer parents its pull-serve span under it, so a sampled
+    # recovery storm's waterfall shows per-pull child spans
+    # cross-daemon.  Appended with a default — old bytes decode
+    # compatibly (generic codec skip-unknown-tail).
+    trace: tuple = ()
 
 
 @dataclass
@@ -435,6 +441,11 @@ class MPGPush:
     deletes: dict = field(default_factory=dict)  # name -> delete version
     force: bool = False  # scrub repair: overwrite same-version bad copies
     checkpoint: int = -1  # peer may advance last_complete to this
+    # (trace_id, span_id) of the pushing storm's root span — the
+    # receiving peer's apply work becomes a per-push child span of the
+    # storm root (ROADMAP telemetry follow-on (b)).  Appended with a
+    # default: old archived bytes decode compatibly.
+    trace: tuple = ()
 
 
 @dataclass

@@ -109,6 +109,9 @@ class _PendingRead:
     stat_only: bool = False  # reply with the object length, not data
     # recovery reads carry a completion callback instead of a client
     on_done: object = None
+    # sub-chunk repair reads (CLAY MSR) need EVERY helper's slices, not
+    # just k chunks: completion waits for all replies
+    want_all: bool = False
     span: object = None    # op span (traced reads): decode stage parent
     stamp: float = field(default_factory=time.time)
 
@@ -782,7 +785,20 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             "scrubs", "scrub_errors", "ec_cache_hit",
                             "ec_cache_miss", "ec_read_cache_hit",
                             "ec_rmw_cache_serves", "map_inc", "map_full",
-                            "snap_trims"])
+                            "snap_trims",
+                            # repair-bandwidth accounting: bytes fetched
+                            # over the wire to rebuild shards vs bytes
+                            # of shard actually rebuilt — the repair-
+                            # bytes-per-lost-byte ratio per daemon —
+                            # plus how rebuilds were served (narrow
+                            # locality set / sub-chunk ranges / whole-
+                            # shard wide) and narrow attempts that had
+                            # to retry wide
+                            "recovery_fetch_bytes",
+                            "recovery_rebuilt_bytes",
+                            "recovery_narrow_rebuilds",
+                            "recovery_subchunk_rebuilds",
+                            "recovery_wide_retries"])
         self.perf.add("op_lat", CounterType.TIME)
         # cross-op EC batching (ec/batcher.py): concurrent stripe
         # encodes/decodes sharing a (matrix, k, m) signature coalesce
@@ -1654,6 +1670,33 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         with (self.tracer.start("ec-decode", parent=span.ctx)
               if span is not None else contextlib.nullcontext()):
             return codec.decode(want, chunks)
+
+    def _ec_repair(self, codec, lost: int, helpers: dict, L: int,
+                   span=None):
+        """Sub-chunk MSR repair (CLAY) — coalesced with concurrent
+        repairs of the same lost shard when batching is engaged (a
+        storm rebuilding one downed OSD's shard across many objects is
+        exactly one repair signature)."""
+        if self._ec_batch_on(codec):
+            if span is not None:
+                with self.tracer.start("ec-repair",
+                                       parent=span.ctx) as sp:
+                    return self._ec_batcher.repair(
+                        codec, lost, helpers, L,
+                        trace=(self.tracer, sp.ctx))
+            return self._ec_batcher.repair(codec, lost, helpers, L)
+        with (self.tracer.start("ec-repair", parent=span.ctx)
+              if span is not None else contextlib.nullcontext()):
+            return codec.repair_chunk(lost, helpers, L)
+
+    def _rec_trace(self, pgid: PgId) -> tuple:
+        """Wire trace context of this PG's recovery-storm root span —
+        () when the storm was not sampled.  Rides MPGPush/MPGPull so
+        peers parent their per-push/pull apply spans under the storm
+        root (cross-daemon recovery waterfalls)."""
+        with self._pending_lock:
+            sp = self._rec_spans.get(pgid)
+        return sp.ctx if sp is not None else ()
 
     # ----------------------------------------------------------- pg log
     def _pglog(self, pgid: PgId) -> PGLog:
@@ -3127,10 +3170,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 agreed = sum(1 for v in pr.shard_vers.values() if v == vmax)
                 if agreed < k and pr.replies < pr.total_shards:
                     return
-            elif len(pr.chunks) < k and pr.replies < pr.total_shards:
+            elif (pr.want_all or len(pr.chunks) < k) \
+                    and pr.replies < pr.total_shards:
                 # finish as soon as enough chunks to decode are present —
                 # no waiting for parity stragglers (ReadPipeline returns
-                # at k); callback readers judge sufficiency themselves
+                # at k); callback readers judge sufficiency themselves.
+                # want_all readers (sub-chunk repairs: the MSR solve
+                # consumes every helper) always wait the full fan-out.
                 return
             self._pending_reads.pop(tid, None)
         self._finish_ec_read(pr)
@@ -4546,7 +4592,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 del names[name]
         if removes and peer != self.osd_id:
             self.messenger.send_message(
-                f"osd.{peer}", MPGPush(pgid, -3, {}, removes))
+                f"osd.{peer}", MPGPush(pgid, -3, {}, removes,
+                                       trace=self._rec_trace(pgid)))
         if pool.kind == "ec":
             for shard, osd in enumerate(up):
                 if osd != peer:
@@ -4574,7 +4621,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 if push:
                     self.perf.inc("recovery_push", len(push))
                     self.messenger.send_message(
-                        f"osd.{peer}", MPGPush(pgid, -1, push))
+                        f"osd.{peer}",
+                        MPGPush(pgid, -1, push,
+                                trace=self._rec_trace(pgid)))
 
             self._recovery_op(pgid, peer, push_delta,
                               nbytes=sum(self._rec_weight(pgid, n)
@@ -4628,7 +4677,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         continue
                 if out or deletes:
                     self.messenger.send_message(
-                        f"osd.{peer}", MPGPush(pgid, -1, out, deletes))
+                        f"osd.{peer}",
+                        MPGPush(pgid, -1, out, deletes,
+                                trace=self._rec_trace(pgid)))
 
             self._recovery_op(pgid, peer, push_objs,
                               nbytes=sum(self._rec_weight(pgid, n)
@@ -4644,7 +4695,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._recovery_op(
                 pgid, peer,
                 lambda pull=list(pull): self.messenger.send_message(
-                    f"osd.{peer}", MPGPull(pgid, pull)),
+                    f"osd.{peer}",
+                    MPGPull(pgid, pull, trace=self._rec_trace(pgid))),
                 nbytes=len(pull))
             if peer_is_member:
                 temp = [peer] + [u for u in up
@@ -4654,20 +4706,29 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         return len(push) + len(deletes) + len(pull)
 
     def _handle_pg_pull(self, conn, m: MPGPull) -> None:
-        cid = CollectionId(m.pgid.pool, m.pgid.seed)
-        push = {}
-        for name in m.names:
-            obj = to_oid(name)
-            try:
-                data = self.store.read(cid, obj).to_bytes()
-                attrs = self.store.getattrs(cid, obj)
-                push[name] = (int(attrs.get("v", 0)), data, None,
-                              self.store.omap_get(cid, obj),
-                              self._push_attrs(attrs))
-            except NoSuchObject:
-                continue
-        if push:
-            conn.send(MPGPush(m.pgid, -1, push, force=m.force))
+        # a sampled storm's pull serve becomes a child span of the
+        # requesting primary's storm root (the carried wire ctx)
+        span_ctx = (self.tracer.start("recovery-pull-serve",
+                                      parent=tuple(m.trace),
+                                      pg=self._pgstr(m.pgid),
+                                      n_objects=len(m.names))
+                    if m.trace else contextlib.nullcontext())
+        with span_ctx:
+            cid = CollectionId(m.pgid.pool, m.pgid.seed)
+            push = {}
+            for name in m.names:
+                obj = to_oid(name)
+                try:
+                    data = self.store.read(cid, obj).to_bytes()
+                    attrs = self.store.getattrs(cid, obj)
+                    push[name] = (int(attrs.get("v", 0)), data, None,
+                                  self.store.omap_get(cid, obj),
+                                  self._push_attrs(attrs))
+                except NoSuchObject:
+                    continue
+            if push:
+                conn.send(MPGPush(m.pgid, -1, push, force=m.force,
+                                  trace=tuple(m.trace)))
 
     def _recover_ec(self, pgid, pool, up, peer, peer_inv, my_inv,
                     dead) -> int:
@@ -4697,7 +4758,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                 Transaction().remove(cid, obj))
             if peer != self.osd_id:
                 self.messenger.send_message(
-                    f"osd.{peer}", MPGPush(pgid, -3, {}, deletes))
+                    f"osd.{peer}", MPGPush(pgid, -3, {}, deletes,
+                                           trace=self._rec_trace(pgid)))
         scheduled += len(deletes)
         if peer not in [u for u in up if u is not None]:
             # demoted holder (notify path): migrate its stranded shards to
@@ -4928,6 +4990,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         """Copy one shard from a demoted holder to its current position
         holder (direct migration — no decode needed)."""
         tid = next(self._tids)
+        # storm ctx captured NOW: the storm accounting can drain (the
+        # scheduling thunk returns before the async reads do) and pop
+        # the root span before on_done fires
+        tctx = self._rec_trace(pgid)
 
         def on_done(pr) -> None:
             if pr is None or shard not in pr.chunks:
@@ -4941,7 +5007,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 f"osd.{dst}",
                 MPGPush(pgid, shard,
                         {name: (version, pr.chunks[shard].tobytes(),
-                                total, omap, extra)}))
+                                total, omap, extra)},
+                        trace=tctx))
 
         pr = _PendingRead(None, 0, pgid.pool, name, total_shards=1,
                           on_done=on_done)
@@ -4950,72 +5017,45 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             f"osd.{src}",
             MSubRead(tid, pgid, name, shard, klass="recovery"))
 
+    def _ec_narrow_on(self) -> bool:
+        return str(self.cfg["osd_ec_repair_narrow"]).lower() != "off"
+
+    def _rebuild_fetch_set(self, codec, shard: int,
+                           fan: list) -> set[int] | None:
+        """Positions a single-shard rebuild actually needs to read —
+        the codec's minimum_to_decode set when it is NARROWER than a
+        k-wide read (LRC: the lost chunk's locality group; SHEC: one
+        shingle window).  None = read every holder (plain RS reads k+
+        survivors anyway, and multi-failure/scrub paths want the full
+        inventory)."""
+        avail = [s for s, src in enumerate(fan)
+                 if src is not None and s != shard
+                 and s < codec.chunk_count]
+        try:
+            need = codec.minimum_to_decode([shard], avail)
+        except Exception:  # noqa: BLE001 - undecodable now: wide fan
+            return None
+        need = [s for s in need if s != shard]
+        if len(need) >= codec.k:
+            return None
+        return set(need)
+
     def _rebuild_shard(self, pgid, name, shard, peer, version,
-                       force: bool = False) -> None:
-        """Reconstruct one shard from k survivors, then push it."""
+                       force: bool = False, wide: bool = False) -> None:
+        """Reconstruct one shard from survivors, then push it.
+
+        Repair-bandwidth-optimal fetch (osd_ec_repair_narrow): a plain
+        single-failure rebuild asks the codec what it MINIMALLY needs —
+        a sub-chunk codec at the MSR point (CLAY, d=k+m-1) reads only
+        the alpha/q repair-plane byte ranges from each helper
+        (_rebuild_shard_subchunk), a locality code reads one narrow
+        group (LRC: |group| < k shards; SHEC: one shingle) — and only
+        falls back to the k-wide whole-shard fan-out (``wide=True``,
+        today's behavior) when the narrow read cannot produce a
+        version-agreed decodable set."""
         up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
         codec = self._pool_codec(pgid.pool)
-        tid = next(self._tids)
-
-        def on_done(pr) -> None:
-            if pr is None or (len(pr.chunks) < codec.k
-                              and shard not in pr.chunks):
-                # not enough survivors NOW; a later peering/requery round
-                # retries (never leave a hole with no retry scheduled)
-                self._requery_pg(pgid)
-                return
-            chunks = pr.chunks
-            push_version = version
-            if pr.shard_vers:
-                # rebuild only from a version-AGREED survivor set: mixing
-                # a stale shard into the decode would fabricate garbage
-                # stamped with the new version
-                vmax = max(pr.shard_vers.values())
-                cand = {s: c for s, c in chunks.items()
-                        if pr.shard_vers.get(s) == vmax}
-                if len(cand) >= codec.k or (shard in cand and not force):
-                    chunks = cand
-                    # stamp what the agreed set actually decodes — NOT
-                    # the requested version: a rebuild scheduled from a
-                    # pre-rollback inventory would otherwise fabricate
-                    # old bytes labelled with the rolled-back version,
-                    # re-tearing the stripe it was meant to heal
-                    push_version = vmax
-                else:
-                    self._requery_pg(pgid, force_full=True)
-                    return  # no consistent set yet; the requery retries
-            if shard in chunks and not force:
-                rebuilt = chunks[shard]
-            else:
-                # scrub repair must NOT trust the (possibly corrupt)
-                # existing shard copy: always re-derive it
-                chunks = {i: c for i, c in chunks.items() if i != shard} \
-                    if force else chunks
-                if len(chunks) < codec.k:
-                    self._requery_pg(pgid)
-                    return
-                out = self._ec_decode(codec, [shard], dict(chunks))
-                rebuilt = out[shard]
-            total = self._ec_total_len(pr)
-            self.perf.inc("recovery_push")
-            # metadata travels with the rebuild — from a SURVIVING
-            # shard's reply when available (the pushing primary's own
-            # copy may itself be the one missing)
-            omap, extra = self._ec_meta_for(pgid, name)
-            for s in chunks:
-                if s in pr.omaps:
-                    omap = pr.omaps[s]
-                    break
-            src = next((s for s in chunks if s in pr.shard_attrs), None)
-            if src is not None:
-                extra = self._push_attrs(pr.shard_attrs[src])
-            self.messenger.send_message(
-                f"osd.{peer}",
-                MPGPush(pgid, shard,
-                        {name: (push_version, rebuilt.tobytes(), total,
-                                omap, extra)},
-                        force=force))
-
+        narrow = self._ec_narrow_on() and not force and not wide
         # shard -> source OSD: the position holder when it (plausibly)
         # has the shard, else ANY holder the collected inventories
         # revealed — after a PG split the shards sit on strays and
@@ -5034,12 +5074,273 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             if osd_id != peer and (name, s) in inv),
                            None)
             fan.append(src)
+        if narrow and self._rebuild_shard_subchunk(pgid, name, shard,
+                                                   peer, version, fan,
+                                                   up, codec):
+            return
+        fetch = self._rebuild_fetch_set(codec, shard, fan) \
+            if narrow else None
+        if fetch is not None:
+            # the lost position itself stays in the fan: a stray still
+            # holding the shard supplies it directly (no decode at all)
+            fan = [src if (s in fetch or s == shard) else None
+                   for s, src in enumerate(fan)]
+        tid = next(self._tids)
+        # storm ctx captured NOW, not at push time: the storm's op
+        # accounting drains when the scheduling thunks return (the
+        # shard reads are async), which can pop the root span before
+        # on_done runs
+        tctx = self._rec_trace(pgid)
+
+        def retry_wide() -> None:
+            # narrow read insufficient (stale/missing group member):
+            # one wide retry — the full fan-out sees every holder
+            self.perf.inc("recovery_wide_retries")
+            self._rebuild_shard(pgid, name, shard, peer, version,
+                                force=force, wide=True)
+
+        def enough(cand: dict) -> bool:
+            if shard in cand and not force:
+                return True
+            return (fetch <= set(cand) if fetch is not None
+                    else len(cand) >= codec.k)
+
+        def on_done(pr) -> None:
+            if pr is None or not enough(pr.chunks):
+                # not enough survivors NOW; a narrow read retries wide,
+                # else a later peering/requery round retries (never
+                # leave a hole with no retry scheduled)
+                if fetch is not None:
+                    retry_wide()
+                else:
+                    self._requery_pg(pgid)
+                return
+            chunks = pr.chunks
+            push_version = version
+            if pr.shard_vers:
+                # rebuild only from a version-AGREED survivor set: mixing
+                # a stale shard into the decode would fabricate garbage
+                # stamped with the new version
+                vmax = max(pr.shard_vers.values())
+                cand = {s: c for s, c in chunks.items()
+                        if pr.shard_vers.get(s) == vmax}
+                if enough(cand):
+                    chunks = cand
+                    # stamp what the agreed set actually decodes — NOT
+                    # the requested version: a rebuild scheduled from a
+                    # pre-rollback inventory would otherwise fabricate
+                    # old bytes labelled with the rolled-back version,
+                    # re-tearing the stripe it was meant to heal
+                    push_version = vmax
+                elif fetch is not None:
+                    retry_wide()
+                    return
+                else:
+                    self._requery_pg(pgid, force_full=True)
+                    return  # no consistent set yet; the requery retries
+            if shard in chunks and not force:
+                rebuilt = chunks[shard]
+            else:
+                # scrub repair must NOT trust the (possibly corrupt)
+                # existing shard copy: always re-derive it
+                chunks = {i: c for i, c in chunks.items() if i != shard} \
+                    if force else chunks
+                if not enough(chunks):
+                    self._requery_pg(pgid)
+                    return
+                try:
+                    out = self._ec_decode(codec, [shard], dict(chunks))
+                except Exception:  # noqa: BLE001 - narrow set fell short
+                    if fetch is not None:
+                        retry_wide()
+                        return
+                    raise
+                rebuilt = out[shard]
+                self.perf.inc("recovery_fetch_bytes",
+                              sum(c.nbytes for c in chunks.values()))
+                self.perf.inc("recovery_rebuilt_bytes", rebuilt.nbytes)
+                if fetch is not None:
+                    self.perf.inc("recovery_narrow_rebuilds")
+            total = self._ec_total_len(pr)
+            self.perf.inc("recovery_push")
+            # metadata travels with the rebuild — from a SURVIVING
+            # shard's reply when available (the pushing primary's own
+            # copy may itself be the one missing)
+            omap, extra = self._ec_meta_for(pgid, name)
+            for s in chunks:
+                if s in pr.omaps:
+                    omap = pr.omaps[s]
+                    break
+            src = next((s for s in chunks if s in pr.shard_attrs), None)
+            if src is not None:
+                extra = self._push_attrs(pr.shard_attrs[src])
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MPGPush(pgid, shard,
+                        {name: (push_version, rebuilt.tobytes(), total,
+                                omap, extra)},
+                        force=force, trace=tctx))
+
         pr = _PendingRead(None, 0, pgid.pool, name,
                           total_shards=sum(1 for u in fan
                                            if u is not None),
                           on_done=on_done)
         self._pending_reads[tid] = pr
         self._fan_shard_reads(tid, pgid, name, fan, klass="recovery")
+
+    def _subchunk_repair_plan(self, pgid: PgId, name: str, shard: int,
+                              fan: list, up: list, peer: int,
+                              codec) -> dict | None:
+        """Fetch plan for a sub-chunk (CLAY MSR) rebuild of `shard`, or
+        None when the bandwidth-optimal repair does not apply: needs
+        the REQUIRE_SUB_CHUNKS repair surface at the MSR point (m == q,
+        i.e. d = k+m-1), a live source for EVERY other position (the
+        column solve consumes all n-1 helpers), a known object length,
+        and a chunk size the plane grid divides.  A position the
+        inventory scan left sourceless still tries its live map holder
+        — a lean peering round simply hasn't shipped that inventory
+        yet, and a holder genuinely missing the object answers ENOENT,
+        which retries wide (the designed fallback)."""
+        from ..ec.interface import Flags as ECFlags
+        if not (codec.get_flags() & ECFlags.REQUIRE_SUB_CHUNKS):
+            return None
+        if not hasattr(codec, "repair_chunk") \
+                or getattr(codec, "q", None) != codec.m:
+            return None
+        n = codec.chunk_count
+        if shard >= n or len(up) < n:
+            return None
+        sources: dict[int, int] = {}
+        for s in range(n):
+            if s == shard:
+                continue
+            src = fan[s] if s < len(fan) else None
+            if src is None and up[s] is not None and up[s] != peer:
+                src = up[s]
+            if src is None:
+                return None
+            sources[s] = src
+        helpers = sorted(sources)
+        if len(helpers) != n - 1:
+            return None
+        total = self._ec_object_len(pgid, name)
+        if not total:
+            return None
+        si = self._pool_stripe(pgid.pool)
+        # sub-chunk layout: the write path encodes the WHOLE write's
+        # shard stream as ONE codec chunk (streams are (k, rows*cs)),
+        # and the degraded-read decode splits whole streams the same
+        # way — so the repair plan's plane grid spans the whole shard
+        # stream too (sub-chunk = stream/alpha), NOT per stripe row.
+        # Both are exact for full-stream writes, the only write shape
+        # REQUIRE_SUB_CHUNKS pools take through this daemon.
+        shard_len = si.object_chunk_size(total)
+        alpha = codec.alpha
+        if shard_len <= 0 or shard_len % alpha:
+            return None
+        sub = shard_len // alpha
+        planes = codec.repair_planes(shard)
+        # contiguous plane indices merge into few ranged extents
+        runs: list[tuple[int, int]] = []
+        start = prev = planes[0]
+        for z in planes[1:]:
+            if z == prev + 1:
+                prev = z
+                continue
+            runs.append((start, prev - start + 1))
+            start = prev = z
+        runs.append((start, prev - start + 1))
+        extents = [(z0 * sub, cnt * sub) for z0, cnt in runs]
+        return {"helpers": helpers, "sources": sources,
+                "extents": extents, "planes": planes,
+                "shard_len": shard_len, "sub": sub}
+
+    def _rebuild_shard_subchunk(self, pgid, name, shard, peer, version,
+                                fan: list, up: list, codec) -> bool:
+        """Bandwidth-optimal single-shard rebuild for sub-chunk codecs
+        (CLAY at d = k+m-1): fetch only the alpha/q repair-plane byte
+        ranges from each of the n-1 helpers — (n-1)/q of the bytes a
+        k-wide whole-shard read moves — and solve the lost chunk with
+        the codec's repair path (folded across the storm by the
+        batcher).  Returns False when the plan does not apply (caller
+        falls through to the plain fan-out); any mid-flight
+        insufficiency retries wide."""
+        plan = self._subchunk_repair_plan(pgid, name, shard, fan, up,
+                                          peer, codec)
+        if plan is None:
+            return False
+        # the rebuilt shard must land WITH its replicated metadata, and
+        # ranged replies ship only the verification attrs — so the
+        # metadata must come from a local shard copy; without one the
+        # wide whole-shard read (which carries omap+attrs) is the
+        # correct path
+        omap, extra = self._ec_meta_for(pgid, name)
+        if not extra and omap is None:
+            return False
+        helpers, extents = plan["helpers"], plan["extents"]
+        sub, P = plan["sub"], len(plan["planes"])
+        per_helper = P * sub
+        tid = next(self._tids)
+        # storm ctx captured now (see _rebuild_shard)
+        tctx = self._rec_trace(pgid)
+
+        def retry_wide() -> None:
+            self.perf.inc("recovery_wide_retries")
+            self._rebuild_shard(pgid, name, shard, peer, version,
+                                wide=True)
+
+        def on_done(pr) -> None:
+            if pr is None or not all(
+                    h in pr.chunks and pr.chunks[h].size == per_helper
+                    for h in helpers):
+                retry_wide()
+                return
+            push_version = version
+            if pr.shard_vers:
+                # the MSR solve mixes every helper's symbols: ALL n-1
+                # must agree on one version (cf. the agreed-k rule)
+                vers = {pr.shard_vers.get(h) for h in helpers}
+                if len(vers) != 1 or None in vers:
+                    retry_wide()
+                    return
+                push_version = vers.pop()
+            sub_arrs = {h: np.asarray(pr.chunks[h],
+                                      dtype=np.uint8).reshape(P, sub)
+                        for h in helpers}
+            try:
+                rebuilt = self._ec_repair(codec, shard, sub_arrs,
+                                          plan["shard_len"])
+            except Exception:  # noqa: BLE001 - solve failed: go wide
+                retry_wide()
+                return
+            self.perf.inc("recovery_fetch_bytes",
+                          per_helper * len(helpers))
+            self.perf.inc("recovery_rebuilt_bytes", rebuilt.nbytes)
+            self.perf.inc("recovery_subchunk_rebuilds")
+            self.perf.inc("recovery_push")
+            total = self._ec_total_len(pr)
+            self.messenger.send_message(
+                f"osd.{peer}",
+                MPGPush(pgid, shard,
+                        {name: (push_version, rebuilt.tobytes(), total,
+                                omap, extra)},
+                        trace=tctx))
+
+        pr = _PendingRead(None, 0, pgid.pool, name,
+                          total_shards=len(helpers), on_done=on_done,
+                          want_all=True)
+        self._pending_reads[tid] = pr
+        for s in helpers:
+            osd = plan["sources"][s]
+            if osd == self.osd_id:
+                self._deliver_local_shard_read(tid, pgid, name, s,
+                                               extents)
+            else:
+                self.messenger.send_message(
+                    f"osd.{osd}",
+                    MSubRead(tid, pgid, name, s, list(extents),
+                             klass="recovery"))
+        return True
 
     def _ec_meta_for(self, pgid: PgId, name: str):
         """(omap, user attrs) from MY shard copy of an EC object —
@@ -5064,6 +5365,22 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 if k not in ("v", "len", "d")}
 
     def _handle_pg_push(self, conn, m: MPGPush) -> None:
+        # per-push child span of the sender's storm root (the carried
+        # wire ctx): a sampled recovery storm's merged waterfall shows
+        # every push apply cross-daemon (ROADMAP telemetry (b))
+        if m.trace:
+            with self.tracer.start("recovery-push-apply",
+                                   parent=tuple(m.trace),
+                                   pg=self._pgstr(m.pgid),
+                                   n_objects=len(m.objects),
+                                   n_deletes=len(m.deletes),
+                                   nbytes=sum(len(p[1]) for p
+                                              in m.objects.values())):
+                self._apply_pg_push(conn, m)
+            return
+        self._apply_pg_push(conn, m)
+
+    def _apply_pg_push(self, conn, m: MPGPush) -> None:
         cid = CollectionId(m.pgid.pool, m.pgid.seed)
         for name, version in m.deletes.items():
             self._record_tombstone(m.pgid, name, version)

@@ -102,6 +102,17 @@ def configs() -> list[dict]:
                 "argv": ["--ec-batch"]})
     out.append({"id": "ec_recovery_storm", "tool": "bench_root",
                 "argv": ["--ec-recovery"]})
+    # 6b. wide/local codes through the batching seam (ISSUE 11): the
+    # {rs, clay, lrc, shec} x {healthy, degraded, storm} matrix's
+    # compact regression row — repair-bytes-per-lost-byte per plugin
+    # (LRC/SHEC/CLAY strictly below plain RS is the gate, enforced by
+    # bench.py's exit code) + degraded p99 trajectory per plugin
+    out.append({"id": "ec_wide_repair", "tool": "bench_root",
+                "argv": ["--ec-recovery"],
+                "extract": ["wide_repair_bytes_per_lost_byte",
+                            "wide_degraded_p99_ms",
+                            "wide_locality_beats_rs",
+                            "wide_ok", "digest_verified"]})
     # 7. the client-facing read pipeline: coalesced MSubReadN fan-out +
     # batched degraded decode vs the per-op baseline (8-reader burst
     # through a real MiniCluster; healthy/hot/ranged/degraded legs)

@@ -339,6 +339,16 @@ OPTIONS: list[Option] = [
     Option("osd_max_backfills", int, 2, OptionLevel.ADVANCED,
            "max PGs concurrently holding a local (and, per target, "
            "remote) recovery reservation on this OSD", min=1),
+    Option("osd_ec_repair_narrow", str, "on", OptionLevel.ADVANCED,
+           "repair-bandwidth-optimal shard rebuilds: single-failure "
+           "rebuilds fetch only the codec's minimum_to_decode set "
+           "(LRC: one locality group; SHEC: one shingle window) and, "
+           "for sub-chunk codecs at d=k+m-1 (CLAY), only the alpha/q "
+           "repair-plane byte ranges per helper instead of whole "
+           "shards; an insufficient narrow read retries wide "
+           "automatically.  off = always fetch every holder's whole "
+           "shard (the pre-narrow behavior)",
+           enum_values=("on", "off")),
     Option("osd_recovery_max_active", int, 4, OptionLevel.ADVANCED,
            "max recovery data-movement ops initiated concurrently",
            min=1),

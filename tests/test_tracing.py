@@ -245,7 +245,7 @@ def test_batcher_coalesced_ops_trace_spans():
     assert fl["tags"]["n_shard"] == 1
     # 2 ops of 1000 cols in a pow2-padded 2x1024 fold
     assert abs(fl["tags"]["pad_waste"] - (1 - 2000 / 2048)) < 1e-4
-    assert fl["tags"]["sig"].startswith("enc/k4m2")
+    assert fl["tags"]["sig"].startswith("enc/mat/k4m2")  # kind/codec/k.m
     for w in waits:
         assert w["tags"]["flush_span"] == fl["span_id"]
         assert w["tags"]["flush_reason"] == "window"
