@@ -1233,6 +1233,14 @@ def saturate_bench(args) -> int:
     from ceph_tpu.load.scenarios import (ScenarioConfig,
                                          default_sweep_points,
                                          run_sweep)
+    if args.tenants:
+        if args.frontend != "rados":
+            print("--saturate --tenants drives librados only; the "
+                  "rgw front-end leg runs through the plain "
+                  "--saturate sweep (--frontend rgw without "
+                  "--tenants)", file=sys.stderr)
+            return 2
+        return saturate_tenants_bench(args)
     if args.smoke:
         base = ScenarioConfig(
             profile=args.profile, procs=args.procs,
@@ -1249,6 +1257,7 @@ def saturate_bench(args) -> int:
             steady_s=args.steady_s, thrash_s=args.thrash_s,
             kill_after_s=1.0, recovery_deadline_s=45.0)
         points = default_sweep_points()
+    base.frontend = args.frontend
     row = run_sweep(points=points, base=base)
     mid = row["points"][len(row["points"]) // 2]
     steady = mid["steady"]
@@ -1257,9 +1266,10 @@ def saturate_bench(args) -> int:
     print(json.dumps({
         "metric": (f"saturation client ops/s ({base.profile} profile, "
                    f"{base.procs}-proc x {base.clients}-client burst, "
-                   f"ec k=2 m=1 over TCP, mclock sweep "
-                   f"{[p['id'] for p in points]}, "
+                   f"ec k=2 m=1 over TCP via {base.frontend}, mclock "
+                   f"sweep {[p['id'] for p in points]}, "
                    "structural-invariant gated)"),
+        "frontend": base.frontend,
         "value": value,
         "unit": "ops/s",
         "vs_baseline": (round(value / offered, 3) if offered else None),
@@ -1281,6 +1291,42 @@ def saturate_bench(args) -> int:
                        for p in row["points"]},
         "points": row["points"],
         "ok": row["ok"],
+    }))
+    return 0 if row["ok"] else 1
+
+
+def saturate_tenants_bench(args) -> int:
+    """`--saturate --tenants` mode: the multi-tenant QoS gate — four
+    aligned per-tenant load streams (gold reserved, silver/bronze
+    weight-only, bulk best-effort) through the PR-7 harness against
+    one cluster whose OSDMap carries the committed tenant profiles,
+    with a kill/revive storm mid-run and the adaptive reservation
+    controller live.  ONE JSON row, exit-gated on the three isolation
+    invariants: a flooding bulk tenant cannot push the reserved
+    tenant's p99 outside its envelope, weights split excess capacity
+    proportionally within slack, and the controller converges the
+    recovery reservation between the hand-tuned sweep points."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ceph_tpu.load.scenarios import (TenantScenarioConfig,
+                                         run_tenant_point)
+    if args.smoke:
+        cfg = TenantScenarioConfig(
+            objects=20, solo_s=2.0, flood_s=3.0, settle_s=1.0,
+            weights_s=2.5, thrash_s=4.0, kill_after_s=0.8,
+            solo_rate=24.0, flood_rate=96.0, thrash_rate=32.0,
+            recovery_deadline_s=30.0)
+    else:
+        cfg = TenantScenarioConfig()
+    row = run_tenant_point(cfg)
+    print(json.dumps({
+        "metric": ("tenant isolation ratio (gold flood-p99 / solo-p99 "
+                   "under a bulk flood; 4 tenant streams, ec k=2 m=1 "
+                   "over TCP, adaptive controller live, isolation-"
+                   "invariant gated)"),
+        "value": row["tenant_isolation_ratio"],
+        "unit": "x",
+        "vs_baseline": None,
+        **row,
     }))
     return 0 if row["ok"] else 1
 
@@ -1371,6 +1417,16 @@ def main() -> int:
     sat.add_argument("--smoke", action="store_true",
                      help="one tier-1-safe point: tens of clients, "
                           "seconds-bounded, no cross-point QoS gate")
+    sat.add_argument("--tenants", action="store_true",
+                     help="with --saturate: the multi-tenant QoS gate "
+                          "(per-tenant dmclock streams, reserved-p99 "
+                          "envelope under flood, proportional weight "
+                          "split, adaptive-controller convergence)")
+    sat.add_argument("--frontend", default="rados",
+                     choices=("rados", "rgw"),
+                     help="with --saturate: drive librados directly "
+                          "or the RgwGateway PUT/GET object path "
+                          "(same legs, histograms and invariants)")
     sat.add_argument("--procs", type=int, default=2,
                      help="load-generator worker processes")
     sat.add_argument("--clients", type=int, default=16,

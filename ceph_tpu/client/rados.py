@@ -77,8 +77,21 @@ class RadosClient(Dispatcher):
                  mon: str = "mon.0", timeout: float = 10.0,
                  mons: list | None = None,
                  auth_entity: str | None = None,
-                 auth_key: bytes | None = None):
+                 auth_key: bytes | None = None,
+                 tenant: str | None = None):
         self.name = name
+        # multi-tenant QoS identity (qos/dmclock.py): with a tenant
+        # set, every op carries dmclock (delta, rho) tags computed by
+        # a per-client ServiceTracker and the tenant name, and every
+        # reply's served-phase feeds the tracker back — the client
+        # half of per-tenant mclock shaping.  None = untagged ops
+        # (the default stream), zero per-op cost.
+        self.tenant = tenant or None
+        if self.tenant:
+            from ..qos.dmclock import ServiceTracker
+            self.qos_tracker: ServiceTracker | None = ServiceTracker()
+        else:
+            self.qos_tracker = None
         # cephx identity (CephXTicketManager role): with a key, every
         # op carries a mon-issued ticket + proof; tickets renew
         # automatically as they approach expiry
@@ -396,6 +409,13 @@ class RadosClient(Dispatcher):
                        # stay local for retroactive slow-op retention)
                        trace=root.ctx if root is not None
                        and root.sampled else ())
+            if self.tenant:
+                # dmclock tags: how much service this tenant received
+                # cluster-wide since its last request to THIS osd —
+                # the server advances its tenant clocks by rho/R and
+                # delta/W, so N osds grant ONE reservation, not N
+                m.tenant = self.tenant
+                m.qdelta, m.qrho = self.qos_tracker.tags_for(target)
             if op in self._WRITE_OPS:
                 seq, snaps = self._snapc.get(pool_id, (0, []))
                 m.snap_seq, m.snaps = seq, list(snaps)
@@ -412,9 +432,18 @@ class RadosClient(Dispatcher):
                 # (the Objecter resend-on-map-change behaviour)
                 dout("client", 5)("%s: rpc timeout to %s, retrying",
                                  self.name, target)
+                if self.qos_tracker is not None:
+                    # reconnect reset: the osd's dmclock state for us
+                    # dies with the connection — restart at (1, 1)
+                    self.qos_tracker.forget(target)
                 last_error = e
                 self._wait_epoch_past(self.osdmap.epoch, self.timeout)
                 continue
+            if self.qos_tracker is not None:
+                # phase feedback: reservation-phase service elsewhere
+                # is what advances rho on the NEXT osd we talk to
+                self.qos_tracker.note_reply(
+                    target, getattr(reply, "qphase", 0))
             if reply.result == -11:  # EAGAIN: PG peering/recovering
                 time.sleep(min(0.05 * 2 ** attempt, 1.0))
                 last_error = RadosError(-11, "pg peering")

@@ -40,7 +40,10 @@ class LoadGenerator:
 
     def __init__(self, mon_addr: str, pool: str, objects: int,
                  legs: list[LegSpec], procs: int = 2, seed: int = 0,
-                 client_timeout: float = 15.0):
+                 client_timeout: float = 15.0,
+                 tenant: str | None = None,
+                 tenants: list | None = None,
+                 frontend: str = "rados"):
         self.mon_addr = mon_addr
         self.pool = pool
         self.objects = int(objects)
@@ -48,6 +51,16 @@ class LoadGenerator:
         self.procs = max(1, int(procs))
         self.seed = int(seed)
         self.client_timeout = float(client_timeout)
+        # QoS identity: every simulated client of this generator
+        # stamps its ops with a tenant's dmclock tags — one name for
+        # the whole stream, or a list assigned round-robin per client
+        # (competing tenants inside ONE worker process)
+        self.tenant = tenant
+        self.tenants = list(tenants) if tenants else None
+        # "rados" drives librados directly; "rgw" drives the
+        # RgwGateway PUT/GET object path (the S3 front-end leg) —
+        # same legs, histograms and invariants either way
+        self.frontend = frontend
         self.start_at: float | None = None
         self.procs_alive: list[subprocess.Popen] = []
 
@@ -75,13 +88,24 @@ class LoadGenerator:
     def launch(self) -> None:
         """Spawn workers, wait for every ready line, send the shared
         go timestamp.  Returns once the start instant is agreed."""
+        self.spawn()
+        self.go()
+
+    def spawn(self) -> None:
+        """Spawn workers and wait for every ready line — WITHOUT
+        sending go.  Callers coordinating several generators (one per
+        tenant stream) spawn them all first, then go() them onto one
+        shared start instant so their leg clocks align."""
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH",
                                                         "")
         env["JAX_PLATFORMS"] = "cpu"
         spec = {"pool": self.pool, "objects": self.objects,
                 "legs": self._worker_legs(), "seed": self.seed,
-                "client_timeout": self.client_timeout}
+                "client_timeout": self.client_timeout,
+                "tenant": self.tenant or "",
+                "tenants": self.tenants or [],
+                "frontend": self.frontend}
         self.procs_alive = [
             subprocess.Popen(
                 [sys.executable, "-m", "ceph_tpu.load.load_worker",
@@ -124,7 +148,11 @@ class LoadGenerator:
                         f"worker {i} never became ready "
                         f"(rc={proc.returncode}): {err[-2000:]}")
                 time.sleep(0.02)
-        self.start_at = time.time() + 0.5
+
+    def go(self, start_at: float | None = None) -> None:
+        """Send the shared go timestamp to every (ready) worker."""
+        self.start_at = start_at if start_at is not None \
+            else time.time() + 0.5
         go = json.dumps({"go": self.start_at}) + "\n"
         try:
             for proc in self.procs_alive:

@@ -62,7 +62,7 @@ def _run_closed_leg(leg, clients, objects, pool, rng, result, deadline,
             lat_us = (time.perf_counter() - t0) * 1e6
             with lock:
                 result.achieved += 1
-                result.hist(klass).record(lat_us)
+                result.hist(_hist_key(cl, klass)).record(lat_us)
 
     threads = [threading.Thread(target=client_loop, args=(i,),
                                 daemon=True)
@@ -74,8 +74,10 @@ def _run_closed_leg(leg, clients, objects, pool, rng, result, deadline,
     # a few clients stuck in a thrash retry chain must not stall the
     # worker per-thread or eat the NEXT leg's absolute window down to
     # zero — stragglers are daemons, their late completions still land
-    # in THIS leg's result object
-    join_by = deadline + min(8.0, max(2.0, leg.duration_s / 2))
+    # in THIS leg's result object.  (A single op riding out one rpc
+    # timeout is the common straggler; multi-leg tenant timelines
+    # cannot afford waiting out a whole retry chain.)
+    join_by = deadline + min(6.0, max(1.0, leg.duration_s / 4))
     for t in threads:
         t.join(timeout=max(0.0, join_by - time.time()))
     result.wall_s = time.time() - t0
@@ -114,7 +116,7 @@ def _run_open_leg(leg, clients, objects, pool, rng, result, deadline,
         lat_us = (time.time() - arrival) * 1e6
         with lock:
             result.achieved += 1
-            result.hist(klass).record(max(1.0, lat_us))
+            result.hist(_hist_key(cl, klass)).record(max(1.0, lat_us))
 
     next_at = t_start
     i = 0
@@ -151,6 +153,52 @@ def _zipf(prof, objects, rng):
     return ZipfSampler(len(objects), prof.zipf_alpha, rng)
 
 
+def _hist_key(cl, klass: str) -> str:
+    """Histogram key for one op: tenant-prefixed ("gold:read") when
+    the worker mixes tenants — competing tenants run inside ONE
+    process so OS scheduling starves them EQUALLY, and the per-tenant
+    split stays readable in the merged result."""
+    return getattr(cl, "_hist_prefix", "") + klass
+
+
+class _RgwClient:
+    """RadosClient-shaped adapter over the RgwGateway object path (the
+    S3 front-end leg of the harness): the leg runners call
+    read/write_full exactly as they do against librados, so the load
+    model — profiles, histograms, invariants — is front-end agnostic
+    by construction.  Drives the gateway's store methods directly
+    (put_object/get_object), the same code path the HTTP handlers
+    call, without paying an HTTP hop the QoS layer never sees."""
+
+    def __init__(self, client, pool: str, bucket: str):
+        self._client = client
+        self._gw = None
+        self._pool = pool
+        self._bucket = bucket
+
+    def _gateway(self):
+        if self._gw is None:
+            from ..services.rgw import RgwGateway
+            # store-only: the load loop measures the object path, not
+            # an HTTP hop the QoS layer never sees (and N listeners
+            # per worker would be pure waste)
+            self._gw = RgwGateway(self._client, self._pool,
+                                  listen=False)
+        return self._gw
+
+    def read(self, pool: str, oid: str) -> bytes:
+        data, _meta, _code = self._gateway().get_object(self._bucket,
+                                                        oid)
+        return data
+
+    def write_full(self, pool: str, oid: str, data: bytes) -> int:
+        self._gateway().put_object(self._bucket, oid, data)
+        return 0
+
+    def close(self) -> None:
+        self._client.close()
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="saturation load worker")
     ap.add_argument("--mon-addr", required=True)
@@ -185,19 +233,42 @@ def main(argv=None) -> int:
 
     net = TcpNetwork()
     net.set_addr("mon.0", args.mon_addr)
+    # tenant identity: one name for the whole worker, or a LIST
+    # assigned round-robin per client — competing tenants sharing one
+    # process starve equally under CPU pressure, so their server-side
+    # split stays a scheduler measurement, not an OS-scheduling one
+    tenants = spec.get("tenants") \
+        or ([spec.get("tenant")] if spec.get("tenant") else [])
+    multi = len(set(tenants)) > 1
+    frontend = spec.get("frontend", "rados")
     clients = []
     try:
         for i in range(n_clients):
-            clients.append(RadosClient(
+            tenant = tenants[i % len(tenants)] if tenants else None
+            # connect with a generous deadline (cold cluster + N
+            # workers racing startup), then drop to the leg-honest op
+            # timeout once the map is in hand
+            cl = RadosClient(
                 net, f"client.ldw{args.worker_id}x{i}",
-                mons=["mon.0"], timeout=timeout).connect())
+                mons=["mon.0"], timeout=max(timeout, 8.0),
+                tenant=tenant).connect()
+            cl.timeout = timeout
+            if frontend == "rgw":
+                # S3 front-end leg: same leg runners, the ops go
+                # through the RgwGateway object path (bucket == pool
+                # name; the scenario pre-created bucket + objects)
+                cl = _RgwClient(cl, pool, pool)
+            cl._hist_prefix = f"{tenant}:" if (multi and tenant) \
+                else ""
+            clients.append(cl)
     except Exception as e:  # noqa: BLE001 - report, don't traceback-spam
         print(json.dumps({"worker": args.worker_id, "ok": False,
                           "error": f"connect: {e!r}"}), flush=True)
         return 1
 
     print(json.dumps({"ready": True, "worker": args.worker_id,
-                      "clients": n_clients}), flush=True)
+                      "clients": n_clients, "tenants": tenants,
+                      "frontend": frontend}), flush=True)
     line = sys.stdin.readline()
     try:
         t0 = float(json.loads(line)["go"])
@@ -241,7 +312,13 @@ def main(argv=None) -> int:
                       "legs": {n: r.to_dict()
                                for n, r in results.items()}}),
           flush=True)
-    return 0
+    sys.stdout.flush()
+    # hard exit: open-loop legs leave non-daemon executor threads
+    # stuck in timeout/retry chains against a saturated (or thrashed)
+    # cluster — the results are already on stdout, and waiting for
+    # those threads to drain would read as a deadlock-invariant trip
+    # in the parent
+    os._exit(0)
 
 
 if __name__ == "__main__":

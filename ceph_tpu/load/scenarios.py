@@ -74,6 +74,10 @@ class ScenarioConfig:
     qos_window_s: float = 3.0
     mclock: dict = field(default_factory=dict)  # osd_mclock_* overrides
     seed: int = 0
+    #: "rados" = librados directly; "rgw" = the RgwGateway PUT/GET
+    #: object path (ROADMAP saturation follow-on (b): the load model
+    #: is front-end agnostic — same legs, histograms and invariants)
+    frontend: str = "rados"
 
     def legs(self) -> list[LegSpec]:
         out = [LegSpec(name=f"ramp{i}", profile=self.profile,
@@ -117,8 +121,17 @@ def _build_cluster(cfg: ScenarioConfig, admin_dir: str):
                    ec_profile={"plugin": "jerasure", "k": "2",
                                "m": "1", "backend": "numpy"})
     payload = b"\xa5" * cfg.obj_bytes
-    for i in range(cfg.objects):
-        cl.write_full("sat", f"o{i:04d}", payload)
+    if getattr(cfg, "frontend", "rados") == "rgw":
+        # S3 front-end leg: seed bucket + objects THROUGH the gateway
+        # so the workers' GETs find gateway-laid-out objects
+        from ..services.rgw import RgwGateway
+        gw = RgwGateway(cl, "sat", listen=False)  # store path only
+        gw.create_bucket("sat")
+        for i in range(cfg.objects):
+            gw.put_object("sat", f"o{i:04d}", payload)
+    else:
+        for i in range(cfg.objects):
+            cl.write_full("sat", f"o{i:04d}", payload)
     return c
 
 
@@ -180,7 +193,8 @@ def run_point(cfg: ScenarioConfig) -> dict:
 def _run_point_on(c, cfg: ScenarioConfig) -> dict:
     gen = LoadGenerator(
         c.network.addr_of("mon.0"), "sat", cfg.objects, cfg.legs(),
-        procs=cfg.procs, seed=cfg.seed, client_timeout=3.0)
+        procs=cfg.procs, seed=cfg.seed, client_timeout=3.0,
+        frontend=getattr(cfg, "frontend", "rados"))
     base = _cluster_counters(c)
     gen.launch()
     times = gen.leg_times()
@@ -470,3 +484,526 @@ def run_sweep(points: list[dict] | None = None,
     invariants_ok = all(all(r["invariants"].values()) for r in rows) \
         and (qos["ordering_holds"] if len(rows) >= 2 else True)
     return {"points": rows, "qos": qos, "ok": invariants_ok}
+
+
+# ---------------------------------------------------------------------------
+# Multi-tenant QoS suite (the --saturate --tenants engine)
+# ---------------------------------------------------------------------------
+
+#: the named tenant population the suite commits via `osd qos
+#: set-profile` (qos/profiles.py grammar): one reserved tenant whose
+#: p99 envelope must survive a flood, two weight-only tenants whose
+#: 2:1 split is gated, and the best-effort flooder
+TENANT_PROFILES = {
+    "gold":   {"res": 60.0, "wgt": 8.0, "lim": 0.0},
+    "silver": {"res": 0.0,  "wgt": 4.0, "lim": 0.0},
+    "bronze": {"res": 0.0,  "wgt": 1.0, "lim": 0.0},
+    "bulk":   {"res": 0.0,  "wgt": 1.0, "lim": 0.0},
+}
+
+
+@dataclass
+class TenantScenarioConfig:
+    """One multi-tenant point: four aligned per-tenant load streams
+    (solo -> flood -> weights -> thrash legs) against one cluster."""
+
+    n_osds: int = 4
+    objects: int = 32
+    obj_bytes: int = 8192
+    pg_num: int = 8
+    solo_s: float = 2.0        # gold alone: the p99 envelope baseline
+    flood_s: float = 3.0       # bulk floods; gold must hold its envelope
+    settle_s: float = 1.2      # flood backlog drains before the split
+    weights_s: float = 3.0     # silver vs bronze saturate: 2:1 split
+    thrash_s: float = 5.0      # kill/revive storm; controller retunes
+    kill_after_s: float = 1.0
+    solo_rate: float = 32.0    # frontline offered in the baseline leg
+    flood_rate: float = 128.0  # frontline offered in the flood leg
+    thrash_rate: float = 40.0  # frontline offered through the storm
+    recovery_deadline_s: float = 40.0
+    seed: int = 0
+    controller: bool = True    # qos_controller=on for the thrash leg
+    #: isolation gates (generous: 2-core CI-box variance).  The
+    #: envelope is judged on the SERVER-side per-tenant queue-wait p99
+    #: (mclock_qwait_us_tenant_gold via mon metrics_query windows with
+    #: absolute edges — the quantity the scheduler owns): flood-window
+    #: p99 within slack x the solo-window baseline, OR under an
+    #: absolute floor (a microsecond-fast solo baseline must not make
+    #: any flood p99 a failure).  Client-observed p99s are REPORTED
+    #: alongside but not gated — on a 2-core box they fold in worker-
+    #: process CPU starvation and rpc-timeout retry spirals the QoS
+    #: layer cannot control.  A throughput floor keeps the claim
+    #: end-to-end honest: a flooded gold must still achieve a real
+    #: fraction of its solo rate.
+    envelope_slack: float = 6.0
+    envelope_floor_ms: float = 80.0
+    #: goodput floor: gold's achieved/offered ratio under flood must
+    #: hold this fraction of its baseline-leg ratio (both tenants
+    #: share one worker process, so CPU starvation cancels out of the
+    #: comparison), plus an absolute achieved-ops/s anti-starvation
+    #: floor
+    throughput_floor_frac: float = 0.4
+    throughput_floor_abs: float = 3.0
+    #: per-tenant offered rate for the weights leg — deliberately
+    #: WELL past the box's knee: the proportional split only binds
+    #: while both tenants hold queued backlog (an under-the-knee rate
+    #: serves everyone their arrival and the ratio reads 1.0)
+    weights_rate: float = 160.0
+    weights_width: int = 14          # per-tenant executor width
+    #: the weight gate: under identical offered overload, the
+    #: heavier-weighted tenant's server-side queue-wait p50 must sit
+    #: WELL below the lighter one's (the proportional share decides
+    #: who queues; measured ratios run 10-30x at 4:1 weights), and
+    #: the favored tenant's served count must never trail far behind
+    weight_wait_min: float = 2.0
+    weight_served_floor: float = 0.7  # silver >= this x bronze served
+
+    def durations(self) -> dict[str, float]:
+        return {"solo": self.solo_s, "flood": self.flood_s,
+                "settle": self.settle_s, "weights": self.weights_s,
+                "thrash": self.thrash_s}
+
+    #: frontline stream client mix: 1 gold client per GOLD_EVERY
+    #: clients, the rest bulk — open-loop arrivals round-robin the
+    #: clients, so gold's offered share is 1/GOLD_EVERY of the
+    #: stream's rate at EVERY leg intensity
+    GOLD_EVERY = 4
+
+    def stream_legs(self) -> dict[str, dict]:
+        """stream -> {"tenants": [...], "legs": [...]} — aligned leg
+        names + durations in every stream, one shared go instant.
+
+        Two streams, each mixing its competing tenants inside ONE
+        worker process: when the 2-core box starves a worker of CPU it
+        starves BOTH competitors equally, so the per-tenant split
+        stays a SCHEDULER measurement instead of an OS-scheduling one.
+
+        - ``frontline``: gold (reserved) + bulk at 3:1 client mix.
+          The solo leg offers a low rate (the envelope baseline); the
+          flood leg multiplies the SAME mix's rate several-fold —
+          gold's qwait must hold its envelope while bulk's offered
+          load explodes around it.
+        - ``weight``: silver vs bronze, idle until the weights leg,
+          then open-loop well past the knee with a wide executor (the
+          split only binds while BOTH tenants hold queued backlog;
+          closed loops self-limit to in-flight counts the box's
+          process scheduler would end up deciding).
+        """
+        d = self.durations()
+
+        def leg(name, mode="open", rate=0.5, conc=2,
+                profile="small_mixed"):
+            return LegSpec(name=name, profile=profile,
+                           duration_s=d[name], mode=mode, rate=rate,
+                           concurrency=conc)
+
+        ge = self.GOLD_EVERY
+        return {
+            "frontline": {
+                "tenants": ["gold"] + ["bulk"] * (ge - 1),
+                "legs": [
+                    leg("solo", rate=self.solo_rate, conc=8),
+                    leg("flood", rate=self.flood_rate, conc=16),
+                    leg("settle", rate=2.0, conc=4),
+                    leg("weights", rate=2.0, conc=4),
+                    leg("thrash", rate=self.thrash_rate, conc=8),
+                ]},
+            "weight": {
+                "tenants": ["silver", "bronze"],
+                "legs": [
+                    leg("solo"), leg("flood"), leg("settle"),
+                    # stream totals: the 2-tenant round-robin halves
+                    # them back to the per-tenant figures
+                    leg("weights", rate=self.weights_rate * 2,
+                        conc=self.weights_width * 2),
+                    leg("thrash"),
+                ]},
+        }
+
+
+def _tenant_cluster(cfg: TenantScenarioConfig, admin_dir: str):
+    from ..tools.vstart import MiniCluster
+    from ..utils.config import default_config
+    conf = default_config()
+    conf.apply_dict({
+        "osd_heartbeat_interval": 0.05,
+        "osd_heartbeat_grace": 0.5,
+        "ec_backend": "native",
+        "ms_dispatch_workers": 2,
+        # ONE scheduler shard per OSD: the isolation invariants need
+        # tenants COMPETING inside a queue — spreading a small box's
+        # shallow in-flight window over N shards leaves most picks
+        # uncontended and the measurement noise-bound
+        "osd_op_num_shards": 1,
+        "osd_op_complaint_time": 2.0,
+        "osd_recovery_sleep": 0.0,
+        "osd_recovery_max_active": 8,
+        "osd_recovery_progress_interval": 0.0,
+        "mgr_progress_linger": 1.0,
+        # the controller senses through the metrics history: sample
+        # fast enough that a seconds-long storm yields p99 windows
+        "metrics_history_interval_s": 0.25,
+        "qos_controller_window_s": 1.5,
+        "qos_controller_hold_ticks": 1,
+        "qos_controller_cooldown_ticks": 1,
+        "qos_controller_step": 16.0,
+        # start recovery at the hand-tuned sweep's LOW point: the
+        # controller must climb out of it on its own
+        "osd_mclock_recovery_res": 4.0,
+        "osd_mclock_recovery_lim": 8.0,
+        # cap aggregate client IOPS per OSD (the operator's fleet-
+        # protection knob): the class limit — not the box's noisy CPU
+        # capacity — becomes the pacing point, so the weights leg's
+        # overload deterministically backs up in the tenant sub-queues
+        # where the proportional split is decided
+        "osd_mclock_client_lim": 60.0,
+    })
+    c = MiniCluster(n_osds=cfg.n_osds, cfg=conf, transport="tcp",
+                    admin_dir=admin_dir).start()
+    cl = c.client()
+    cl.create_pool("sat", kind="ec", pg_num=cfg.pg_num,
+                   ec_profile={"plugin": "jerasure", "k": "2",
+                               "m": "1", "backend": "numpy"})
+    for name, prof in TENANT_PROFILES.items():
+        cl.mon_command({"prefix": "osd qos set-profile",
+                        "name": name, **prof})
+    payload = b"\xa5" * cfg.obj_bytes
+    for i in range(cfg.objects):
+        cl.write_full("sat", f"o{i:04d}", payload)
+    # profiles ride the map: wait until every OSD's scheduler holds
+    # the committed book before any tenant traffic arrives
+    deadline = time.time() + 10.0
+    while time.time() < deadline:
+        if all("gold" in o.scheduler.shards[0]._tparams
+               for o in c.osds.values()):
+            break
+        time.sleep(0.02)
+    else:
+        c.stop()  # no leaked cluster behind the raise
+        raise TimeoutError("qos profiles never reached the OSDs")
+    return c, conf
+
+
+def _tenant_served(c) -> dict[str, int]:
+    out: dict[str, int] = {}
+    for o in list(c.osds.values()):
+        for t, n in o.scheduler.tenant_served.items():
+            out[t] = out.get(t, 0) + n
+    return out
+
+
+def run_tenant_point(cfg: TenantScenarioConfig | None = None) -> dict:
+    """The --saturate --tenants engine: commit tenant profiles, run
+    four aligned per-tenant load streams, thrash mid-run with the
+    adaptive controller live, and gate the three isolation
+    invariants."""
+    cfg = cfg or TenantScenarioConfig()
+    with tempfile.TemporaryDirectory(prefix="sat-tenant-") as admin_dir:
+        c, conf = _tenant_cluster(cfg, admin_dir)
+        mgr = None
+        try:
+            from ..mon.mgr import MgrDaemon
+            mgr = MgrDaemon(c.mon, modules=("qos",), tick=0.25)
+            qos_mod = mgr.module("qos")
+            qos_mod.TICK_EVERY = 0.5
+
+            def apply_retune(res, lim):
+                conf.set("osd_mclock_recovery_res", res)
+                conf.set("osd_mclock_recovery_lim", lim)
+                for o in list(c.osds.values()):
+                    try:
+                        o.admin_command("reset_mclock")
+                    except Exception:  # noqa: BLE001 - mid-kill races
+                        pass
+
+            qos_mod.bind(apply_retune,
+                         res0=conf["osd_mclock_recovery_res"])
+            if cfg.controller:
+                conf.set("qos_controller", "on")
+            mgr.start()
+            return _run_tenant_point_on(c, conf, cfg, qos_mod)
+        finally:
+            if mgr is not None:
+                mgr.stop()
+            c.stop()
+
+
+def _run_tenant_point_on(c, conf, cfg: TenantScenarioConfig,
+                         qos_mod) -> dict:
+    mon_addr = c.network.addr_of("mon.0")
+    streams = {
+        name: LoadGenerator(mon_addr, "sat", cfg.objects,
+                            spec["legs"], procs=1, seed=cfg.seed + i,
+                            client_timeout=2.5,
+                            tenants=spec["tenants"])
+        for i, (name, spec) in enumerate(cfg.stream_legs().items())
+    }
+    # spawn ALL streams first, then go() them onto one shared instant:
+    # the per-leg phases (solo/flood/weights/thrash) line up across
+    # tenants by construction
+    spawn_errors = []
+
+    def spawn_one(gen):
+        try:
+            gen.spawn()
+        except Exception as e:  # noqa: BLE001
+            spawn_errors.append(repr(e))
+
+    spawners = [threading.Thread(target=spawn_one, args=(g,),
+                                 daemon=True)
+                for g in streams.values()]
+    for t in spawners:
+        t.start()
+    for t in spawners:
+        t.join(timeout=90.0)
+    if spawn_errors:
+        for g in streams.values():
+            g.abort()
+        raise RuntimeError(f"tenant stream spawn failed: "
+                           f"{spawn_errors}")
+    start_at = time.time() + 0.5
+    for g in streams.values():
+        g.go(start_at)
+    times = next(iter(streams.values())).leg_times()
+
+    # weight-split window: the silver:bronze SERVED ratio inside the
+    # weights leg, measured server-side (scheduler tenant counters —
+    # what the weights actually shape), sampled just inside the edges
+    w_start, w_end = times["weights"]
+    weight_snap = {}
+
+    def weight_sampler():
+        if (d := w_start + 0.3 - time.time()) > 0:
+            time.sleep(d)
+        weight_snap["t0"] = _tenant_served(c)
+        if (d := w_end - 0.1 - time.time()) > 0:
+            time.sleep(d)
+        weight_snap["t1"] = _tenant_served(c)
+
+    wthread = threading.Thread(target=weight_sampler, daemon=True)
+    wthread.start()
+
+    # thrash: kill + fresh-store revive mid-leg; the controller climbs
+    # the recovery reservation out of the hand-tuned low point
+    t_start, _t_end = times["thrash"]
+    kill_at = t_start + cfg.kill_after_s
+    if (d := kill_at - time.time()) > 0:
+        time.sleep(d)
+    victim = max(c.osds)
+    c.kill_osd(victim)
+    kill_t = time.time()
+    time.sleep(0.3)
+    c.revive_osd(victim)
+
+    merged: dict[str, dict] = {}
+    results: dict[str, dict] = {}
+
+    def collect_one(tenant, gen):
+        try:
+            results[tenant] = gen.collect(grace=45.0)
+        except Exception as e:  # noqa: BLE001
+            results[tenant] = {"legs": {}, "ok": False,
+                               "worker_errors": [repr(e)]}
+
+    collectors = [threading.Thread(target=collect_one, args=(t, g),
+                                   daemon=True)
+                  for t, g in streams.items()]
+    for t in collectors:
+        t.start()
+    for t in collectors:
+        t.join(timeout=120.0)
+    ok_all = True
+    errors: list[str] = []
+    for tenant in streams:
+        res = results.get(tenant) or {"legs": {}, "ok": False,
+                                      "worker_errors": ["no result"]}
+        merged[tenant] = res["legs"]
+        ok_all = ok_all and res["ok"]
+        errors.extend(f"{tenant}: {e}" for e in res["worker_errors"])
+
+    # recovery drain (post-collect: the workers already stopped)
+    def rec_busy() -> bool:
+        for o in list(c.osds.values()):
+            if o._recovery_inflight > 0 or len(o._recovery_q) > 0 \
+                    or o.scheduler.queue_depth("recovery") > 0:
+                return True
+        return False
+
+    recovered = False
+    deadline = kill_t + cfg.recovery_deadline_s
+    while time.time() < deadline:
+        if not rec_busy() and not c.mon.progress.active():
+            recovered = True
+            break
+        time.sleep(0.1)
+    wthread.join(timeout=5.0)
+
+    from .profiles import LegResult
+
+    def leg_of(stream, name):
+        return merged.get(stream, {}).get(name) or LegResult()
+
+    def tenant_hists(stream, name, tenant):
+        leg = leg_of(stream, name)
+        return {k: h for k, h in leg.hists.items()
+                if k.startswith(f"{tenant}:")}
+
+    def tenant_count(stream, name, tenant):
+        return sum(h.count
+                   for h in tenant_hists(stream, name,
+                                         tenant).values())
+
+    def tenant_p99_us(stream, name, tenant):
+        from .profiles import Pow2Histogram
+        h = Pow2Histogram()
+        for hh in tenant_hists(stream, name, tenant).values():
+            h.merge(hh)
+        return h.quantile(0.99)
+
+    # ---- invariant 1: the reserved tenant's p99 envelope ----
+    # server-side: a tenant's queue-wait quantile over a leg's
+    # ABSOLUTE window, answered by the mon's merged metrics history
+    # (the same per-tenant histograms the exporter scrapes), bucket
+    # deltas aggregated across every OSD registry
+    def qwait_quantile(tenant: str, t0: float, t1: float,
+                       quant: float) -> float | None:
+        from ..utils.metrics_history import pow2_quantile
+        store = c.mon.metrics_history
+        buckets: dict[int, int] = {}
+        for reg in store.registries():
+            if not reg.startswith("osd."):
+                continue
+            qq = store.query(reg,
+                             f"mclock_qwait_us_tenant_{tenant}",
+                             start_ts=t0, end_ts=t1)
+            for b, n in (qq.get("buckets_delta") or {}).items():
+                buckets[int(b)] = buckets.get(int(b), 0) + int(n)
+        return pow2_quantile(buckets, quant) if buckets else None
+
+    def qwait_p99(tenant: str, t0: float, t1: float) -> float | None:
+        return qwait_quantile(tenant, t0, t1, 0.99)
+
+    solo_t = times["solo"]
+    flood_t = times["flood"]
+    solo_p99 = qwait_p99("gold", *solo_t)
+    flood_p99 = qwait_p99("gold", *flood_t)
+    isolation_ratio = (round(flood_p99 / solo_p99, 2)
+                       if solo_p99 and flood_p99 else None)
+    # goodput: gold's achieved/offered ratio per leg — offered splits
+    # by the frontline client mix (1/GOLD_EVERY of the stream), and
+    # both tenants share ONE worker process, so a CPU-starved run
+    # shrinks offered and achieved TOGETHER instead of faking a drop
+    ge = cfg.GOLD_EVERY
+    solo_leg = leg_of("frontline", "solo")
+    flood_leg = leg_of("frontline", "flood")
+    gold_solo_ach = tenant_count("frontline", "solo", "gold")
+    gold_flood_ach = tenant_count("frontline", "flood", "gold")
+    gold_solo_off = max(1.0, solo_leg.offered / ge)
+    gold_flood_off = max(1.0, flood_leg.offered / ge)
+    solo_goodput = gold_solo_ach / gold_solo_off
+    flood_goodput = gold_flood_ach / gold_flood_off
+    flood_rate_achieved = gold_flood_ach / max(1e-3,
+                                               flood_leg.wall_s
+                                               or cfg.flood_s)
+    solo_rate = gold_solo_ach / max(1e-3, solo_leg.wall_s
+                                    or cfg.solo_s)
+    envelope_ok = (
+        flood_p99 is not None and solo_p99 is not None
+        and (flood_p99 <= solo_p99 * cfg.envelope_slack
+             or flood_p99 <= cfg.envelope_floor_ms * 1e3)
+        and gold_flood_ach >= cfg.throughput_floor_abs * cfg.flood_s
+        and flood_goodput >= cfg.throughput_floor_frac
+        * max(0.1, solo_goodput))
+
+    # ---- invariant 2: proportional weight split ----
+    # under identical offered overload from ONE worker process, the
+    # weights decide WHO QUEUES: the heavier tenant's queue-wait p50
+    # stays far below the lighter one's, and its served count never
+    # trails far behind (served-count ratios stay arrival-coupled on
+    # a shared executor, so the wait ratio is the gated signal)
+    t0, t1 = weight_snap.get("t0", {}), weight_snap.get("t1", {})
+    silver_ops = t1.get("silver", 0) - t0.get("silver", 0)
+    bronze_ops = t1.get("bronze", 0) - t0.get("bronze", 0)
+    split_ratio = (round(silver_ops / bronze_ops, 2)
+                   if bronze_ops > 0 else None)
+    weights_t = times["weights"]
+    silver_wait = qwait_quantile("silver", *weights_t, 0.50)
+    bronze_wait = qwait_quantile("bronze", *weights_t, 0.50)
+    wait_ratio = (round(bronze_wait / silver_wait, 2)
+                  if silver_wait and bronze_wait else None)
+    split_ok = (wait_ratio is not None
+                and wait_ratio >= cfg.weight_wait_min
+                and silver_ops >= cfg.weight_served_floor
+                * max(1, bronze_ops))
+
+    # ---- invariant 3: the controller converged between the sweep points
+    status = qos_mod.command("status")
+    ctl = status.get("controller") or {}
+    res_min = conf["qos_recovery_res_min"]
+    res_max = conf["qos_recovery_res_max"]
+    retunes = int(ctl.get("retunes", 0))
+    final_res = float(ctl.get("res", 0.0))
+    controller_ok = (not cfg.controller) or (
+        retunes >= 1 and res_min < final_res <= res_max)
+    qos_events = len((c.mon.cluster_log.dump(channel="qos")
+                      or {}).get("events", []))
+
+    served = _tenant_served(c)
+    invariants = {
+        "no_deadlock": ok_all,
+        "reserved_p99_envelope": envelope_ok,
+        "weight_split_proportional": split_ok,
+        "controller_converges": controller_ok,
+        "recovery_completes": recovered,
+    }
+
+    def _tenant_row(stream, leg, tenant):
+        p99 = tenant_p99_us(stream, leg, tenant)
+        return {"achieved": tenant_count(stream, leg, tenant),
+                "client_p99_ms": (round(p99 / 1e3, 3)
+                                  if p99 is not None else None)}
+
+    row = {
+        "tenants": dict(TENANT_PROFILES),
+        "frontline": {
+            leg: _leg_row(leg_of("frontline", leg),
+                          cfg.durations()[leg])
+            for leg in ("solo", "flood", "thrash")},
+        "gold": {leg: _tenant_row("frontline", leg, "gold")
+                 for leg in ("solo", "flood", "thrash")},
+        "bulk": {leg: _tenant_row("frontline", leg, "bulk")
+                 for leg in ("solo", "flood")},
+        "weights": {"silver": _tenant_row("weight", "weights",
+                                          "silver"),
+                    "bronze": _tenant_row("weight", "weights",
+                                          "bronze")},
+        "tenant_isolation_ratio": isolation_ratio,
+        "gold_solo_qwait_p99_ms": (round(solo_p99 / 1e3, 3)
+                                   if solo_p99 else None),
+        "gold_flood_qwait_p99_ms": (round(flood_p99 / 1e3, 3)
+                                    if flood_p99 else None),
+        "gold_solo_goodput": round(solo_goodput, 3),
+        "gold_flood_goodput": round(flood_goodput, 3),
+        "gold_flood_achieved_per_s": round(flood_rate_achieved, 1),
+        "gold_solo_achieved_per_s": round(solo_rate, 1),
+        "weight_split_ratio": split_ratio,
+        "weight_wait_ratio": wait_ratio,
+        "weight_wait_p50_ms": {
+            "silver": (round(silver_wait / 1e3, 3)
+                       if silver_wait else None),
+            "bronze": (round(bronze_wait / 1e3, 3)
+                       if bronze_wait else None)},
+        "weight_served": {"silver": silver_ops, "bronze": bronze_ops},
+        "tenant_served_total": served,
+        "controller_retunes": retunes,
+        "controller_final_res": final_res,
+        "controller_convergence_error":
+            float(ctl.get("convergence_error", 0.0)),
+        "controller_trajectory": [h.get("res")
+                                  for h in ctl.get("history", [])],
+        "qos_events": qos_events,
+        "invariants": invariants,
+        "worker_errors": errors,
+        "ok": all(invariants.values()),
+    }
+    return row

@@ -100,7 +100,7 @@ class OSDMapIncremental(Encodable):
     """One epoch's worth of map change (OSDMap::Incremental,
     src/osd/OSDMap.h): changed records only, applied in epoch order."""
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def __init__(self, base_epoch: int = 0, new_epoch: int = 0):
         self.base_epoch = base_epoch
@@ -115,6 +115,9 @@ class OSDMapIncremental(Encodable):
         self.primary_temp_set: dict[tuple[int, int], int] = {}
         self.primary_temp_rm: list[tuple[int, int]] = []
         self.next_pool_id = 1
+        # v2 tail: tenant QoS profile changes (qos/profiles.py)
+        self.qos_set: dict[str, dict] = {}   # name -> {res, wgt, lim}
+        self.qos_rm: list[str] = []
 
     def encode(self, enc: Encoder) -> None:
         def kv_list(e, items, val_enc):
@@ -142,6 +145,14 @@ class OSDMapIncremental(Encodable):
                     lambda ee, v: ee.i64(v))
             key_list(e, self.primary_temp_rm)
             e.u64(self.next_pool_id)
+            # v2 tail: tenant QoS profile deltas
+            e.seq(sorted(self.qos_set.items()),
+                  lambda ee, kv: (ee.string(kv[0]),
+                                  ee.f64(float(kv[1].get("res", 0.0))),
+                                  ee.f64(float(kv[1].get("wgt", 1.0))),
+                                  ee.f64(float(kv[1].get("lim",
+                                                         0.0)))))
+            e.seq(sorted(self.qos_rm), Encoder.string)
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -169,6 +180,13 @@ class OSDMapIncremental(Encodable):
             inc.primary_temp_set = dict(d.seq(kv_item(Decoder.i64)))
             inc.primary_temp_rm = d.seq(key_item)
             inc.next_pool_id = d.u64()
+            if v >= 2:
+                def qos_item(dd: Decoder):
+                    return dd.string(), {"res": dd.f64(),
+                                         "wgt": dd.f64(),
+                                         "lim": dd.f64()}
+                inc.qos_set = dict(d.seq(qos_item))
+                inc.qos_rm = d.seq(Decoder.string)
             return inc
         return dec.versioned(cls.VERSION, body)
 
@@ -195,13 +213,18 @@ def apply_map_push(current, msg):
 class OSDMap(Encodable):
     """Epoch-versioned cluster map; placement is a pure function of it."""
 
-    VERSION, COMPAT = 3, 1
+    VERSION, COMPAT = 4, 1
 
     def __init__(self):
         self.epoch = 0
         self.osds: dict[int, OsdInfo] = {}
         self.pools: dict[int, PoolSpec] = {}
         self.next_pool_id = 1
+        # tenant QoS profiles (qos/profiles.py grammar): name ->
+        # {"res", "wgt", "lim"} in ops/s, distributed cluster-wide
+        # like pool options — the mon commits `osd qos set-profile`
+        # here, every OSD converges its scheduler on the next push
+        self.qos_profiles: dict[str, dict] = {}
         # explicit placement overrides (the pg_upmap/read-balancer
         # machinery, ref OSDMap.cc upmap handling): (pool, seed) -> osds
         self.pg_upmap: dict[tuple[int, int], list[int]] = {}
@@ -375,6 +398,11 @@ class OSDMap(Encodable):
         inc.primary_temp_rm = [k for k in old.primary_temp
                                if k not in self.primary_temp]
         inc.next_pool_id = self.next_pool_id
+        for name, prof in self.qos_profiles.items():
+            if old.qos_profiles.get(name) != prof:
+                inc.qos_set[name] = dict(prof)
+        inc.qos_rm = [n for n in old.qos_profiles
+                      if n not in self.qos_profiles]
         return inc
 
     def apply_incremental(self, inc: "OSDMapIncremental") -> None:
@@ -399,6 +427,10 @@ class OSDMap(Encodable):
         for k in inc.primary_temp_rm:
             self.primary_temp.pop(k, None)
         self.next_pool_id = inc.next_pool_id
+        for name, prof in getattr(inc, "qos_set", {}).items():
+            self.qos_profiles[name] = dict(prof)
+        for name in getattr(inc, "qos_rm", ()):
+            self.qos_profiles.pop(name, None)
         self.epoch = inc.new_epoch
 
     def up_osds(self) -> list[int]:
@@ -427,6 +459,13 @@ class OSDMap(Encodable):
             e.seq(sorted(self.primary_temp.items()),
                   lambda ee, kv: (ee.u64(kv[0][0]), ee.u64(kv[0][1]),
                                   ee.i64(kv[1])))
+            # v4 tail: tenant QoS profiles
+            e.seq(sorted(self.qos_profiles.items()),
+                  lambda ee, kv: (ee.string(kv[0]),
+                                  ee.f64(float(kv[1].get("res", 0.0))),
+                                  ee.f64(float(kv[1].get("wgt", 1.0))),
+                                  ee.f64(float(kv[1].get("lim",
+                                                         0.0)))))
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -454,5 +493,12 @@ class OSDMap(Encodable):
                     return (pool, seed), dd.i64()
                 for k, who in d.seq(ptemp_item):
                     m.primary_temp[k] = who
+            if v >= 4:
+                def qos_item(dd: Decoder):
+                    return dd.string(), {"res": dd.f64(),
+                                         "wgt": dd.f64(),
+                                         "lim": dd.f64()}
+                for name, prof in d.seq(qos_item):
+                    m.qos_profiles[name] = prof
             return m
         return dec.versioned(cls.VERSION, body)

@@ -364,6 +364,140 @@ class MetricsModule(MgrModule):
         raise KeyError(cmd)
 
 
+@register_module("qos")
+class QosModule(MgrModule):
+    """The adaptive recovery-reservation controller's host (the
+    mclock-profiles role closed into a feedback loop): each tick it
+    senses the cluster — worst client p99 ``mclock_qwait_us_client``
+    across daemons over a ``metrics_query`` window, recovery backlog
+    from the freshest ``mclock_depth_recovery`` snapshots, storm
+    liveness from the progress tracker — feeds the pure AIMD
+    controller (qos/controller.py), and applies any retune through a
+    bound actuator (config set + ``reset_mclock`` on every OSD),
+    journaling a ``qos`` cluster event per move.
+
+    Config-gated on ``qos_controller=on``; inert until ``bind()``
+    hands it an apply function (the harness/bench wires one over the
+    cluster's admin sockets)."""
+
+    TICK_EVERY = 1.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._ctl = None
+        self._apply = None
+
+    def bind(self, apply_fn, res0: float | None = None) -> "QosModule":
+        """apply_fn(res, lim) pushes the setting at every OSD (the
+        `config set osd_mclock_recovery_{res,lim}` + `reset_mclock`
+        round).  res0 seeds the controller at the currently-configured
+        reservation."""
+        self._apply = apply_fn
+        self._ctl = self._make_controller(res0)
+        return self
+
+    def _make_controller(self, res0):
+        from ..qos.controller import (ControllerKnobs,
+                                      ReservationController)
+        cfg = self.mgr.mon.cfg
+        knobs = ControllerKnobs(
+            res_min=cfg["qos_recovery_res_min"],
+            res_max=cfg["qos_recovery_res_max"],
+            step=cfg["qos_controller_step"],
+            backoff=cfg["qos_controller_backoff"],
+            p99_low_us=cfg["qos_controller_p99_low_ms"] * 1e3,
+            p99_high_us=cfg["qos_controller_p99_high_ms"] * 1e3,
+            hold=cfg["qos_controller_hold_ticks"],
+            cooldown=cfg["qos_controller_cooldown_ticks"],
+            lim_factor=cfg["qos_recovery_lim_factor"])
+        return ReservationController(knobs, res0=res0)
+
+    # ------------------------------------------------------------ sensing
+    def _client_p99_us(self) -> float | None:
+        store = getattr(self.mgr.mon, "metrics_history", None)
+        if store is None:
+            return None
+        window = self.mgr.mon.cfg["qos_controller_window_s"]
+        worst = None
+        for reg in store.registries():
+            if not reg.startswith("osd."):
+                continue
+            q = store.query(reg, "mclock_qwait_us_client",
+                            since_s=window)
+            p99 = q.get("p99")
+            if p99 is not None and (worst is None or p99 > worst):
+                worst = float(p99)
+        return worst
+
+    def _recovery_state(self) -> tuple[int, bool]:
+        """(queued recovery items cluster-wide, storm live?) from the
+        freshest metrics snapshots + the progress tracker."""
+        backlog = 0
+        store = getattr(self.mgr.mon, "metrics_history", None)
+        if store is not None:
+            # staleness fence: a dead OSD's final snapshot can carry a
+            # nonzero depth forever — a phantom backlog no reservation
+            # can drain must not walk the knob to its ceiling
+            max_age = max(5.0,
+                          2 * self.mgr.mon.cfg[
+                              "qos_controller_window_s"])
+            now = time.time()
+            for reg in store.registries():
+                if not reg.startswith("osd."):
+                    continue
+                # window(max_age) copies only the fresh tail (not the
+                # whole 600-snapshot ring per tick); the explicit ts
+                # check below also rejects the window's BASELINE edge
+                # sample, which may predate the window — a dead OSD's
+                # final nonzero depth must age out, not pin a phantom
+                # backlog that walks the knob to its ceiling
+                rows = store.window(reg, since_s=max_age)
+                if not rows or now - float(rows[-1].get("ts", 0)) \
+                        > max_age:
+                    continue
+                counters = rows[-1].get("counters") or {}
+                backlog += int(counters.get("mclock_depth_recovery",
+                                            0) or 0)
+        progress = getattr(self.mgr.mon, "progress", None)
+        active = bool(progress.active()) if progress is not None \
+            else False
+        return backlog, active
+
+    # ----------------------------------------------------------- the loop
+    def tick(self) -> None:
+        cfg = self.mgr.mon.cfg
+        if cfg["qos_controller"] != "on" or self._apply is None:
+            return
+        if self._ctl is None:
+            self._ctl = self._make_controller(None)
+        p99 = self._client_p99_us()
+        backlog, active = self._recovery_state()
+        move = self._ctl.observe(p99, backlog, active)
+        if move is None:
+            return
+        res, lim = move
+        self._apply(res, lim)
+        last = self._ctl.history[-1]
+        from ..utils.event_log import make_event
+        mon = self.mgr.mon
+        mon.cluster_log.append(make_event(
+            mon.name, "qos",
+            f"recovery reservation {last.reason} -> "
+            f"{res:g}/{lim:g} ops/s",
+            reason=last.reason, res=float(res), lim=float(lim),
+            p99_us=float(p99) if p99 is not None else -1.0,
+            backlog=int(backlog)))
+
+    def command(self, cmd: str, **kw):
+        if cmd == "status":
+            return {"enabled":
+                    self.mgr.mon.cfg["qos_controller"] == "on",
+                    "bound": self._apply is not None,
+                    "controller": (self._ctl.status()
+                                   if self._ctl is not None else None)}
+        raise KeyError(cmd)
+
+
 @register_module("balancer")
 class BalancerModule(MgrModule):
     """Automatic upmap balancing (pybind/mgr/balancer role): when

@@ -1386,7 +1386,7 @@ class MonitorLite(Dispatcher):
     _READONLY_CMDS = frozenset({"status", "osd dump", "osd stats",
                                 "auth list", "dump_cluster_log",
                                 "progress", "dump_metrics_history",
-                                "metrics_query"})
+                                "metrics_query", "osd qos ls"})
 
     def _mon_cmd_denied(self, m: MMonCommand):
         """(errno, detail) if the command must be refused, else None.
@@ -1631,6 +1631,42 @@ class MonitorLite(Dispatcher):
                     self._commit_map(
                         f"pool {pool.name} snap {snapid} removed")
             return 0, {}
+        if prefix == "osd qos set-profile":
+            # tenant QoS profile (qos/profiles.py grammar): committed
+            # into the OSDMap like pool options — every OSD's
+            # scheduler converges on the next map push, no per-daemon
+            # config fan-out
+            from ..qos.profiles import TenantProfile
+            try:
+                prof = TenantProfile(
+                    str(cmd["name"]),
+                    reservation=float(cmd.get("res", 0.0)),
+                    weight=float(cmd.get("wgt", 1.0)),
+                    limit=float(cmd.get("lim", 0.0)))
+            except (KeyError, TypeError, ValueError) as e:
+                return -22, {"error": f"bad qos profile: {e}"}
+            with self._lock:
+                self.osdmap.qos_profiles[prof.name] = prof.to_dict()
+                self._clog("qos", f"qos profile {prof.name} set "
+                                  f"({prof.spec()})",
+                           tenant=prof.name, **prof.to_dict())
+                self._commit_map(f"qos profile {prof.name} "
+                                 f"({prof.spec()})")
+            return 0, {"profile": {prof.name: prof.to_dict()}}
+        if prefix == "osd qos rm-profile":
+            name = str(cmd.get("name", ""))
+            with self._lock:
+                if self.osdmap.qos_profiles.pop(name, None) is None:
+                    return -2, {"error": f"no qos profile {name!r}"}
+                self._clog("qos", f"qos profile {name} removed",
+                           tenant=name)
+                self._commit_map(f"qos profile {name} removed")
+            return 0, {}
+        if prefix == "osd qos ls":
+            with self._lock:
+                return 0, {"profiles": {n: dict(p) for n, p in
+                                        sorted(self.osdmap
+                                               .qos_profiles.items())}}
         if prefix == "balancer optimize":
             return self._balancer_optimize(int(cmd.get("max_moves", 10)))
         if prefix == "osd dump":

@@ -53,8 +53,18 @@ class MOSDOp(Encodable):
     # empty = cluster runs without authorization
     ticket: bytes = b""
     proof: bytes = b""
+    # v5 tail: client-side dmclock tags (qos/dmclock.py ServiceTracker
+    # role) — tenant names the mclock sub-queue this op bills to;
+    # qdelta/qrho say how many responses (total / reservation-phase)
+    # this tenant received cluster-wide since its last request to THIS
+    # osd, so the server advances its tenant clocks multi-server-
+    # correctly with no global clock.  Empty tenant = untagged: the op
+    # rides the default stream and the tags are ignored.
+    tenant: str = ""
+    qdelta: int = 0
+    qrho: int = 0
 
-    VERSION, COMPAT = 4, 1
+    VERSION, COMPAT = 5, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
@@ -65,6 +75,8 @@ class MOSDOp(Encodable):
             e.seq(self.snaps, Encoder.u64)
             e.seq(list(self.trace), Encoder.u64)       # v3 tail
             e.blob(self.ticket); e.blob(self.proof)    # v4 tail
+            e.string(self.tenant)                      # v5 tail
+            e.u64(self.qdelta); e.u64(self.qrho)
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -81,6 +93,10 @@ class MOSDOp(Encodable):
             if v >= 4:
                 m.ticket = d.blob()
                 m.proof = d.blob()
+            if v >= 5:
+                m.tenant = d.string()
+                m.qdelta = d.u64()
+                m.qrho = d.u64()
             return m
         return dec.versioned(cls.VERSION, body)
 
@@ -92,19 +108,27 @@ class MOSDOpReply(Encodable):
     data: bytes = b""
     version: int = 0
     epoch: int = 0  # responder's map epoch (client refreshes if newer)
+    # v2 tail: the mclock phase this op was served under (qos/dmclock
+    # PHASE_*: 0 none/fifo, 1 reservation, 2 weight) — the feedback the
+    # client-side ServiceTracker folds into its rho bookkeeping
+    qphase: int = 0
 
-    VERSION, COMPAT = 1, 1
+    VERSION, COMPAT = 2, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
             e.u64(self.tid); e.i64(self.result); e.blob(self.data)
             e.u64(self.version); e.u64(self.epoch)
+            e.u8(self.qphase)                          # v2 tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
     def decode(cls, dec: Decoder) -> "MOSDOpReply":
         def body(d, v):
-            return cls(d.u64(), d.i64(), d.blob(), d.u64(), d.u64())
+            m = cls(d.u64(), d.i64(), d.blob(), d.u64(), d.u64())
+            if v >= 2:
+                m.qphase = d.u8()
+            return m
         return dec.versioned(cls.VERSION, body)
 
 

@@ -69,7 +69,8 @@ from .extent_cache import ECExtentCache
 from .intervals import INTERVALS_KEY, Interval, LES_KEY, PastIntervals
 from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
-from .scheduler import ClassParams, ShardedScheduler
+from .scheduler import (ClassParams, PHASE_NONE, ShardedScheduler,
+                        current_service)
 from .scrub import FaultInjection, ScrubMixin
 from .snaps import SnapMixin, split_vname, to_oid, vname, vname_of
 
@@ -86,6 +87,7 @@ class _PendingWrite:
     retry: int = 0  # version-conflict sub-op refusals (client retries)
     lock_key: tuple | None = None  # per-object write lock to release
     span: object = None  # op span closed when the client reply leaves
+    qphase: int = 0  # mclock phase served under (rides the reply)
     stamp: float = field(default_factory=time.time)
 
 
@@ -113,6 +115,7 @@ class _PendingRead:
     # just k chunks: completion waits for all replies
     want_all: bool = False
     span: object = None    # op span (traced reads): decode stage parent
+    qphase: int = 0  # mclock phase served under (rides the reply)
     stamp: float = field(default_factory=time.time)
 
 
@@ -128,6 +131,23 @@ class _SpanConn:
         if isinstance(msg, MOSDOpReply):
             self._span.tag("result", msg.result)
             self._span.finish()
+        return self._conn.send(msg)
+
+
+class _PhaseConn:
+    """Send-handle that stamps the mclock service phase onto the
+    client reply (the dmclock feedback channel: qphase tells the
+    tenant's ServiceTracker whether this op consumed reservation or
+    proportional share).  Wraps once at dispatch so every reply path —
+    including async EC ack drains on other threads — carries it."""
+
+    def __init__(self, conn, phase: int):
+        self._conn = conn
+        self._phase = phase
+
+    def send(self, msg) -> bool:
+        if isinstance(msg, MOSDOpReply) and not msg.qphase:
+            msg.qphase = self._phase
         return self._conn.send(msg)
 
 
@@ -856,11 +876,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         }
         self._use_mclock = self.cfg["osd_op_queue"] == "mclock"
         # always constructed (zeroed QoS counter schema even under
-        # fifo); per-class served/dropped/depth/qwait land on self.perf
+        # fifo); per-class served/dropped/depth/qwait land on self.perf.
+        # Tenant profiles arrive with the OSDMap (we have none at
+        # construction); unknown tenants ride the default profile and
+        # counter cardinality is LRU-bounded by osd_qos_max_tenants.
         self.scheduler = ShardedScheduler(
             self._run_scheduled, self._mclock_params(),
             shards=self.cfg["osd_op_num_shards"],
-            name=f"mclock-{self.name}", perf=self.perf)
+            name=f"mclock-{self.name}", perf=self.perf,
+            max_tenants=self.cfg["osd_qos_max_tenants"])
 
     def _mclock_params(self) -> dict[str, ClassParams]:
         """Current (R, W, L) per QoS class from config — built at
@@ -978,14 +1002,23 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     "depth": self.scheduler.queue_depth(),
                     "depths": self.scheduler.queue_depths(),
                     "served": dict(self.scheduler.served),
-                    "dropped": dict(self.scheduler.dropped)}
+                    "dropped": dict(self.scheduler.dropped),
+                    "tenants": self.scheduler.tenant_depths(),
+                    "tenant_served":
+                        dict(self.scheduler.tenant_served)}
         if cmd == "reset_mclock":
             # re-read osd_mclock_* from config and retune the LIVE
-            # scheduler (the reservation-sweep knob: `config set` the
-            # new values, then this verb applies them without a restart)
+            # scheduler (the reservation-sweep knob AND the adaptive
+            # controller's actuator: `config set` the new values, then
+            # this verb applies them without a restart); the tenant
+            # profile book re-pushes from the current map too
             params = self._mclock_params()
             for klass, p in params.items():
                 self.scheduler.set_params(klass, p)
+            if self.osdmap is not None:
+                from ..qos.profiles import params_from_map
+                self.scheduler.set_tenant_profiles(params_from_map(
+                    getattr(self.osdmap, "qos_profiles", {})))
             return {"applied": {k: {"reservation": p.reservation,
                                     "weight": p.weight,
                                     "limit": p.limit}
@@ -1019,8 +1052,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             or self._op_classes.get(type(msg), "system")
         if klass not in ("client", "recovery", "scrub", "system"):
             klass = "system"  # never KeyError on a peer's future tag
+        # tenant-tagged client ops land in per-tenant dmclock
+        # sub-queues; the shipped (delta, rho) pair advances the
+        # tenant's clocks multi-server-correctly (qos/dmclock.py)
+        tenant = getattr(msg, "tenant", "") if klass == "client" else ""
+        tags = (getattr(msg, "qdelta", 0),
+                getattr(msg, "qrho", 0)) if tenant else None
         self.scheduler.enqueue(klass, (handler, conn, msg),
-                               key=self._shard_key(msg))
+                               key=self._shard_key(msg),
+                               tenant=tenant or None, tags=tags)
         return True
 
     def _shard_key(self, msg):
@@ -1045,6 +1085,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _run_scheduled(self, klass: str, item) -> None:
         handler, conn, msg = item
         self._sub_epoch.v = 0  # fresh epoch pin per dispatched op
+        if isinstance(msg, MOSDOp):
+            # the scheduler worker published what it is serving just
+            # before this call (same thread): remember the phase so
+            # the reply can carry it back to the dmclock client
+            phase = current_service()[1]
+            if phase != PHASE_NONE:
+                msg._qos_phase = phase
         handler(conn, msg)
 
     # ------------------------------------------------------------- mapping
@@ -1073,6 +1120,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return
         self.osdmap = newmap
         self._last_map = time.time()
+        # tenant QoS profiles ride the map like pool options: push the
+        # committed book into the live schedulers on change (unknown
+        # tenants keep falling into the default profile)
+        new_profiles = getattr(newmap, "qos_profiles", {})
+        if old is None or getattr(old, "qos_profiles",
+                                  {}) != new_profiles:
+            from ..qos.profiles import params_from_map
+            self.scheduler.set_tenant_profiles(
+                params_from_map(new_profiles))
         # drop cached extents only for CACHED PGs whose membership
         # actually changed (an unrelated epoch bump must not cold the
         # cache, and the check is O(cached PGs), not O(cluster PGs))
@@ -1294,6 +1350,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if span is not None and span.sampled:
             m._span = span
             conn = _SpanConn(conn, span)
+        qphase = getattr(m, "_qos_phase", PHASE_NONE)
+        if qphase != PHASE_NONE:
+            # dmclock feedback: the reply carries the phase this op was
+            # served under, whichever async path eventually sends it
+            conn = _PhaseConn(conn, qphase)
         self.perf.inc("op_rw_bytes", len(m.data))
         with self.op_tracker.create(f"{m.op} {m.oid}", span=span) as op:
             if pool.kind == "ec":
@@ -1481,6 +1542,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, len(peers), version)
         self._pending_writes[tid].span = getattr(m, '_span', None)
+        self._pending_writes[tid].qphase = getattr(m, '_qos_phase', 0)
         sub_attrs = dict(extra_attrs)
         if rider is not None:
             sub_attrs["_snap"] = rider
@@ -1545,6 +1607,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._pending_writes[tid] = _PendingWrite(
             m.client, m.tid, len(peers), version)
         self._pending_writes[tid].span = getattr(m, '_span', None)
+        self._pending_writes[tid].qphase = getattr(m, '_qos_phase', 0)
         for peer in peers:
             self.messenger.send_message(
                 f"osd.{peer}",
@@ -1586,6 +1649,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                               total_shards=sum(1 for u in up
                                                if u is not None),
                               stat_only=True)
+            pr.qphase = getattr(m, '_qos_phase', 0)
             self._pending_reads[tid] = pr
             self._fan_shard_reads(tid, pgid, m.oid, up)
             return
@@ -2263,6 +2327,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             pw = _PendingWrite(m.client, m.tid, remote, version,
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
+            pw.qphase = getattr(m, '_qos_phase', 0)
             self._pending_writes[tid] = pw
         for shard, osd in enumerate(up):
             if osd is None:
@@ -2351,6 +2416,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             pw = _PendingWrite(m.client, m.tid, remote, version,
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
+            pw.qphase = getattr(m, '_qos_phase', 0)
             self._pending_writes[tid] = pw
         local_failed = local_retry = 0
         for shard, osd in enumerate(up):
@@ -2459,6 +2525,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 pw = _PendingWrite(m.client, m.tid, remote_n, version,
                                    lock_key=lock_key)
                 pw.span = getattr(m, '_span', None)
+                pw.qphase = getattr(m, '_qos_phase', 0)
                 self._pending_writes[wtid] = pw
             deltas: dict[int, list[tuple[int, bytes]]] = {}
             news: dict[int, list[tuple[int, bytes]]] = {}
@@ -2900,6 +2967,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           offset=m.offset, length=m.length,
                           row_base=row_base, row_len=row_len)
         pr.span = getattr(m, "_span", None)
+        pr.qphase = getattr(m, '_qos_phase', 0)
         self._pending_reads[tid] = pr
         if pr.span is not None:
             # the fan-out stage of a traced read: local shard reads run
@@ -3209,7 +3277,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 if pr.client:
                     self.messenger.send_message(
                         pr.client, MOSDOpReply(pr.client_tid, EAGAIN,
-                                               epoch=epoch))
+                                               epoch=epoch,
+                                               qphase=pr.qphase))
                 return
             chunks = agreed
             # total length must come from an agreed shard, not the merged
@@ -3226,7 +3295,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             err = ENOENT if not pr.chunks else EIO
             if pr.client:
                 self.messenger.send_message(
-                    pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch))
+                    pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch,
+                                           qphase=pr.qphase))
             return
         if pr.stat_only:
             if pr.client:
@@ -3235,7 +3305,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     pr.client,
                     MOSDOpReply(pr.client_tid, 0,
                                 data=size.to_bytes(8, "little"),
-                                epoch=epoch))
+                                epoch=epoch, qphase=pr.qphase))
             return
         # equalize stream lengths (a straggling short shard pads; decode
         # is positional so padding is safe)
@@ -3268,7 +3338,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if pr.client:
             self.messenger.send_message(
                 pr.client,
-                MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch))
+                MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch,
+                            qphase=pr.qphase))
 
     def _ec_total_len(self, pr: _PendingRead) -> int | None:
         if "len" in pr.attrs:
@@ -3296,6 +3367,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             pw = _PendingWrite(m.client, m.tid, remote, version,
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
+            pw.qphase = getattr(m, '_qos_phase', 0)
             self._pending_writes[tid] = pw
         sub_attrs = {"_snap": rider} if rider is not None else {}
         for shard, osd in enumerate(up):
@@ -3508,7 +3580,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                         data=getattr(pw, "reply_data", b"")
                         if result == 0 else b"",
                         version=pw.version,
-                        epoch=self.osdmap.epoch if self.osdmap else 0))
+                        epoch=self.osdmap.epoch if self.osdmap else 0,
+                        qphase=pw.qphase))
         self._obj_unlock(pw.lock_key)
 
     # ----------------------------------------------------------- heartbeats

@@ -108,10 +108,13 @@ class RgwGateway:
     def __init__(self, client: RadosClient, pool: str,
                  host: str = "127.0.0.1", port: int = 0,
                  users: dict[str, str] | None = None,
-                 zone: str = "default"):
+                 zone: str = "default", listen: bool = True):
         """users: access_key -> secret_key registry (RGWUserInfo role);
         None = anonymous gateway (no auth enforced).  zone names this
-        gateway's multisite zone (bilog origin stamping)."""
+        gateway's multisite zone (bilog origin stamping).  listen=False
+        skips binding the HTTP frontend entirely — a store-only
+        gateway for callers (the saturation harness) that drive
+        put_object/get_object directly."""
         self.client = client
         self.pool = pool
         self.users = dict(users) if users is not None else None
@@ -636,12 +639,16 @@ class RgwGateway:
                 except ValueError:
                     self._error(409, "BucketNotEmpty")
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="rgw-frontend",
-            daemon=True)
-        self._thread.start()
+        if listen:
+            self._server = ThreadingHTTPServer((host, port), Handler)
+            self.port = self._server.server_address[1]
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name="rgw-frontend", daemon=True)
+            self._thread.start()
+        else:
+            self._server = None
+            self.port = 0
 
     # ---------------------------------------------------- swift auth
     SWIFT_TOKEN_TTL = 3600.0
@@ -680,6 +687,8 @@ class RgwGateway:
         return user
 
     def stop(self) -> None:
+        if self._server is None:
+            return
         self._server.shutdown()
         self._server.server_close()
 

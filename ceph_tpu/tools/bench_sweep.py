@@ -165,6 +165,23 @@ def configs() -> list[dict]:
                             "recovery_eta_s", "recovery_wall_s",
                             "msgs_per_op", "slow_ops_trips",
                             "qos", "ok"]})
+    # 10. the multi-tenant QoS control plane (ISSUE 12): per-tenant
+    # dmclock streams through the saturation harness, gated on the
+    # three isolation invariants — the compact row tracks the
+    # tenant-isolation ratio (gold flood-p99 / solo-p99 under a bulk
+    # flood), the silver:bronze proportional split, and the adaptive
+    # controller's convergence trajectory from this PR forward
+    out.append({"id": "saturate_tenant", "tool": "bench_root",
+                "argv": ["--saturate", "--tenants"],
+                "extract": ["tenant_isolation_ratio",
+                            "gold_solo_qwait_p99_ms",
+                            "gold_flood_qwait_p99_ms",
+                            "gold_flood_achieved_per_s",
+                            "weight_split_ratio", "weight_served",
+                            "controller_retunes",
+                            "controller_final_res",
+                            "controller_convergence_error",
+                            "qos_events", "invariants", "ok"]})
     return out
 
 

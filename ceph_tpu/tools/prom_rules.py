@@ -26,11 +26,17 @@ PREFIX = "ceph_tpu"
 #: the EC kernel decomposition (compile cliffs / device compute / host
 #: sync), the messenger dispatch latency, and the mclock scheduler's
 #: per-class queue-wait (the QoS quantity the saturation harness's
-#: reservation sweeps move — client vs recovery wait under load)
+#: reservation sweeps move — client vs recovery wait under load).
+#: mclock_qwait_us_tenant_default is the per-TENANT family's anchor:
+#: it exists zeroed on every daemon from boot (scheduler construction
+#: registers it), so the rule never strands — named tenants' series
+#: (mclock_qwait_us_tenant_<name>) appear as tenants register, bounded
+#: by osd_qos_max_tenants, and ride the same bucket contract
 HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
               "msg_dispatch_us",
               "mclock_qwait_us_client", "mclock_qwait_us_recovery",
-              "mclock_qwait_us_scrub")
+              "mclock_qwait_us_scrub",
+              "mclock_qwait_us_tenant_default")
 QUANTILES = (0.50, 0.99)
 
 #: per-daemon tracer head-sampling counters (trace_sample_rate draws):
@@ -92,8 +98,34 @@ def render(rules: list[dict], group: str = "ceph_tpu_latency") -> str:
     return "\n".join(lines) + "\n"
 
 
+def tenant_histograms(tenants) -> tuple:
+    """Histogram names for a deployment's NAMED tenants (the dynamic
+    half of the per-tenant family: the default anchor is always in
+    HISTOGRAMS; named tenants' series exist once those tenants have
+    sent ops, so their rules are generated per deployment via
+    ``--tenants``)."""
+    from ..osd.scheduler import _tenant_metric
+    return tuple(f"mclock_qwait_us_tenant_{_tenant_metric(t)}"
+                 for t in tenants)
+
+
 def main(argv=None) -> int:
-    print(render(recording_rules()), end="")
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="emit Prometheus recording rules for the "
+                    "exporter's pow-2 histograms")
+    ap.add_argument("--tenants", default="",
+                    help="comma-separated tenant names to stand "
+                         "per-tenant mclock_qwait p50/p99 rules for "
+                         "(the default-tenant anchor is always "
+                         "included)")
+    args = ap.parse_args(argv)
+    hists = HISTOGRAMS
+    if args.tenants:
+        names = [t.strip() for t in args.tenants.split(",")
+                 if t.strip()]
+        hists = HISTOGRAMS + tenant_histograms(names)
+    print(render(recording_rules(histograms=hists)), end="")
     return 0
 
 

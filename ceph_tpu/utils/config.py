@@ -334,6 +334,61 @@ OPTIONS: list[Option] = [
            "scrub weight", min=0.001),
     Option("osd_mclock_scrub_lim", float, 0.0, OptionLevel.ADVANCED,
            "scrub limit (ops/s; 0 unlimited)", min=0.0),
+    # multi-tenant QoS (qos/): per-tenant dmclock sub-queues under the
+    # client class + the adaptive recovery-reservation controller
+    Option("osd_qos_max_tenants", int, 64, OptionLevel.ADVANCED,
+           "tenant sub-queues (and per-tenant counter series) one "
+           "scheduler shard keeps: beyond it, idle tenants evict LRU "
+           "and new tenants' counters fold into the default-profile "
+           "series — bounded exporter cardinality under tenant churn",
+           min=1, max=65536),
+    Option("qos_controller", str, "off", OptionLevel.ADVANCED,
+           "adaptive recovery-reservation controller (mgr qos "
+           "module): reads windowed client p99 queue-wait vs recovery "
+           "backlog from metrics_query and retunes "
+           "osd_mclock_recovery_{res,lim} live via reset_mclock — "
+           "AIMD with hysteresis, every retune journaled as a `qos` "
+           "cluster event", enum_values=("on", "off"),
+           see_also=("osd_mclock_recovery_res",)),
+    Option("qos_controller_window_s", float, 3.0, OptionLevel.ADVANCED,
+           "metrics_query window the controller senses client p99 "
+           "queue-wait over", min=0.5, max=600.0,
+           see_also=("qos_controller",)),
+    Option("qos_controller_step", float, 8.0, OptionLevel.ADVANCED,
+           "additive reservation increase per grow move (ops/s)",
+           min=0.1, see_also=("qos_controller",)),
+    Option("qos_controller_backoff", float, 0.5, OptionLevel.ADVANCED,
+           "multiplicative reservation decrease factor per backoff "
+           "move", min=0.05, max=0.95, see_also=("qos_controller",)),
+    Option("qos_controller_p99_low_ms", float, 20.0,
+           OptionLevel.ADVANCED,
+           "client p99 queue-wait below which recovery may grow "
+           "(milliseconds)", min=0.1, see_also=("qos_controller",)),
+    Option("qos_controller_p99_high_ms", float, 100.0,
+           OptionLevel.ADVANCED,
+           "client p99 queue-wait above which recovery backs off "
+           "(milliseconds; the hysteresis band's top)", min=0.1,
+           see_also=("qos_controller_p99_low_ms",)),
+    Option("qos_controller_hold_ticks", int, 2, OptionLevel.ADVANCED,
+           "consecutive ticks a condition must hold before the "
+           "controller acts (hysteresis)", min=1, max=100,
+           see_also=("qos_controller",)),
+    Option("qos_controller_cooldown_ticks", int, 2,
+           OptionLevel.ADVANCED,
+           "ticks of silence after every applied retune", min=0,
+           max=100, see_also=("qos_controller",)),
+    Option("qos_recovery_res_min", float, 4.0, OptionLevel.ADVANCED,
+           "controller clamp: recovery reservation floor (ops/s) — "
+           "the hand-tuned sweep's low endpoint", min=0.1,
+           see_also=("qos_controller",)),
+    Option("qos_recovery_res_max", float, 128.0, OptionLevel.ADVANCED,
+           "controller clamp: recovery reservation ceiling (ops/s) — "
+           "the hand-tuned sweep's high endpoint", min=0.1,
+           see_also=("qos_recovery_res_min",)),
+    Option("qos_recovery_lim_factor", float, 2.0, OptionLevel.ADVANCED,
+           "controller-applied recovery limit = reservation x this "
+           "(0 = leave the limit unlimited)", min=0.0,
+           see_also=("qos_controller",)),
     # recovery reservations + throttles (AsyncReserver / osd_max_backfills
     # / osd_recovery_max_active / osd_recovery_sleep roles)
     Option("osd_max_backfills", int, 2, OptionLevel.ADVANCED,

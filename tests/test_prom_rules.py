@@ -46,7 +46,9 @@ def test_rules_reference_only_emitted_metrics():
     # store with one merged sample (the staleness gauge) — the
     # exporter emits every histogram's +Inf bucket even at zero
     # samples, so the schema exists without traffic
-    from ceph_tpu.osd.scheduler import ClassParams, register_qos_counters
+    from ceph_tpu.osd.scheduler import (ClassParams,
+                                        register_qos_counters,
+                                        register_tenant_counters)
     from ceph_tpu.utils.metrics_history import MetricsHistoryStore
     from ceph_tpu.utils.perf import global_perf
     from ceph_tpu.utils.tracer import Tracer
@@ -58,6 +60,9 @@ def test_rules_reference_only_emitted_metrics():
         "client": ClassParams(0, 1, 0),
         "recovery": ClassParams(0, 1, 0),
         "scrub": ClassParams(0, 1, 0)})
+    # the per-tenant family's always-present anchor (the scheduler
+    # registers it at construction — same zeroed-schema contract)
+    register_tenant_counters(qos_probe, ("default",))
     Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
     import time as _time
     store = MetricsHistoryStore()
@@ -83,10 +88,10 @@ def test_rules_shape_and_rendering():
     rules = recording_rules()
     # one rule per (histogram, quantile) + one rate rule per tracer
     # counter + the staleness max, records namespaced
-    assert len(rules) == 17
+    assert len(rules) == 19
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
-    assert len(hist) == 14
+    assert len(hist) == 16
     assert all("by (daemon, le)" in r["expr"] for r in hist)
     quantiles = {r["record"].rsplit(":", 1)[1] for r in hist}
     assert quantiles == {"p50", "p99"}
@@ -102,8 +107,20 @@ def test_rules_shape_and_rendering():
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 17
-    assert text.count("    expr: ") == 17
+    assert text.count("  - record: ") == 19
+    assert text.count("    expr: ") == 19
+    # per-tenant family: the default anchor is standing, and named
+    # tenants generate the same rule shape via tenant_histograms
+    from ceph_tpu.tools.prom_rules import tenant_histograms
+    named = recording_rules(
+        histograms=tenant_histograms(("gold", "Bul-k!")))
+    recs = {r["record"] for r in named
+            if "histogram_quantile(" in r["expr"]}
+    assert ("ceph_tpu:daemon_mclock_qwait_us_tenant_gold:p99"
+            in recs)
+    # names sanitize exactly like the scheduler's counter stems
+    assert ("ceph_tpu:daemon_mclock_qwait_us_tenant_bul_k_:p50"
+            in recs)
 
 
 def test_exporter_histogram_buckets_are_cumulative_le():

@@ -1,0 +1,642 @@
+"""Multi-tenant QoS control plane (ceph_tpu.qos + scheduler tenant
+sub-queues): dmclock tag arithmetic, wire compatibility of the new
+trailing fields, per-tenant scheduling, the adaptive reservation
+controller's AIMD/hysteresis steps, exporter-cardinality bounds, and
+the two-tenant MiniCluster e2e with byte-identical IO.
+
+The heavyweight multi-stream isolation gates (reserved-p99 envelope
+under flood, proportional weight split, controller convergence under a
+thrash storm) live in `bench.py --saturate --tenants`; the `slow` test
+at the bottom runs that engine once.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from ceph_tpu.osd.scheduler import (ClassParams, MClockScheduler,
+                                    register_tenant_counters)
+from ceph_tpu.qos.controller import (ControllerKnobs,
+                                     ReservationController)
+from ceph_tpu.qos.dmclock import (PHASE_RESERVATION, PHASE_WEIGHT,
+                                  ServiceTracker)
+from ceph_tpu.qos.profiles import (TenantProfile, parse_profile,
+                                   params_from_map, profiles_from_map)
+
+
+# ------------------------------------------------------ tag arithmetic
+def test_tracker_delta_rho_across_two_osds():
+    """The multi-server dmclock property: replies from osd.A advance
+    the (delta, rho) pair shipped to osd.B — so B learns how much
+    service the tenant got elsewhere without any global clock."""
+    clock = [1000.0]
+    tr = ServiceTracker(idle_age_s=60.0, clock=lambda: clock[0])
+    # first request to each server: the neutral (1, 1)
+    assert tr.tags_for("osd.a") == (1, 1)
+    assert tr.tags_for("osd.b") == (1, 1)
+    # 5 replies from A: 2 reservation-phase, 3 weight-phase
+    for phase in (PHASE_RESERVATION, PHASE_WEIGHT, PHASE_WEIGHT,
+                  PHASE_RESERVATION, PHASE_WEIGHT):
+        tr.note_reply("osd.a", phase)
+    # next request to B counts everything since B's last request
+    assert tr.tags_for("osd.b") == (6, 3)   # 5 responses + self, 2 + 1
+    # ... and the pair resets: an immediate follow-up is neutral again
+    assert tr.tags_for("osd.b") == (1, 1)
+    # A's own next request also counts its own replies (single-server
+    # degenerates to ~1 only when replies interleave requests 1:1)
+    assert tr.tags_for("osd.a") == (6, 3)
+
+
+def test_tracker_reset_on_reconnect_and_idle_decay():
+    clock = [0.0]
+    tr = ServiceTracker(idle_age_s=10.0, clock=lambda: clock[0])
+    tr.tags_for("osd.a")
+    for _ in range(4):
+        tr.note_reply("osd.a", PHASE_WEIGHT)
+    # reconnect: forget() restarts the pair at neutral
+    tr.forget("osd.a")
+    assert tr.tags_for("osd.a") == (1, 1)
+    for _ in range(3):
+        tr.note_reply("osd.a", PHASE_RESERVATION)
+    # idle decay: past idle_age_s the pair restarts instead of
+    # replaying ancient foreign service into one giant tag
+    clock[0] += 11.0
+    assert tr.tags_for("osd.a") == (1, 1)
+    # ... and long-idle servers are swept from the table entirely
+    tr.tags_for("osd.b")
+    clock[0] += 11.0
+    tr.tags_for("osd.b")
+    clock[0] += 11.0
+    tr.tags_for("osd.b")
+    assert tr.tracked_servers() == ["osd.b"]
+
+
+# ------------------------------------------------- wire compatibility
+def test_mosdop_v5_tags_roundtrip_and_old_bytes_decode():
+    """The new trailing fields ride the wire; archived pre-tenant
+    bytes (the corpus blobs) decode to defaults — the rolling-restart
+    contract test_wire_corpus.py pins for every registered type."""
+    import ceph_tpu
+    from ceph_tpu.msg.messages import MOSDOp, MOSDOpReply
+    from ceph_tpu.msg.wire import decode_frame, encode_frame
+
+    m = MOSDOp(7, "client.t", 1, "o", "write", 0, 9, b"x" * 9, 3,
+               tenant="gold", qdelta=12, qrho=4)
+    _src, _dst, got = decode_frame(encode_frame("a", "b", m)[4:])
+    assert (got.tenant, got.qdelta, got.qrho) == ("gold", 12, 4)
+    r = MOSDOpReply(7, 0, b"", 5, 3, qphase=PHASE_RESERVATION)
+    _src, _dst, gr = decode_frame(encode_frame("a", "b", r)[4:])
+    assert gr.qphase == PHASE_RESERVATION
+    # archived pre-v5/pre-v2 bytes decode with default tails
+    repo = os.path.dirname(os.path.dirname(
+        os.path.abspath(ceph_tpu.__file__)))
+    corpus = os.path.join(repo, "corpus_wire")
+    raw = open(os.path.join(corpus, "msg_MOSDOp.bin"), "rb").read()
+    _s, _d, old = decode_frame(raw[4:])
+    assert (old.tenant, old.qdelta, old.qrho) == ("", 0, 0)
+    raw = open(os.path.join(corpus, "msg_MOSDOpReply.bin"),
+               "rb").read()
+    _s, _d, oldr = decode_frame(raw[4:])
+    assert oldr.qphase == 0
+
+
+def test_osdmap_qos_profiles_roundtrip_and_incremental():
+    from ceph_tpu.mon.maps import OSDMap, OSDMapIncremental
+    m = OSDMap()
+    m.epoch = 5
+    m.qos_profiles["gold"] = {"res": 60.0, "wgt": 8.0, "lim": 0.0}
+    m2 = OSDMap.decode_bytes(m.encode_bytes())
+    assert m2.qos_profiles == m.qos_profiles
+    old = OSDMap()
+    old.epoch = 4
+    old.qos_profiles["dead"] = {"res": 1.0, "wgt": 1.0, "lim": 2.0}
+    inc = m.diff_from(old)
+    inc2 = OSDMapIncremental.decode_bytes(inc.encode_bytes())
+    assert inc2.qos_set == {"gold": m.qos_profiles["gold"]}
+    assert inc2.qos_rm == ["dead"]
+    old.apply_incremental(inc2)
+    assert old.qos_profiles == m.qos_profiles
+    assert old.epoch == 5
+
+
+# --------------------------------------------------- profile grammar
+def test_profile_grammar_and_map_parsing():
+    p = parse_profile("gold", "res=50,wgt=4,lim=200")
+    assert (p.reservation, p.weight, p.limit) == (50.0, 4.0, 200.0)
+    assert p.spec() == "res=50,wgt=4,lim=200"
+    assert parse_profile("t", "").weight == 1.0
+    with pytest.raises(ValueError):
+        parse_profile("t", "nope=3")
+    with pytest.raises(ValueError):
+        parse_profile("t", "wgt=zero")
+    with pytest.raises(ValueError):
+        TenantProfile("Bad Name!")
+    with pytest.raises(ValueError):
+        TenantProfile("t", weight=0.0)
+    # map form round-trips; junk entries degrade instead of raising
+    book = profiles_from_map({"gold": {"res": 9, "wgt": 3, "lim": 0},
+                              "junk": {"wgt": "x"},
+                              "BAD NAME": {}})
+    assert book["gold"].reservation == 9.0
+    assert book["junk"].weight == 1.0       # degraded to defaults
+    assert "BAD NAME" not in book           # unusable name skipped
+    # the map form yields raw ClassParams (the scheduler clamps
+    # res > lim on ingestion, not here)
+    params = params_from_map({"gold": {"res": 9, "wgt": 3, "lim": 4}})
+    assert params["gold"] == ClassParams(9.0, 3.0, 4.0)
+
+
+# ------------------------------------------- tenant scheduling (unit)
+def _drain_tenants(s, clock, seconds, capacity=1000.0):
+    served = {}
+    end = clock[0] + seconds
+    while clock[0] < end:
+        klass, res = s._pick(clock[0])
+        if klass is None:
+            clock[0] = min(end, res if res is not None
+                           else clock[0] + 0.01)
+            continue
+        _item, _phase, tenant = s._dequeue_locked(klass, res, clock[0])
+        served[tenant] = served.get(tenant, 0) + 1
+        clock[0] += 1.0 / capacity
+    return served
+
+
+def test_tenant_weight_split_and_reservation_floor():
+    """Weights split capacity among backlogged tenants; a reserved
+    tenant keeps its floor against heavier-weighted competition."""
+    clock = [100.0]
+    s = MClockScheduler(
+        lambda k, i: None, {"client": ClassParams(0.0, 10.0, 0.0)},
+        clock=lambda: clock[0],
+        tenant_profiles={"a": ClassParams(0.0, 4.0, 0.0),
+                         "b": ClassParams(0.0, 2.0, 0.0),
+                         "g": ClassParams(50.0, 0.001, 0.0)})
+    # incremental arrivals so no queue exhausts inside the window
+    for _ in range(400):
+        s.enqueue("client", object(), tenant="a", tags=(1, 1))
+        s.enqueue("client", object(), tenant="b", tags=(1, 1))
+    for _ in range(200):
+        s.enqueue("client", object(), tenant="g", tags=(1, 1))
+    served = _drain_tenants(s, clock, 0.55)
+    ratio = served["a"] / max(1, served["b"])
+    assert 1.4 < ratio < 3.0, served          # ~2:1 by weight
+    assert served["g"] >= 22, served          # >= ~0.5s * 50/s floor
+
+
+def test_tenant_limit_caps_and_unknown_tenant_defaults():
+    clock = [100.0]
+    s = MClockScheduler(
+        lambda k, i: None, {"client": ClassParams(0.0, 10.0, 0.0)},
+        clock=lambda: clock[0],
+        tenant_profiles={"capped": ClassParams(0.0, 100.0, 50.0)})
+    for _ in range(400):
+        s.enqueue("client", object(), tenant="capped", tags=(1, 1))
+        # never named in any profile: dynamic registration under the
+        # DEFAULT profile — isolated sub-queue, neutral params
+        s.enqueue("client", object(), tenant="stranger", tags=(1, 1))
+    served = _drain_tenants(s, clock, 2.0)
+    assert 90 <= served["capped"] <= 115, served   # ~2s * 50/s cap
+    assert served["stranger"] >= 400 - served["capped"] - 50, served
+    assert "stranger" in s._tqueues
+
+
+def test_rho_advances_reservation_clock_multi_server():
+    """An op whose rho says 'I was served by reservation N times
+    elsewhere' advances the local reservation clock by N/R — the
+    cluster grants ONE floor, not one per OSD."""
+    def run(rho: int) -> int:
+        clock = [100.0]
+        s = MClockScheduler(
+            lambda k, i: None,
+            {"client": ClassParams(0.0, 10.0, 0.0)},
+            clock=lambda: clock[0],
+            tenant_profiles={"g": ClassParams(50.0, 0.001, 0.0),
+                             "noise": ClassParams(0.0, 1000.0, 0.0)})
+        # a heavy competing stream wins every weight pick, so g's
+        # service is ~reservation-only — the rho effect in isolation.
+        # The window stays SHORT of noise's QUEUE_CAP backlog (512 at
+        # capacity 1000/s) so the competitor never drains away.
+        for _ in range(100):
+            s.enqueue("client", object(), tenant="g", tags=(1, rho))
+        for _ in range(3000):
+            s.enqueue("client", object(), tenant="noise",
+                      tags=(1, 1))
+        return _drain_tenants(s, clock, 0.4).get("g", 0)
+
+    # rho=5 per op: each arrival advances the r clock 5x further than
+    # a rho=1 op would — eligibility (and so the floor) thins out 5x:
+    # the cluster-wide reservation is granted ONCE, not once per OSD
+    served_rho1, served_rho5 = run(1), run(5)
+    assert 15 <= served_rho1 <= 30, (served_rho1, served_rho5)
+    assert served_rho1 >= 3 * max(1, served_rho5), \
+        (served_rho1, served_rho5)
+
+
+def test_tenant_lru_eviction_and_counter_fold():
+    """Cardinality bounds: tenant streams LRU-evict at
+    osd_qos_max_tenants; counter names stop registering past the bound
+    and fold into the default series (the exporter face stays
+    bounded under tenant churn)."""
+    from ceph_tpu.utils.perf import PerfCounters
+    perf = PerfCounters("tenant_lru_probe")
+    clock = [100.0]
+    s = MClockScheduler(
+        lambda k, i: None, {"client": ClassParams(0.0, 10.0, 0.0)},
+        clock=lambda: clock[0], perf=perf, max_tenants=3)
+    # register 3 tenants, drain them so they are idle
+    for t in ("t0", "t1", "t2"):
+        s.enqueue("client", object(), tenant=t, tags=(1, 1))
+    _drain_tenants(s, clock, 0.1)
+    assert set(s._tqueues) == {"t0", "t1", "t2"}
+    # a 4th tenant evicts the LRU idle stream (t0)
+    clock[0] += 1.0
+    s.enqueue("client", object(), tenant="t3", tags=(1, 1))
+    assert "t0" not in s._tqueues and "t3" in s._tqueues
+    assert s.tenant_evicted == 1
+    # counter registration is bounded at max_tenants FOREVER: t3's
+    # service books into the default series, not a fresh name
+    _drain_tenants(s, clock, 0.1)
+    assert perf.has("mclock_served_tenant_t0")       # registered early
+    assert not perf.has("mclock_served_tenant_t3")   # folded
+    assert perf.get("mclock_served_tenant_default") >= 1
+    # ... and when every stream is busy, a new tenant's op folds into
+    # the untagged stream instead of growing state without bound
+    for t in ("t1", "t2", "t3"):
+        s.enqueue("client", object(), tenant=t, tags=(1, 1))
+    s.enqueue("client", object(), tenant="t9", tags=(1, 1))
+    assert "t9" not in s._tqueues
+    assert s.tenant_folded == 1
+    assert len(s._queues["client"]) == 1   # rode the untagged stream
+
+
+def test_zeroed_tenant_schema_is_stable():
+    """The default-tenant series exists zeroed from construction —
+    same schema on every backend, before any tenant traffic."""
+    from ceph_tpu.utils.perf import PerfCounters
+    perf = PerfCounters("tenant_schema_probe")
+    MClockScheduler(lambda k, i: None,
+                    {"client": ClassParams(0, 1, 0)}, perf=perf)
+    assert perf.get("mclock_served_tenant_default") == 0
+    assert perf.get("mclock_depth_tenant_default") == 0
+    assert perf.dump()["mclock_qwait_us_tenant_default"]["count"] == 0
+    # idempotent re-registration never resets live counters
+    perf.inc("mclock_served_tenant_default", 7)
+    register_tenant_counters(perf, ("default",))
+    assert perf.get("mclock_served_tenant_default") == 7
+
+
+def test_threaded_tenant_service_publishes_phase():
+    """Through the real worker thread: tenant items serve, and the
+    thread-local service context the OSD stamps replies from carries
+    the (klass, phase, tenant) triple during the handler call."""
+    import threading
+
+    from ceph_tpu.osd.scheduler import current_service
+    seen = []
+    done = threading.Event()
+
+    def handler(klass, item):
+        seen.append(current_service())
+        if len(seen) >= 20:
+            done.set()
+
+    s = MClockScheduler(
+        handler, {"client": ClassParams(0, 100, 0)},
+        tenant_profiles={"g": ClassParams(1000.0, 1.0, 0.0)})
+    s.start()
+    try:
+        for _ in range(20):
+            s.enqueue("client", object(), tenant="g", tags=(1, 1))
+        assert done.wait(10)
+    finally:
+        s.shutdown()
+    assert all(k == "client" and t == "g" for k, _p, t in seen)
+    phases = {p for _k, p, _t in seen}
+    assert phases <= {PHASE_RESERVATION, PHASE_WEIGHT}
+    assert PHASE_RESERVATION in phases   # res 1000/s: floor dominates
+    # off the worker threads the context is empty
+    assert current_service() == (None, 0, None)
+
+
+def test_idle_class_catchup_counts_tenant_depth():
+    """A newly-busy background class must catch its proportional
+    clock up to the CLIENT class's even when every client op lives in
+    a tenant sub-queue (the plain deque is empty) — otherwise
+    recovery starts at p=0 and starves tenant-tagged client IO."""
+    clock = [100.0]
+    s = MClockScheduler(
+        lambda k, i: None,
+        {"client": ClassParams(0.0, 10.0, 0.0),
+         "recovery": ClassParams(0.0, 1.0, 0.0)},
+        clock=lambda: clock[0],
+        tenant_profiles={"gold": ClassParams(0.0, 1.0, 0.0)})
+    for _ in range(500):
+        s.enqueue("client", object(), tenant="gold", tags=(1, 1))
+    for _ in range(250):
+        k, r = s._pick(clock[0])
+        s._dequeue_locked(k, r, clock[0])
+        clock[0] += 0.001
+    for _ in range(200):
+        s.enqueue("recovery", object())
+    wins = {"client": 0, "recovery": 0}
+    for _ in range(60):
+        k, r = s._pick(clock[0])
+        s._dequeue_locked(k, r, clock[0])
+        wins[k] += 1
+        clock[0] += 0.001
+    # ~10:1 by class weights; pre-fix recovery took 51/60
+    assert wins["recovery"] <= 15, wins
+
+
+def test_untagged_burst_cannot_outrank_busy_tenant():
+    """The untagged/default stream's sub-clock catches up to the busy
+    tenant floor on idle->busy — a fresh untagged burst must compete
+    at the tenants' current round, not replay from p=0."""
+    clock = [100.0]
+    s = MClockScheduler(
+        lambda k, i: None, {"client": ClassParams(0.0, 10.0, 0.0)},
+        clock=lambda: clock[0],
+        tenant_profiles={"gold": ClassParams(0.0, 1.0, 0.0)})
+    for _ in range(500):
+        s.enqueue("client", object(), tenant="gold", tags=(1, 1))
+    for _ in range(200):
+        k, r = s._pick(clock[0])
+        s._dequeue_locked(k, r, clock[0])
+        clock[0] += 0.001
+    for _ in range(300):
+        s.enqueue("client", object())   # untagged burst
+    wins = {"gold": 0, "default": 0}
+    for _ in range(100):
+        k, r = s._pick(clock[0])
+        _i, _p, t = s._dequeue_locked(k, r, clock[0])
+        wins[t] += 1
+        clock[0] += 0.001
+    # equal weights -> ~50/50; pre-fix untagged took 100/100
+    assert wins["gold"] >= 30, wins
+
+
+# ------------------------------------------------- controller (unit)
+def test_controller_aimd_steps_with_hysteresis():
+    k = ControllerKnobs(res_min=4.0, res_max=128.0, step=8.0,
+                        backoff=0.5, p99_low_us=20e3, p99_high_us=100e3,
+                        hold=2, cooldown=1, lim_factor=2.0)
+    c = ReservationController(k, res0=16.0)
+    # hysteresis: ONE cold tick does not act
+    assert c.observe(5e3, backlog=10, recovery_active=True) is None
+    # second consecutive cold tick: additive increase
+    assert c.observe(5e3, 10, True) == (24.0, 48.0)
+    # cooldown tick: silent even though still cold
+    assert c.observe(5e3, 10, True) is None
+    # condition persisted through cooldown: acts the instant it lifts
+    assert c.observe(5e3, 10, True) == (32.0, 64.0)
+    # hot ticks: multiplicative decrease once hold is met (the
+    # counters advance THROUGH the grow's cooldown tick)
+    assert c.observe(500e3, 10, True) is None     # hot 1/2 + cooldown
+    assert c.observe(500e3, 10, True) == (16.0, 32.0)
+    assert c.history[-1].reason == "backoff"
+    # clamps: repeated backoff floors at res_min
+    for _ in range(20):
+        c.observe(500e3, 10, True)
+    assert c.res == k.res_min
+    # no backlog and comfortable clients: steady, no move
+    c2 = ReservationController(k, res0=16.0)
+    for _ in range(10):
+        assert c2.observe(5e3, backlog=0,
+                          recovery_active=False) is None
+    # mid-band p99 (between low and high): steady too
+    for _ in range(10):
+        assert c2.observe(50e3, 10, True) is None
+    assert c2.retunes() == 0
+
+
+def test_controller_ceiling_and_convergence_metrics():
+    k = ControllerKnobs(res_min=4.0, res_max=40.0, step=16.0,
+                        backoff=0.5, hold=1, cooldown=0)
+    c = ReservationController(k, res0=4.0)
+    while c.res < k.res_max:
+        c.observe(1e3, 5, True)
+    assert c.res == 40.0
+    # at the ceiling: cold ticks no longer retune
+    assert c.observe(1e3, 5, True) is None
+    assert c.converged_between()            # moved, inside (min, max]
+    assert 0.0 < c.convergence_error() < 1.0
+    st = c.status()
+    assert st["retunes"] == len(st["history"]) >= 3
+    assert st["history"][0]["reason"] == "grow"
+
+
+def test_controller_mgr_module_applies_and_journals():
+    """The mgr qos module wired to a stub mon: metrics windows in,
+    reset_mclock-shaped applies out, a `qos` cluster event per move."""
+    import threading
+
+    from ceph_tpu.mon.mgr import MgrDaemon
+    from ceph_tpu.utils.config import default_config
+    from ceph_tpu.utils.event_log import ClusterLog
+    from ceph_tpu.utils.metrics_history import MetricsHistoryStore
+
+    class StubProgress:
+        def active(self):
+            return [{"id": "recovery/x"}]
+
+    class StubMon:
+        def __init__(self):
+            self.cfg = default_config()
+            self.name = "mon.stub"
+            self._lock = threading.RLock()
+            self.metrics_history = MetricsHistoryStore()
+            self.progress = StubProgress()
+            self.cluster_log = ClusterLog()
+
+    mon = StubMon()
+    mon.cfg.apply_dict({"qos_controller": "on",
+                        "qos_controller_hold_ticks": 1,
+                        "qos_controller_cooldown_ticks": 0})
+    # two snapshots with a LOW client qwait p99 and recovery backlog
+    now = time.time()
+    mon.metrics_history.merge("osd.0", {"osd.0": [
+        {"ts": now - 2.0, "seq": 1, "counters": {
+            "mclock_qwait_us_client": {"buckets_pow2": {}, "count": 0,
+                                       "sum": 0.0},
+            "mclock_depth_recovery": 0}},
+        {"ts": now, "seq": 2, "counters": {
+            "mclock_qwait_us_client": {"buckets_pow2": {"10": 50},
+                                       "count": 50, "sum": 40000.0},
+            "mclock_depth_recovery": 30}},
+    ]})
+    applied = []
+    mgr = MgrDaemon.__new__(MgrDaemon)  # no tick thread
+    mgr.mon = mon
+    mgr._modules = {}
+    from ceph_tpu.mon.mgr import QosModule
+    mod = QosModule(mgr)
+    mod.bind(lambda res, lim: applied.append((res, lim)), res0=4.0)
+    mod.tick()
+    assert applied == [(12.0, 24.0)]     # 4 + step 8, lim = 2x
+    st = mod.command("status")
+    assert st["enabled"] and st["bound"]
+    assert st["controller"]["retunes"] == 1
+    events = mon.cluster_log.dump(channel="qos")["events"]
+    assert len(events) == 1
+    assert events[0]["fields"]["reason"] == "grow"
+    assert events[0]["fields"]["res"] == 12.0
+    # config-gated: off -> inert
+    mon.cfg.set("qos_controller", "off")
+    mod.tick()
+    assert len(applied) == 1
+    # staleness fence: a dead OSD's final nonzero recovery depth must
+    # not read as live backlog forever (phantom backlog would walk
+    # the reservation to its ceiling)
+    mon2 = StubMon()
+    mon2.cfg.apply_dict({"qos_controller": "on",
+                         "qos_controller_hold_ticks": 1,
+                         "qos_controller_cooldown_ticks": 0})
+    mon2.progress = type("P", (), {"active": lambda self: []})()
+    stale_ts = time.time() - 3600.0
+    mon2.metrics_history.merge("osd.9", {"osd.9": [
+        {"ts": stale_ts - 1.0, "seq": 1, "counters":
+            {"mclock_depth_recovery": 40}},
+        {"ts": stale_ts, "seq": 2, "counters":
+            {"mclock_depth_recovery": 40}}]})
+    applied2 = []
+    mod2 = QosModule(mgr)
+    mod2.mgr = type("G", (), {"mon": mon2})()
+    mod2.bind(lambda res, lim: applied2.append((res, lim)), res0=4.0)
+    for _ in range(5):
+        mod2.tick()
+    assert applied2 == []   # stale backlog sensed as none -> steady
+
+
+# ----------------------------------------------------------- e2e legs
+def _make_cluster():
+    from ceph_tpu.tools.vstart import MiniCluster
+    from ceph_tpu.utils.config import default_config
+    cfg = default_config()
+    cfg.apply_dict({"osd_heartbeat_interval": 0.05,
+                    "osd_heartbeat_grace": 0.5,
+                    "ec_backend": "native",
+                    "osd_op_num_shards": 2})
+    return MiniCluster(n_osds=3, cfg=cfg).start()
+
+
+def test_e2e_two_tenant_minicluster_byte_identical():
+    """The tier-1 e2e: tenant profiles committed via `osd qos
+    set-profile` reach every OSD's scheduler through the map, two
+    tenants' IO round-trips byte-identically through their dmclock
+    sub-queues, per-tenant counters move, and the phase feedback
+    reaches the clients' ServiceTrackers."""
+    from ceph_tpu.client.rados import RadosClient
+    c = _make_cluster()
+    try:
+        admin = c.client()
+        admin.create_pool("p", kind="ec", pg_num=4,
+                          ec_profile={"plugin": "jerasure", "k": "2",
+                                      "m": "1", "backend": "numpy"})
+        admin.mon_command({"prefix": "osd qos set-profile",
+                           "name": "gold", "res": 50.0, "wgt": 8.0,
+                           "lim": 0.0})
+        admin.mon_command({"prefix": "osd qos set-profile",
+                           "name": "bulk", "res": 0.0, "wgt": 1.0,
+                           "lim": 0.0})
+        ls = admin.mon_command({"prefix": "osd qos ls"})
+        assert set(ls["profiles"]) == {"gold", "bulk"}
+        # profiles ride the map to every OSD scheduler
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if all("gold" in o.scheduler.shards[0]._tparams
+                   and "bulk" in o.scheduler.shards[0]._tparams
+                   for o in c.osds.values()):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("profiles never reached the OSDs")
+        gold = RadosClient(c.network, "client.gold",
+                           mons=c.mon_names, tenant="gold").connect()
+        bulk = RadosClient(c.network, "client.bulk",
+                           mons=c.mon_names, tenant="bulk").connect()
+        payloads = {}
+        for i in range(10):
+            payloads[f"g{i}"] = os.urandom(3000 + i)
+            payloads[f"b{i}"] = os.urandom(2000 + i)
+            gold.write_full("p", f"g{i}", payloads[f"g{i}"])
+            bulk.write_full("p", f"b{i}", payloads[f"b{i}"])
+        for i in range(10):
+            assert gold.read("p", f"g{i}") == payloads[f"g{i}"]
+            assert bulk.read("p", f"b{i}") == payloads[f"b{i}"]
+        # server side: both tenants served through their sub-queues
+        served = {}
+        for o in c.osds.values():
+            for t, n in o.scheduler.tenant_served.items():
+                served[t] = served.get(t, 0) + n
+        assert served.get("gold", 0) >= 20
+        assert served.get("bulk", 0) >= 20
+        # per-tenant counters on the daemon registries moved
+        total_gold = sum(o.perf.get("mclock_served_tenant_gold")
+                         for o in c.osds.values())
+        assert total_gold == served["gold"]
+        # the admin verb surfaces tenant state
+        dq = c.osds[0].admin_command("dump_op_queue")
+        assert "gold" in dq["tenant_served"]
+        # phase feedback: both trackers absorbed replies, and at
+        # least one gold op was served by reservation cluster-wide
+        gd, gr = gold.qos_tracker.totals()
+        assert gd >= 20
+        bd, _br = bulk.qos_tracker.totals()
+        assert bd >= 20
+        assert gr >= 1, "no reservation-phase feedback reached gold"
+        # rm-profile commits a map that drops the tenant back to the
+        # default profile book
+        admin.mon_command({"prefix": "osd qos rm-profile",
+                           "name": "bulk"})
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if all("bulk" not in o.scheduler.shards[0]._tparams
+                   for o in c.osds.values()):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("rm-profile never converged")
+        gold.close()
+        bulk.close()
+    finally:
+        c.stop()
+
+
+def test_rgw_frontend_saturation_smoke():
+    """ROADMAP saturation follow-on (b): the SAME harness profile
+    drives the RgwGateway PUT/GET object path instead of raw librados
+    — identical legs, histograms and structural invariants (the load
+    model is front-end agnostic).  Thrash-free and seconds-bounded to
+    stay tier-1-safe."""
+    from ceph_tpu.load.scenarios import ScenarioConfig, run_point
+    cfg = ScenarioConfig(
+        point_id="rgw_smoke", frontend="rgw", procs=2, clients=8,
+        objects=12, obj_bytes=4096, ramp_rates=(30.0,),
+        ramp_leg_s=1.0, steady_s=2.0, thrash=False)
+    row = run_point(cfg)
+    assert row["invariants"]["no_deadlock"], json.dumps(row, indent=1)
+    assert row["invariants"]["queues_bounded"]
+    steady = row["steady"]
+    assert steady["achieved_per_s"] > 0
+    # both op classes measured through the gateway path
+    assert steady["read"]["ops"] > 0 and steady["write"]["ops"] > 0
+    assert steady["read"]["p99_ms"] is not None
+
+
+@pytest.mark.slow
+def test_tenant_isolation_full_point():
+    """The full `bench.py --saturate --tenants` engine: four aligned
+    tenant streams, bulk flood vs gold's reserved envelope, the
+    silver:bronze weight split, and controller convergence under a
+    kill/revive storm."""
+    from ceph_tpu.load.scenarios import (TenantScenarioConfig,
+                                         run_tenant_point)
+    row = run_tenant_point(TenantScenarioConfig())
+    assert row["ok"], json.dumps(
+        {k: row[k] for k in ("invariants", "tenant_isolation_ratio",
+                             "weight_split_ratio",
+                             "controller_trajectory",
+                             "worker_errors")}, indent=1)

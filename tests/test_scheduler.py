@@ -6,7 +6,6 @@ IO (ref src/osd/scheduler/mClockScheduler.cc + dmclock).
 import time
 
 import numpy as np
-import pytest
 
 from ceph_tpu.client.rados import RadosError
 from ceph_tpu.osd.scheduler import ClassParams, MClockScheduler
@@ -139,8 +138,15 @@ def test_set_params_retunes_live_scheduler():
     s.set_params("recovery", ClassParams(0.0, 1.0, 200.0))
     served = drain(s, clock, 1.0)
     assert served["recovery"] >= 150                 # ~1s * 200/s
-    with pytest.raises(KeyError):
-        s.set_params("nope", ClassParams(0, 1, 0))
+    # a class this scheduler never served AUTO-REGISTERS with clamped
+    # defaults (the reset_mclock-on-a-fresh-daemon satellite: the
+    # admin verb must configure, not 500 with a KeyError)
+    s.set_params("late", ClassParams(500.0, 1.0, 50.0))
+    assert s._classes["late"].reservation == 50.0    # clamped to lim
+    for _ in range(100):
+        s._queues["late"].append(object())
+    served = drain(s, clock, 1.0)
+    assert served["late"] <= 75                      # paced by its lim
     # reservation above the limit clamps to it (constructor rule)
     s.set_params("recovery", ClassParams(500.0, 1.0, 50.0))
     assert s._classes["recovery"].reservation == 50.0
