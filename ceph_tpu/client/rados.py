@@ -561,8 +561,12 @@ class RadosClient(Dispatcher):
              length: int = 0, snapid: int = 0) -> bytes:
         """snapid > 0 reads the object's state as of that snapshot
         (rados_ioctx_snap_set_read role)."""
-        return self._op(pool, oid, "read", offset=offset,
+        data = self._op(pool, oid, "read", offset=offset,
                         length=length, snapid=snapid).data
+        # the librados boundary promises bytes: a zero-copy carve over
+        # the rx frame buffer detaches HERE — the one ingest copy into
+        # user space (the daemon-internal wire path stays copy-free)
+        return bytes(data) if isinstance(data, memoryview) else data
 
     def remove(self, pool: str, oid: str) -> None:
         self._op(pool, oid, "remove")

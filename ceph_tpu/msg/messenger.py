@@ -31,9 +31,17 @@ from ..utils.perf import CounterType, global_perf
 from ..utils.throttle import Throttle
 
 #: perf counters every messenger registers (schema is stable even for
-#: idle endpoints, so scrapes see one shape across the cluster)
+#: idle endpoints, so scrapes see one shape across the cluster).  The
+#: msg_tx_flatten_* / msg_rx_copy_* pairs are the zero-copy wire
+#: path's measured "copies per hop": every Python-side assembly of an
+#: outgoing frame's payload (compression join, secure-mode seal) and
+#: every receive-side payload copy (decrypt, decompress) is counted —
+#: plaintext data frames book ZERO on both, the kernel's iovec
+#: gather/scatter being the only remaining copy.
 MSG_COUNTERS = ("msg_dispatched", "msg_drop_wire",
-                "msg_drop_backpressure")
+                "msg_drop_backpressure",
+                "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
+                "msg_rx_copy_bytes", "msg_rx_copy_copies")
 MSG_HISTOGRAMS = ("msg_dispatch_us",)
 MSG_TIMES = ("msg_throttle_wait_time",)
 MSG_GAUGES = ("msg_queue_depth",)

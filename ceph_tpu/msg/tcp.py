@@ -30,9 +30,10 @@ import time
 import collections
 import secrets
 
+from ..utils.codec import SEG_REF_MIN
 from ..utils.log import dout
 from .messenger import Network
-from .wire import decode_frame, encode_frame
+from .wire import decode_frame, frame_encoder
 
 _AUTH_MAGIC = b"CTPX1\0"
 _RESM_MAGIC = b"RESM"
@@ -40,23 +41,70 @@ _TAG_LEN = 16
 _RING_MAX = 512          # replayable frames kept per session
 _RING_MAX_BYTES = 32 << 20  # payload-byte budget per session ring
 _STASH_MAX = 64          # dead sessions kept for resume
+_IOV_CAP = 512           # segments per sendmsg call (under IOV_MAX)
+#: frames up to this size are received into ONE reusable buffer (and
+#: decoded fully-detached); larger frames get a fresh buffer so decode
+#: can carve zero-copy views that stay valid by refcount after the
+#: read loop moves on.  Equal to the carve threshold on purpose: a
+#: frame small enough for the reuse buffer cannot contain a carvable
+#: blob, so reuse never aliases a live payload.
+_RECV_REUSE_MAX = SEG_REF_MIN
 
 
-def _mac(key: bytes, *parts: bytes) -> bytes:
+def _mac(key: bytes, *parts) -> bytes:
     return hmac.new(key, b"".join(parts), hashlib.sha256).digest()
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
-    buf = bytearray()
-    while len(buf) < n:
+def _recv_into(sock: socket.socket, mv: memoryview) -> bool:
+    """Fill mv exactly from the socket (recv_into: no per-chunk
+    accumulation copies).  False on EOF/reset."""
+    got, n = 0, len(mv)
+    while got < n:
         try:
-            chunk = sock.recv(min(1 << 20, n - len(buf)))
+            r = sock.recv_into(mv[got:])
         except OSError:  # peer reset / socket closed under us
-            return None
-        if not chunk:
-            return None
-        buf.extend(chunk)
+            return False
+        if not r:
+            return False
+        got += r
+    return True
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    buf = bytearray(n)
+    if not _recv_into(sock, memoryview(buf)):
+        return None
     return bytes(buf)
+
+
+def _sendmsg_all(sock: socket.socket, segs: list) -> None:
+    """Vectored sendall: gather the segment list straight from the
+    callers' buffers (scatter-gather IO — the kernel's iovec copy is
+    the only one), resuming mid-segment on partial sends.  Raises
+    OSError on a dead peer like sendall."""
+    if getattr(sock, "sendmsg", None) is None:
+        # non-POSIX socket (or a test stub): assemble and stream
+        sock.sendall(b"".join(segs))
+        return
+    mvs = [memoryview(s) for s in segs if len(s)]
+    i = 0
+    while i < len(mvs):
+        sent = sock.sendmsg(mvs[i:i + _IOV_CAP])
+        while sent > 0:
+            seg = mvs[i]
+            if sent >= len(seg):
+                sent -= len(seg)
+                i += 1
+            else:
+                mvs[i] = seg[sent:]
+                sent = 0
+
+
+def _payload_nbytes(plain) -> int:
+    """Byte length of a ring payload: bytes or a tuple of segments."""
+    if isinstance(plain, tuple):
+        return sum(len(s) for s in plain)
+    return len(plain)
 
 
 class _SessState:
@@ -71,26 +119,29 @@ class _SessState:
         self.cookie = secrets.token_bytes(16)
         self.send_seq = 0
         self.recv_seq = 0
-        # ring holds (seq, flags, plain_payload), bounded both by entry
-        # count and payload bytes — recovery pushes can be huge frames,
-        # so a count-only cap could pin GiB of plaintext per session
-        # (the reference bounds replay state by bytes too).  Mutations
-        # under self.lock (the state outlives any one conn).
+        # ring holds (seq, flags, plain_payload) where the payload is
+        # bytes OR a tuple of bytes-like segments (zero-copy sends ring
+        # the segment list itself — no assembly just to be replayable),
+        # bounded both by entry count and payload bytes — recovery
+        # pushes can be huge frames, so a count-only cap could pin GiB
+        # of plaintext per session (the reference bounds replay state
+        # by bytes too).  Mutations under self.lock (the state outlives
+        # any one conn).
         self.ring: collections.deque = collections.deque()
         self.ring_bytes = 0
         self.lock = threading.Lock()
 
-    def ring_append(self, seq: int, flags: int, plain: bytes) -> None:
+    def ring_append(self, seq: int, flags: int, plain) -> None:
         """Append under self.lock, evicting oldest past either budget.
         The newest entry is never evicted — send_payload's RINGED
         contract promises the just-appended frame is replayable, so one
         oversized frame may transiently exceed the byte budget rather
         than be silently lost."""
         self.ring.append((seq, flags, plain))
-        self.ring_bytes += len(plain)
+        self.ring_bytes += _payload_nbytes(plain)
         while len(self.ring) > 1 and (len(self.ring) > _RING_MAX or
                                       self.ring_bytes > _RING_MAX_BYTES):
-            self.ring_bytes -= len(self.ring.popleft()[2])
+            self.ring_bytes -= _payload_nbytes(self.ring.popleft()[2])
 
     def ring_floor(self) -> int:
         return self.ring[0][0] if self.ring else self.send_seq + 1
@@ -102,7 +153,7 @@ class _SessState:
             for item in list(self.ring):
                 if item[0] == seq:
                     self.ring.remove(item)
-                    self.ring_bytes -= len(item[2])
+                    self.ring_bytes -= _payload_nbytes(item[2])
                     return
 
 
@@ -131,23 +182,53 @@ class _Conn:
         b = _mac(self.session_key, b"enc-s2c")
         self.enc_send, self.enc_recv = (a, b) if role == "c" else (b, a)
 
-    def _seal(self, payload: bytes) -> bytes:
+    def seal_segments(self, segs: list) -> tuple[list, int, int]:
+        """Seal a frame held as a segment list.  Plaintext and
+        auth-only (HMAC) modes never assemble — the MAC folds over the
+        segments incrementally and rides as one more segment.  Secure
+        mode is the ONLY Python-side assembly point on the tx path:
+        the join + cipher output are the (counted) flatten copies.
+        Returns (sealed_segments, flattened_bytes, flatten_copies)."""
+        flat_b = flat_c = 0
         if self.enc_send is not None:
             from ..ops.native import chacha20_xor
+            if len(segs) == 1:
+                plain = segs[0]
+                if not isinstance(plain, bytes):
+                    # the cipher detaches non-bytes input internally —
+                    # count that copy too (honest counters)
+                    flat_b += len(plain)
+                    flat_c += 1
+            else:
+                plain = b"".join(segs)
+                flat_b += len(plain)
+                flat_c += 1
             nonce = b"\x00" * 4 + self.enc_send_n.to_bytes(8, "little")
             self.enc_send_n += 1
-            payload = chacha20_xor(self.enc_send, nonce, payload)
+            sealed = chacha20_xor(self.enc_send, nonce, plain)
+            flat_b += len(sealed)
+            flat_c += 1
+            segs = [sealed]
         if self.session_key is not None:
-            payload = payload + _mac(self.session_key, payload)[:_TAG_LEN]
-        return payload
+            h = hmac.new(self.session_key, digestmod=hashlib.sha256)
+            for s in segs:
+                h.update(s)
+            segs = list(segs) + [h.digest()[:_TAG_LEN]]
+        return segs, flat_b, flat_c
 
-    def unseal(self, payload: bytes) -> bytes | None:
+    def unseal(self, payload) -> bytes | memoryview | None:
+        """Verify-and-strip the MAC tag (a zero-copy slice) + decrypt
+        (secure mode: a fresh plaintext buffer).  Accepts bytes or a
+        memoryview over the receive buffer."""
         if self.session_key is not None:
             if len(payload) < _TAG_LEN:
                 return None
             payload, tag = payload[:-_TAG_LEN], payload[-_TAG_LEN:]
-            want = _mac(self.session_key, payload)[:_TAG_LEN]
-            if not hmac.compare_digest(tag, want):
+            # digest the buffer in place (no b"".join materialization:
+            # auth-only rx stays genuinely zero-copy, like the tx MAC)
+            want = hmac.new(self.session_key, payload,
+                            hashlib.sha256).digest()[:_TAG_LEN]
+            if not hmac.compare_digest(bytes(tag), want):
                 return None
         if self.enc_recv is not None:
             from ..ops.native import chacha20_xor
@@ -158,13 +239,24 @@ class _Conn:
 
     SENT, DEAD, RINGED = 1, 0, -1
 
-    def send_payload(self, flags: int, plain: bytes) -> tuple[int, int]:
+    def send_payload(self, flags: int, plain,
+                     on_flatten=None) -> tuple[int, int]:
         """Sequence (resume mode), seal, frame, send — atomically, so
-        seq order on the wire matches ring order.  Returns (rc, seq):
+        seq order on the wire matches ring order.  ``plain`` is bytes
+        or a LIST of bytes-like segments; segments go to the socket via
+        vectored sendmsg without assembly (the resume ring references
+        them too — callers must not mutate referenced buffers after
+        submitting, and a ringed bytearray cannot be RESIZED until the
+        ring evicts it: BufferError by design, not silent replay
+        corruption).  ``on_flatten(nbytes, copies)`` is invoked when
+        sealing had to assemble (secure mode).  Returns (rc, seq):
         SENT; DEAD (nothing ringed); or RINGED (seq is in the ring but
         the socket died — a session resume will replay it; the caller
         must either trust the replay OR ring_drop(seq) before sending
         the frame any other way, or the peer gets it twice)."""
+        segs = ([plain] if isinstance(plain, (bytes, bytearray,
+                                              memoryview))
+                else list(plain))
         with self.lock:
             if not self.alive:
                 return self.DEAD, 0
@@ -173,31 +265,60 @@ class _Conn:
                 with self.state.lock:
                     self.state.send_seq += 1
                     seq = self.state.send_seq
-                    self.state.ring_append(seq, flags, plain)
-                plain = struct.pack("<Q", seq) + plain
-            body = self._seal(plain)
+                    self.state.ring_append(seq, flags, tuple(segs))
+                segs = [struct.pack("<Q", seq)] + segs
+            segs, flat_b, flat_c = self.seal_segments(segs)
+            total = sum(len(s) for s in segs)
+            if len(segs) > 1 and \
+                    getattr(self.sock, "sendmsg", None) is None:
+                # no vectored IO on this socket: _sendmsg_all's
+                # fallback joins the frame — count the assembly
+                flat_b += total
+                flat_c += 1
+            if flat_c and on_flatten is not None:
+                on_flatten(flat_b, flat_c)
             try:
-                self.sock.sendall(
-                    struct.pack("<I", len(body) | flags) + body)
+                _sendmsg_all(self.sock,
+                             [struct.pack("<I", total | flags)] + segs)
                 return self.SENT, seq
             except OSError:
                 self.alive = False
                 return (self.RINGED if seq else self.DEAD), seq
 
-    def replay_from(self, last_recv: int) -> bool:
-        """Resend ring entries the peer never saw (resume replay)."""
+    def replay_from(self, last_recv: int, on_flatten=None) -> bool:
+        """Resend ring entries the peer never saw (resume replay).
+        ``on_flatten`` keeps replayed assemblies visible on the same
+        copy counters as first sends.  Attribution caveat: the ring
+        does not record each frame's original sender, so replay copies
+        book against the entity whose reconnect drove the resume (the
+        dialing sender client-side, the listener owner server-side) —
+        an approximation on shared connections, acceptable because the
+        counters exist to catch hot-path copies, not to bill the rare
+        reconnect burst."""
         with self.lock:
             if not self.alive or self.state is None:
                 return False
             with self.state.lock:
                 pending = list(self.state.ring)
+            no_vec = getattr(self.sock, "sendmsg", None) is None
             for seq, flags, plain in pending:
                 if seq <= last_recv:
                     continue
-                body = self._seal(struct.pack("<Q", seq) + plain)
+                segs = (list(plain) if isinstance(plain, tuple)
+                        else [plain])
+                segs, flat_b, flat_c = self.seal_segments(
+                    [struct.pack("<Q", seq)] + segs)
+                total = sum(len(s) for s in segs)
+                if no_vec and len(segs) > 1:
+                    # the fallback join below is an assembly too
+                    flat_b += total
+                    flat_c += 1
+                if flat_c and on_flatten is not None:
+                    on_flatten(flat_b, flat_c)
                 try:
-                    self.sock.sendall(
-                        struct.pack("<I", len(body) | flags) + body)
+                    _sendmsg_all(
+                        self.sock,
+                        [struct.pack("<I", total | flags)] + segs)
                 except OSError:
                     self.alive = False
                     return False
@@ -415,16 +536,28 @@ class TcpNetwork(Network):
             conn.session_key = key
             if self._secure:
                 conn.arm_secure("s")
-        if self._resume and not self._resume_server(conn):
+        if self._resume and not self._resume_server(conn, owner):
             conn.close()
             return
         self._read_loop(conn)
+
+    def _perf_flatten(self, name: str):
+        """Flatten-counter callback booked against a local entity's
+        messenger registry (None when the entity is not local)."""
+        m = self.lookup(name)
+        if m is None:
+            return None
+
+        def flatten(nbytes: int, copies: int = 1) -> None:
+            m.perf.inc("msg_tx_flatten_bytes", nbytes)
+            m.perf.inc("msg_tx_flatten_copies", copies)
+        return flatten
 
     # -- session resume handshake -----------------------------------------
     # client: RESM | peer_cookie(16, zeros=fresh) | last_recv(u64)
     # server: RESM | my_cookie(16) | flag(u8: 1=resumed) | last_recv(u64)
     # On resume both sides replay ring entries past the peer's last_recv.
-    def _resume_server(self, conn: _Conn) -> bool:
+    def _resume_server(self, conn: _Conn, owner: str | None = None) -> bool:
         sock = conn.sock
         sock.settimeout(5)
         try:
@@ -457,14 +590,18 @@ class TcpNetwork(Network):
                          + struct.pack("<Q", state.recv_seq))
             if resumed:
                 self.resumed += 1
-                conn.replay_from(last_recv)
+                conn.replay_from(
+                    last_recv,
+                    on_flatten=self._perf_flatten(owner)
+                    if owner else None)
             return True
         except OSError:
             return False
         finally:
             sock.settimeout(None)
 
-    def _resume_client(self, conn: _Conn, addr: str) -> bool:
+    def _resume_client(self, conn: _Conn, addr: str,
+                       on_flatten=None) -> bool:
         sock = conn.sock
         sock.settimeout(5)
         try:
@@ -487,7 +624,7 @@ class TcpNetwork(Network):
                 self._by_addr[addr] = (srv_cookie, state)
             if resumed:
                 self.resumed += 1
-                conn.replay_from(srv_last)
+                conn.replay_from(srv_last, on_flatten=on_flatten)
             return True
         except OSError:
             return False
@@ -498,9 +635,16 @@ class TcpNetwork(Network):
 
     def _read_loop(self, conn: _Conn) -> None:
         sock = conn.sock
+        head = memoryview(bytearray(4))
+        # small-frame reuse buffer: acks/heartbeats/map chatter recv
+        # into ONE buffer (no per-frame alloc) and decode fully
+        # detached; payload-bearing frames (> _RECV_REUSE_MAX) recv
+        # into a FRESH buffer so decode can carve zero-copy views over
+        # it — the views refcount-pin the buffer, and this loop never
+        # touches it again (the carve ownership contract)
+        reuse = memoryview(bytearray(_RECV_REUSE_MAX))
         while not self._stopping and conn.alive:
-            head = _recv_exact(sock, 4)
-            if head is None:
+            if not _recv_into(sock, head):
                 break
             (length,) = struct.unpack("<I", head)
             compressed = bool(length & _COMPRESSED)
@@ -510,16 +654,28 @@ class TcpNetwork(Network):
                 # attempting a multi-GB buffer
                 dout("msg", 1)("tcp: oversized frame header (%d)", length)
                 break
-            payload = _recv_exact(sock, length)
-            if payload is None:
+            if length <= _RECV_REUSE_MAX:
+                mv = reuse[:length]
+                owned = False  # reused next frame: decode must detach
+            else:
+                mv = memoryview(bytearray(length))
+                owned = True   # fresh buffer: decode may carve views
+            if not _recv_into(sock, mv):
                 break
+            rx_b = rx_c = 0  # receive-side payload copies (counted)
             # verify-and-strip signature + decrypt (cephx signing /
-            # secure-mode stream)
-            payload = conn.unseal(payload)
+            # secure-mode stream); the tag strip is a zero-copy slice,
+            # the decrypt materializes a fresh owned buffer
+            payload = conn.unseal(mv.toreadonly())
             if payload is None:
                 dout("msg", 0)("tcp: BAD frame signature; dropping "
                                "connection")
                 break
+            if conn.enc_recv is not None:
+                rx_b += len(payload)
+                rx_c += 1
+                payload = memoryview(payload)
+                owned = True
             # snapshot: a resume takeover may null conn.state mid-frame
             state = conn.state
             if state is not None:
@@ -555,8 +711,14 @@ class TcpNetwork(Network):
                 if len(payload) != rawlen:
                     dout("msg", 1)("tcp: decompressed size mismatch")
                     break
+                rx_b += rawlen
+                rx_c += 1
+                owned = True  # decompression output: a fresh buffer
             try:
-                src, dst, msg = decode_frame(payload)
+                # carve-on-decode only over buffers this loop will
+                # never reuse; the reuse-buffer path detaches
+                src, dst, msg = decode_frame(
+                    payload, carve_min=SEG_REF_MIN if owned else 0)
             except Exception as e:  # noqa: BLE001 - poisoned frame
                 dout("msg", 0)("tcp: undecodable frame: %r", e)
                 break
@@ -564,6 +726,9 @@ class TcpNetwork(Network):
                 self._routes[src] = conn  # answer on the inbound pipe
             target = self.lookup(dst)
             if target is not None and not target._stopped:
+                if rx_c:
+                    target.perf.inc("msg_rx_copy_bytes", rx_b)
+                    target.perf.inc("msg_rx_copy_copies", rx_c)
                 target._enqueue(src, msg)
             else:
                 dout("msg", 10)("tcp: no local entity %s for %s", dst,
@@ -585,7 +750,7 @@ class TcpNetwork(Network):
                     self._stash.pop(next(iter(self._stash)))
 
     # -- send side ---------------------------------------------------------
-    def _connect(self, addr: str) -> _Conn | None:
+    def _connect(self, addr: str, on_flatten=None) -> _Conn | None:
         host, _, port = addr.rpartition(":")
         try:
             sock = socket.create_connection((host, int(port)), timeout=5)
@@ -602,7 +767,8 @@ class TcpNetwork(Network):
             conn.session_key = key
             if self._secure:
                 conn.arm_secure("c")
-        if self._resume and not self._resume_client(conn, addr):
+        if self._resume and not self._resume_client(conn, addr,
+                                                    on_flatten):
             dout("msg", 1)("tcp: resume handshake to %s failed", addr)
             conn.close()
             return None
@@ -611,7 +777,7 @@ class TcpNetwork(Network):
                          name=f"tcp-read-out-{addr}", daemon=True).start()
         return conn
 
-    def _conn_for(self, dst: str) -> _Conn | None:
+    def _conn_for(self, dst: str, on_flatten=None) -> _Conn | None:
         with self._net_lock:
             route = self._routes.get(dst)
             if route is not None and route.alive:
@@ -629,7 +795,7 @@ class TcpNetwork(Network):
                 conn = self._out.get(addr)
                 if conn is not None and conn.alive:
                     return conn
-            conn = self._connect(addr)
+            conn = self._connect(addr, on_flatten)
             if conn is None:
                 return None
             with self._net_lock:
@@ -648,18 +814,36 @@ class TcpNetwork(Network):
             return True  # silently dropped, like a lossy wire
         if self.latency:
             time.sleep(self.latency)
-        payload = encode_frame(src, dst, msg)[4:]
+        # segmented framing: large data payloads ride the segment list
+        # by reference — in plaintext/auth modes they reach sendmsg
+        # with ZERO Python-side assembly (msg_tx_flatten_* counts every
+        # copy the frame does take: compression join, secure-mode seal)
+        enc = frame_encoder(src, dst, msg)
+        total = enc.nbytes
+        sender = self.lookup(src)
+        perf = sender.perf if sender is not None else None
+
+        def flatten(nbytes: int, copies: int = 1) -> None:
+            if perf is not None:
+                perf.inc("msg_tx_flatten_bytes", nbytes)
+                perf.inc("msg_tx_flatten_copies", copies)
+
         flags = 0
-        if self._compressor is not None and \
-                len(payload) >= self._compress_min:
+        if self._compressor is not None and total >= self._compress_min:
+            payload = enc.tobytes()
+            flatten(total)  # compression needs contiguous input
             packed = self._compressor.compress(payload)
             if len(packed) + 4 < len(payload):  # only when it wins
-                payload = struct.pack("<I", len(payload)) + packed
+                segs = [struct.pack("<I", total), packed]
                 flags = _COMPRESSED
-        conn = self._conn_for(dst)
+            else:
+                segs = [payload]
+        else:
+            segs = enc.segments()
+        conn = self._conn_for(dst, flatten)
         if conn is None:
             return False
-        rc, seq = conn.send_payload(flags, payload)
+        rc, seq = conn.send_payload(flags, segs, on_flatten=flatten)
         if rc == _Conn.SENT:
             return True
         old_state = conn.state
@@ -669,7 +853,7 @@ class TcpNetwork(Network):
             for table in (self._routes, self._out):
                 for k in [k for k, v in table.items() if v is conn]:
                     del table[k]
-        conn2 = self._conn_for(dst)
+        conn2 = self._conn_for(dst, flatten)
         if conn2 is None:
             return False
         if rc == _Conn.RINGED:
@@ -681,4 +865,5 @@ class TcpNetwork(Network):
             # pull the frame out of the old ring or a later resume of
             # that session would deliver it a second time
             old_state.ring_drop(seq)
-        return conn2.send_payload(flags, payload)[0] == _Conn.SENT
+        return conn2.send_payload(flags, segs,
+                                  on_flatten=flatten)[0] == _Conn.SENT

@@ -15,11 +15,22 @@ Frame layout on a stream (the frame_message contract):
 `src` lets the receiving endpoint learn reply routes (the Connection
 identity of AsyncMessenger: you answer on the pipe the request came in
 on); `dst` routes frames when one socket serves several entities.
+
+Zero-copy wire path (the bufferlist discipline): `frame_encoder`
+returns the frame as a SEGMENTED Encoder — large data payloads ride as
+referenced segments, never copied into the stream — so the transport
+can `sendmsg` the segment list straight from the submitter's buffers.
+`decode_frame(payload, carve_min=N)` carves large blob fields as
+read-only memoryviews over the one received frame buffer (skip-copy
+decode).  Frame BYTES are unchanged either way: `encode_frame` (the
+assembling face) and `b"".join(frame_encoder(...).segments())` produce
+identical layouts, which the archived corpus_wire/ gate pins.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import struct
 
 from ..utils.codec import CodecError, Decoder, Encoder
 from . import messages as M
@@ -50,7 +61,7 @@ def encode_value(enc: Encoder, v) -> None:
         enc.string(v)
     elif isinstance(v, (bytes, bytearray, memoryview)):
         enc.u8(_T_BYTES)
-        enc.blob(bytes(v))
+        enc.blob(v)  # large blobs ride by reference (Encoder.blob)
     elif isinstance(v, M.PgId):
         enc.u8(_T_PGID)
         enc.u64(v.pool)
@@ -94,8 +105,15 @@ def decode_value(dec: Decoder):
     if tag == _T_LIST:
         return dec.seq(decode_value)
     if tag == _T_DICT:
-        return {decode_value(dec): decode_value(dec)
-                for _ in range(dec.u32())}
+        out = {}
+        for _ in range(dec.u32()):
+            k = decode_value(dec)
+            if isinstance(k, memoryview):
+                # keys must stay hashable-by-value: a carved view over
+                # a writable frame buffer is not — detach
+                k = bytes(k)
+            out[k] = decode_value(dec)
+        return out
     raise CodecError(f"bad wire value tag {tag}")
 
 
@@ -172,10 +190,14 @@ def unpack_value(raw: bytes):
     return decode_value(Decoder(raw)) if raw else None
 
 
-def encode_frame(src: str, dst: str, msg) -> bytes:
-    """Full stream frame: length-prefixed [src][dst][type_id][body].
-    dst rides the frame because one socket can serve several local
-    entities (shared outgoing pipes, learned reply routes)."""
+def frame_encoder(src: str, dst: str, msg) -> Encoder:
+    """The frame body [src][dst][type_id][body] WITHOUT the u32 length
+    prefix, as a segmented Encoder: the transport streams
+    ``enc.segments()`` via vectored IO (data payloads never flatten
+    Python-side) or assembles with ``enc.tobytes()`` when it must
+    (seal/encrypt, compression).  dst rides the frame because one
+    socket can serve several local entities (shared outgoing pipes,
+    learned reply routes)."""
     e = Encoder()
     e.string(src)
     e.string(dst)
@@ -184,15 +206,26 @@ def encode_frame(src: str, dst: str, msg) -> bytes:
         raise CodecError(f"unregistered message type {type(msg).__name__}")
     e.u16(tid)
     _encode_body(e, msg)
+    return e
+
+
+def encode_frame(src: str, dst: str, msg) -> bytes:
+    """Full stream frame as contiguous bytes: length-prefixed
+    [src][dst][type_id][body] (the assembling face of frame_encoder,
+    for corpus archiving and in-proc consumers)."""
+    e = frame_encoder(src, dst, msg)
     payload = e.tobytes()
-    head = Encoder()
-    head.u32(len(payload))
-    return head.tobytes() + payload
+    return struct.pack("<I", len(payload)) + payload
 
 
-def decode_frame(payload: bytes):
-    """payload (after the u32 length prefix) -> (src, dst, message)."""
-    d = Decoder(payload)
+def decode_frame(payload, carve_min: int = 0):
+    """payload (after the u32 length prefix) -> (src, dst, message).
+    ``carve_min > 0`` enables carve-on-decode: data blob fields at or
+    above that size come back as read-only memoryviews over
+    ``payload`` (which the caller must never reuse/mutate — the
+    transport hands a fresh refcount-pinned buffer per carved frame;
+    see msg/README.md for the ownership contract)."""
+    d = Decoder(payload, carve_min=carve_min)
     src = d.string()
     dst = d.string()
     cls = _ID_TYPES.get(d.u16())

@@ -235,7 +235,9 @@ def _carve_extents(union: tuple | None, data: bytes,
         for off, ln in want:
             seg = data[off:off + ln]
             if len(seg) < ln:
-                seg += b"\0" * (ln - len(seg))
+                # bytes(seg): seg may be a carved memoryview (the rx
+                # zero-copy path), which cannot concatenate in place
+                seg = bytes(seg) + b"\0" * (ln - len(seg))
             parts.append(seg)
         return b"".join(parts)
     bases = []  # start offset of each union interval in the buffer
@@ -2334,7 +2336,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 continue  # degraded write: hole shard skipped
             chunk = streams[shard] if shard < codec.k \
                 else parity[shard - codec.k]
-            data = chunk.tobytes()
+            # zero-copy wire path: a contiguous staged chunk (the
+            # batcher's single metered d2h output) rides the frame by
+            # reference — Encoder.blob refs the memoryview, sendmsg
+            # gathers it; nothing mutates a flush output after the
+            # fact.  Non-contiguous scatter views still flatten here.
+            data = chunk.data if chunk.flags.c_contiguous \
+                else chunk.tobytes()
             if csums is not None:
                 attrs = dict(attrs, dcsum=int(csums[shard]))
                 sub_attrs = dict(sub_attrs, dcsum=int(csums[shard]))

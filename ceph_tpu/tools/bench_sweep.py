@@ -131,6 +131,22 @@ def configs() -> list[dict]:
                             "e2e_within_2x_kernel",
                             "d2h_copies_per_flush",
                             "single_d2h_per_flush", "digest_verified"]})
+    # 8a2. the zero-copy wire path (ISSUE 13): scatter-gather framing
+    # + vectored sends + carve-on-decode over a real socket pair —
+    # payload GB/s and flatten-copies-per-MiB in plaintext and secure
+    # modes.  The counter contract is the gate (enforced by bench.py's
+    # exit code): plaintext hops book ZERO Python-side payload copies,
+    # secure mode at most 2 tx (seal assembly) and 1 rx (decrypt)
+    out.append({"id": "wire_path", "tool": "bench_root",
+                "argv": ["--ec-batch"],
+                "extract": ["wire_gbps", "wire_secure_gbps",
+                            "wire_msg_mib",
+                            "wire_tx_flatten_copies_per_op",
+                            "wire_rx_copy_copies_per_op",
+                            "wire_flatten_copies_per_mib",
+                            "wire_secure_tx_flatten_copies_per_op",
+                            "wire_secure_rx_copy_copies_per_op",
+                            "wire_zero_copy_ok", "digest_verified"]})
     # 8b. kernel auto-selection trajectory (ISSUE 8): per-signature
     # winner + per-candidate GB/s on the staged fold (xla / pallas /
     # mxu / bitxor) — recorded so the pick and the candidate gap are

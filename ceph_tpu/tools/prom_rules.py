@@ -42,8 +42,15 @@ QUANTILES = (0.50, 0.99)
 #: per-daemon tracer head-sampling counters (trace_sample_rate draws):
 #: standing rate series make the sampled:dropped ratio — and any
 #: sampler misconfiguration — visible on a dashboard without ad-hoc
-#: PromQL
-COUNTERS = ("trace_sampled", "trace_dropped")
+#: PromQL.  The messenger copy counters ride the same rate-rule shape:
+#: msg_tx_flatten_* books every Python-side assembly of an outgoing
+#: frame's payload, msg_rx_copy_* every receive-side payload copy —
+#: standing series keep the zero-copy wire path's "copies per hop"
+#: claim a measured number (0 in plaintext mode) instead of a
+#: code-reading exercise
+COUNTERS = ("trace_sampled", "trace_dropped",
+            "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
+            "msg_rx_copy_bytes", "msg_rx_copy_copies")
 
 #: the metrics-history liveness gauge the exporter emits per daemon
 #: (seconds since the mon merged that daemon's newest snapshot); the

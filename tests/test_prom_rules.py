@@ -86,9 +86,9 @@ def test_rules_reference_only_emitted_metrics():
 
 def test_rules_shape_and_rendering():
     rules = recording_rules()
-    # one rule per (histogram, quantile) + one rate rule per tracer
-    # counter + the staleness max, records namespaced
-    assert len(rules) == 19
+    # one rule per (histogram, quantile) + one rate rule per tracer /
+    # messenger-copy counter + the staleness max, records namespaced
+    assert len(rules) == 23
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
     assert len(hist) == 16
@@ -98,7 +98,11 @@ def test_rules_shape_and_rendering():
     rates = [r for r in rules if ":rate" in r["record"]]
     assert {r["record"] for r in rates} == {
         "ceph_tpu:daemon_trace_sampled:rate5m",
-        "ceph_tpu:daemon_trace_dropped:rate5m"}
+        "ceph_tpu:daemon_trace_dropped:rate5m",
+        "ceph_tpu:daemon_msg_tx_flatten_bytes:rate5m",
+        "ceph_tpu:daemon_msg_tx_flatten_copies:rate5m",
+        "ceph_tpu:daemon_msg_rx_copy_bytes:rate5m",
+        "ceph_tpu:daemon_msg_rx_copy_copies:rate5m"}
     assert all("rate(" in r["expr"] and "by (daemon)" in r["expr"]
                for r in rates)
     stale = [r for r in rules
@@ -107,8 +111,8 @@ def test_rules_shape_and_rendering():
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 19
-    assert text.count("    expr: ") == 19
+    assert text.count("  - record: ") == 23
+    assert text.count("    expr: ") == 23
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms

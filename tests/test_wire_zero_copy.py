@@ -1,0 +1,345 @@
+"""Zero-copy bufferlist wire path (ISSUE 13): scatter-gather framing,
+vectored sends, carve-on-decode payloads.
+
+Pins the three contracts the zero-copy path lives by:
+
+- BYTE IDENTITY: segmented assembly produces exactly the pre-change
+  frame layout (``b"".join(frame_encoder(...).segments())`` ==
+  ``encode_frame(...)`` body), so corpus_wire/ keeps decoding and
+  freshly encoded frames match archived bytes.
+- OWNERSHIP: a carved rx payload aliases ONLY a buffer the transport
+  never reuses (refcount-pinned fresh buffer per carved frame); the
+  small-frame reuse buffer is decoded fully detached; an APPLIED write
+  must survive mutation of the original frame buffer (the store's
+  ingest copy is the detach point).
+- MEASUREMENT: msg_tx_flatten_* / msg_rx_copy_* count every
+  Python-side payload copy per hop — zero in plaintext mode, bounded
+  (<= 2 tx, 1 rx) in secure mode.
+"""
+
+import struct
+import time
+
+import pytest
+
+from ceph_tpu.msg import messages as M
+from ceph_tpu.msg.messenger import Dispatcher, Messenger, Policy
+from ceph_tpu.msg.wire import decode_frame, encode_frame, frame_encoder
+from ceph_tpu.utils.codec import SEG_REF_MIN, Decoder, Encoder
+
+PG = M.PgId(3, 7)
+BIG = bytes(range(256)) * 64  # 16 KiB >= SEG_REF_MIN
+
+
+# ------------------------------------------------------------ byte identity
+def test_segments_join_equals_tobytes_for_every_wire_type():
+    from ceph_tpu.tools.dencoder import message_samples
+    for cls, msg in message_samples().items():
+        legacy = encode_frame("alice", "bob", msg)
+        enc = frame_encoder("alice", "bob", msg)
+        assembled = struct.pack("<I", enc.nbytes) \
+            + b"".join(enc.segments())
+        assert assembled == legacy, cls.__name__
+
+
+def test_versioned_splice_matches_blob_layout():
+    """Encoder.versioned splices sub-parts but the bytes must equal the
+    old sub.tobytes()-then-blob layout."""
+    e = Encoder()
+    e.versioned(3, 1, lambda s: (s.u32(7), s.blob(BIG)))
+    raw = e.tobytes()
+    want = struct.pack("<BBI", 3, 1, 4 + 4 + len(BIG)) \
+        + struct.pack("<I", 7) + struct.pack("<I", len(BIG)) + BIG
+    assert raw == want
+
+
+# --------------------------------------------------------- tx: by reference
+def test_large_blob_rides_by_reference():
+    e = Encoder()
+    e.string("hdr")
+    e.blob(BIG)
+    segs = e.segments()
+    assert any(s is BIG for s in segs), "large bytes blob was copied"
+    # a large mutable buffer rides as a (zero-copy) memoryview
+    mutable = bytearray(BIG)
+    e2 = Encoder()
+    e2.blob(mutable)
+    ref = [s for s in e2.segments() if isinstance(s, memoryview)]
+    assert len(ref) == 1 and ref[0].obj is mutable
+    # small mutable buffers are defensively copied (flatten allowed)
+    e3 = Encoder()
+    small = bytearray(b"tiny")
+    e3.blob(small)
+    small[0] = 0x99
+    assert e3.tobytes() == struct.pack("<I", 4) + b"tiny"
+
+
+def test_segment_count_stays_bounded_by_coalescing():
+    """Metadata parts coalesce: a message with one payload makes a
+    handful of segments, not one per primitive."""
+    msg = M.MSubWrite(1, PG, "obj", -1, 9, "write", BIG,
+                      {"v": 9, "len": len(BIG)})
+    segs = frame_encoder("a", "b", msg).segments()
+    assert len(segs) <= 4, [len(s) for s in segs]
+    assert any(s is BIG for s in segs)
+
+
+# ------------------------------------------------------- rx: carve + detach
+def test_carve_on_decode_returns_pinned_views():
+    msg = M.MPGPush(PG, 1, {"o1": (3, BIG, len(BIG)),
+                            "o2": (4, b"small", 5)}, {"gone": 4})
+    frame = bytearray(encode_frame("a", "b", msg)[4:])
+    _s, _d, got = decode_frame(frame, carve_min=SEG_REF_MIN)
+    carved = got.objects["o1"][1]
+    assert isinstance(carved, memoryview) and carved.readonly
+    assert carved == BIG
+    # small blobs detach; dict KEYS always detach (hashability)
+    assert isinstance(got.objects["o2"][1], bytes)
+    assert all(isinstance(k, str) for k in got.objects)
+    # the carve aliases the frame buffer (that IS the zero-copy)...
+    off = bytes(frame).find(BIG[:32])
+    frame[off] ^= 0xFF
+    assert carved[0] != BIG[0]
+    # ...and refcount-pins it: the view stays valid when the loop's
+    # reference to the buffer goes away
+    del frame
+    assert carved[1] == BIG[1]
+
+
+def test_decode_without_carve_detaches_everything():
+    """The read loop's REUSE-buffer rule: frames decoded with carve
+    disabled must not alias the buffer at all — mutating it after
+    decode never corrupts the message."""
+    msg = M.MSubWrite(1, PG, "o", -1, 3, "write", b"x" * 2048)
+    frame = bytearray(encode_frame("a", "b", msg)[4:])
+    _s, _d, got = decode_frame(frame, carve_min=0)
+    frame[:] = b"\xff" * len(frame)
+    assert isinstance(got.data, bytes) and got.data == b"x" * 2048
+
+
+def test_applied_write_detaches_from_frame_buffer():
+    """The aliasing-hazard regression (ISSUE 13 satellite): a carved
+    payload applied to the object store must be DETACHED by the store's
+    ingest copy — mutating the original frame buffer afterwards must
+    never corrupt the applied write."""
+    from ceph_tpu.osd.objectstore import (CollectionId, MemStore,
+                                          ObjectId, Transaction)
+    msg = M.MSubWrite(7, PG, "o", -1, 3, "write", BIG)
+    frame = bytearray(encode_frame("a", "b", msg)[4:])
+    _s, _d, got = decode_frame(frame, carve_min=SEG_REF_MIN)
+    assert isinstance(got.data, memoryview)
+    store = MemStore()
+    cid, oid = CollectionId(3, 7), ObjectId("o")
+    tx = Transaction().create_collection(cid)
+    tx.touch(cid, oid).write(cid, oid, 0, got.data)
+    store.queue_transaction(tx)
+    # the ring/reuse hazard: the transport (or a hostile peer) reuses
+    # the frame buffer for the next recv
+    frame[:] = b"\xee" * len(frame)
+    assert store.read(cid, oid).to_bytes() == BIG
+
+
+# -------------------------------------------------- the wire, end to end
+class _Sink(Dispatcher):
+    def __init__(self):
+        self.got = []
+
+    def ms_dispatch(self, conn, msg):
+        self.got.append(msg)
+        return True
+
+
+def _wire_pair(**net_kw):
+    from ceph_tpu.msg.tcp import TcpNetwork
+    net = TcpNetwork(**net_kw)
+    a = Messenger(net, "zc.tx", Policy.lossless_peer())
+    b = Messenger(net, "zc.rx", Policy.lossless_peer())
+    sink = _Sink()
+    b.add_dispatcher(sink)
+    a.start()
+    b.start()
+    net.set_addr("zc.rx", net.addr_of("zc.rx"))
+    return net, a, b, sink
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _drain(net, a, b):
+    a.shutdown()
+    b.shutdown()
+    net.stop()
+
+
+def test_plaintext_hop_has_zero_python_copies():
+    """The acceptance number: a data payload crosses a plaintext hop
+    with ZERO Python-side flatten/copy — counters, not code-reading."""
+    net, a, b, sink = _wire_pair()
+    try:
+        payload = bytes(bytearray(range(256)) * 4096)  # 1 MiB
+        n = 4
+        for i in range(n):
+            assert a.send_message(
+                "zc.rx", M.MSubWrite(i, PG, f"o{i}", -1, 1, "write",
+                                     payload))
+        assert _wait(lambda: len(sink.got) == n)
+        for m in sink.got:
+            assert isinstance(m.data, memoryview)  # carved, not copied
+            assert m.data == payload
+        tx = a.perf.dump()
+        rx = b.perf.dump()
+        assert tx["msg_tx_flatten_copies"] == 0
+        assert tx["msg_tx_flatten_bytes"] == 0
+        assert rx["msg_rx_copy_copies"] == 0
+    finally:
+        _drain(net, a, b)
+
+
+def test_auth_mode_still_zero_copy():
+    """HMAC signing folds over the segments incrementally — auth alone
+    must not cost an assembly."""
+    net, a, b, sink = _wire_pair(auth_secret=b"zc-secret")
+    try:
+        payload = b"\x5a" * (256 << 10)
+        assert a.send_message(
+            "zc.rx", M.MSubWrite(1, PG, "o", -1, 1, "write", payload))
+        assert _wait(lambda: len(sink.got) == 1)
+        assert sink.got[0].data == payload
+        assert a.perf.dump()["msg_tx_flatten_copies"] == 0
+        assert b.perf.dump()["msg_rx_copy_copies"] == 0
+    finally:
+        _drain(net, a, b)
+
+
+def test_secure_mode_copies_are_bounded_and_counted():
+    """Secure mode is the ONLY tx assembly point: <= 2 counted copies
+    per frame (join + cipher output), exactly 1 rx copy (decrypt)."""
+    net, a, b, sink = _wire_pair(auth_secret=b"zc-secret", secure=True)
+    try:
+        payload = b"\xc3" * (256 << 10)
+        n = 3
+        for i in range(n):
+            assert a.send_message(
+                "zc.rx", M.MSubWrite(i, PG, f"o{i}", -1, 1, "write",
+                                     payload))
+        assert _wait(lambda: len(sink.got) == n)
+        for m in sink.got:
+            assert m.data == payload
+        tx = a.perf.dump()
+        rx = b.perf.dump()
+        assert 1 * n <= tx["msg_tx_flatten_copies"] <= 2 * n
+        assert rx["msg_rx_copy_copies"] == n
+        assert rx["msg_rx_copy_bytes"] >= n * len(payload)
+    finally:
+        _drain(net, a, b)
+
+
+def test_many_segment_frame_survives_iovec_chunking():
+    """A recovery push with more referenced payloads than one sendmsg
+    iovec can carry (> _IOV_CAP segments) must chunk and still land
+    byte-exact — exercises _sendmsg_all's resume-mid-segment loop."""
+    from ceph_tpu.msg.tcp import _IOV_CAP
+    objs = {f"o{i}": (1, bytes([i & 0xFF]) * SEG_REF_MIN, SEG_REF_MIN)
+            for i in range(_IOV_CAP + 50)}
+    net, a, b, sink = _wire_pair()
+    try:
+        assert a.send_message("zc.rx", M.MPGPush(PG, 1, objs))
+        assert _wait(lambda: len(sink.got) == 1, timeout=30.0)
+        got = sink.got[0]
+        assert len(got.objects) == len(objs)
+        for name, (_v, data, _t) in objs.items():
+            assert got.objects[name][1] == data, name
+        assert a.perf.dump()["msg_tx_flatten_copies"] == 0
+    finally:
+        _drain(net, a, b)
+
+
+def test_resume_ring_accounts_segment_tuples():
+    """The replay ring stores segment TUPLES for zero-copy sends; byte
+    accounting and drop must handle both shapes."""
+    from ceph_tpu.msg import tcp as tcpmod
+    st = tcpmod._SessState()
+    seg_frame = (b"h" * 32, b"p" * 8192)
+    st.ring_append(1, 0, seg_frame)
+    st.ring_append(2, 0, b"plain")
+    assert st.ring_bytes == 32 + 8192 + 5
+    st.ring_drop(1)
+    assert st.ring_bytes == 5 and st.ring[0][0] == 2
+
+
+def test_recv_exact_contract_for_services():
+    """smb/nbd/nvmeof import _recv_exact: bytes of exactly n, None on
+    EOF — now recv_into-backed, same contract."""
+    import socket as _socket
+    from ceph_tpu.msg.tcp import _recv_exact
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(b"abcdef")
+        assert _recv_exact(b, 4) == b"abcd"
+        a.close()
+        assert _recv_exact(b, 4) is None  # EOF mid-read
+    finally:
+        b.close()
+
+
+def test_non_contiguous_views_are_normalized():
+    """Exotic buffer shapes keep working (the pre-segmented encoder
+    accepted anything bytes() could copy): strided / multi-byte views
+    detach instead of blowing up at join/sendmsg time."""
+    import numpy as np
+    strided = memoryview(bytes(range(200)) * 100)[::2]  # 10000 B view
+    e = Encoder()
+    e.blob(strided)
+    assert e.tobytes() == struct.pack("<I", 10000) + bytes(strided)
+    wide = memoryview(np.arange(4096, dtype=np.uint32))  # itemsize 4
+    e2 = Encoder()
+    e2.blob(wide)
+    assert e2.tobytes() == struct.pack("<I", 16384) + bytes(wide)
+    # strided decoder input detaches up front: interleave the frame
+    # bytes with junk and hand the decoder the odd-byte view
+    frame = struct.pack("<I", 4) + b"abcd"
+    woven = bytes(b for pair in zip(frame, b"\xff" * len(frame))
+                  for b in pair)
+    d = Decoder(memoryview(woven)[::2], carve_min=SEG_REF_MIN)
+    assert d.blob() == b"abcd"
+    d2 = Decoder(np.frombuffer(woven, dtype=np.uint8)[::2])
+    assert d2.blob() == b"abcd"
+
+
+def test_decoder_rejects_carve_below_threshold():
+    d = Decoder(bytearray(struct.pack("<I", 4) + b"abcd"),
+                carve_min=SEG_REF_MIN)
+    out = d.blob()
+    assert isinstance(out, bytes) and out == b"abcd"
+
+
+@pytest.mark.parametrize("secure", [False, True])
+def test_cluster_ec_io_over_zero_copy_wire(secure):
+    """End-to-end sanity at cluster scope: EC write/read over the
+    segmented wire in both plaintext and secure modes."""
+    import numpy as np
+    from ceph_tpu.tools.vstart import MiniCluster
+    from tests.test_cluster import make_cfg
+    kw = ({"tcp_auth_secret": b"zc", "tcp_secure": True}
+          if secure else {})
+    c = MiniCluster(n_osds=4, cfg=make_cfg(), transport="tcp",
+                    **kw).start()
+    try:
+        cl = c.client()
+        cl.create_pool("ec", kind="ec", pg_num=2,
+                       ec_profile={"plugin": "jerasure", "k": "2",
+                                   "m": "1", "backend": "native"})
+        rng = np.random.default_rng(7)
+        data = rng.integers(0, 256, 1 << 20, dtype=np.uint8).tobytes()
+        cl.write_full("ec", "o", data)
+        got = cl.read("ec", "o")
+        assert isinstance(got, bytes)  # librados boundary detaches
+        assert got == data
+    finally:
+        c.stop()
