@@ -217,6 +217,119 @@ def _wire_path_leg() -> dict:
     }
 
 
+def _store_commit_leg() -> dict:
+    """The async group-commit transaction pipeline, measured
+    (ISSUE 14): an 8-writer burst of 1 MiB object writes on a real
+    BlueStore, async (kv-sync/finisher pipeline) vs sync (inline
+    fsync-per-txn baseline).  The structural gates: fsyncs per
+    transaction < 0.5 on the async leg's best round (group commit is
+    REAL — one device fsync + one KV fsync cover many transactions)
+    and async throughput at or above the sync baseline (best-of-N;
+    the pipeline must never cost throughput).  Payloads submit as
+    memoryviews so the by-reference ingest path (whole pages sliced
+    zero-copy into the buffered device write) is exercised and its
+    ref/copy split reported."""
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from ceph_tpu.osd.bluestore import BlueStore
+    from ceph_tpu.osd.objectstore import (CollectionId, ObjectId,
+                                          Transaction)
+    from ceph_tpu.utils.perf import global_perf
+
+    writers, per = 8, 8
+    payload = np.random.default_rng(3).integers(
+        0, 256, 1 << 20, dtype=np.uint8).tobytes()
+    nbytes_round = writers * per * len(payload)
+    cid = CollectionId(9, 1)
+
+    def burst(store, tag: str) -> float:
+        barrier = threading.Barrier(writers + 1)
+
+        def w(wi: int) -> None:
+            barrier.wait()
+            for i in range(per):
+                store.queue_transaction(
+                    Transaction().write(cid, ObjectId(f"{tag}-{wi}-{i}"),
+                                        0, memoryview(payload)))
+
+        ts = [threading.Thread(target=w, args=(wi,))
+              for wi in range(writers)]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        store.flush()  # durability barrier: every on_commit fired
+        return time.perf_counter() - t0
+
+    with tempfile.TemporaryDirectory() as d:
+        # both stores up front, rounds INTERLEAVED sync/async so box
+        # noise hits both legs alike (the trace-overhead leg's
+        # best-of-N treatment); compression off on both — this
+        # measures the commit pipeline, not zlib
+        sync = BlueStore(os.path.join(d, "sync"), compression="none")
+        sync.mount()
+        sync.queue_transaction(Transaction().create_collection(cid))
+        # async pipeline: throughput-tuned window knobs (the OSD's
+        # defaults favor latency; a bench burst wants deep batches)
+        st = BlueStore(os.path.join(d, "async"), compression="none")
+        st.mount()
+        st.enable_async(name="bench", window_us=20000.0,
+                        window_min_us=2000.0, window_max_us=60000.0,
+                        target_txns=12.0)
+        st.queue_transaction(Transaction().create_collection(cid))
+        st.flush()
+        perf = global_perf().registries()["store.bench"]
+        # drain any earlier bench legs' dirty pages, then one unmeasured
+        # warmup round per store: cold allocation/writeback effects land
+        # off the clock (both legs, same treatment)
+        os.sync()
+        burst(sync, "warm-s")
+        burst(st, "warm-a")
+        sync_walls, async_walls, ratios = [], [], []
+        rounds = 4
+        for r in range(rounds):
+            sync_walls.append(burst(sync, f"s{r}"))
+            p0 = perf.dump()
+            async_walls.append(burst(st, f"a{r}"))
+            p1 = perf.dump()
+            dtx = p1["store_txns"] - p0["store_txns"]
+            dfs = p1["store_fsyncs"] - p0["store_fsyncs"]
+            ratios.append(round(dfs / dtx, 3) if dtx else None)
+        totals = perf.dump()
+        sync.umount()
+        digest_ok = all(
+            st.read(cid, ObjectId(f"a{rounds - 1}-{wi}-{per - 1}")
+                    ).to_bytes() == payload
+            for wi in range(writers))
+        ref_b = totals["store_ingest_ref_bytes"]
+        copy_b = totals["store_ingest_copy_bytes"]
+        st.umount()
+        st.disable_async()
+    sync_gbps = nbytes_round / min(sync_walls) / 2**30
+    async_gbps = nbytes_round / min(async_walls) / 2**30
+    best_ratio = min(r for r in ratios if r is not None)
+    ok = (digest_ok and best_ratio < 0.5 and async_gbps >= sync_gbps)
+    return {
+        "store_commit_async_gbps": round(async_gbps, 3),
+        "store_commit_sync_gbps": round(sync_gbps, 3),
+        "store_commit_speedup": (round(async_gbps / sync_gbps, 3)
+                                 if sync_gbps > 0 else None),
+        "store_fsyncs_per_txn": best_ratio,
+        "store_fsyncs_per_txn_rounds": ratios,
+        "store_txns": totals["store_txns"],
+        "store_fsyncs": totals["store_fsyncs"],
+        "store_batches": totals["store_batches"],
+        "store_ingest_ref_share": (round(ref_b / (ref_b + copy_b), 3)
+                                   if ref_b + copy_b else None),
+        "store_commit_ok": ok,
+    }
+
+
 def ec_batch_bench(trace: bool = False) -> int:
     """`--ec-batch` mode: cross-op batched vs per-op encode under a
     simulated multi-client write burst (8 writer threads submitting
@@ -553,6 +666,11 @@ def ec_batch_bench(trace: bool = False) -> int:
     # mode's seal/encrypt assembly is bounded and counted)
     wire = _wire_path_leg()
 
+    # ---- store group-commit leg (ISSUE 14): async kv-sync pipeline
+    # vs inline fsync-per-txn on a real BlueStore (GATED: fsyncs/txn
+    # < 0.5 and async >= sync throughput)
+    store_leg = _store_commit_leg()
+
     verified = True
     for w in range(writers):
         for i in range(ops_per):
@@ -665,11 +783,16 @@ def ec_batch_bench(trace: bool = False) -> int:
         # the measured copies-per-hop counters (GATED: plaintext 0,
         # secure <= 2 tx / 1 rx)
         **wire,
+        # async group-commit store pipeline (ISSUE 14): 8-writer 1 MiB
+        # burst on BlueStore — fsyncs/txn from counter deltas (GATED
+        # < 0.5) and async-vs-sync GB/s (GATED async >= sync)
+        **store_leg,
         **({"trace_stages": trace_stages}
            if trace_stages is not None else {}),
     }))
     return 0 if verified and single_copy and trace_overhead_ok \
-        and wire["wire_zero_copy_ok"] else 1
+        and wire["wire_zero_copy_ok"] \
+        and store_leg["store_commit_ok"] else 1
 
 
 def _recovery_progress_leg() -> dict:

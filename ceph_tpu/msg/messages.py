@@ -257,10 +257,18 @@ class MSubReadN:
     so the reply routes by fetch, not tid.  pgid rides the MESSAGE so
     the peer's sharded op queue serializes the whole batch with that
     pg's write applies, exactly like a plain MSubRead — which is why
-    one message never mixes pgs."""
+    one message never mixes pgs.
+
+    klass mirrors MSubRead's: the mclock class the SERVING peer queues
+    the whole batch under — recovery repair-plane fetches coalesce per
+    helper (one MSubReadN per storm window instead of one MSubRead per
+    object) and still ride the peer's recovery reservation/limit.
+    Trailing append with a default: archived bytes decode compatibly,
+    and one message never mixes classes (lanes split by klass)."""
 
     items: list  # [(fetch_id, oid, shard, extents|None)]
     pgid: PgId | None = None
+    klass: str = "client"
 
 
 @dataclass

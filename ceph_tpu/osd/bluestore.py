@@ -47,7 +47,7 @@ import os
 import threading
 from typing import Callable
 
-from ..ops.native import crc32c
+from ..ops.native import crc32c, crc32c_blocks
 from ..utils.buffer import BufferList
 from ..utils.codec import Decoder, Encoder
 from .filestore import _dec_value, _enc_value, _esc
@@ -199,6 +199,13 @@ class BlueStore(ObjectStore):
         self._refs: dict[int, int] = {}   # phys -> refcount (live pages)
         self._npages = 0
         self._deferred: dict[int, bytes] = {}  # committed, not yet fsync'd
+        # staged deferred payloads whose KV commit is still queued in the
+        # async pipeline: readable from RAM immediately (read-your-writes
+        # before durability) but NOT written to the device until after
+        # their batch's KV fsync — an in-place deferred overwrite of a
+        # live page must never clobber committed bytes the crash replay
+        # would still need
+        self._deferred_pending: dict[int, bytes] = {}
 
     # ------------------------------------------------------------ mount
     def mount(self) -> None:
@@ -228,6 +235,7 @@ class BlueStore(ObjectStore):
             self._mounted = True
 
     def umount(self) -> None:
+        self.flush()  # drain the commit pipeline before closing
         with self._lock:
             if not self._mounted:
                 return
@@ -318,7 +326,9 @@ class BlueStore(ObjectStore):
                    verify: bool = True) -> bytes:
         if st is not None and phys in st.page_data:
             return st.page_data[phys]
-        data = self._deferred.get(phys)
+        data = self._deferred_pending.get(phys)
+        if data is None:
+            data = self._deferred.get(phys)
         if data is None:
             data = self._dev_read(phys)
         if verify and crc32c(data) != crc:
@@ -326,8 +336,16 @@ class BlueStore(ObjectStore):
         return data
 
     # ------------------------------------------------------ transactions
-    def queue_transaction(self, tx: Transaction,
-                          on_commit: Callable[[], None] | None = None) -> None:
+    def _prepare(self, tx: Transaction) -> _Staging:
+        """Stage against shadow onodes, then pre-commit apply: large
+        payloads land on FRESH pages (buffered — the batch fsync makes
+        them durable before any KV metadata points at them), the onode/
+        omap/defer KV mutations are encoded into the staging's batch,
+        and the in-RAM state flips so reads observe the transaction
+        immediately (read-your-writes before durability).  What does
+        NOT happen here: fsyncs, the KV commit, freed-page release and
+        deferred device writes — all batch-ordered in _commit_batch so
+        a crash can only lose un-acked suffixes."""
         with self._lock:
             if not self._mounted:
                 raise StoreError("not mounted")
@@ -338,9 +356,125 @@ class BlueStore(ObjectStore):
             except Exception:
                 self._rollback(st)
                 raise
-            self._commit(st)
-        if on_commit:
-            on_commit()
+            # 1) large writes: buffered now, fsync'd once per batch.
+            #    Fresh pages only — never over live data — so the
+            #    buffered window cannot clobber committed bytes.
+            #    Contiguous pages (the common fresh-allocation shape)
+            #    coalesce into one seek+write per run instead of one
+            #    syscall pair per 4K page.
+            if st.large:
+                run_phys = -2
+                run: list = []
+                for phys, content in sorted(st.large):
+                    if phys != run_phys + len(run):
+                        if run:
+                            self._dev.seek(run_phys * PAGE)
+                            self._dev.write(b"".join(run))
+                        run_phys, run = phys, []
+                    run.append(content)
+                if run:
+                    self._dev.seek(run_phys * PAGE)
+                    self._dev.write(b"".join(run))
+            # 2) KV mutations for this tx (order matters: the staged
+            #    coll/omap ops are already in st.kv; onodes then
+            #    deferred payloads append behind them)
+            for (cid, oid), onode in st.onodes.items():
+                if (cid, oid) not in st.touched:
+                    continue
+                key = _onode_key(cid, oid)
+                if onode is None:
+                    st.kv.rm(_P_ONODE, key)
+                else:
+                    st.kv.put(_P_ONODE, key, _encode_onode(oid, onode))
+            # deferred payloads detach here (they may be memoryview
+            # carves over an rx frame; they outlive this call) and
+            # become readable from RAM at once
+            st.defer = [(phys, bytes(content))
+                        for phys, content in st.defer]
+            for phys, content in st.defer:
+                st.kv.put(_P_DEFER, str(phys), content)
+                self._deferred_pending[phys] = content
+            # 3) in-RAM state flips to the shadow copies — reads (and
+            #    later prepares) see this transaction from now on
+            for cid in st.colls_created:
+                self._colls.setdefault(cid, {})
+            for (cid, oid), onode in st.onodes.items():
+                if onode is None:
+                    self._colls.get(cid, {}).pop(oid, None)
+                else:
+                    self._colls.setdefault(cid, {})[oid] = onode
+            for cid in st.colls_removed:
+                self._colls.pop(cid, None)
+            return st
+
+    def _commit_batch(self, items: list) -> int:
+        """Group commit: ONE device fsync covering every item's large
+        pages, ONE vectored KV append + fsync for the whole batch, then
+        the ordered epilogue (freed-page release, deferred device
+        writes) that must wait for durability.
+
+        Crash ordering: the device fsync precedes any KV write, so a
+        committed (prefix of the) KV batch only ever references durable
+        pages; freed pages rejoin the allocator only AFTER the KV fsync
+        (a reallocated page's new bytes could otherwise clobber data a
+        replayed-but-unfreed onode still points at); deferred device
+        writes run after commit exactly as the inline path did, with
+        the "D" replay covering the crash window."""
+        fsyncs = 0
+        if any(st.large for st in items):
+            self._dev.flush()
+            os.fsync(self._dev.fileno())
+            fsyncs += 1
+        merged = KVTransaction()
+        for st in items:
+            merged.ops.extend(st.kv.ops)
+        with self._lock:
+            # pages whose refcount hits ZERO across this batch shed any
+            # pending "D" record IN THIS COMMIT: once free they can be
+            # reallocated, and a stale deferred replay after a crash
+            # would clobber the new owner.  Dead pages are unreachable
+            # from live onodes (every RAM flip already happened at
+            # prepare), so no concurrent preparer can re-reference one
+            # before the frees apply below.
+            dead: set[int] = set()
+            refsim: dict[int, int] = {}
+            for st in items:
+                for phys in st.frees:
+                    n = refsim.get(phys, self._refs.get(phys, 0)) - 1
+                    refsim[phys] = n
+                    if n <= 0:
+                        dead.add(phys)
+            for phys in dead:
+                merged.rm(_P_DEFER, str(phys))
+        if merged.ops:
+            self._kv.submit(merged, sync=False)
+            self._kv.sync()
+            fsyncs += 1
+        with self._lock:
+            for st in items:
+                for phys in st.frees:
+                    n = self._refs.get(phys, 0) - 1
+                    if n <= 0:
+                        self._refs.pop(phys, None)
+                        self._deferred.pop(phys, None)
+                        self._deferred_pending.pop(phys, None)
+                        heapq.heappush(self._free, phys)
+                    else:
+                        self._refs[phys] = n
+                for phys, content in st.defer:
+                    if phys in dead:
+                        continue
+                    self._dev_write(phys, content)
+                    self._deferred[phys] = content
+                    # a LATER (still pending) batch may have re-staged
+                    # this page — only retire OUR payload from the
+                    # read-path overlay
+                    if self._deferred_pending.get(phys) is content:
+                        self._deferred_pending.pop(phys, None)
+            if len(self._deferred) > DEFER_FLUSH_N:
+                self._flush_deferred()
+                fsyncs += 2  # device fsync + the trim's KV fsync
+        return fsyncs
 
     # -- staging helpers ---------------------------------------------------
     def _coll_exists(self, st: _Staging, cid: CollectionId) -> bool:
@@ -429,14 +563,15 @@ class BlueStore(ObjectStore):
         return self._read_page(st, phys, crc)
 
     def _put_page(self, st: _Staging, o: Onode, idx: int, content: bytes,
-                  deferred: bool) -> None:
+                  deferred: bool, crc: int | None = None) -> None:
         """Install new content for logical page idx: allocate (or reuse
         in-place on the deferred path when we are the sole owner) and
         route the payload to the right write path."""
         while len(o.pages) <= idx:
             o.pages.append((HOLE, 0))
         old_phys, _old_crc = o.pages[idx]
-        crc = crc32c(content)
+        if crc is None:
+            crc = crc32c(content)
         in_place = (deferred and old_phys != HOLE
                     and self._refs.get(old_phys, 0) == 1
                     and old_phys not in (p for p, _ in st.large))
@@ -465,17 +600,42 @@ class BlueStore(ObjectStore):
         if self.compression and not deferred and \
                 self._try_compress(st, o, offset, data):
             return
+        ref_b = copy_b = 0
+        # per-page csums for the whole-page span in ONE native sweep
+        # (a ctypes round-trip per 4K page dominates MiB-scale ingest)
+        full_first = first if offset % PAGE == 0 else first + 1
+        full_last = last if end % PAGE == 0 else last - 1
+        full_crcs: list[int] = []
+        if full_last >= full_first:
+            full_crcs = crc32c_blocks(
+                data[full_first * PAGE - offset: (full_last + 1) * PAGE
+                     - offset], PAGE)
         for idx in range(first, last + 1):
             pstart = idx * PAGE
             lo = max(offset, pstart) - pstart
             hi = min(end, pstart + PAGE) - pstart
             if lo == 0 and hi == PAGE:
+                # whole-page run: a slice of the caller's buffer — for
+                # a memoryview payload this is BY REFERENCE (detached
+                # at the buffered write() syscall in _prepare, so the
+                # rx frame never pins past the enqueue)
                 content = data[pstart - offset: pstart - offset + PAGE]
+                if isinstance(content, memoryview):
+                    ref_b += PAGE
+                else:
+                    copy_b += PAGE
+                self._put_page(st, o, idx, content, deferred,
+                               crc=full_crcs[idx - full_first])
             else:
                 old = bytearray(self._page_content(st, o, idx))
                 old[lo:hi] = data[pstart + lo - offset: pstart + hi - offset]
                 content = bytes(old)
-            self._put_page(st, o, idx, content, deferred)
+                copy_b += PAGE
+                self._put_page(st, o, idx, content, deferred)
+        if ref_b:
+            self._book("store_ingest_ref_bytes", ref_b)
+        if copy_b:
+            self._book("store_ingest_copy_bytes", copy_b)
         o.size = max(o.size, end)
 
     def _try_compress(self, st: _Staging, o: Onode, offset: int,
@@ -596,7 +756,11 @@ class BlueStore(ObjectStore):
             self._get_onode(st, cid, oid, create=True)
         elif kind == TxOp.WRITE:
             o = self._get_onode(st, cid, oid, create=True)
-            self._write_range(st, o, op[3], op[4].to_bytes())
+            # contiguous(): single-buffer payloads (the rx-carved wire
+            # path) arrive as a zero-copy view — whole aligned pages
+            # slice out of it by reference straight into the buffered
+            # device write; partial pages rebuild (copy) as ever
+            self._write_range(st, o, op[3], op[4].contiguous())
         elif kind == TxOp.ZERO:
             o = self._get_onode(st, cid, oid, create=True)
             self._zero_range(st, o, op[3], op[4])
@@ -648,66 +812,6 @@ class BlueStore(ObjectStore):
                 st.kv.put(_P_OMAP, f"{dst_key}\x00{k}", e.tobytes())
         else:  # pragma: no cover
             raise StoreError(f"unknown tx op {kind}")
-
-    def _commit(self, st: _Staging) -> None:
-        # 1) large writes land on FRESH pages and reach the platter
-        #    before any metadata points at them
-        if st.large:
-            for phys, content in st.large:
-                self._dev_write(phys, content)
-            self._dev.flush()
-            os.fsync(self._dev.fileno())
-        # 2) one atomic KV commit: onodes, colls, omap, deferred payloads
-        for (cid, oid), onode in st.onodes.items():
-            key = _onode_key(cid, oid)
-            if (cid, oid) in st.touched:
-                if onode is None:
-                    st.kv.rm(_P_ONODE, key)
-                else:
-                    st.kv.put(_P_ONODE, key, _encode_onode(oid, onode))
-        for phys, content in st.defer:
-            st.kv.put(_P_DEFER, str(phys), content)
-        # pages whose refcount will hit zero must shed any pending "D"
-        # record IN THIS COMMIT: once free they can be reallocated, and a
-        # stale deferred replay after a crash would clobber the new owner
-        dead: set[int] = set()
-        refsim: dict[int, int] = {}
-        for phys in st.frees:
-            n = refsim.get(phys, self._refs.get(phys, 0)) - 1
-            refsim[phys] = n
-            if n <= 0:
-                dead.add(phys)
-        for phys in dead:
-            st.kv.rm(_P_DEFER, str(phys))
-        self._kv.submit(st.kv)
-        # 3) in-RAM state flips to the shadow copies
-        for cid in st.colls_created:
-            self._colls.setdefault(cid, {})
-        for (cid, oid), onode in st.onodes.items():
-            if onode is None:
-                self._colls.get(cid, {}).pop(oid, None)
-            else:
-                self._colls.setdefault(cid, {})[oid] = onode
-        for cid in st.colls_removed:
-            self._colls.pop(cid, None)
-        for phys in st.frees:
-            n = self._refs.get(phys, 0) - 1
-            if n <= 0:
-                self._refs.pop(phys, None)
-                self._deferred.pop(phys, None)
-                heapq.heappush(self._free, phys)
-            else:
-                self._refs[phys] = n
-        # 4) deferred device writes AFTER the KV commit (crash replays
-        #    them from "D"); kept readable from RAM until flushed.  Pages
-        #    freed by this same tx are skipped — their "D" rows are gone.
-        for phys, content in st.defer:
-            if phys in dead:
-                continue
-            self._dev_write(phys, content)
-            self._deferred[phys] = content
-        if len(self._deferred) > DEFER_FLUSH_N:
-            self._flush_deferred()
 
     def _flush_deferred(self) -> None:
         if not self._deferred:
@@ -776,7 +880,9 @@ class BlueStore(ObjectStore):
             for b in o.blobs.values():
                 entries.extend(b["pages"])
             for phys, crc in entries:
-                data = self._deferred.get(phys)
+                data = self._deferred_pending.get(phys)
+                if data is None:
+                    data = self._deferred.get(phys)
                 if data is None:
                     data = self._dev_read(phys)
                 if crc32c(data) != crc:

@@ -169,7 +169,13 @@ READ_AGG_COUNTERS = ("ec_read_msgs", "ec_read_fetches",
                      "ec_read_coalesced_subreads", "ec_read_dup_hits",
                      "ec_read_union_merges", "ec_read_stale_rejects",
                      "ec_read_flush_window", "ec_read_flush_size",
-                     "ec_read_flush_idle")
+                     "ec_read_flush_idle",
+                     # recovery-class lanes (repair-plane sub-chunk
+                     # fetches riding the aggregator): sub-reads
+                     # submitted and MSubReadN messages sent, so the
+                     # msgs-per-helper drop on a wide storm is a
+                     # counter fact, not a code-reading exercise
+                     "ec_read_repair_subreads", "ec_read_repair_msgs")
 READ_AGG_HISTOGRAMS = ("ec_read_fetches_per_msg",
                        "ec_read_subreads_per_msg")
 
@@ -180,13 +186,15 @@ class _ReadFetch:
     pending reads (duplicate collapse / union-range merge)."""
 
     __slots__ = ("fid", "pgid", "oid", "shard", "extents", "waiters",
-                 "tspans", "fspan_id", "stamp", "marker")
+                 "tspans", "fspan_id", "stamp", "marker", "klass")
 
-    def __init__(self, fid, pgid, oid, shard, extents, marker=0):
+    def __init__(self, fid, pgid, oid, shard, extents, marker=0,
+                 klass="client"):
         self.fid = fid
         self.pgid = pgid
         self.oid = oid
         self.shard = shard
+        self.klass = klass
         self.extents = extents      # None (whole shard) or merged
         # union: tuple of disjoint sorted (off, len)
         self.waiters: list = []     # [(tid, requested extents|None)]
@@ -315,8 +323,9 @@ class SubReadAggregator:
         self._stopped = False
 
     @staticmethod
-    def _key(peer, pgid, oid, shard, whole: bool) -> tuple:
-        return (peer, pgid, oid, shard, whole)
+    def _key(peer, pgid, oid, shard, whole: bool,
+             klass: str = "client") -> tuple:
+        return (peer, pgid, oid, shard, whole, klass)
 
     def _inc(self, name: str, n: int = 1) -> None:
         if self._perf is not None:
@@ -324,13 +333,19 @@ class SubReadAggregator:
 
     # ------------------------------------------------------------ submit
     def submit(self, peer: str, tid: int, pgid, oid: str, shard: int,
-               extents: list | None, trace: tuple | None = None) -> None:
+               extents: list | None, trace: tuple | None = None,
+               klass: str = "client") -> None:
         """Queue one sub-read for `peer`; the reply reaches the
         daemon's _on_shard_read exactly as a plain MSubReadReply
-        would."""
+        would.  ``klass`` splits lanes (and rides the MSubReadN) so a
+        recovery storm's repair-plane fetches coalesce per helper yet
+        queue under the peer's recovery reservation — client and
+        recovery reads never share a wire message."""
         want = (None if extents is None
                 else tuple((int(o), int(ln)) for o, ln in extents))
-        key = self._key(peer, pgid, oid, shard, want is None)
+        if klass == "recovery":
+            self._inc("ec_read_repair_subreads")
+        key = self._key(peer, pgid, oid, shard, want is None, klass)
         tspan = None
         if trace is not None:
             tracer, ctx = trace
@@ -341,7 +356,7 @@ class SubReadAggregator:
         # whole-reads and client range reads of one hot object meet
         # here), so ranged lookups consult the whole-shard key too
         keys = (key,) if want is None else (
-            key, self._key(peer, pgid, oid, shard, True))
+            key, self._key(peer, pgid, oid, shard, True, klass))
         flush_peer = False
         with self._lock:
             if self._stopped:
@@ -392,11 +407,12 @@ class SubReadAggregator:
                     f.tspans.append(tspan)
             else:
                 f = _ReadFetch(next(self._fids), pgid, oid, shard, want,
-                               marker=self._daemon._obj_write_marker())
+                               marker=self._daemon._obj_write_marker(),
+                               klass=klass)
                 f.waiters.append((tid, want))
                 if tspan is not None:
                     f.tspans.append(tspan)
-                lane = (peer, pgid)
+                lane = (peer, pgid, klass)
                 q = self._queued.setdefault(lane, [])
                 q.append(f)
                 self._qindex[key] = f
@@ -413,7 +429,7 @@ class SubReadAggregator:
                         self._flusher.start()
                     self._cv.notify_all()
         if flush_peer:
-            self._flush((peer, pgid), reason="size")
+            self._flush((peer, pgid, klass), reason="size")
 
     def _flush_loop(self) -> None:
         """The single flusher: sleeps to the EARLIEST lane deadline,
@@ -441,18 +457,18 @@ class SubReadAggregator:
 
     # ------------------------------------------------------------- flush
     def _flush(self, lane: tuple, reason: str | None = None) -> None:
-        peer, pgid = lane
+        peer, pgid, klass = lane
         with self._lock:
             self._deadlines.pop(lane, None)
             fetches = self._queued.pop(lane, [])
             for f in fetches:
                 self._qindex.pop(
                     self._key(peer, f.pgid, f.oid, f.shard,
-                              f.extents is None), None)
+                              f.extents is None, f.klass), None)
                 self._inflight[f.fid] = f
                 self._inflight_keys.setdefault(
                     self._key(peer, f.pgid, f.oid, f.shard,
-                              f.extents is None), []).append(f)
+                              f.extents is None, f.klass), []).append(f)
         if not fetches:
             return
         n_subreads = sum(len(f.waiters) for f in fetches)
@@ -478,6 +494,8 @@ class SubReadAggregator:
         self._inc("ec_read_fetches", len(fetches))
         self._inc("ec_read_coalesced_subreads", n_subreads)
         self._inc(f"ec_read_flush_{reason}")
+        if klass == "recovery":
+            self._inc("ec_read_repair_msgs")
         if self._perf is not None:
             self._perf.hinc("ec_read_fetches_per_msg", len(fetches))
             self._perf.hinc("ec_read_subreads_per_msg", n_subreads)
@@ -486,7 +504,7 @@ class SubReadAggregator:
                  for f in fetches]
         try:
             sent = self._daemon.messenger.send_message(
-                peer, MSubReadN(items, pgid))
+                peer, MSubReadN(items, pgid, klass=klass))
         except Exception:  # noqa: BLE001 - racing daemon shutdown
             sent = False
         if fspan is not None:
@@ -503,7 +521,8 @@ class SubReadAggregator:
 
     def _drop_locked(self, peer: str, f: _ReadFetch) -> None:
         self._inflight.pop(f.fid, None)
-        key = self._key(peer, f.pgid, f.oid, f.shard, f.extents is None)
+        key = self._key(peer, f.pgid, f.oid, f.shard, f.extents is None,
+                        f.klass)
         lst = self._inflight_keys.get(key)
         if lst is not None:
             if f in lst:
@@ -620,6 +639,29 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self.cfg = cfg or default_config()
         self.store = store or ObjectStore.create("memstore")
         self.store.mount()
+        # async group-commit pipeline (store_sync_commit=on pins the
+        # inline path): queue_transaction returns after the in-RAM
+        # apply; client/EC/recovery commit replies ride the on_commit
+        # continuations (commit_barrier) so op workers never block on
+        # a device fsync, and N concurrent writers share one
+        # (mclock-only: completion continuations re-enter through the
+        # sharded scheduler to keep the per-PG serialization invariant;
+        # fifo's inline dispatch has no shard to route them to)
+        self._store_async = str(
+            self.cfg["store_sync_commit"]).lower() not in (
+            "on", "true", "1", "yes") \
+            and self.cfg["osd_op_queue"] == "mclock"
+        if self._store_async:
+            self.store.enable_async(
+                name=self.name,
+                throttle_bytes=self.cfg["store_throttle_bytes"],
+                throttle_ops=self.cfg["store_throttle_ops"],
+                window_us=self.cfg["store_batch_window_us"],
+                window_min_us=self.cfg["store_batch_window_min_us"],
+                window_max_us=self.cfg["store_batch_window_max_us"],
+                target_txns=self.cfg["store_batch_target_txns"],
+                adaptive=str(self.cfg["store_batch_adaptive"]).lower()
+                == "on")
         # fifo op-queue mode executes client ops INLINE on the dispatch
         # thread with no per-PG serialization — it is only safe with
         # exactly one worker (mclock mode re-serializes through the
@@ -930,6 +972,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         for t in timers:
             t.cancel()  # a dead daemon must not keep querying peers
         self._read_agg.stop()
+        # drain the store commit pipeline: queued acks fire (or are
+        # dropped by the dead messenger) before the sockets close, and
+        # the per-store registry leaves the global collection with us
+        if self._store_async:
+            self.store.disable_async()
         self.messenger.shutdown()
         self.hb_messenger.shutdown()
         if self._use_mclock:
@@ -1538,13 +1585,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         peers = [u for u in up if u is not None and u != self.osd_id]
         tid = next(self._tids)
         if not peers:
-            conn.send(MOSDOpReply(m.tid, 0, version=version,
-                                  epoch=self.osdmap.epoch))
+            # single-copy pool: the client reply IS the durability ack —
+            # it rides the commit pipeline's finisher (inline when sync)
+            self.store.commit_barrier(lambda: conn.send(
+                MOSDOpReply(m.tid, 0, version=version,
+                            epoch=self.osdmap.epoch)))
             return
+        # +1 ack for the primary's own store commit (the barrier below)
         self._pending_writes[tid] = _PendingWrite(
-            m.client, m.tid, len(peers), version)
+            m.client, m.tid, len(peers) + 1, version)
         self._pending_writes[tid].span = getattr(m, '_span', None)
         self._pending_writes[tid].qphase = getattr(m, '_qos_phase', 0)
+        self._local_commit_ack(tid, pgid)
         sub_attrs = dict(extra_attrs)
         if rider is not None:
             sub_attrs["_snap"] = rider
@@ -1603,13 +1655,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         peers = [u for u in up if u is not None and u != self.osd_id]
         tid = next(self._tids)
         if not peers:
-            conn.send(MOSDOpReply(m.tid, 0, version=version,
-                                  epoch=self.osdmap.epoch))
+            self.store.commit_barrier(lambda: conn.send(
+                MOSDOpReply(m.tid, 0, version=version,
+                            epoch=self.osdmap.epoch)))
             return
+        # +1 ack: the local whiteout/remove commit (barrier below)
         self._pending_writes[tid] = _PendingWrite(
-            m.client, m.tid, len(peers), version)
+            m.client, m.tid, len(peers) + 1, version)
         self._pending_writes[tid].span = getattr(m, '_span', None)
         self._pending_writes[tid].qphase = getattr(m, '_qos_phase', 0)
+        self._local_commit_ack(tid, pgid)
         for peer in peers:
             self.messenger.send_message(
                 f"osd.{peer}",
@@ -2420,8 +2475,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         pw = None
         if remote:
             # registered BEFORE any send: a reply may run on another
-            # shard worker immediately (sharded-dispatch ordering)
-            pw = _PendingWrite(m.client, m.tid, remote, version,
+            # shard worker immediately (sharded-dispatch ordering).
+            # +1 ack for the primary's own store commit (the barrier
+            # registered after the local tallies below).
+            pw = _PendingWrite(m.client, m.tid, remote + 1, version,
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
             pw.qphase = getattr(m, '_qos_phase', 0)
@@ -2447,9 +2504,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 local_failed += 1
         if pw is not None:
             # local tallies land before any send, so a full ack drain
-            # computes the true result
+            # computes the true result; the commit barrier fires the
+            # +1 local ack once those applies are durable
             pw.failed += local_failed
             pw.retry += local_retry
+            self._local_commit_ack(tid, pgid)
         # write-through the freshly encoded rows, parity included (the
         # device-resident stripe plane's hot-read feed: the next
         # overlapping read or rmw of these rows serves from cache —
@@ -2486,9 +2545,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             result = EIO if local_failed else (EAGAIN if local_retry else 0)
             if result != 0:
                 self._ec_cache.invalidate(pgid, m.oid)
-            conn.send(MOSDOpReply(m.tid, result,
-                                  version=version, epoch=self.osdmap.epoch))
-            self._obj_unlock(lock_key)
+
+            def _finish_local() -> None:
+                conn.send(MOSDOpReply(m.tid, result, version=version,
+                                      epoch=self.osdmap.epoch))
+                self._obj_unlock(lock_key)
+            # the client reply (and the unlock's next-thunk run) wait
+            # for durability, on the pg's shard
+            self._on_store_commit(pgid, _finish_local)
 
     def _ec_partial_write(self, conn, m: MOSDOp, pgid: PgId, up: list,
                           codec, si: StripeInfo, object_size: int,
@@ -2529,9 +2593,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                            if o is not None and o != self.osd_id)
             pw = None
             if remote_n:
-                # registered before any send (sharded-dispatch rule)
-                pw = _PendingWrite(m.client, m.tid, remote_n, version,
-                                   lock_key=lock_key)
+                # registered before any send (sharded-dispatch rule);
+                # +1 ack for the primary's own store commit
+                pw = _PendingWrite(m.client, m.tid, remote_n + 1,
+                                   version, lock_key=lock_key)
                 pw.span = getattr(m, '_span', None)
                 pw.qphase = getattr(m, '_qos_phase', 0)
                 self._pending_writes[wtid] = pw
@@ -2587,6 +2652,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if pw is not None:
                 pw.failed += local_failed
                 pw.retry += local_retry
+                self._local_commit_ack(wtid, pgid)
             # cache maintenance BEFORE any send (a remote failure can
             # drain every ack — invalidating — before this thread
             # resumes; a write-through landing after that would re-
@@ -2633,11 +2699,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     else (EAGAIN if local_retry else 0)
                 if result != 0:
                     self._ec_cache.invalidate(pgid, m.oid)
-                self.messenger.send_message(
-                    m.client,
-                    MOSDOpReply(m.tid, result,
-                                version=version, epoch=self.osdmap.epoch))
-                self._obj_unlock(lock_key)
+
+                def _finish_local() -> None:
+                    self.messenger.send_message(
+                        m.client,
+                        MOSDOpReply(m.tid, result, version=version,
+                                    epoch=self.osdmap.epoch))
+                    self._obj_unlock(lock_key)
+                self._on_store_commit(pgid, _finish_local)
 
         # extent-cache fast path (ECExtentCache role): if EVERY touched
         # segment is cached at a known version, skip the read fan-out
@@ -2916,8 +2985,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
-        conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id,
-                                 code))
+            self.store.commit_barrier(lambda: conn.send(
+                MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id, 0)))
+        else:
+            # refusal: nothing was applied, nothing to wait on
+            conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id,
+                                     code))
 
     def _handle_sub_delta(self, conn, m: MSubDelta) -> None:
         self.perf.inc("subop_w")
@@ -2935,8 +3008,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
-        conn.send(MSubWriteReply(m.tid, m.pgid, m.parity_shard,
-                                 self.osd_id, code))
+            self.store.commit_barrier(lambda: conn.send(
+                MSubWriteReply(m.tid, m.pgid, m.parity_shard,
+                               self.osd_id, 0)))
+        else:
+            conn.send(MSubWriteReply(m.tid, m.pgid, m.parity_shard,
+                                     self.osd_id, code))
 
     def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
         si = self._pool_stripe(pgid.pool)
@@ -3371,8 +3448,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         tid = next(self._tids)
         remote = sum(1 for o in up
                      if o is not None and o != self.osd_id)
-        if remote:  # registered before any send (sharded dispatch)
-            pw = _PendingWrite(m.client, m.tid, remote, version,
+        if remote:  # registered before any send (sharded dispatch);
+            # +1 ack for the primary's own store commit
+            pw = _PendingWrite(m.client, m.tid, remote + 1, version,
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
             pw.qphase = getattr(m, '_qos_phase', 0)
@@ -3399,9 +3477,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                               epoch=self._entry_epoch(),
                               trace=self._tctx(m)))
         if remote == 0:
-            conn.send(MOSDOpReply(m.tid, 0, version=version,
-                                  epoch=self.osdmap.epoch))
-            self._obj_unlock(lock_key)
+            def _finish_local() -> None:
+                conn.send(MOSDOpReply(m.tid, 0, version=version,
+                                      epoch=self.osdmap.epoch))
+                self._obj_unlock(lock_key)
+            self._on_store_commit(pgid, _finish_local)
+        else:
+            self._local_commit_ack(tid, pgid)
 
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
@@ -3537,7 +3619,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                       pre_tx=pre_tx, shard=m.shard)
         self._pg_versions[m.pgid] = max(
             self._pg_versions.get(m.pgid, 0), m.version)
-        conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
+        # the ack IS the durability promise: ride the commit pipeline's
+        # finisher (in submission order) so it leaves only once the
+        # apply's transactions are fsync'd — inline when sync-pinned
+        self.store.commit_barrier(lambda: conn.send(
+            MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id)))
 
     def _apply_remove(self, pgid: PgId, oid: str, shard: int,
                       version: int) -> None:
@@ -3552,6 +3638,35 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self.store.queue_transaction(tx)
         self._ec_cache.invalidate(pgid, oid)
         self._record_tombstone(pgid, oid, version)
+
+    def _on_store_commit(self, pgid: PgId, fn) -> None:
+        """Run ``fn`` once everything queued in the store SO FAR is
+        durable, ON pgid's scheduler shard — the finisher thread must
+        never execute PG-state work itself (per-PG serialization is a
+        shard-thread invariant).  Inline in sync mode: nothing is
+        pending and the caller already holds the shard."""
+        if not self._store_async:
+            fn()
+            return
+
+        def fire() -> None:
+            # force past the lossy QUEUE_CAP: a dropped completion has
+            # no retry path — the reply would never leave and the
+            # object lock would wedge forever
+            self.scheduler.enqueue(
+                "system", (lambda _c, _m: fn(), None, None),
+                key=(pgid.pool, pgid.seed), force=True)
+        self.store.commit_barrier(fire)
+
+    def _local_commit_ack(self, tid: int, pgid: PgId) -> None:
+        """Count the primary's OWN store commit as one ack on a pending
+        write: registered as a commit barrier AFTER the local applies,
+        so the finisher fires it once those transactions are durable
+        (inline in sync mode — identical accounting to the pre-pipeline
+        path).  The synthetic shard -2 rides the normal ack drain so
+        result/fence/unlock/reply logic stays in one place."""
+        self._on_store_commit(pgid, lambda: self._handle_sub_write_reply(
+            None, MSubWriteReply(tid, pgid, -2, self.osd_id, 0)))
 
     def _handle_sub_write_reply(self, conn, m: MSubWriteReply) -> None:
         if m.result == EAGAIN:
@@ -5411,11 +5526,21 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           total_shards=len(helpers), on_done=on_done,
                           want_all=True)
         self._pending_reads[tid] = pr
+        # repair-plane extents ride the per-(peer, pg) aggregator when
+        # read coalescing is on (ROADMAP wide-codes follow-on (c)): a
+        # storm rebuilding many objects sends ONE MSubReadN per helper
+        # per window — recovery-class lanes, so the peer still queues
+        # the batch under its recovery reservation — instead of one
+        # MSubRead per (object, helper)
+        coalesce = self._ec_read_coalesce_on(pgid.pool)
         for s in helpers:
             osd = plan["sources"][s]
             if osd == self.osd_id:
                 self._deliver_local_shard_read(tid, pgid, name, s,
                                                extents)
+            elif coalesce:
+                self._read_agg.submit(f"osd.{osd}", tid, pgid, name, s,
+                                      list(extents), klass="recovery")
             else:
                 self.messenger.send_message(
                     f"osd.{osd}",

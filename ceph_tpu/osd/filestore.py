@@ -82,7 +82,15 @@ def _dec_oid(d: Decoder) -> ObjectId:
     return ObjectId(d.string(), d.i64(), d.i64())
 
 
-def encode_transaction(tx: Transaction) -> bytes:
+def encode_transaction_enc(tx: Transaction) -> Encoder:
+    """Segmented WAL-record encoder: large WRITE payloads ride BY
+    REFERENCE (``BufferList.contiguous()`` + the segmented
+    ``Encoder.blob`` contract) so the group-commit WAL append is a
+    vectored write straight out of the rx-carved frame buffer — no
+    eager detach copy.  Safe because (a) the transport never reuses a
+    carved frame buffer (msg/README.md ownership contract) and (b) the
+    pipeline holds the Transaction — and thus the payload — alive
+    until the batch commits."""
     e = Encoder()
 
     def body(se: Encoder):
@@ -96,7 +104,7 @@ def encode_transaction(tx: Transaction) -> bytes:
             _enc_cid(se, op[1])
             _enc_oid(se, op[2])
             if kind == TxOp.WRITE:
-                se.u64(op[3]); se.blob(op[4].to_bytes())
+                se.u64(op[3]); se.blob(op[4].contiguous())
             elif kind == TxOp.ZERO:
                 se.u64(op[3]); se.u64(op[4])
             elif kind == TxOp.TRUNCATE:
@@ -112,7 +120,11 @@ def encode_transaction(tx: Transaction) -> bytes:
             elif kind == TxOp.CLONE:
                 _enc_oid(se, op[3])
     e.versioned(1, 1, body)
-    return e.tobytes()
+    return e
+
+
+def encode_transaction(tx: Transaction) -> bytes:
+    return encode_transaction_enc(tx).tobytes()
 
 
 def decode_transaction(data: bytes) -> Transaction:
@@ -191,6 +203,7 @@ class FileStore(ObjectStore):
             self._mounted = True
 
     def umount(self) -> None:
+        self.flush()  # drain the commit pipeline before the WAL closes
         with self._lock:
             if self._wal_file:
                 self._wal_file.close()
@@ -198,29 +211,113 @@ class FileStore(ObjectStore):
             self._mounted = False
 
     # -------------------------------------------------------- durability
-    def queue_transaction(self, tx: Transaction,
-                          on_commit: Callable[[], None] | None = None) -> None:
-        payload = encode_transaction(tx)
-        frame = struct.pack("<II", len(payload),
-                            native.crc32c(payload)) + payload
+    def _tx_cost(self, tx: Transaction) -> int:
+        """Throttle accounting: a queued FileStore item pins a FULL
+        per-object snapshot (data+attrs+omap, see _tx_snaps), so the
+        touched objects' current sizes count toward the admission
+        bound — a stream of tiny appends to a huge object must hit
+        backpressure on the snapshots it pins, not on its payload."""
+        n = super()._tx_cost(tx)
+        seen: set[tuple] = set()
+        for op in tx.ops:
+            kind = op[0]
+            if kind in (TxOp.CREATE_COLLECTION, TxOp.REMOVE_COLLECTION,
+                        TxOp.REMOVE):
+                continue
+            keys = [(op[1], op[2])]
+            if kind == TxOp.CLONE:
+                keys.append((op[1], op[3]))
+            for key in keys:
+                if key in seen:
+                    continue
+                seen.add(key)
+                try:
+                    n += len(self._mem._obj(*key).data)
+                except (NoSuchObject, NoSuchCollection):
+                    pass  # created by this tx: payload already counted
+        return n
+
+    def _snapshot(self, cid: CollectionId, oid: ObjectId):
+        """Post-tx image of one object (data, attrs, omap) — or None
+        when absent."""
+        try:
+            obj = self._mem._obj(cid, oid)
+        except (NoSuchObject, NoSuchCollection):
+            return None
+        return (bytes(obj.data), dict(obj.attrs), dict(obj.omap))
+
+    def _tx_snaps(self, tx: Transaction) -> dict:
+        """Per-object snapshots AS OF this transaction (taken right
+        after its replica apply, under the store lock): the batch
+        mirror writes THESE, never the live replica — live state may
+        already include later queued transactions whose WAL records
+        are not yet fsync'd, and persisting their fragments would
+        break the committed-prefix crash contract."""
+        snaps: dict = {}
+        for op in tx.ops:
+            kind = op[0]
+            if kind in (TxOp.CREATE_COLLECTION, TxOp.REMOVE_COLLECTION,
+                        TxOp.REMOVE):
+                continue
+            snaps[(op[1], op[2])] = self._snapshot(op[1], op[2])
+            if kind == TxOp.CLONE:
+                snaps[(op[1], op[3])] = self._snapshot(op[1], op[3])
+        return snaps
+
+    def _prepare(self, tx: Transaction):
+        """Validate + apply to the memory replica (read-your-writes
+        holds on return), encode the WAL record and snapshot the
+        touched objects (see _tx_snaps).  Durability — the append,
+        the ONE batch fsync, the file mirror and the applied
+        checkpoint — happens in ``_commit_batch``."""
+        segments = encode_transaction_enc(tx).segments()
+        plen = crc = 0
+        ref_b = 0
+        for s in segments:
+            plen += len(s)
+            crc = native.crc32c(s, crc)
+            if isinstance(s, memoryview):
+                ref_b += len(s)
+        self._book("store_ingest_ref_bytes", ref_b)
+        self._book("store_ingest_copy_bytes", plen - ref_b)
+        header = struct.pack("<II", plen, crc)
         with self._lock:
             if not self._mounted:
                 raise StoreError("not mounted")
-            # 1) validate first: a rejected tx must never reach the WAL
-            #    (a durable-but-invalid record would replay later)
-            self._mem.validate(tx)
-            # 2) WAL append + fsync: the commit point
-            self._wal_file.write(frame)
-            self._wal_file.flush()
-            os.fsync(self._wal_file.fileno())
-            # 3) apply to the memory replica then the files, and advance
-            #    the applied checkpoint so replay never re-runs this record
+            # validate-before-anything: a rejected tx must never reach
+            # the WAL (a durable-but-invalid record would replay later).
+            # MemStore.queue_transaction validates then applies
+            # atomically under its own lock.
             self._mem.queue_transaction(tx)
-            self._apply_files(tx)
-            self._write_ckpt(self._wal_file.tell())
+            return (header, segments, tx, self._tx_snaps(tx))
+
+    def _commit_batch(self, items: list) -> int:
+        """The group commit: every record in one vectored append, ONE
+        WAL fsync (the commit point for the whole batch), then each
+        dirty object mirrored ONCE and the checkpoint advanced once.
+
+        The mirror writes each dirty object's LAST per-tx snapshot
+        from THIS batch (captured at prepare, see _tx_snaps) — never
+        the live replica, which may already hold effects of later
+        queued transactions whose records are not yet journaled; a
+        crash must only ever surface fsync'd-WAL-prefix state."""
+        fsyncs = 0
+        with self._lock:
+            wal = self._wal_file
+            if wal is None:
+                raise StoreError("not mounted")
+            for header, segments, _tx, _snaps in items:
+                wal.write(header)
+                for s in segments:
+                    wal.write(s)
+            wal.flush()
+            os.fsync(wal.fileno())
+            fsyncs += 1
+            fsyncs += self._apply_files_batch(items)
+            self._write_ckpt(wal.tell())
+            fsyncs += 1  # the checkpoint's tmp-file fsync
             self._maybe_compact()
-        if on_commit:
-            on_commit()
+        return fsyncs
 
     def _write_ckpt(self, offset: int) -> None:
         tmp = self._ckpt_path + ".tmp"
@@ -288,44 +385,60 @@ class FileStore(ObjectStore):
                             f"{_esc(oid.name)}_{oid.shard}_{oid.generation}")
 
     def _apply_files(self, tx: Transaction) -> None:
-        dirty: set[tuple[CollectionId, ObjectId]] = set()
-        for op in tx.ops:
-            kind = op[0]
-            if kind == TxOp.CREATE_COLLECTION:
-                os.makedirs(self._coll_dir(op[1]), exist_ok=True)
-            elif kind == TxOp.REMOVE_COLLECTION:
-                d = self._coll_dir(op[1])
-                if os.path.isdir(d):
-                    for f in os.listdir(d):
-                        os.unlink(os.path.join(d, f))
-                    os.rmdir(d)
-            elif kind == TxOp.REMOVE:
-                base = self._obj_base(op[1], op[2])
-                for suffix in (".data", ".meta"):
-                    if os.path.exists(base + suffix):
-                        os.unlink(base + suffix)
-                dirty.discard((op[1], op[2]))
-                self._corrupt.discard((op[1], op[2]))
-            else:
-                dirty.add((op[1], op[2]))
-                if kind == TxOp.CLONE:
-                    dirty.add((op[1], op[3]))
-        for cid, oid in dirty:
-            self._write_object_files(cid, oid)
+        # replay/mount path (single-threaded): the live replica IS the
+        # post-tx state, so snapshots taken now are exact
+        self._apply_files_batch([(None, None, tx, self._tx_snaps(tx))])
 
-    def _write_object_files(self, cid: CollectionId, oid: ObjectId) -> None:
-        """Mirror one object's authoritative state from the replica to
-        disk, with per-page checksums in the meta sidecar."""
-        try:
-            obj = self._mem._obj(cid, oid)
-        except (NoSuchObject, NoSuchCollection):
-            return
+    def _apply_files_batch(self, items: list) -> int:
+        """Mirror a whole batch: collection/remove ops in order, then
+        each dirty object written ONCE from its LAST per-tx snapshot —
+        N same-object writes in a batch cost one mirror, not N, and
+        the persisted state is exactly the batch's WAL prefix.
+        Returns the fsync count spent."""
+        fsyncs = 0
+        dirty: dict[tuple[CollectionId, ObjectId], tuple | None] = {}
+        for _h, _s, tx, snaps in items:
+            for op in tx.ops:
+                kind = op[0]
+                if kind == TxOp.CREATE_COLLECTION:
+                    os.makedirs(self._coll_dir(op[1]), exist_ok=True)
+                elif kind == TxOp.REMOVE_COLLECTION:
+                    d = self._coll_dir(op[1])
+                    if os.path.isdir(d):
+                        for f in os.listdir(d):
+                            os.unlink(os.path.join(d, f))
+                        os.rmdir(d)
+                    dirty = {k: v for k, v in dirty.items()
+                             if k[0] != op[1]}
+                elif kind == TxOp.REMOVE:
+                    base = self._obj_base(op[1], op[2])
+                    for suffix in (".data", ".meta"):
+                        if os.path.exists(base + suffix):
+                            os.unlink(base + suffix)
+                    dirty.pop((op[1], op[2]), None)
+                    self._corrupt.discard((op[1], op[2]))
+                else:
+                    dirty[(op[1], op[2])] = snaps.get((op[1], op[2]))
+                    if kind == TxOp.CLONE:
+                        dirty[(op[1], op[3])] = snaps.get(
+                            (op[1], op[3]))
+        for (cid, oid), snap in dirty.items():
+            fsyncs += self._write_object_files(cid, oid, snap)
+        return fsyncs
+
+    def _write_object_files(self, cid: CollectionId, oid: ObjectId,
+                            snap: tuple | None) -> int:
+        """Mirror one object's snapshotted state (data, attrs, omap)
+        to disk, with per-page checksums in the meta sidecar.  Returns
+        the fsync count spent."""
+        if snap is None:  # absent at snapshot time (e.g. removed)
+            return 0
+        data, attrs, omap = snap
         base = self._obj_base(cid, oid)
         os.makedirs(os.path.dirname(base), exist_ok=True)
         self._corrupt.discard((cid, oid))  # fresh write supersedes rot
-        data = bytes(obj.data)
-        csums = [native.crc32c(data[i:i + CSUM_BLOCK])
-                 for i in range(0, len(data), CSUM_BLOCK)]
+        # one native round-trip for the whole page sweep
+        csums = native.crc32c_blocks(data, CSUM_BLOCK) if data else []
         tmp = base + ".tmp"
         with open(tmp, "wb") as f:
             f.write(data)
@@ -335,11 +448,11 @@ class FileStore(ObjectStore):
         e = Encoder()
 
         def body(se: Encoder):
-            se.u32(len(obj.attrs))
-            for k, v in sorted(obj.attrs.items()):
+            se.u32(len(attrs))
+            for k, v in sorted(attrs.items()):
                 se.string(str(k)); _enc_value(se, v)
-            se.u32(len(obj.omap))
-            for k, v in sorted(obj.omap.items()):
+            se.u32(len(omap))
+            for k, v in sorted(omap.items()):
                 se.string(str(k)); _enc_value(se, v)
             se.u64(len(data))
             se.u32(CSUM_BLOCK)
@@ -350,6 +463,7 @@ class FileStore(ObjectStore):
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, base + ".meta")
+        return 2  # data tmp + meta tmp
 
     def _load_from_files(self) -> None:
         if not os.path.isdir(self.path):

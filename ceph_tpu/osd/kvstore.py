@@ -48,13 +48,20 @@ class KVTransaction:
 
 class KeyValueDB(ABC):
     @abstractmethod
-    def submit(self, tx: KVTransaction) -> None: ...
+    def submit(self, tx: KVTransaction, sync: bool = True) -> None:
+        """Apply one atomic batch.  ``sync=False`` defers durability:
+        the record is written (appended) but not fsync'd — the group-
+        commit path submits a whole batch unsynced then calls
+        ``sync()`` ONCE (the kv-sync-thread contract)."""
 
     @abstractmethod
     def get(self, prefix: str, key: str) -> bytes | None: ...
 
     @abstractmethod
     def iterate(self, prefix: str, start: str = ""): ...
+
+    def sync(self) -> None:
+        """Make every prior unsynced submit durable (one fsync)."""
 
     def put(self, prefix: str, key: str, value: bytes) -> None:
         self.submit(KVTransaction().put(prefix, key, value))
@@ -75,7 +82,7 @@ class MemKV(KeyValueDB):
         self._data: dict[str, dict[str, bytes]] = {}
         self._lock = threading.RLock()
 
-    def submit(self, tx: KVTransaction) -> None:
+    def submit(self, tx: KVTransaction, sync: bool = True) -> None:
         with self._lock:
             for op, prefix, key, val in tx.ops:
                 if op == "put":
@@ -160,7 +167,12 @@ class WalKV(MemKV):
                    for k, v in kv.items()) or 1
 
     # -- api ---------------------------------------------------------------
-    def submit(self, tx: KVTransaction) -> None:
+    def submit(self, tx: KVTransaction, sync: bool = True) -> None:
+        """Append one crc-framed record (+apply).  ``sync=False`` skips
+        the fsync — the group-commit caller batches N submits behind
+        ONE ``sync()``; a crash before it loses only unacked records
+        (each frame is individually crc-gated, so replay applies the
+        committed prefix and discards the torn tail)."""
         e = Encoder()
         e.u8(_REC_TX)
         e.u32(len(tx.ops))
@@ -173,12 +185,19 @@ class WalKV(MemKV):
         with self._lock:
             super().submit(tx)
             self._file.write(self._frame(payload))
-            self._file.flush()
-            os.fsync(self._file.fileno())
+            if sync:
+                self._file.flush()
+                os.fsync(self._file.fileno())
             self._log_bytes += len(payload) + 8
             if self._log_bytes > self.COMPACT_RATIO * \
                     max(self._live_bytes, 4096):
                 self._compact()
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                os.fsync(self._file.fileno())
 
     def _compact(self) -> None:
         """Rewrite the file as one snapshot record (tmp+rename)."""

@@ -442,7 +442,11 @@ class MClockScheduler:
 
     # ---------------------------------------------------------------- API
     def enqueue(self, klass: str, item, tenant: str | None = None,
-                tags: tuple | None = None) -> None:
+                tags: tuple | None = None, force: bool = False) -> None:
+        """``force`` bypasses the lossy QUEUE_CAP drop: completion
+        continuations (store commit acks/replies) have no retry path —
+        dropping one would wedge its object lock forever — and their
+        count is bounded by in-flight ops, not by hostile senders."""
         with self._cv:
             now = self._clock()
             self._class_catchup_locked(klass)
@@ -453,7 +457,7 @@ class MClockScheduler:
                     return
                 # fold-through: ride the untagged stream below
             q = self._queues[klass]
-            if len(q) >= self.QUEUE_CAP:
+            if len(q) >= self.QUEUE_CAP and not force:
                 self.dropped[klass] += 1
                 if self._perf is not None:
                     self._perf.inc(f"mclock_dropped_{klass}")
@@ -753,10 +757,11 @@ class ShardedScheduler:
 
     def enqueue(self, klass: str, item, key=None,
                 tenant: str | None = None,
-                tags: tuple | None = None) -> None:
+                tags: tuple | None = None, force: bool = False) -> None:
         shard = self.shards[hash(key) % len(self.shards)] \
             if key is not None else self.shards[0]
-        shard.enqueue(klass, item, tenant=tenant, tags=tags)
+        shard.enqueue(klass, item, tenant=tenant, tags=tags,
+                      force=force)
 
     def queue_depth(self, klass: str | None = None) -> int:
         return sum(s.queue_depth(klass) for s in self.shards)

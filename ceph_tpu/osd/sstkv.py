@@ -269,7 +269,7 @@ class SstKV(KeyValueDB):
         self._mem_size += len(ck) + len(val)
 
     # ----------------------------------------------------------------- api
-    def submit(self, tx: KVTransaction) -> None:
+    def submit(self, tx: KVTransaction, sync: bool = True) -> None:
         with self._lock:
             flat: list[tuple[bytes, int, bytes]] = []
             for op, prefix, key, val in tx.ops:
@@ -296,12 +296,19 @@ class SstKV(KeyValueDB):
             payload = e.tobytes()
             self._wal.write(struct.pack("<II", len(payload),
                                         crc32c(payload)) + payload)
-            self._wal.flush()
-            os.fsync(self._wal.fileno())
+            if sync:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
             for ck, tomb, val in flat:
                 self._mem_put(ck, tomb, val)
             if self._mem_size >= self._memtable_bytes:
                 self._flush_memtable()
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.flush()
+                os.fsync(self._wal.fileno())
 
     def get(self, prefix: str, key: str) -> bytes | None:
         ck = _ckey(prefix, key)

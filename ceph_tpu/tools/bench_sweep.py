@@ -147,6 +147,20 @@ def configs() -> list[dict]:
                             "wire_secure_tx_flatten_copies_per_op",
                             "wire_secure_rx_copy_copies_per_op",
                             "wire_zero_copy_ok", "digest_verified"]})
+    # 8a3. the async group-commit store pipeline (ISSUE 14): 8-writer
+    # 1 MiB burst on a real BlueStore, async kv-sync/finisher pipeline
+    # vs the inline fsync-per-txn baseline — fsyncs-per-transaction
+    # (counter deltas, gated < 0.5 by bench.py's exit code) and the
+    # async:sync throughput ratio (gated >= 1) are the compact row
+    out.append({"id": "store_commit", "tool": "bench_root",
+                "argv": ["--ec-batch"],
+                "extract": ["store_commit_async_gbps",
+                            "store_commit_sync_gbps",
+                            "store_commit_speedup",
+                            "store_fsyncs_per_txn",
+                            "store_fsyncs_per_txn_rounds",
+                            "store_ingest_ref_share",
+                            "store_commit_ok", "digest_verified"]})
     # 8b. kernel auto-selection trajectory (ISSUE 8): per-signature
     # winner + per-candidate GB/s on the staged fold (xla / pallas /
     # mxu / bitxor) — recorded so the pick and the candidate gap are

@@ -32,11 +32,16 @@ PREFIX = "ceph_tpu"
 #: registers it), so the rule never strands — named tenants' series
 #: (mclock_qwait_us_tenant_<name>) appear as tenants register, bounded
 #: by osd_qos_max_tenants, and ride the same bucket contract
+#: ...plus the object-store commit pipeline's two latency halves
+#: (store.<daemon> registries, osd/objectstore.py): store_queue_us =
+#: enqueue -> batch cut (the coalescing wait), store_commit_us = the
+#: group commit itself (vectored WAL append + the batch's one fsync)
 HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
               "msg_dispatch_us",
               "mclock_qwait_us_client", "mclock_qwait_us_recovery",
               "mclock_qwait_us_scrub",
-              "mclock_qwait_us_tenant_default")
+              "mclock_qwait_us_tenant_default",
+              "store_commit_us", "store_queue_us")
 QUANTILES = (0.50, 0.99)
 
 #: per-daemon tracer head-sampling counters (trace_sample_rate draws):

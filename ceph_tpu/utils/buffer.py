@@ -134,6 +134,19 @@ class BufferList:
     def to_bytes(self) -> bytes:
         return b"".join(b.to_bytes() for b in self._bufs)
 
+    def contiguous(self):
+        """Zero-copy bytes-like for the common single-buffer case: a
+        memoryview over the raw array (read-only when the source was —
+        e.g. an rx-carved wire payload), detached bytes otherwise.
+        The store ingest path rides this into the WAL append instead
+        of the eager ``to_bytes()`` detach; the caller owns keeping
+        the source unmutated until consumed (the carve contract)."""
+        if len(self._bufs) == 1:
+            arr = self._bufs[0].view()
+            if arr.flags["C_CONTIGUOUS"]:
+                return memoryview(arr).cast("B")
+        return self.to_bytes()
+
     def to_array(self) -> np.ndarray:
         """Contiguous uint8 array (single-buffer lists return the view)."""
         if len(self._bufs) == 1:

@@ -296,6 +296,55 @@ OPTIONS: list[Option] = [
            "EC chunk size (bytes per shard per stripe row); must be a "
            "multiple of 4096 (the EC_ALIGN_SIZE page-alignment contract, "
            "ref ECUtil.h:33)", min=4096),
+    # -- object-store commit pipeline (the BlueStore kv-sync/finisher
+    # group commit: queue_transaction returns after the in-RAM apply,
+    # a per-store kv-sync thread batches WAL appends behind ONE fsync,
+    # and on_commit callbacks fire from a finisher in submission order)
+    Option("store_sync_commit", str, "off", OptionLevel.ADVANCED,
+           "'on' pins the pre-pipeline inline behavior: every "
+           "queue_transaction stages, fsyncs and fires on_commit in "
+           "the caller's thread (strict interleaving for scrub-heavy "
+           "or crash-bisection runs); 'off' engages the async group-"
+           "commit pipeline", enum_values=("on", "off"), startup=True,
+           see_also=("store_throttle_bytes", "store_batch_window_us")),
+    Option("store_throttle_bytes", int, 64 << 20, OptionLevel.ADVANCED,
+           "admission throttle: bytes of transactions in flight in the "
+           "commit pipeline before submitters block (BlueStore "
+           "throttle_bytes role — backpressure instead of unbounded "
+           "queue growth; also bounds how long by-reference wire "
+           "payloads stay pinned)", min=1 << 20,
+           see_also=("store_throttle_ops",)),
+    Option("store_throttle_ops", int, 1024, OptionLevel.ADVANCED,
+           "admission throttle: transactions in flight in the commit "
+           "pipeline before submitters block", min=1,
+           see_also=("store_throttle_bytes",)),
+    Option("store_batch_window_us", float, 0.0, OptionLevel.ADVANCED,
+           "initial extra coalescing delay before the kv-sync thread "
+           "cuts a batch: 0 = pure self-clocking (txns arriving during "
+           "the previous commit's fsync form the next batch — zero "
+           "added latency); store_batch_adaptive steers it from there",
+           min=0.0, see_also=("store_batch_adaptive",
+                              "store_batch_window_max_us")),
+    Option("store_batch_adaptive", str, "on", OptionLevel.ADVANCED,
+           "EWMA window steering toward store_batch_target_txns per "
+           "fsync: grows only while batches show real concurrency "
+           "(and never past a few commit durations), decays to 0 for "
+           "sequential writers so closed-loop latency never pays for "
+           "coalescing that cannot happen",
+           enum_values=("on", "off"),
+           see_also=("store_batch_target_txns",)),
+    Option("store_batch_target_txns", float, 8.0, OptionLevel.ADVANCED,
+           "adaptive window target: transactions per group commit",
+           min=1.0, see_also=("store_batch_adaptive",)),
+    Option("store_batch_window_min_us", float, 50.0,
+           OptionLevel.ADVANCED,
+           "adaptive window growth seed (first nonzero window size)",
+           min=1.0),
+    Option("store_batch_window_max_us", float, 4000.0,
+           OptionLevel.ADVANCED,
+           "the max-latency clamp: the batch window never exceeds "
+           "this, so an idle or trickle-load store still commits (and "
+           "acks) promptly", min=10.0),
     Option("osd_op_timeout", float, 5.0, OptionLevel.ADVANCED,
            "seconds before an in-flight op whose sub-ops never completed "
            "is failed back to the client", min=0.1, max=3600.0,

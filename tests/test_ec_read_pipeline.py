@@ -191,6 +191,36 @@ def test_aggregator_union_merge_carves_per_waiter():
     agg.stop()
 
 
+def test_aggregator_recovery_lane_coalesces_per_helper():
+    """ISSUE 14 satellite (ROADMAP wide-codes follow-on (c)): repair-
+    plane sub-chunk fetches ride the aggregator in a RECOVERY-class
+    lane — a storm rebuilding many objects sends ONE MSubReadN per
+    helper per window (msgs/helper drops N -> 1), the message carries
+    klass="recovery" for the serving peer's mclock queue, and client
+    fetches to the same helper never share the wire message."""
+    d = _FakeDaemon()
+    agg = SubReadAggregator(d, window_us=20_000, max_items=64)
+    pg = PgId(3, 0)
+    # 6 repair-plane fetches of 6 objects to ONE helper + an
+    # interleaved client read to the same helper
+    for i in range(6):
+        agg.submit("osd.1", 100 + i, pg, f"obj{i}", 2,
+                   [(0, 512), (2048, 512)], klass="recovery")
+    agg.submit("osd.1", 99, pg, "client-obj", 2, [(0, 100)])
+    assert _wait(lambda: len(d.sent) >= 2)
+    by_klass = {m.klass: m for _p, m in d.sent}
+    assert set(by_klass) == {"recovery", "client"}
+    rec = by_klass["recovery"]
+    assert len(rec.items) == 6          # 6 fetches, ONE wire message
+    assert len(by_klass["client"].items) == 1
+    # replies route exactly like client-lane ones
+    agg.on_reply("osd.1", [(fid, shard, 0, b"z" * 1024, {"v": 3})
+                           for fid, _o, shard, _e in rec.items])
+    assert _wait(lambda: len(d.completions) == 6)
+    assert sorted(c[0] for c in d.completions) == list(range(100, 106))
+    agg.stop()
+
+
 def test_aggregator_ranged_rides_whole_shard_fetch():
     """A ranged read of a shard object with a queued OR in-flight
     whole-shard fetch attaches as a waiter (the whole stream covers any

@@ -361,6 +361,16 @@ def test_e2e_clay_subchunk_recovery_fetch():
         # decode: the blended fleet ratio must stay under k
         ratio = tot["recovery_fetch_bytes"] / tot["recovery_rebuilt_bytes"]
         assert ratio < 2, f"repair-bytes-per-lost-byte {ratio} >= k"
+        # ISSUE 14 satellite: the repair-plane extents rode the
+        # per-(peer, pg) aggregator in recovery-class lanes — and
+        # coalescing means the helper-bound MESSAGE count stays at or
+        # below the sub-read count (strictly below whenever a storm
+        # window caught two rebuilds; >= 1 msgs proves the routing)
+        agg = _counters(c, prefix="ec_read_repair")
+        assert agg.get("ec_read_repair_subreads", 0) > 0, agg
+        assert agg.get("ec_read_repair_msgs", 0) > 0
+        assert agg["ec_read_repair_msgs"] <= \
+            agg["ec_read_repair_subreads"]
         c.settle(1.0)
         _assert_reads(c, cl, "cw", payloads, "post-recovery")
     finally:

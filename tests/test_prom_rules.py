@@ -63,6 +63,10 @@ def test_rules_reference_only_emitted_metrics():
     # the per-tenant family's always-present anchor (the scheduler
     # registers it at construction — same zeroed-schema contract)
     register_tenant_counters(qos_probe, ("default",))
+    # the store commit pipeline's schema (store_commit_us /
+    # store_queue_us p50/p99 rules)
+    from ceph_tpu.osd.objectstore import register_store_counters
+    register_store_counters(qos_probe)
     Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
     import time as _time
     store = MetricsHistoryStore()
@@ -88,10 +92,10 @@ def test_rules_shape_and_rendering():
     rules = recording_rules()
     # one rule per (histogram, quantile) + one rate rule per tracer /
     # messenger-copy counter + the staleness max, records namespaced
-    assert len(rules) == 23
+    assert len(rules) == 27
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
-    assert len(hist) == 16
+    assert len(hist) == 20
     assert all("by (daemon, le)" in r["expr"] for r in hist)
     quantiles = {r["record"].rsplit(":", 1)[1] for r in hist}
     assert quantiles == {"p50", "p99"}
@@ -111,8 +115,8 @@ def test_rules_shape_and_rendering():
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 23
-    assert text.count("    expr: ") == 23
+    assert text.count("  - record: ") == 27
+    assert text.count("    expr: ") == 27
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms

@@ -1,0 +1,489 @@
+"""Async group-commit transaction pipeline (ISSUE 14): ordering,
+throttling, group-commit counters, crash consistency, and sync-mode
+byte-identity across the store grid.
+
+The durability contract under test (osd/objectstore.py docstring):
+``queue_transaction`` returns after the in-RAM apply (read-your-writes
+holds before durability), ``on_commit`` fires in submission order from
+the finisher, one batch costs one fsync pass, and a crash replays
+exactly the committed WAL prefix — acked transactions always survive,
+the surviving state is prefix-consistent, and a torn tail is discarded.
+"""
+
+import os
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.osd.bluestore import BlueStore
+from ceph_tpu.osd.filestore import FileStore
+from ceph_tpu.osd.objectstore import (CollectionId, CommitPipeline,
+                                      MemStore, ObjectId, Transaction)
+from ceph_tpu.utils.perf import global_perf
+
+CID = CollectionId(7, 3)
+
+
+def _mk(kind: str, path: str):
+    if kind == "memstore":
+        s = MemStore()
+    elif kind == "filestore":
+        s = FileStore(os.path.join(path, "fs"))
+    else:
+        s = BlueStore(os.path.join(path, "bs"), compression="none")
+    s.mount()
+    return s
+
+
+STORES = ("memstore", "filestore", "bluestore")
+
+
+# ---------------------------------------------------- order + semantics
+@pytest.mark.parametrize("kind", STORES)
+def test_on_commit_fires_in_submission_order(kind, tmp_path):
+    s = _mk(kind, str(tmp_path))
+    s.enable_async(name=f"t-ord-{kind}")
+    try:
+        order = []
+        s.queue_transaction(Transaction().create_collection(CID))
+        for i in range(40):
+            s.queue_transaction(
+                Transaction().write(CID, ObjectId(f"o{i}"), 0,
+                                    bytes([i]) * 4096),
+                on_commit=lambda i=i: order.append(i))
+            # read-your-writes BEFORE durability: the apply is
+            # synchronous, only the fsync is deferred
+            assert s.read(CID, ObjectId(f"o{i}")).to_bytes() \
+                == bytes([i]) * 4096
+        s.flush()
+        assert order == list(range(40))
+    finally:
+        s.umount()
+        s.disable_async()
+
+
+@pytest.mark.parametrize("kind", ("memstore", "bluestore"))
+def test_order_holds_per_collection_across_interleave(kind, tmp_path):
+    """Two collections interleaved from two threads: each collection's
+    callbacks fire in ITS submission order (the global FIFO finisher
+    makes the stronger guarantee; assert the contractual one)."""
+    s = _mk(kind, str(tmp_path))
+    s.enable_async(name=f"t-coll-{kind}")
+    cids = (CollectionId(1, 1), CollectionId(2, 2))
+    try:
+        for c in cids:
+            s.queue_transaction(Transaction().create_collection(c))
+        fired = {c: [] for c in cids}
+        lock = threading.Lock()
+
+        def writer(c):
+            for i in range(25):
+                def cb(c=c, i=i):
+                    with lock:
+                        fired[c].append(i)
+                s.queue_transaction(
+                    Transaction().write(c, ObjectId(f"x{i}"), 0,
+                                        b"y" * 512), on_commit=cb)
+        ts = [threading.Thread(target=writer, args=(c,)) for c in cids]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        s.flush()
+        for c in cids:
+            assert fired[c] == list(range(25))
+    finally:
+        s.umount()
+        s.disable_async()
+
+
+def test_commit_barrier_fires_after_prior_txns():
+    s = MemStore()
+    s.mount()
+    s.enable_async(name="t-barrier")
+    try:
+        events = []
+        s.queue_transaction(Transaction().create_collection(CID))
+        s.queue_transaction(
+            Transaction().touch(CID, ObjectId("a")),
+            on_commit=lambda: events.append("tx"))
+        s.commit_barrier(lambda: events.append("barrier"))
+        s.flush()
+        assert events == ["tx", "barrier"]
+        # sync mode: inline
+        s.disable_async()
+        s.commit_barrier(lambda: events.append("inline"))
+        assert events[-1] == "inline"
+    finally:
+        s.umount()
+
+
+def test_group_commit_batches_fsyncs(tmp_path):
+    """8 concurrent writers on BlueStore: the kv-sync thread groups
+    transactions behind shared fsyncs — store_fsyncs lands well below
+    the per-txn fsync count the inline path pays (>= 2/txn), and the
+    txns-per-fsync histogram sees multi-txn batches."""
+    s = _mk("bluestore", str(tmp_path))
+    s.enable_async(name="t-group", window_us=5000.0,
+                   window_min_us=1000.0, window_max_us=20000.0)
+    try:
+        s.queue_transaction(Transaction().create_collection(CID))
+        s.flush()
+        data = os.urandom(128 * 1024)
+
+        def w(wi):
+            for i in range(10):
+                s.queue_transaction(Transaction().write(
+                    CID, ObjectId(f"g{wi}-{i}"), 0, data))
+        ts = [threading.Thread(target=w, args=(wi,)) for wi in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        s.flush()
+        perf = global_perf().registries()["store.t-group"].dump()
+        assert perf["store_txns"] >= 80
+        assert perf["store_batches"] < perf["store_txns"]
+        # strictly better than one fsync pass per txn (inline = 2+)
+        assert perf["store_fsyncs"] < perf["store_txns"]
+        for wi in range(8):
+            assert s.read(CID, ObjectId(f"g{wi}-9")).to_bytes() == data
+    finally:
+        s.umount()
+        s.disable_async()
+
+
+# ------------------------------------------------------------- throttle
+def test_throttle_blocks_then_unblocks(tmp_path):
+    """store_throttle_ops backpressure: with the committer stalled, a
+    submitter past the bound BLOCKS (counted) and unblocks as soon as
+    the batch drains — no unbounded queue growth, no deadlock."""
+    s = MemStore()
+    s.mount()
+    s.enable_async(name="t-throttle", throttle_ops=2,
+                   throttle_bytes=1 << 30)
+    gate = threading.Event()
+    orig = MemStore._commit_batch
+
+    def slow_commit(self, items):
+        gate.wait(10)
+        return orig(self, items)
+    MemStore._commit_batch = slow_commit
+    try:
+        # fill the ops bound (the committer is wedged on the gate, so
+        # nothing drains underneath us)
+        s.queue_transaction(Transaction().create_collection(CID))
+        s.queue_transaction(Transaction().touch(CID, ObjectId("a")))
+        done = threading.Event()
+
+        def third():
+            s.queue_transaction(Transaction().touch(CID, ObjectId("c")))
+            done.set()
+        t = threading.Thread(target=third)
+        t.start()
+        # the third submitter must be throttled while the committer
+        # is wedged...
+        assert not done.wait(0.3)
+        perf = global_perf().registries()["store.t-throttle"].dump()
+        assert perf["store_throttle_stalls"] >= 1
+        # ...and released once the batch drains
+        gate.set()
+        assert done.wait(10)
+        t.join()
+        s.flush()
+        assert s.exists(CID, ObjectId("c"))
+        perf = global_perf().registries()["store.t-throttle"].dump()
+        assert perf["store_queue_depth"] == 0
+    finally:
+        MemStore._commit_batch = orig
+        gate.set()
+        s.umount()
+        s.disable_async()
+
+
+def test_adaptive_window_decays_for_sequential_writer():
+    """A closed-loop sequential writer must not pay coalescing
+    latency: batches of one decay the window toward zero."""
+    s = MemStore()
+    s.mount()
+    s.enable_async(name="t-decay", window_us=2000.0, adaptive=True,
+                   window_max_us=4000.0)
+    try:
+        s.queue_transaction(Transaction().create_collection(CID))
+        for i in range(30):
+            s.queue_transaction(Transaction().touch(CID,
+                                                    ObjectId(f"s{i}")))
+            s.flush()  # closed loop: one txn per batch
+        assert s._pipeline.window_us == 0.0
+    finally:
+        s.umount()
+        s.disable_async()
+
+
+# ----------------------------------------------------- crash consistency
+_CRASH_CHILD = r"""
+import os, sys
+sys.path.insert(0, REPO)
+from ceph_tpu.osd.bluestore import BlueStore
+from ceph_tpu.osd.filestore import FileStore
+from ceph_tpu.osd.objectstore import CollectionId, ObjectId, Transaction
+
+kind, path, ackfile = sys.argv[1], sys.argv[2], sys.argv[3]
+CID = CollectionId(7, 3)
+s = (BlueStore(os.path.join(path, "bs"), compression="none")
+     if kind == "bluestore" else FileStore(os.path.join(path, "fs")))
+s.mount()
+s.enable_async(name="crash-child")
+s.queue_transaction(Transaction().create_collection(CID))
+s.flush()
+ack = os.open(ackfile, os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+
+KILL_AT = 6
+def on_commit(i):
+    # record the ack DURABLY before anything else (the driver treats
+    # every recorded ack as a client-visible commit)...
+    os.write(ack, (str(i) + "\n").encode())
+    os.fsync(ack)
+    if i == KILL_AT:
+        # ...then die MID-BATCH: later txns are queued/unfsynced
+        os._exit(1)
+
+for i in range(20):
+    s.queue_transaction(
+        Transaction().write(CID, ObjectId("c%d" % i), 0,
+                            bytes([i % 251]) * 8192),
+        on_commit=lambda i=i: on_commit(i))
+s.flush()
+os._exit(0)  # should never get here: the kill fires first
+"""
+
+
+@pytest.mark.parametrize("kind", ("filestore", "bluestore"))
+def test_crash_mid_batch_replays_committed_prefix(kind, tmp_path):
+    """Kill the store process from inside an on_commit callback (some
+    transactions acked, later ones still queued): remount must show
+    (a) EVERY acked transaction — an ack is a durability promise —
+    and (b) a PREFIX of the submission order: no transaction appears
+    without all its predecessors (no torn batch)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ackfile = str(tmp_path / "acks")
+    child = _CRASH_CHILD.replace("REPO", repr(repo))
+    proc = subprocess.run(
+        [sys.executable, "-c", child, kind, str(tmp_path), ackfile],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, (proc.returncode, proc.stderr[-2000:])
+    acked = [int(x) for x in open(ackfile).read().split()]
+    assert acked == list(range(len(acked))) and len(acked) >= 7
+
+    s = _mk(kind, str(tmp_path))  # remount: replay
+    try:
+        present = []
+        for i in range(20):
+            try:
+                got = s.read(CID, ObjectId(f"c{i}")).to_bytes()
+                assert got == bytes([i % 251]) * 8192
+                present.append(i)
+            except Exception:  # noqa: BLE001 - absent is legal past
+                break          # the committed prefix
+        # every ACKED txn survived...
+        assert len(present) >= len(acked), (present, acked)
+        # ...and the survivors are exactly a prefix (nothing beyond
+        # the break exists either — no holes, no torn batch)
+        for i in range(len(present), 20):
+            assert not s.exists(CID, ObjectId(f"c{i}"))
+        if kind == "bluestore":
+            fs = s.fsck()
+            assert not fs["leaked"] and not fs["double_booked"], fs
+    finally:
+        s.umount()
+
+
+def test_filestore_mirror_uses_per_tx_snapshots(tmp_path):
+    """The batch mirror must persist each object AS OF its batch's WAL
+    prefix — never the live replica, which may already hold a LATER
+    queued transaction whose record is not yet journaled.  Simulate
+    the race with the pipeline primitives: tx2 prepares (replica
+    updated) before batch 1 commits; crash before tx2's batch → the
+    files must show tx1's content and no fragment of tx2."""
+    s = FileStore(str(tmp_path / "fs"))
+    s.mount()
+    i0 = s._prepare(Transaction().create_collection(CID))
+    i1 = s._prepare(Transaction().write(CID, ObjectId("x"), 0,
+                                        b"A" * 8192))
+    # tx2: touches x AND y, applied to the replica, queued for a LATER
+    # batch (its WAL record never lands — the crash window)
+    s._prepare(Transaction()
+               .write(CID, ObjectId("x"), 0, b"B" * 8192)
+               .write(CID, ObjectId("y"), 0, b"C" * 8192))
+    s._commit_batch([i0, i1])  # batch 1 only, then "crash"
+    s2 = FileStore(str(tmp_path / "fs"))
+    s2.mount()
+    try:
+        assert s2.read(CID, ObjectId("x")).to_bytes() == b"A" * 8192
+        assert not s2.exists(CID, ObjectId("y"))
+    finally:
+        s2.umount()
+
+
+def test_torn_wal_tail_discarded_on_remount(tmp_path):
+    """A partially-written last record (torn write at the crash
+    instant) must be dropped by the crc gate: the committed prefix
+    replays, the torn tail is truncated away, and the store keeps
+    accepting writes."""
+    s = _mk("bluestore", str(tmp_path))
+    s.queue_transaction(Transaction().create_collection(CID))
+    for i in range(4):
+        s.queue_transaction(Transaction().write(
+            CID, ObjectId(f"t{i}"), 0, b"k" * 8192))
+    s.umount()
+    wal = os.path.join(str(tmp_path), "bs", "kv.wal")
+    raw = open(wal, "rb").read()
+    # tear INSIDE the last record's payload
+    ln = struct.unpack_from("<I", raw, 0)[0]  # sanity: framed
+    assert ln > 0
+    open(wal, "wb").write(raw[:-7])
+    s2 = BlueStore(os.path.join(str(tmp_path), "bs"),
+                   compression="none")
+    s2.mount()
+    try:
+        # prefix intact (the torn record was the tail of the stream)
+        assert s2.read(CID, ObjectId("t0")).to_bytes() == b"k" * 8192
+        s2.queue_transaction(Transaction().write(
+            CID, ObjectId("after"), 0, b"z" * 4096))
+        assert s2.read(CID, ObjectId("after")).to_bytes() == b"z" * 4096
+    finally:
+        s2.umount()
+
+
+# ------------------------------------------------- sync-mode identity
+def _drive_grid(s) -> None:
+    """A representative tx mix across the store op grid."""
+    s.queue_transaction(Transaction().create_collection(CID))
+    big = bytes(range(256)) * 64  # 16K
+    s.queue_transaction(Transaction()
+                        .write(CID, ObjectId("a"), 0, big)
+                        .setattrs(CID, ObjectId("a"), {"v": 3}))
+    s.queue_transaction(Transaction().write(CID, ObjectId("a"),
+                                            4096, b"Q" * 100))
+    s.queue_transaction(Transaction()
+                        .omap_setkeys(CID, ObjectId("a"),
+                                      {"k1": b"v1", "k2": b"v2"})
+                        .clone(CID, ObjectId("a"), ObjectId("b")))
+    s.queue_transaction(Transaction().truncate(CID, ObjectId("b"),
+                                               5000))
+    s.queue_transaction(Transaction().zero(CID, ObjectId("a"),
+                                           100, 300))
+    s.queue_transaction(Transaction().touch(CID, ObjectId("c")))
+    s.queue_transaction(Transaction().remove(CID, ObjectId("c")))
+
+
+@pytest.mark.parametrize("kind", ("filestore", "bluestore"))
+def test_sync_commit_mode_is_byte_identical(kind, tmp_path):
+    """store_sync_commit=on (no enable_async) must equal async+flush
+    state-for-state across the op grid — and the two stores' durable
+    images must decode identically on remount."""
+    sync = _mk(kind, str(tmp_path / "sync"))
+    _drive_grid(sync)
+    sync.umount()
+    a = _mk(kind, str(tmp_path / "async"))
+    a.enable_async(name=f"t-ident-{kind}")
+    _drive_grid(a)
+    a.umount()
+    a.disable_async()
+    # remount both and compare full logical state
+    s1 = _mk(kind, str(tmp_path / "sync"))
+    s2 = _mk(kind, str(tmp_path / "async"))
+    try:
+        assert s1.list_collections() == s2.list_collections()
+        assert s1.list_objects(CID) == s2.list_objects(CID)
+        for oid in s1.list_objects(CID):
+            assert s1.read(CID, oid).to_bytes() \
+                == s2.read(CID, oid).to_bytes()
+            assert s1.getattrs(CID, oid) == s2.getattrs(CID, oid)
+            assert s1.omap_get(CID, oid) == s2.omap_get(CID, oid)
+    finally:
+        s1.umount()
+        s2.umount()
+
+
+# ------------------------------------------------------- failure paths
+def test_validation_failure_raises_in_caller_and_books_nothing():
+    s = MemStore()
+    s.mount()
+    s.enable_async(name="t-vfail")
+    try:
+        with pytest.raises(Exception):
+            # no collection yet: validate must raise IN THE CALLER
+            # (never reach the queue, never fire on_commit)
+            s.queue_transaction(
+                Transaction().touch(CID, ObjectId("x")),
+                on_commit=lambda: pytest.fail("acked a rejected tx"))
+        s.flush()
+        perf = global_perf().registries()["store.t-vfail"].dump()
+        assert perf["store_txns"] == 0
+        assert perf["store_queue_depth"] == 0  # unadmitted cleanly
+    finally:
+        s.umount()
+        s.disable_async()
+
+
+def test_failed_pipeline_stops_acking_and_refuses_work():
+    """A failed group commit poisons the pipeline: the batch's acks
+    never fire, LATER batches never commit or ack (their records would
+    land behind the torn frame, unreachable to replay), flush() raises
+    instead of pretending to drain, and a subsequent
+    queue_transaction refuses BEFORE the in-RAM apply (an errored
+    write must not stay visible to reads)."""
+    s = MemStore()
+    s.mount()
+    s.enable_async(name="t-fail")
+    acked = []
+    orig = MemStore._commit_batch
+    boom = [True]
+
+    def failing(self, items):
+        if boom[0]:
+            raise OSError(28, "No space left on device")
+        return orig(self, items)
+    try:
+        s.queue_transaction(Transaction().create_collection(CID))
+        s.flush()
+        MemStore._commit_batch = failing
+        s.queue_transaction(Transaction().touch(CID, ObjectId("a")),
+                            on_commit=lambda: acked.append("a"))
+        with pytest.raises(Exception):
+            s.flush()
+        # device "recovers", but the pipeline must STAY failed: a
+        # late tx sneaking into a post-failure batch must not ack
+        boom[0] = False
+        deadline = time.time() + 2
+        while time.time() < deadline and s._pipeline._failed is None:
+            time.sleep(0.01)
+        with pytest.raises(Exception):
+            s.queue_transaction(
+                Transaction().touch(CID, ObjectId("b")),
+                on_commit=lambda: acked.append("b"))
+        assert acked == []
+        # the refused tx never reached the in-RAM state
+        assert not s.exists(CID, ObjectId("b"))
+    finally:
+        MemStore._commit_batch = orig
+        s._pipeline._failed = None  # let stop() drain
+        s.umount()
+        s.disable_async()
+
+
+def test_pipeline_registry_removed_on_disable():
+    s = MemStore()
+    s.mount()
+    s.enable_async(name="t-reg")
+    assert "store.t-reg" in global_perf().registries()
+    s.disable_async()
+    assert "store.t-reg" not in global_perf().registries()
+    s.umount()
