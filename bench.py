@@ -35,6 +35,7 @@ tunnel answers.  BENCH_SWEEP_CPU.json carries the measured CPU leg.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import subprocess
@@ -270,13 +271,16 @@ def _store_commit_leg() -> dict:
         # both stores up front, rounds INTERLEAVED sync/async so box
         # noise hits both legs alike (the trace-overhead leg's
         # best-of-N treatment); compression off on both — this
-        # measures the commit pipeline, not zlib
-        sync = BlueStore(os.path.join(d, "sync"), compression="none")
+        # measures the commit pipeline, not zlib.  kv_backend=sst:
+        # the leveled LSM (ISSUE 15) is the measured metadata path
+        sync = BlueStore(os.path.join(d, "sync"), compression="none",
+                         kv_backend="sst")
         sync.mount()
         sync.queue_transaction(Transaction().create_collection(cid))
         # async pipeline: throughput-tuned window knobs (the OSD's
         # defaults favor latency; a bench burst wants deep batches)
-        st = BlueStore(os.path.join(d, "async"), compression="none")
+        st = BlueStore(os.path.join(d, "async"), compression="none",
+                       kv_backend="sst")
         st.mount()
         st.enable_async(name="bench", window_us=20000.0,
                         window_min_us=2000.0, window_max_us=60000.0,
@@ -327,6 +331,206 @@ def _store_commit_leg() -> dict:
         "store_ingest_ref_share": (round(ref_b / (ref_b + copy_b), 3)
                                    if ref_b + copy_b else None),
         "store_commit_ok": ok,
+    }
+
+
+def _kv_maint_leg() -> dict:
+    """Background LSM maintenance for the KV tier (ISSUE 15), measured
+    + gated: a sustained omap-heavy write burst on BlueStore over
+    ``kv_backend=sst`` with a small memtable, spanning many memtable
+    flushes and at least one compaction.  The inline leg
+    (``kv_bg_maintenance=off``) shows the cliff — the batch that tips
+    the memtable pays the whole flush (and any cascading level merge)
+    inside the kv-sync thread, so every commit behind it inherits the
+    wall.  The background leg gates on: ZERO inline flush/compaction
+    in the kv-sync thread (counted ``kv_*_inline``), commit p99
+    STRICTLY below the inline leg, a nonzero block-cache hit count on
+    the hot-read leg, and byte-identity vs the inline path over the
+    full KV op grid (rm_prefix + tombstone-shadowing included) and the
+    store's logical state."""
+    import random
+    import tempfile
+    import threading
+
+    from ceph_tpu.osd.bluestore import BlueStore
+    from ceph_tpu.osd.kvstore import KVTransaction, MemKV
+    from ceph_tpu.osd.objectstore import (CollectionId, ObjectId,
+                                          Transaction)
+    from ceph_tpu.osd.sstkv import SstKV
+    from ceph_tpu.utils.perf import global_perf
+
+    # ---- KV-grid byte identity: one deterministic op stream (puts,
+    # overwrites, rms, rm_prefix, tombstone-shadowing across flush
+    # boundaries) through bg-sst, inline-sst and the MemKV oracle
+    def drive_kv_grid(kv) -> None:
+        rng = random.Random(1510)
+        keys = [f"k{i:03d}" for i in range(120)]
+        for step in range(900):
+            r = rng.random()
+            prefix = rng.choice(("p1", "p2", "gone"))
+            key = rng.choice(keys)
+            if r < 0.62:
+                kv.put(prefix, key, rng.randbytes(rng.randrange(64, 512)))
+            elif r < 0.87:
+                kv.rm(prefix, key)  # tombstones shadow flushed values
+            elif r < 0.97:
+                # multi-op tx: put-then-rm_prefix-then-put ordering
+                kv.submit(KVTransaction()
+                          .put("gone", f"e{step}", b"early")
+                          .rm_prefix("gone")
+                          .put("gone", f"l{step}", b"late"))
+            else:
+                kv.submit(KVTransaction().rm_prefix("p2"))
+
+    def kv_dump(kv) -> dict:
+        return {p: list(kv.iterate(p)) for p in ("p1", "p2", "gone")}
+
+    grid_identical = True
+    with tempfile.TemporaryDirectory() as d:
+        oracle = MemKV()
+        drive_kv_grid(oracle)
+        for tag, bg in (("bg", True), ("inline", False)):
+            kv = SstKV(os.path.join(d, tag), memtable_bytes=4096,
+                       background=bg)
+            drive_kv_grid(kv)
+            if kv_dump(kv) != kv_dump(oracle):
+                grid_identical = False
+            kv.close()
+            # remount: durable image replays to the same contents
+            kv = SstKV(os.path.join(d, tag), memtable_bytes=4096,
+                       background=bg)
+            if kv_dump(kv) != kv_dump(oracle):
+                grid_identical = False
+            kv.close()
+
+    # ---- the commit-latency burst: omap-heavy transactions so the
+    # KV tier (not the page device) dominates each group commit.
+    # Group commit merges each batch into ONE vectored KV submit, so
+    # seals track BATCH count (a submit that tips the memtable seals
+    # once however much it carried) — the memtable budget and the
+    # L0 trigger are set low enough that the burst spans many seals
+    # and at least one compaction
+    writers, per = 4, 48
+    nkeys, vbytes = 4, 2048  # ~8 KiB of KV mutations per txn
+    cid = CollectionId(15, 1)
+    payload = random.Random(15).randbytes(vbytes)
+
+    def burst(store, tag: str) -> list[float]:
+        lats: list[float] = []
+        barrier = threading.Barrier(writers)
+
+        def w(wi: int) -> None:
+            barrier.wait()
+            for i in range(per):
+                kv = {f"{tag}-{wi}-{i}-{j}": payload
+                      for j in range(nkeys)}
+                t0 = time.perf_counter()
+                store.queue_transaction(
+                    Transaction().omap_setkeys(
+                        cid, ObjectId(f"o-{wi}"), kv),
+                    on_commit=lambda t0=t0: lats.append(
+                        time.perf_counter() - t0))
+
+        ts = [threading.Thread(target=w, args=(wi,))
+              for wi in range(writers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        store.flush()
+        return lats
+
+    def p99(lats: list[float]) -> float:
+        s = sorted(lats)
+        return s[min(len(s) - 1, int(0.99 * len(s)))]
+
+    with tempfile.TemporaryDirectory() as d:
+        stores = {}
+        for tag, bg in (("bg", True), ("inline", False)):
+            st = BlueStore(os.path.join(d, tag), compression="none",
+                           kv_backend="sst", kv_name=f"bench-{tag}",
+                           kv_memtable_bytes=16 * 1024,
+                           kv_background=bg)
+            st.mount()
+            # low L0 trigger (same on both legs): the burst must span
+            # at least one level merge, the wall the inline leg pays
+            st._kv.L0_COMPACT_FILES = 3
+            st.enable_async(name=f"kvm-{tag}")
+            st.queue_transaction(Transaction()
+                                 .create_collection(cid)
+                                 .touch(cid, ObjectId("seed")))
+            st.flush()
+            stores[tag] = st
+        kv_perf = {t: global_perf().registries()[f"kv.bench-{t}"]
+                   for t in stores}
+        p0 = {t: kv_perf[t].dump() for t in stores}
+        # rounds interleaved bg/inline so box noise hits both alike;
+        # best (min) p99 per leg
+        p99s = {"bg": [], "inline": []}
+        rounds = 4
+        for r in range(rounds):
+            for tag in ("bg", "inline"):
+                p99s[tag].append(p99(burst(stores[tag], f"r{r}")))
+        # quiesce: in-flight background flush/compaction must finish
+        # before the counter deltas are read (the p99s above were
+        # already taken — waiting here costs the gate nothing)
+        stores["bg"]._kv.wait_maintenance_idle()
+        p1 = {t: kv_perf[t].dump() for t in stores}
+        delta = {t: {k: p1[t][k] - p0[t][k]
+                     for k in ("kv_flush", "kv_compact",
+                               "kv_flush_inline", "kv_compact_inline",
+                               "kv_stall_memtable", "kv_stall_l0",
+                               "kv_slowdown")}
+                 for t in stores}
+        # ---- hot-read leg: repeated gets against the bg store's LSM
+        # (onode-lookup shape: bloom + index + block via the shared
+        # cache) — the hit counter must move
+        kv = stores["bg"]._kv
+        hot = [k for k, _v in itertools.islice(kv.iterate("M"), 16)]
+        h0 = kv_perf["bg"].get("kv_cache_hit")
+        for _ in range(40):
+            for k in hot:
+                kv.get("M", k)
+        cache_hits = kv_perf["bg"].get("kv_cache_hit") - h0
+        # ---- store-level identity: both stores ran the same txn
+        # stream; their logical contents must match
+        store_identical = True
+        for wi in range(writers):
+            oid = ObjectId(f"o-{wi}")
+            if stores["bg"].omap_get(cid, oid) \
+                    != stores["inline"].omap_get(cid, oid):
+                store_identical = False
+        for st in stores.values():
+            st.umount()
+            st.disable_async()
+    bg_p99, inline_p99 = min(p99s["bg"]), min(p99s["inline"])
+    inline_maint = (delta["bg"]["kv_flush_inline"]
+                    + delta["bg"]["kv_compact_inline"])
+    ok = (grid_identical and store_identical
+          and delta["bg"]["kv_flush"] >= 4
+          and delta["bg"]["kv_compact"] >= 1
+          and inline_maint == 0
+          and bg_p99 < inline_p99
+          and cache_hits > 0)
+    return {
+        "kv_maint_bg_p99_ms": round(bg_p99 * 1e3, 3),
+        "kv_maint_inline_p99_ms": round(inline_p99 * 1e3, 3),
+        "kv_maint_p99_ratio": (round(inline_p99 / bg_p99, 2)
+                               if bg_p99 > 0 else None),
+        "kv_maint_p99_rounds_ms": {
+            t: [round(v * 1e3, 3) for v in vs]
+            for t, vs in p99s.items()},
+        "kv_maint_flushes": delta["bg"]["kv_flush"],
+        "kv_maint_compactions": delta["bg"]["kv_compact"],
+        "kv_maint_inline_maintenance": inline_maint,
+        "kv_maint_inline_leg_flushes_inline":
+            delta["inline"]["kv_flush_inline"],
+        "kv_maint_stalls": (delta["bg"]["kv_stall_memtable"]
+                            + delta["bg"]["kv_stall_l0"]),
+        "kv_maint_slowdowns": delta["bg"]["kv_slowdown"],
+        "kv_maint_cache_hits": cache_hits,
+        "kv_maint_identical": grid_identical and store_identical,
+        "kv_maint_ok": ok,
     }
 
 
@@ -671,6 +875,13 @@ def ec_batch_bench(trace: bool = False) -> int:
     # < 0.5 and async >= sync throughput)
     store_leg = _store_commit_leg()
 
+    # ---- KV background-maintenance leg (ISSUE 15): sustained multi-
+    # memtable omap burst on kv_backend=sst — the bg leg gates on zero
+    # inline flush/compaction in the kv-sync thread, commit p99
+    # strictly below the inline-maintenance leg, nonzero block-cache
+    # hits on the hot-read leg, and byte-identity vs the inline path
+    kv_leg = _kv_maint_leg()
+
     verified = True
     for w in range(writers):
         for i in range(ops_per):
@@ -787,12 +998,18 @@ def ec_batch_bench(trace: bool = False) -> int:
         # burst on BlueStore — fsyncs/txn from counter deltas (GATED
         # < 0.5) and async-vs-sync GB/s (GATED async >= sync)
         **store_leg,
+        # background LSM maintenance for the KV tier (ISSUE 15):
+        # seal-and-flush + streaming compaction off the commit path
+        # (GATED: zero inline maintenance in the kv-sync thread, bg
+        # p99 < inline p99, cache hits > 0, byte-identity)
+        **kv_leg,
         **({"trace_stages": trace_stages}
            if trace_stages is not None else {}),
     }))
     return 0 if verified and single_copy and trace_overhead_ok \
         and wire["wire_zero_copy_ok"] \
-        and store_leg["store_commit_ok"] else 1
+        and store_leg["store_commit_ok"] \
+        and kv_leg["kv_maint_ok"] else 1
 
 
 def _recovery_progress_leg() -> dict:

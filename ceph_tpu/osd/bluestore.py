@@ -182,10 +182,24 @@ class BlueStore(ObjectStore):
     docstring for the layout and crash-ordering rules)."""
 
     def __init__(self, path: str, defer_limit: int = DEFER_LIMIT,
-                 kv_backend: str = "wal", compression: str = "zlib"):
+                 kv_backend: str | None = None,
+                 compression: str = "zlib",
+                 kv_name: str | None = None,
+                 kv_memtable_bytes: int | None = None,
+                 kv_cache_bytes: int | None = None,
+                 kv_background: bool | None = None):
         self.path = path
         self.defer_limit = defer_limit
-        self.kv_backend = kv_backend  # "wal" or "sst" (RocksDB-tier LSM)
+        # metadata KV tier: "wal" (snapshot-compacting log) or "sst"
+        # (leveled LSM with background flush/compaction, osd/sstkv.py).
+        # None = unset; configure_kv may fill it from config before
+        # mount, else "wal".  kv_name stands the kv.<name> perf
+        # registry (flush/compact/stall/cache telemetry)
+        self.kv_backend = kv_backend
+        self.kv_name = kv_name
+        self.kv_memtable_bytes = kv_memtable_bytes
+        self.kv_cache_bytes = kv_cache_bytes
+        self.kv_background = kv_background
         # inline blob compression mode ("zlib" | "none") —
         # bluestore_compression_{mode,algorithm} role
         self.compression = None if compression in ("none", "", None) \
@@ -207,6 +221,39 @@ class BlueStore(ObjectStore):
         # would still need
         self._deferred_pending: dict[int, bytes] = {}
 
+    def configure_kv(self, cfg, name: str | None = None) -> None:
+        """Fill UNSET kv-tier knobs from config before mount (the
+        daemon calls this with its own name so maintenance telemetry
+        lands on ``kv.<daemon>``).  Explicit constructor arguments
+        always win — a store built with ``kv_backend="sst"`` stays
+        sst whatever the config says."""
+        if self._mounted:
+            return
+        if name is not None and self.kv_name is None:
+            self.kv_name = name
+
+        def opt(key):
+            # per-option guard: a cfg missing ONE knob (older/test
+            # schema) must not silently drop the knobs after it
+            try:
+                return cfg[key]
+            except Exception:  # noqa: BLE001
+                return None
+        if self.kv_backend is None:
+            self.kv_backend = opt("kv_backend")
+        if self.kv_memtable_bytes is None:
+            self.kv_memtable_bytes = opt("kv_memtable_bytes")
+        if self.kv_cache_bytes is None:
+            self.kv_cache_bytes = opt("kv_cache_bytes")
+        if self.kv_background is None:
+            bg = opt("kv_bg_maintenance")
+            if bg is not None:
+                self.kv_background = str(bg).lower() == "on"
+
+    def kv_stats(self) -> dict | None:
+        with self._lock:
+            return self._kv.stats() if self._kv is not None else None
+
     # ------------------------------------------------------------ mount
     def mount(self) -> None:
         with self._lock:
@@ -214,9 +261,25 @@ class BlueStore(ObjectStore):
                 return
             os.makedirs(self.path, exist_ok=True)
             from .kvstore import create_kv
-            self._kv = (WalKV(self.path) if self.kv_backend == "wal"
-                        else create_kv(self.kv_backend,
-                                       os.path.join(self.path, "kv")))
+            backend = self.kv_backend or "wal"
+            if backend == "wal":
+                # bg snapshot compaction: the wal backend's inline
+                # stall in miniature moves off the submit (kv-sync)
+                # path too, unless explicitly pinned off
+                self._kv = WalKV(self.path, name=self.kv_name,
+                                 bg_compact=self.kv_background
+                                 is not False)
+            else:
+                kw: dict = {"name": self.kv_name}
+                if self.kv_memtable_bytes is not None:
+                    kw["memtable_bytes"] = self.kv_memtable_bytes
+                if self.kv_cache_bytes is not None:
+                    kw["cache_bytes"] = self.kv_cache_bytes
+                if self.kv_background is not None:
+                    kw["background"] = self.kv_background
+                self._kv = create_kv(backend,
+                                     os.path.join(self.path, "kv"),
+                                     **kw)
             super_raw = self._kv.get(_P_SUPER, "super")
             if super_raw is None:
                 self._kv.put(_P_SUPER, "super", str(PAGE).encode())

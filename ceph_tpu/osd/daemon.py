@@ -638,6 +638,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self.mon = self._mons[0]
         self.cfg = cfg or default_config()
         self.store = store or ObjectStore.create("memstore")
+        # KV metadata tier: fill unset knobs (backend, memtable/cache
+        # budgets, background maintenance) from config and land the
+        # maintenance telemetry on kv.<daemon> — before mount, which
+        # is what opens the KV (a no-op for KV-less backends)
+        self.store.configure_kv(self.cfg, name=self.name)
         self.store.mount()
         # async group-commit pipeline (store_sync_commit=on pins the
         # inline path): queue_transaction returns after the in-RAM
@@ -1043,6 +1048,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if cmd == "dump_messenger":
             return {"data": self.messenger.dump_state(),
                     "hb": self.hb_messenger.dump_state()}
+        if cmd == "dump_kv_stats":
+            # the KV metadata tier's maintenance face (memtable seal
+            # depth, level shape, stall/cache tallies); None-shaped for
+            # backends without one (memstore/filestore)
+            return {"store": type(self.store).__name__.lower(),
+                    "kv": self.store.kv_stats()}
         if cmd == "config show":
             return self.cfg.dump()
         if cmd == "dump_op_queue":

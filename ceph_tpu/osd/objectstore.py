@@ -54,6 +54,13 @@ backpressure instead of unbounded growth); the adaptive batch window
 clamped to ``store_batch_window_max_us``) adds coalescing delay only
 when concurrency exists, decaying to 0 for sequential writers so an
 idle store commits promptly.
+
+The KV tier below BlueStore composes with this chain: its background
+flush/compaction threads keep ``_commit_batch`` off the merge path,
+and when LSM maintenance falls behind, the counted KV write stall
+lands on the kv-sync thread → the commit queue stays full → this
+admission throttle blocks submitters.  Backpressure stays honest end
+to end instead of an unbounded inline merge (osd/sstkv.py).
 """
 
 from __future__ import annotations
@@ -570,6 +577,17 @@ class ObjectStore:
     # -- lifecycle ---------------------------------------------------------
     def mount(self) -> None: ...
     def umount(self) -> None: ...
+
+    # -- KV metadata tier (BlueStore overrides) ----------------------------
+    def configure_kv(self, cfg, name: str | None = None) -> None:
+        """Fill unset KV-tier knobs from config before mount; no-op
+        for backends without a KV metadata tier."""
+
+    def kv_stats(self) -> dict | None:
+        """KV-tier maintenance/occupancy stats (memtable seal depth,
+        level shape, stall/cache tallies) or None when the backend has
+        no KV tier — the ``dump_kv_stats`` admin surface."""
+        return None
 
     # -- async commit pipeline --------------------------------------------
     def enable_async(self, *, name: str = "store",

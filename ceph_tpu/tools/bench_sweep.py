@@ -161,6 +161,23 @@ def configs() -> list[dict]:
                             "store_fsyncs_per_txn_rounds",
                             "store_ingest_ref_share",
                             "store_commit_ok", "digest_verified"]})
+    # 8a4. background LSM maintenance for the KV tier (ISSUE 15):
+    # omap-heavy multi-memtable burst on kv_backend=sst — commit p99
+    # with background seal/flush/compaction vs the inline-maintenance
+    # cliff (gated: zero inline maintenance in the kv-sync thread, bg
+    # p99 strictly below inline, cache hits nonzero, byte-identity)
+    out.append({"id": "kv_maint", "tool": "bench_root",
+                "argv": ["--ec-batch"],
+                "extract": ["kv_maint_bg_p99_ms",
+                            "kv_maint_inline_p99_ms",
+                            "kv_maint_p99_ratio",
+                            "kv_maint_flushes",
+                            "kv_maint_compactions",
+                            "kv_maint_inline_maintenance",
+                            "kv_maint_stalls", "kv_maint_slowdowns",
+                            "kv_maint_cache_hits",
+                            "kv_maint_identical",
+                            "kv_maint_ok", "digest_verified"]})
     # 8b. kernel auto-selection trajectory (ISSUE 8): per-signature
     # winner + per-candidate GB/s on the staged fold (xla / pallas /
     # mxu / bitxor) — recorded so the pick and the candidate gap are

@@ -36,12 +36,20 @@ PREFIX = "ceph_tpu"
 #: (store.<daemon> registries, osd/objectstore.py): store_queue_us =
 #: enqueue -> batch cut (the coalescing wait), store_commit_us = the
 #: group commit itself (vectored WAL append + the batch's one fsync)
+#: ...plus the KV metadata tier's maintenance histograms (kv.<store>
+#: registries, osd/kvstore.py schema): kv_flush_us / kv_compact_us =
+#: background memtable flush and level-merge walls, kv_stall_us =
+#: write-stall time writers paid while maintenance was behind (the
+#: p99 cliff the background seam removes), kv_wal_compact_us = the
+#: wal backend's snapshot-compaction wall
 HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
               "msg_dispatch_us",
               "mclock_qwait_us_client", "mclock_qwait_us_recovery",
               "mclock_qwait_us_scrub",
               "mclock_qwait_us_tenant_default",
-              "store_commit_us", "store_queue_us")
+              "store_commit_us", "store_queue_us",
+              "kv_flush_us", "kv_compact_us", "kv_stall_us",
+              "kv_wal_compact_us")
 QUANTILES = (0.50, 0.99)
 
 #: per-daemon tracer head-sampling counters (trace_sample_rate draws):
@@ -53,9 +61,14 @@ QUANTILES = (0.50, 0.99)
 #: standing series keep the zero-copy wire path's "copies per hop"
 #: claim a measured number (0 in plaintext mode) instead of a
 #: code-reading exercise
+#: KV maintenance/cache counters ride the same rate-rule shape:
+#: flush/compact rates say how hard the LSM is working, the cache
+#: hit:miss ratio is the block cache's value on a dashboard
 COUNTERS = ("trace_sampled", "trace_dropped",
             "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
-            "msg_rx_copy_bytes", "msg_rx_copy_copies")
+            "msg_rx_copy_bytes", "msg_rx_copy_copies",
+            "kv_flush", "kv_compact",
+            "kv_cache_hit", "kv_cache_miss")
 
 #: the metrics-history liveness gauge the exporter emits per daemon
 #: (seconds since the mon merged that daemon's newest snapshot); the

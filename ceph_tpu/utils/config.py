@@ -345,6 +345,37 @@ OPTIONS: list[Option] = [
            "the max-latency clamp: the batch window never exceeds "
            "this, so an idle or trickle-load store still commits (and "
            "acks) promptly", min=10.0),
+    # -- BlueStore metadata KV tier (osd/kvstore.py + osd/sstkv.py):
+    # the RocksDBStore slot — backend choice + LSM maintenance knobs
+    Option("kv_backend", str, "wal", OptionLevel.ADVANCED,
+           "BlueStore metadata KeyValueDB backend: 'wal' (snapshot-"
+           "compacting log) or 'sst' (leveled LSM: WAL-backed "
+           "memtables seal and flush to L0 in the background, a "
+           "compaction thread streams levels together, reads ride an "
+           "atomically-swapped snapshot + shared block cache — the "
+           "RocksDB-tier path)", enum_values=("wal", "sst"),
+           startup=True,
+           see_also=("kv_memtable_bytes", "kv_bg_maintenance")),
+    Option("kv_memtable_bytes", int, 256 * 1024, OptionLevel.ADVANCED,
+           "sst backend: memtable bytes before it seals into an "
+           "immutable memtable and a fresh WAL segment opens "
+           "(write_buffer_size role)", min=4096,
+           see_also=("kv_backend",)),
+    Option("kv_cache_bytes", int, 8 << 20, OptionLevel.ADVANCED,
+           "sst backend: byte budget of the LRU block cache shared "
+           "across every sorted table of one store (parsed data "
+           "blocks; bloom filters + sparse indexes stay resident "
+           "regardless).  0 disables caching", min=0,
+           see_also=("kv_backend",)),
+    Option("kv_bg_maintenance", str, "on", OptionLevel.ADVANCED,
+           "'on' runs LSM flushes/compactions (and the wal backend's "
+           "snapshot compaction) on background threads with counted "
+           "write-stall backpressure (kv_stall_*); 'off' pins the "
+           "inline path — every maintenance wall lands in the "
+           "submitting thread (the kv-sync thread under the async "
+           "commit pipeline), the cliff the kv_maint bench leg "
+           "measures", enum_values=("on", "off"), startup=True,
+           see_also=("kv_backend", "store_sync_commit")),
     Option("osd_op_timeout", float, 5.0, OptionLevel.ADVANCED,
            "seconds before an in-flight op whose sub-ops never completed "
            "is failed back to the client", min=0.1, max=3600.0,

@@ -1,0 +1,800 @@
+"""Background LSM maintenance for the KV tier (ISSUE 15): seal-and-
+flush memtables, streaming compaction off the commit path, the shared
+block cache, write-stall backpressure, and the crash contract.
+
+The contract under test (osd/sstkv.py docstring): a full memtable
+seals and a BACKGROUND thread flushes it to L0 (zero inline
+maintenance in the submit path); compaction streams levels together
+against an immutable snapshot; reads resolve against atomically-
+swapped snapshots and keep working across a concurrent merge; writers
+stall (counted) instead of paying the merge inline; and a kill at any
+maintenance crash point remounts to exactly the acked prefix with
+orphaned SSTs garbage-collected.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.osd.kvstore import KVTransaction, MemKV, WalKV
+from ceph_tpu.osd.sstkv import SstKV
+from ceph_tpu.utils.perf import global_perf
+
+
+def _dump(kv, prefixes=("p",)):
+    return {p: list(kv.iterate(p)) for p in prefixes}
+
+
+# ------------------------------------------------ background seal/flush
+def test_background_maintenance_keeps_submit_path_clean(tmp_path):
+    """A write burst spanning many seals and at least one compaction
+    books ZERO inline maintenance — every flush/compact ran on the
+    background threads — and the contents match the MemKV oracle."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=2048)
+    kv.L0_COMPACT_FILES = 2
+    model = MemKV()
+    try:
+        for i in range(600):
+            val = (f"v{i}".encode()) * 7
+            kv.put("p", f"k{i % 150:04d}", val)
+            model.put("p", f"k{i % 150:04d}", val)
+        assert kv.wait_maintenance_idle(30)
+        d = kv.perf.dump()
+        assert d["kv_flush"] >= 4
+        assert d["kv_compact"] >= 1
+        assert d["kv_flush_inline"] == 0
+        assert d["kv_compact_inline"] == 0
+        assert d["kv_flush_us"]["count"] == d["kv_flush"]
+        assert d["kv_compact_us"]["count"] == d["kv_compact"]
+        assert _dump(kv) == _dump(model)
+    finally:
+        kv.close()
+
+
+def test_inline_mode_books_inline_counters(tmp_path):
+    """background=False pins the pre-background behavior: the caller's
+    thread pays every flush/compaction (counted kv_*_inline) and the
+    contents are byte-identical to the background path."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=2048,
+               background=False)
+    kv.L0_COMPACT_FILES = 2
+    model = MemKV()
+    try:
+        for i in range(600):
+            val = (f"v{i}".encode()) * 7
+            kv.put("p", f"k{i % 150:04d}", val)
+            model.put("p", f"k{i % 150:04d}", val)
+        d = kv.perf.dump()
+        assert d["kv_flush_inline"] >= 4
+        assert d["kv_compact_inline"] >= 1
+        assert d["kv_flush"] == d["kv_flush_inline"]
+        # inline mode never write-stalls: maintenance IS the write
+        assert d["kv_stall_memtable"] == d["kv_stall_l0"] == 0
+        assert _dump(kv) == _dump(model)
+    finally:
+        kv.close()
+
+
+def test_concurrent_readers_and_writers_during_maintenance(tmp_path):
+    """gets/iterates run against the snapshot while flushes and
+    compactions churn underneath: every read returns a value some
+    write produced for that key (never a torn/foreign value), iterate
+    stays sorted and duplicate-free, and the final state matches the
+    MemKV oracle."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=1024)
+    kv.L0_COMPACT_FILES = 2
+    model = MemKV()
+    stop = threading.Event()
+    errors: list[str] = []
+
+    def reader():
+        while not stop.is_set():
+            for i in range(0, 80, 7):
+                k = f"k{i:04d}"
+                v = kv.get("p", k)
+                if v is not None and not v.startswith(k.encode()):
+                    errors.append(f"foreign value for {k}: {v!r}")
+            keys = [k for k, _v in kv.iterate("p")]
+            if keys != sorted(set(keys)):
+                errors.append("iterate unsorted or duplicated")
+
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers:
+        t.start()
+    try:
+        for round_ in range(8):
+            for i in range(80):
+                k = f"k{i:04d}"
+                val = f"{k}:{round_}".encode() * 3
+                kv.put("p", k, val)
+                model.put("p", k, val)
+            for i in range(0, 80, 9):  # tombstones shadow flushed rows
+                kv.rm("p", f"k{i:04d}")
+                model.rm("p", f"k{i:04d}")
+    finally:
+        stop.set()
+        for t in readers:
+            t.join()
+    try:
+        assert not errors, errors[:3]
+        assert kv.wait_maintenance_idle(30)
+        assert kv.perf.get("kv_compact") >= 1
+        assert _dump(kv) == _dump(model)
+    finally:
+        kv.close()
+
+
+def test_submit_is_atomic_for_lock_free_readers(tmp_path):
+    """A multi-op transaction must be all-or-nothing to concurrent
+    lock-free gets: a key the tx puts AND then tombstones (the
+    put-then-rm_prefix shape) must NEVER be visible, even mid-apply —
+    the memtable applies the tx's collapsed final image in one step."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=1 << 20)
+    stop = threading.Event()
+    leaks: list[bytes] = []
+
+    def reader():
+        while not stop.is_set():
+            v = kv.get("t", "early")
+            if v is not None:
+                leaks.append(v)
+
+    rd = threading.Thread(target=reader)
+    rd.start()
+    try:
+        for i in range(400):
+            kv.submit(KVTransaction()
+                      .put("t", "early", b"never-visible")
+                      .rm_prefix("t")
+                      .put("t", f"late{i}", b"v"))
+    finally:
+        stop.set()
+        rd.join()
+    try:
+        assert not leaks, leaks[:3]
+        assert kv.get("t", "late399") == b"v"
+    finally:
+        kv.close()
+
+
+def test_iterate_snapshot_survives_compaction(tmp_path):
+    """An in-flight iterator keeps yielding correct rows after a
+    compaction unlinks the files it is reading (open-fd preads over
+    the immutable snapshot — the reader never blocks or breaks)."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=1024)
+    try:
+        for i in range(300):
+            kv.put("p", f"k{i:04d}", f"v{i}".encode() * 5)
+        assert kv.wait_maintenance_idle(30)
+        it = kv.iterate("p")
+        head = [next(it) for _ in range(3)]
+        # force a full merge under the live iterator
+        kv.L0_COMPACT_FILES = 0
+        with kv._cv:
+            kv._signal_compact_locked()
+        assert kv.wait_maintenance_idle(30)
+        assert kv.perf.get("kv_compact") >= 1
+        rows = head + list(it)
+        assert [k for k, _ in rows] == [f"k{i:04d}" for i in range(300)]
+        assert all(v == f"v{int(k[1:]):d}".encode() * 5 for k, v in rows)
+    finally:
+        kv.close()
+
+
+# ------------------------------------------------ write-stall backpressure
+def test_write_stall_blocks_then_releases(tmp_path):
+    """With the flush thread wedged and the sealed-memtable budget
+    exhausted, a writer STALLS (counted, kv_stall_us booked) until the
+    flush catches up — bounded backpressure, not an inline merge."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=512)
+    gate = threading.Event()
+    kv.STALL_IMM_SLOWDOWN = 1
+    kv.STALL_IMM_STOP = 2
+    kv.test_hooks["flush.pre_manifest"] = lambda: gate.wait(30)
+    done = threading.Event()
+    try:
+        # two seals: the wedged flush thread holds the first, the
+        # second piles behind it -> imm count reaches STOP
+        kv.put("p", "a", b"x" * 600)
+        kv.put("p", "b", b"y" * 600)
+        deadline = time.time() + 5
+        while len(kv._state.imm) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(kv._state.imm) >= 2
+
+        def blocked_writer():
+            kv.put("p", "c", b"z" * 600)
+            done.set()
+        t = threading.Thread(target=blocked_writer)
+        t.start()
+        assert not done.wait(0.3)      # stalled while behind
+        gate.set()
+        assert done.wait(10)           # released once flushed
+        t.join()
+        d = kv.perf.dump()
+        assert d["kv_stall_memtable"] >= 1
+        assert d["kv_stall_us"]["count"] >= 1
+        assert kv.get("p", "c") == b"z" * 600
+    finally:
+        gate.set()
+        kv.close()
+
+
+def test_close_during_write_stall_raises_cleanly(tmp_path):
+    """A writer blocked in the write stall when close() lands gets a
+    clean IOError — never an AttributeError from dereferencing the
+    torn-down WAL after close emptied it."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=512)
+    gate = threading.Event()
+    kv.STALL_IMM_SLOWDOWN = 1
+    kv.STALL_IMM_STOP = 2
+    kv.test_hooks["flush.pre_manifest"] = lambda: gate.wait(30)
+    errs: list = []
+    kv.put("p", "a", b"x" * 600)
+    kv.put("p", "b", b"y" * 600)
+    deadline = time.time() + 5
+    while len(kv._state.imm) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert len(kv._state.imm) >= 2
+
+    def stalled_writer():
+        try:
+            kv.put("p", "c", b"z" * 600)
+        except IOError as e:
+            errs.append(e)
+    t = threading.Thread(target=stalled_writer)
+    t.start()
+    deadline = time.time() + 5
+    while kv.perf.get("kv_stall_memtable") == 0 \
+            and time.time() < deadline:
+        time.sleep(0.005)
+    assert kv.perf.get("kv_stall_memtable") >= 1
+    closer = threading.Thread(target=kv.close)
+    closer.start()
+    gate.set()  # un-wedge the flush thread so close() can join it
+    t.join(10)
+    closer.join(10)
+    assert not t.is_alive() and not closer.is_alive()
+    assert len(errs) == 1 and isinstance(errs[0], IOError)
+
+
+def test_slowdown_pacing_counted(tmp_path):
+    """Below the stop threshold writers PACE (brief counted sleeps)
+    instead of blocking."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=512)
+    gate = threading.Event()
+    kv.STALL_IMM_SLOWDOWN = 1
+    kv.STALL_IMM_STOP = 99
+    kv.test_hooks["flush.pre_manifest"] = lambda: gate.wait(30)
+    try:
+        for i in range(6):
+            kv.put("p", f"s{i}", b"x" * 600)
+        assert kv.perf.get("kv_slowdown") >= 1
+        assert kv.perf.get("kv_stall_memtable") == 0
+    finally:
+        gate.set()
+        kv.close()
+
+
+def test_stall_backpressures_the_commit_pipeline(tmp_path):
+    """The tentpole chain: a KV write stall lands on the kv-sync
+    thread, so async store commits queue behind it and acks wait —
+    then everything drains once maintenance catches up (no loss, no
+    inline merge)."""
+    from ceph_tpu.osd.bluestore import BlueStore
+    from ceph_tpu.osd.objectstore import (CollectionId, ObjectId,
+                                          Transaction)
+    cid = CollectionId(9, 9)
+    st = BlueStore(str(tmp_path / "bs"), compression="none",
+                   kv_backend="sst", kv_memtable_bytes=1024,
+                   kv_background=True)
+    st.mount()
+    kv = st._kv
+    gate = threading.Event()
+    kv.STALL_IMM_SLOWDOWN = 1
+    kv.STALL_IMM_STOP = 2
+    kv.test_hooks["flush.pre_manifest"] = lambda: gate.wait(30)
+    st.enable_async(name="t-kv-stall")
+    acked: list[int] = []
+    try:
+        st.queue_transaction(Transaction().create_collection(cid))
+
+        def writer():
+            # paced so each txn commits as its OWN batch: every batch
+            # seals the 1 KiB memtable, so the third batch's submit
+            # finds two sealed memtables behind the wedged flush
+            # thread and stalls IN THE KV-SYNC THREAD
+            for i in range(8):
+                st.queue_transaction(
+                    Transaction().omap_setkeys(
+                        cid, ObjectId(f"o{i}"),
+                        {f"k{j}": b"v" * 400 for j in range(4)}),
+                    on_commit=lambda i=i: acked.append(i))
+                time.sleep(0.05)
+        t = threading.Thread(target=writer)
+        t.start()
+        deadline = time.time() + 10
+        while not (kv.perf.get("kv_stall_memtable")
+                   or kv.perf.get("kv_slowdown")) \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        stalled = (kv.perf.get("kv_stall_memtable")
+                   + kv.perf.get("kv_slowdown"))
+        gate.set()
+        t.join()
+        st.flush()
+        assert acked == list(range(8))
+        assert kv.perf.get("kv_flush_inline") == 0
+        assert stalled >= 1
+    finally:
+        gate.set()
+        st.umount()
+        st.disable_async()
+
+
+# ---------------------------------------------------- shared block cache
+def test_block_cache_hit_miss_evict_and_budget(tmp_path):
+    """Repeat gets hit the shared cache (one file read per block, not
+    per probe); the byte budget evicts LRU-first and the gauge tracks
+    residency; compaction invalidates dead tables' blocks."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=2048,
+               cache_bytes=8 * 1024)
+    try:
+        for i in range(200):
+            kv.put("p", f"k{i:04d}", f"v{i}".encode() * 9)
+        assert kv.wait_maintenance_idle(30)
+        assert kv.stats()["files"] > 0
+        kv.get("p", "k0010")
+        h0 = kv.perf.get("kv_cache_hit")
+        for _ in range(5):
+            assert kv.get("p", "k0010") == b"v10" * 9
+        assert kv.perf.get("kv_cache_hit") >= h0 + 4
+        # budget: walking the whole keyspace overflows 8 KiB of
+        # parsed blocks -> evictions, residency stays bounded
+        for i in range(200):
+            kv.get("p", f"k{i:04d}")
+        assert kv.perf.get("kv_cache_evict") >= 1
+        assert kv.cache.stats()["bytes"] <= 8 * 1024
+        assert kv.perf.get("kv_cache_bytes") == kv.cache.stats()["bytes"]
+        # compaction drops dead tables' blocks from the cache: only
+        # live tables may keep cached blocks afterwards
+        kv.L0_COMPACT_FILES = 0
+        with kv._cv:
+            kv._signal_compact_locked()
+        assert kv.wait_maintenance_idle(30)
+        with kv.cache._lock:
+            cached_uids = {k[0] for k in kv.cache._map}
+        live = {s.uid for lvl in kv._state.levels for s in lvl}
+        assert cached_uids <= live
+    finally:
+        kv.close()
+
+
+def test_close_does_not_break_inflight_readers(tmp_path):
+    """close() must not close table fds under a lock-free reader: an
+    in-flight iterator keeps yielding correct rows after close (the
+    fds close when the last snapshot reference drops), and reads that
+    START after close see the empty snapshot instead of EBADF."""
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=1024)
+    for i in range(200):
+        kv.put("p", f"k{i:04d}", f"v{i}".encode() * 5)
+    assert kv.wait_maintenance_idle(30)
+    it = kv.iterate("p")
+    head = [next(it) for _ in range(3)]
+    kv.close()
+    rows = head + list(it)
+    assert [k for k, _ in rows] == [f"k{i:04d}" for i in range(200)]
+    assert kv.get("p", "k0000") is None  # post-close reads: empty
+
+
+def test_block_cache_refuses_insert_after_invalidate(tmp_path):
+    """A reader on a pre-compaction snapshot that loses the
+    lookup/insert race against invalidate() must not pin a dead
+    table's blocks in the budget."""
+    from ceph_tpu.osd.sstkv import BlockCache
+    cache = BlockCache(1 << 20)
+    cache.insert((1, 0), [(b"a", 0, b"x")])
+    assert cache.lookup((1, 0)) is not None
+    cache.invalidate(1)
+    assert cache.lookup((1, 0)) is None
+    # the racing reader's late insert is refused
+    cache.insert((1, 0), [(b"a", 0, b"x")])
+    assert cache.lookup((1, 0)) is None
+    assert cache.stats()["bytes"] == 0
+    # a NEW table (fresh uid) caches normally
+    cache.insert((2, 0), [(b"b", 0, b"y")])
+    assert cache.lookup((2, 0)) is not None
+
+
+def test_block_cache_zero_budget_disables(tmp_path):
+    kv = SstKV(str(tmp_path / "kv"), memtable_bytes=1024,
+               cache_bytes=0)
+    try:
+        for i in range(100):
+            kv.put("p", f"k{i:03d}", b"v" * 40)
+        assert kv.wait_maintenance_idle(30)
+        for _ in range(3):
+            kv.get("p", "k007")
+        assert kv.perf.get("kv_cache_hit") == 0
+        assert kv.cache.stats()["bytes"] == 0
+    finally:
+        kv.close()
+
+
+def test_sst_open_handle_cap_reopens_on_demand(tmp_path):
+    """A store past MAX_OPEN tables must not exhaust the fd rlimit:
+    least-recently-opened LIVE handles close and the next read reopens
+    them by path, byte-identically."""
+    from ceph_tpu.osd.sstkv import _Sst
+    old = _Sst.MAX_OPEN
+    _Sst.MAX_OPEN = 4
+    try:
+        kv = SstKV(str(tmp_path / "kv"), memtable_bytes=600,
+                   cache_bytes=0)
+        kv.L0_COMPACT_FILES = 10_000  # no compaction: every flush
+        kv.STALL_L0_SLOWDOWN = 10_000  # ...and no L0 write stall
+        kv.STALL_L0_STOP = 10_000      # (the cap is what's under test)
+        try:                          # output stays a live L0 table
+            for i in range(400):
+                kv.put("p", f"k{i:04d}", f"v{i}".encode() * 9)
+            assert kv.wait_maintenance_idle(30)
+            tables = [s for lvl in kv._state.levels for s in lvl]
+            assert len(tables) > _Sst.MAX_OPEN
+            n_open = sum(1 for s in tables if s._f is not None)
+            assert n_open <= _Sst.MAX_OPEN + 2  # busy-victim slack
+            # evicted handles reopen on demand, bytes identical
+            for i in range(0, 400, 7):
+                assert kv.get("p", f"k{i:04d}") \
+                    == f"v{i}".encode() * 9
+        finally:
+            kv.close()
+    finally:
+        _Sst.MAX_OPEN = old
+
+
+# ------------------------------------------------------- crash contract
+_KV_CRASH_CHILD = r"""
+import os, sys
+sys.path.insert(0, REPO)
+from ceph_tpu.osd.sstkv import SstKV
+
+point, path, ackfile = sys.argv[1], sys.argv[2], sys.argv[3]
+SstKV.CRASH_POINTS = frozenset({point})
+SstKV.L0_COMPACT_FILES = 2
+kv = SstKV(path, memtable_bytes=600, background=True)
+ack = os.open(ackfile, os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+for i in range(5000):
+    # sync submit: durable once put() returns -> the ack is a promise
+    kv.put("p", "k%04d" % i, ("v%d" % i).encode() * 9)
+    os.write(ack, ("%d\n" % i).encode())
+    os.fsync(ack)
+os._exit(0)  # never reached: a maintenance crash point fires first
+"""
+
+_CRASH_POINTS = ("flush.pre_manifest", "flush.pre_wal_unlink",
+                 "compact.pre_manifest", "compact.pre_unlink")
+
+
+@pytest.mark.parametrize("point", _CRASH_POINTS)
+def test_kill_at_maintenance_crash_point_replays_acked_prefix(
+        point, tmp_path):
+    """os._exit at each maintenance crash window (PR-14 style): the
+    remount must show every acked key with its exact value, the
+    surviving keys must be a contiguous prefix of the put order
+    (sealed-segment WAL replay + atomic manifest), and open-time GC
+    must leave disk sst files == manifest files (no orphan leak from
+    the window between an sst/manifest write and its unlinks)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = str(tmp_path / "kv")
+    ackfile = str(tmp_path / "acks")
+    child = _KV_CRASH_CHILD.replace("REPO", repr(repo))
+    proc = subprocess.run(
+        [sys.executable, "-c", child, point, path, ackfile],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-2000:])
+    acked = [int(x) for x in open(ackfile).read().split()]
+    assert acked == list(range(len(acked))) and len(acked) >= 1
+
+    kv = SstKV(path, memtable_bytes=600)
+    try:
+        assert kv.wait_maintenance_idle(30)
+        rows = dict(kv.iterate("p"))
+        # every ACKED key survived with its exact bytes...
+        for i in acked:
+            assert rows.get(f"k{i:04d}") == f"v{i}".encode() * 9, i
+        # ...and the survivors are exactly a contiguous prefix (sync
+        # submits: anything later than the last durable put is absent)
+        idxs = sorted(int(k[1:]) for k in rows)
+        assert idxs == list(range(len(idxs)))
+        assert len(idxs) >= len(acked)
+        # orphan GC: disk ssts == the manifest's live set
+        live = {os.path.basename(s.path)
+                for lvl in kv._state.levels for s in lvl}
+        disk = {fn for fn in os.listdir(path)
+                if fn.startswith("sst_") and fn.endswith(".sst")}
+        assert disk == live, (disk - live, live - disk)
+    finally:
+        kv.close()
+
+
+_BS_CRASH_CHILD = r"""
+import os, sys
+sys.path.insert(0, REPO)
+from ceph_tpu.osd.bluestore import BlueStore
+from ceph_tpu.osd.objectstore import CollectionId, ObjectId, Transaction
+from ceph_tpu.osd.sstkv import SstKV
+
+path, ackfile = sys.argv[1], sys.argv[2]
+SstKV.CRASH_POINTS = frozenset({"flush.pre_manifest"})
+CID = CollectionId(7, 3)
+s = BlueStore(os.path.join(path, "bs"), compression="none",
+              kv_backend="sst", kv_memtable_bytes=2048,
+              kv_background=True)
+s.mount()
+s.enable_async(name="kv-crash-child")
+s.queue_transaction(Transaction().create_collection(CID))
+s.flush()
+ack = os.open(ackfile, os.O_WRONLY | os.O_CREAT | os.O_APPEND)
+
+def on_commit(i):
+    os.write(ack, (str(i) + "\n").encode())
+    os.fsync(ack)
+
+for i in range(200):
+    s.queue_transaction(
+        Transaction().omap_setkeys(CID, ObjectId("o%d" % i),
+                                   {"k": bytes([i % 251]) * 512}),
+        on_commit=lambda i=i: on_commit(i))
+s.flush()
+os._exit(0)  # never reached: the LSM flush crash point fires first
+"""
+
+
+def test_bluestore_over_sst_kill_mid_flush_replays_and_fscks(tmp_path):
+    """The full stack: BlueStore async commit pipeline over the LSM,
+    killed from inside a background memtable flush — remount shows
+    every acked transaction, a prefix of submission order, and a clean
+    fsck (the manifest swap is atomic; sealed segments replay)."""
+    from ceph_tpu.osd.bluestore import BlueStore
+    from ceph_tpu.osd.objectstore import CollectionId, ObjectId
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ackfile = str(tmp_path / "acks")
+    child = _BS_CRASH_CHILD.replace("REPO", repr(repo))
+    proc = subprocess.run(
+        [sys.executable, "-c", child, str(tmp_path), ackfile],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-2000:])
+    acked = [int(x) for x in open(ackfile).read().split()]
+    assert acked == list(range(len(acked)))
+
+    cid = CollectionId(7, 3)
+    s = BlueStore(str(tmp_path / "bs"), compression="none",
+                  kv_backend="sst", kv_memtable_bytes=2048)
+    s.mount()
+    try:
+        present = []
+        for i in range(200):
+            om = s.omap_get(cid, ObjectId(f"o{i}")) \
+                if s.exists(cid, ObjectId(f"o{i}")) else None
+            if om is None:
+                break
+            assert om == {"k": bytes([i % 251]) * 512}
+            present.append(i)
+        assert len(present) >= len(acked), (len(present), len(acked))
+        for i in range(len(present), 200):
+            assert not s.exists(cid, ObjectId(f"o{i}"))
+        fs = s.fsck()
+        assert not fs.get("errors"), fs
+    finally:
+        s.umount()
+
+
+def test_late_maintenance_publish_after_close_is_refused(tmp_path):
+    """close() past a timed-out thread join must not let a still-
+    running flush publish a manifest from the emptied state (it would
+    reference only the new file — open-time GC would then delete
+    every other live sst).  The late publish aborts: manifest bytes
+    untouched, the sealed WAL segment stays replayable, the orphan
+    output is GC'd on reopen."""
+    path = str(tmp_path / "kv")
+    kv = SstKV(path, memtable_bytes=512)
+    for i in range(50):
+        kv.put("p", f"k{i:03d}", b"v" * 40)
+    assert kv.wait_maintenance_idle(30)
+    manifest_path = os.path.join(path, "MANIFEST")
+    manifest_before = open(manifest_path, "rb").read()
+    entered, gate = threading.Event(), threading.Event()
+    kv.test_hooks["flush.pre_manifest"] = \
+        lambda: (entered.set(), gate.wait(30))
+    kv.put("p", "sealed-key", b"s" * 600)  # seals -> flush wedges
+    assert entered.wait(5)
+    # simulate close() proceeding past a 30s join timeout: closed
+    # flag up, state emptied — exactly what the wedged flush would
+    # have clobbered
+    with kv._lock:
+        kv._closed = True
+        kv._state = type(kv._state)()
+    gate.set()
+    kv._flush_thread.join(10)
+    assert not kv._flush_thread.is_alive()
+    assert open(manifest_path, "rb").read() == manifest_before
+    kv.close()
+    # reopen: the sealed key replays from its surviving WAL segment,
+    # the aborted flush's output file is GC'd, nothing else was lost
+    kv2 = SstKV(path, memtable_bytes=512)
+    try:
+        assert kv2.wait_maintenance_idle(30)
+        assert kv2.get("p", "sealed-key") == b"s" * 600
+        assert len(list(kv2.iterate("p"))) == 51
+        live = {os.path.basename(s.path)
+                for lvl in kv2._state.levels for s in lvl}
+        disk = {fn for fn in os.listdir(path)
+                if fn.startswith("sst_") and fn.endswith(".sst")}
+        assert disk == live
+    finally:
+        kv2.close()
+
+
+def test_orphan_sst_gc_on_open(tmp_path):
+    """A foreign sst_*.sst absent from the manifest is removed at open
+    and its sequence number is retired (a later flush can never reuse
+    the just-GC'd name)."""
+    path = str(tmp_path / "kv")
+    kv = SstKV(path, memtable_bytes=1024)
+    for i in range(100):
+        kv.put("p", f"k{i:03d}", b"v" * 40)
+    kv.wait_maintenance_idle(30)
+    kv.close()
+    orphan = os.path.join(path, "sst_00009999.sst")
+    open(orphan, "wb").write(b"leaked by a crash between manifest+unlink")
+    kv2 = SstKV(path, memtable_bytes=1024)
+    try:
+        assert not os.path.exists(orphan)
+        assert kv2._seq >= 9999  # name retired, no future collision
+        assert len(list(kv2.iterate("p"))) == 100
+    finally:
+        kv2.close()
+
+
+# ------------------------------------------------------ WalKV compaction
+def test_walkv_inline_compaction_counted(tmp_path):
+    """The wal backend's snapshot rewrite is the same inline stall in
+    miniature — it must be COUNTED (kv_wal_compact_inline +
+    kv_wal_compact_us) so the cliff is at least visible."""
+    kv = WalKV(str(tmp_path))
+    try:
+        for i in range(300):
+            kv.put("p", "hot", os.urandom(256))
+        d = kv.perf.dump()
+        assert d["kv_wal_compact"] >= 1
+        assert d["kv_wal_compact_inline"] == d["kv_wal_compact"]
+        assert d["kv_wal_compact_us"]["count"] == d["kv_wal_compact"]
+        assert kv.get("p", "hot") is not None
+    finally:
+        kv.close()
+
+
+def test_walkv_bg_compaction_off_submit_path(tmp_path):
+    """bg_compact=True moves the snapshot rewrite behind a thread:
+    compactions happen (counted, zero inline), concurrent writes keep
+    landing, and the durable image replays to the exact final state."""
+    path = str(tmp_path)
+    kv = WalKV(path, bg_compact=True)
+    model = MemKV()
+    try:
+        for i in range(400):
+            v = f"val{i}".encode() * 11
+            kv.put("p", f"k{i % 13}", v)
+            model.put("p", f"k{i % 13}", v)
+        deadline = time.time() + 10
+        while kv.perf.get("kv_wal_compact") == 0 \
+                and time.time() < deadline:
+            kv.put("p", "kick", os.urandom(256))
+            model.put("p", "kick", b"")  # value rewritten below
+        kv.put("p", "kick", b"final")
+        model.put("p", "kick", b"final")
+        assert kv.perf.get("kv_wal_compact") >= 1
+        assert kv.perf.get("kv_wal_compact_inline") == 0
+        assert kv.stats()["bg_compact"]
+    finally:
+        kv.close()
+    kv2 = WalKV(path)
+    try:
+        assert list(kv2.iterate("p")) == list(model.iterate("p"))
+    finally:
+        kv2.close()
+
+
+def test_walkv_bg_compaction_concurrent_writers_durable(tmp_path):
+    """Writers racing the background snapshot: frames landing during
+    the rewrite replay into the tmp before the rename, so a reopen
+    loses nothing."""
+    path = str(tmp_path)
+    kv = WalKV(path, bg_compact=True)
+    lock = threading.Lock()
+    model: dict[str, bytes] = {}
+
+    def writer(wi):
+        for i in range(250):
+            v = f"{wi}:{i}".encode() * 7
+            with lock:
+                kv.put("p", f"w{wi}-{i % 9}", v)
+                model[f"w{wi}-{i % 9}"] = v
+    ts = [threading.Thread(target=writer, args=(wi,)) for wi in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    compactions = kv.perf.get("kv_wal_compact")
+    kv.close()
+    kv2 = WalKV(path)
+    try:
+        assert compactions >= 1
+        assert dict(kv2.iterate("p")) == model
+    finally:
+        kv2.close()
+
+
+# ----------------------------------------------- perf registry + wiring
+def test_kv_perf_registry_lifecycle(tmp_path):
+    kv = SstKV(str(tmp_path / "a"), name="t-kv-reg")
+    assert "kv.t-kv-reg" in global_perf().registries()
+    kv.close()
+    assert "kv.t-kv-reg" not in global_perf().registries()
+    w = WalKV(str(tmp_path / "b"), name="t-wal-reg")
+    assert "kv.t-wal-reg" in global_perf().registries()
+    w.close()
+    assert "kv.t-wal-reg" not in global_perf().registries()
+
+
+def test_bluestore_configure_kv_from_config(tmp_path):
+    """The daemon seam: unset kv knobs fill from config before mount
+    (backend choice, budgets, background toggle, kv.<daemon> registry
+    name) — and explicit constructor arguments always win."""
+    from ceph_tpu.osd.bluestore import BlueStore
+    from ceph_tpu.utils.config import default_config
+    cfg = default_config()
+    cfg.set("kv_backend", "sst")
+    cfg.set("kv_memtable_bytes", 4096)
+    cfg.set("kv_cache_bytes", 1 << 20)
+    st = BlueStore(str(tmp_path / "bs"), compression="none")
+    st.configure_kv(cfg, name="osd.7")
+    st.mount()
+    try:
+        assert isinstance(st._kv, SstKV)
+        assert st._kv._memtable_bytes == 4096
+        assert st._kv.cache.max_bytes == 1 << 20
+        assert st._kv.background
+        assert "kv.osd.7" in global_perf().registries()
+        ks = st.kv_stats()
+        assert ks is not None and ks["background"]
+    finally:
+        st.umount()
+    assert "kv.osd.7" not in global_perf().registries()
+    # explicit ctor args win over config
+    st2 = BlueStore(str(tmp_path / "bs2"), compression="none",
+                    kv_backend="wal")
+    st2.configure_kv(cfg, name="osd.8")
+    st2.mount()
+    try:
+        assert isinstance(st2._kv, WalKV)
+        assert st2.kv_stats() is not None
+    finally:
+        st2.umount()
+
+
+def test_memstore_kv_stats_none():
+    from ceph_tpu.osd.objectstore import MemStore
+    s = MemStore()
+    s.mount()
+    try:
+        assert s.kv_stats() is None
+        s.configure_kv(None)  # no-op for KV-less backends
+    finally:
+        s.umount()

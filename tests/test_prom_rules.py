@@ -67,6 +67,11 @@ def test_rules_reference_only_emitted_metrics():
     # store_queue_us p50/p99 rules)
     from ceph_tpu.osd.objectstore import register_store_counters
     register_store_counters(qos_probe)
+    # the KV metadata tier's maintenance schema (kv_flush_us /
+    # kv_compact_us / kv_stall_us / kv_wal_compact_us p50/p99 rules +
+    # flush/compact/cache rate rules)
+    from ceph_tpu.osd.kvstore import register_kv_counters
+    register_kv_counters(qos_probe)
     Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
     import time as _time
     store = MetricsHistoryStore()
@@ -91,14 +96,20 @@ def test_rules_reference_only_emitted_metrics():
 def test_rules_shape_and_rendering():
     rules = recording_rules()
     # one rule per (histogram, quantile) + one rate rule per tracer /
-    # messenger-copy counter + the staleness max, records namespaced
-    assert len(rules) == 27
+    # messenger-copy / kv-maintenance counter + the staleness max,
+    # records namespaced
+    assert len(rules) == 39
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
-    assert len(hist) == 20
+    assert len(hist) == 28
     assert all("by (daemon, le)" in r["expr"] for r in hist)
     quantiles = {r["record"].rsplit(":", 1)[1] for r in hist}
     assert quantiles == {"p50", "p99"}
+    # the KV tier's maintenance walls + write-stall time quantiles
+    hist_recs = {r["record"] for r in hist}
+    for kvh in ("kv_flush_us", "kv_compact_us", "kv_stall_us",
+                "kv_wal_compact_us"):
+        assert f"ceph_tpu:daemon_{kvh}:p99" in hist_recs
     rates = [r for r in rules if ":rate" in r["record"]]
     assert {r["record"] for r in rates} == {
         "ceph_tpu:daemon_trace_sampled:rate5m",
@@ -106,7 +117,11 @@ def test_rules_shape_and_rendering():
         "ceph_tpu:daemon_msg_tx_flatten_bytes:rate5m",
         "ceph_tpu:daemon_msg_tx_flatten_copies:rate5m",
         "ceph_tpu:daemon_msg_rx_copy_bytes:rate5m",
-        "ceph_tpu:daemon_msg_rx_copy_copies:rate5m"}
+        "ceph_tpu:daemon_msg_rx_copy_copies:rate5m",
+        "ceph_tpu:daemon_kv_flush:rate5m",
+        "ceph_tpu:daemon_kv_compact:rate5m",
+        "ceph_tpu:daemon_kv_cache_hit:rate5m",
+        "ceph_tpu:daemon_kv_cache_miss:rate5m"}
     assert all("rate(" in r["expr"] and "by (daemon)" in r["expr"]
                for r in rates)
     stale = [r for r in rules
@@ -115,8 +130,8 @@ def test_rules_shape_and_rendering():
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 27
-    assert text.count("    expr: ") == 27
+    assert text.count("  - record: ") == 39
+    assert text.count("    expr: ") == 39
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms
