@@ -1668,6 +1668,269 @@ def ec_read_bench(trace: bool = False) -> int:
     return 0 if verified else 1
 
 
+def read_storm_bench(args) -> int:
+    """`--read-storm` mode: the hot-object read-path scale-out gate —
+    a zipf(1.2) read storm against a spare-less k=2+m=1 MiniCluster,
+    comparing pool read_policy=primary (every hot read lands on the
+    hot object's PG primary) against read_policy=balance (clients
+    hash (oid, nonce) across the acting set's shard holders), plus a
+    lease leg where repeat readers are served from the CLIENT cache.
+
+    Four legs, ONE JSON row, exit-gated on:
+    - per-OSD served-read spread (max/mean of op_r deltas) <= 1.5x
+      under balance (the primary baseline's spread is reported
+      alongside, not gated — it is the problem being fixed);
+    - balance p99 inside a generous envelope of the primary leg's
+      (3x + scheduling noise floor: the CI box is a 2-core machine);
+    - the repeat-reader lease leg serves >= 50% of its hot reads from
+      the client lease cache with ZERO RADOS ops for those hits
+      (client lease_hits counters vs cluster op_r deltas);
+    - EVERY read in EVERY leg is byte-identical to what was written,
+      including across the mid-leg write-under-lease revoke (readers
+      must converge to the new bytes within the leg, and never
+      observe a torn mix);
+    - a reader-x10 leg (same storm, 10x the clients) stays
+      byte-identical and completes.
+    """
+    import threading
+
+    import numpy as np
+
+    from ceph_tpu.tools.vstart import MiniCluster
+    from ceph_tpu.utils.config import default_config
+
+    n_objects = args.storm_objects
+    n_reads = args.storm_reads
+    readers = 6
+    obj_bytes = 16 * 1024
+    ZIPF_S = 1.2
+
+    def build(policy: str, lease_ttl: float):
+        cfg = default_config()
+        cfg.apply_dict({
+            "osd_heartbeat_interval": 0.05,
+            "osd_heartbeat_grace": 0.5,
+            "ec_backend": "native",
+            "ms_dispatch_workers": 2,
+            "osd_op_num_shards": 2,
+            "osd_read_lease_ttl": lease_ttl,
+            "osd_read_lease_rate": 5.0,
+        })
+        c = MiniCluster(n_osds=3, cfg=cfg).start()
+        cl = c.client()
+        cl.create_pool("storm", kind="ec", pg_num=4,
+                       ec_profile={"plugin": "jerasure", "k": "2",
+                                   "m": "1", "backend": "numpy",
+                                   "read_policy": policy})
+        rng = np.random.default_rng(7)
+        payloads = {}
+        for i in range(n_objects):
+            data = rng.integers(0, 256, obj_bytes,
+                                dtype=np.uint8).tobytes()
+            payloads[f"h{i:02d}"] = data
+            cl.write_full("storm", f"h{i:02d}", data)
+        return c, cl, payloads
+
+    # zipf(1.2) pmf over object ranks: rank 0 is the hot object
+    ranks = np.arange(1, n_objects + 1, dtype=np.float64)
+    pmf = ranks ** -ZIPF_S
+    pmf /= pmf.sum()
+
+    def op_r_by_osd(c):
+        return {o: osd.perf.dump().get("op_r", 0)
+                for o, osd in c.osds.items()}
+
+    def counters(c, names):
+        return {n: sum(osd.perf.dump().get(n, 0)
+                       for osd in c.osds.values()) for n in names}
+
+    def storm(c, payloads, *, n_clients=readers, reads=None,
+              mutate=None):
+        """n_clients readers each draw `reads` zipf-distributed
+        objects and byte-verify every result; optional `mutate`
+        callback fires mid-leg from a writer thread.  Returns
+        (sorted latencies, wall seconds, ok, per-osd op_r deltas,
+        clients)."""
+        reads = n_reads if reads is None else reads
+        clients = [c.client() for _ in range(n_clients)]
+        names = sorted(payloads)
+        # mutated objects verify against a (old, new) transition set
+        allowed = {n: {payloads[n]} for n in names}
+        allowed_lock = threading.Lock()
+        lat: list[list[float]] = [[] for _ in range(n_clients)]
+        ok = [True]
+        errs: list[str] = []
+        before = op_r_by_osd(c)
+        barrier = threading.Barrier(n_clients + 1)
+
+        def reader(r):
+            rng_r = np.random.default_rng(100 + r)
+            draws = rng_r.choice(n_objects, size=reads, p=pmf)
+            barrier.wait()
+            for i in draws:
+                name = names[int(i)]
+                t0 = time.perf_counter()
+                try:
+                    got = clients[r].read("storm", name)
+                except Exception as e:  # noqa: BLE001 - counted below
+                    ok[0] = False
+                    errs.append(f"{name}: {e!r}")
+                    continue
+                lat[r].append(time.perf_counter() - t0)
+                with allowed_lock:
+                    good = got in allowed[name]
+                if not good:
+                    ok[0] = False
+                    errs.append(f"{name}: torn/stale bytes")
+
+        threads = [threading.Thread(target=reader, args=(r,))
+                   for r in range(n_clients)]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        if mutate is not None:
+            mutate(allowed, allowed_lock)
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        after = op_r_by_osd(c)
+        deltas = {o: after[o] - before.get(o, 0) for o in after}
+        flat = sorted(x for row in lat for x in row)
+        if errs:
+            print(f"bench: read-storm errors: {errs[:5]}",
+                  file=sys.stderr)
+        return flat, wall, ok[0], deltas, clients
+
+    def spread(deltas):
+        served = [v for v in deltas.values()]
+        mean = sum(served) / max(1, len(served))
+        return (max(served) / mean) if mean > 0 else None
+
+    def pcts(flat):
+        if not flat:
+            return {"p50_ms": None, "p99_ms": None}
+        return {"p50_ms": round(flat[len(flat) // 2] * 1e3, 3),
+                "p99_ms": round(flat[min(len(flat) - 1,
+                                         int(len(flat) * 0.99))] * 1e3,
+                                3)}
+
+    results: dict[str, dict] = {}
+    gates: dict[str, bool] = {}
+    verified = True
+
+    # ---- leg 1+2: spread under the storm, primary vs balance --------
+    for policy in ("primary", "balance"):
+        c, cl, payloads = build(policy, lease_ttl=0.0)
+        try:
+            flat, wall, ok, deltas, _cls = storm(c, payloads)
+            verified &= ok
+            sp = spread(deltas)
+            results[policy] = dict(
+                pcts(flat), spread=round(sp, 3) if sp else None,
+                per_osd_reads=deltas,
+                reads_per_s=round(readers * n_reads / wall, 1),
+                **counters(c, ("balanced_read_serve",
+                               "balanced_read_bounce",
+                               "ec_read_tier_hit",
+                               "ec_read_tier_admit",
+                               "ec_read_tier_evict")))
+        finally:
+            c.stop()
+    gates["spread_balance_le"] = (
+        results["balance"]["spread"] is not None
+        and results["balance"]["spread"] <= args.storm_spread)
+    p99_pri = results["primary"]["p99_ms"] or 0.0
+    p99_bal = results["balance"]["p99_ms"] or 0.0
+    gates["p99_envelope"] = p99_bal <= max(3.0 * p99_pri, 50.0)
+
+    # ---- leg 3: repeat readers under leases + mid-leg revoke --------
+    c, cl, payloads = build("balance", lease_ttl=30.0)
+    try:
+        hot = sorted(payloads)[0]
+        new_hot = bytes([0xAB]) * obj_bytes
+
+        def mutate(allowed, allowed_lock):
+            # mid-leg write-under-lease: readers may serve the old
+            # bytes until the revoke lands, then must flip — both
+            # whole generations are valid, a mix never is
+            time.sleep(0.35)
+            with allowed_lock:
+                allowed[hot].add(new_hot)
+            cl.write_full("storm", hot, new_hot)
+
+        flat, wall, ok, deltas, lease_clients = storm(
+            c, payloads, mutate=mutate)
+        verified &= ok
+        hits = sum(cl_.lease_hits for cl_ in lease_clients)
+        misses = sum(cl_.lease_misses for cl_ in lease_clients)
+        total = readers * n_reads
+        rados_reads = sum(deltas.values())
+        hit_rate = hits / max(1, total)
+        # counter-enforced zero-RADOS-ops: every lease hit is a read
+        # that never produced an op_r anywhere
+        gates["lease_hits_ge_half"] = hit_rate >= 0.5
+        gates["lease_hits_zero_rados"] = \
+            rados_reads + hits <= total + misses
+        # post-leg: every reader converges to the new bytes (the
+        # revoke reached them; ttl=30s means expiry can't be why)
+        fresh = True
+        deadline = time.time() + 10.0
+        for cl_ in lease_clients:
+            got = cl_.read("storm", hot)
+            while got != new_hot and time.time() < deadline:
+                time.sleep(0.05)
+                got = cl_.read("storm", hot)
+            fresh &= got == new_hot
+        gates["revoke_converges"] = fresh
+        verified &= fresh
+        results["lease_repeat"] = dict(
+            pcts(flat), lease_hit_rate=round(hit_rate, 3),
+            lease_hits=int(hits), rados_reads=int(rados_reads),
+            reads_per_s=round(total / wall, 1),
+            **counters(c, ("read_lease_grant", "read_lease_revoke",
+                           "balanced_read_serve")))
+    finally:
+        c.stop()
+
+    # ---- leg 4: reader x10 scaling, byte-identity under pressure ----
+    c, cl, payloads = build("balance", lease_ttl=0.0)
+    try:
+        flat, wall, ok, deltas, _cls = storm(
+            c, payloads, n_clients=readers * 10,
+            reads=max(4, n_reads // 10))
+        verified &= ok
+        sp = spread(deltas)
+        results["readers_x10"] = dict(
+            pcts(flat), spread=round(sp, 3) if sp else None,
+            reads_per_s=round(
+                readers * 10 * max(4, n_reads // 10) / wall, 1))
+    finally:
+        c.stop()
+
+    gates["byte_identity"] = verified
+    all_ok = all(gates.values())
+    v = results["balance"]["reads_per_s"]
+    base = results["primary"]["reads_per_s"]
+    print(json.dumps({
+        "metric": (f"balanced-read storm reads/s (zipf-{ZIPF_S}, "
+                   f"{n_objects} objects x {obj_bytes // 1024}KiB, "
+                   f"{readers} readers x {n_reads} reads, k=2 m=1 "
+                   "no-spare, spread+lease+byte-identity gated)"),
+        "value": v,
+        "unit": "reads/s",
+        "vs_baseline": round(v / base, 3) if base else None,
+        "spread": {"primary": results["primary"]["spread"],
+                   "balance": results["balance"]["spread"],
+                   "gate_max": args.storm_spread},
+        "lease_hit_rate": results["lease_repeat"]["lease_hit_rate"],
+        "legs": results,
+        "gates": gates,
+        "digest_verified": verified,
+    }))
+    return 0 if all_ok else 1
+
+
 def saturate_bench(args) -> int:
     """`--saturate` mode: the many-client QoS regression gate — a
     multi-process load generator (ceph_tpu.load) drives simulated
@@ -1854,6 +2117,11 @@ def main() -> int:
                       help="many-client saturation harness with the "
                            "mclock QoS reservation sweep (the SLO "
                            "regression gate)")
+    mode.add_argument("--read-storm", action="store_true",
+                      help="zipf-1.2 hot-object read storm: balanced "
+                           "reads vs primary (per-OSD spread gate), "
+                           "client lease-cache hit-rate gate, mid-leg "
+                           "write-under-lease revoke, reader-x10 leg")
     ap.add_argument("--trace", action="store_true",
                     help="with --ec-batch/--ec-read: print the per-"
                          "stage latency decomposition table")
@@ -1891,6 +2159,16 @@ def main() -> int:
                      help="steady-saturation leg seconds")
     sat.add_argument("--thrash-s", type=float, default=8.0,
                      help="thrash-while-loaded leg seconds")
+    storm = ap.add_argument_group("read-storm options")
+    storm.add_argument("--storm-objects", type=int, default=16,
+                       help="with --read-storm: zipf working-set size")
+    storm.add_argument("--storm-reads", type=int, default=80,
+                       help="with --read-storm: reads per reader "
+                            "per leg")
+    storm.add_argument("--storm-spread", type=float, default=1.5,
+                       help="with --read-storm: max allowed per-OSD "
+                            "served-read spread (max/mean) under "
+                            "read_policy=balance")
     args = ap.parse_args()
     if args.ec_batch:
         return ec_batch_bench(trace=args.trace)
@@ -1901,6 +2179,8 @@ def main() -> int:
         return ec_read_bench(trace=args.trace)
     if args.saturate:
         return saturate_bench(args)
+    if args.read_storm:
+        return read_storm_bench(args)
     return headline_bench()
 
 

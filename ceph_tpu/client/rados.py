@@ -11,9 +11,11 @@ and retries with a refreshed map on ESTALE/timeout.
 
 from __future__ import annotations
 
+import collections
 import itertools
 import threading
 import time
+import zlib
 
 from ..mon.maps import OSDMap
 from ..auth.cephx import AuthContext, canonical_command, op_proof
@@ -78,8 +80,29 @@ class RadosClient(Dispatcher):
                  mons: list | None = None,
                  auth_entity: str | None = None,
                  auth_key: bytes | None = None,
-                 tenant: str | None = None):
+                 tenant: str | None = None,
+                 lease_cache_bytes: int = 16 << 20):
         self.name = name
+        # balanced-read spread: a stable per-client nonce folded into
+        # the shard-holder pick, so different clients fan one hot
+        # object across different holders while ONE client stays
+        # sticky (cache-friendly on the serving OSD)
+        self._client_nonce = zlib.crc32(name.encode())
+        # lease-covered object bytes, (pool_id, oid) -> (bytes,
+        # expires): byte-budgeted LRU; repeat reads under a live lease
+        # are served HERE — zero RADOS ops.  Dropped on the server's
+        # "_lease" write-revoke notify, on this client's own writes,
+        # and at expiry (the hard staleness bound).
+        self._lease_cache: collections.OrderedDict = \
+            collections.OrderedDict()
+        self._lease_cache_bytes = 0
+        self._lease_cache_max = int(lease_cache_bytes)
+        self._lease_lock = threading.Lock()
+        self.lease_hits = 0
+        self.lease_misses = 0
+        # fault injection for tests: swallow "_lease" revoke notifies
+        # (the client then serves staleness bounded by the lease TTL)
+        self.drop_lease_revokes = False
         # multi-tenant QoS identity (qos/dmclock.py): with a tenant
         # set, every op carries dmclock (delta, rho) tags computed by
         # a per-client ServiceTracker and the tenant name, and every
@@ -195,6 +218,18 @@ class RadosClient(Dispatcher):
                 self._reregister_watches()
             return True
         if isinstance(msg, MWatchNotify):
+            if msg.notifier == "_lease":
+                # server-side write revoke of a read lease: drop the
+                # cached object bytes so the next read goes to RADOS.
+                # notify_id 0 carries no ack collection server-side,
+                # but ack anyway — harmless, and symmetric with real
+                # notifies.  Fault-injection hook: tests set
+                # drop_lease_revokes to model a LOST revoke; staleness
+                # is then bounded by the lease TTL.
+                if not self.drop_lease_revokes:
+                    self._lease_drop(msg.pool, msg.oid)
+                conn.send(MNotifyAck(msg.notify_id, self.name))
+                return True
             cb = self._watches.get((msg.pool, msg.oid), (None, 0))[0]
             try:
                 if cb is not None:
@@ -372,6 +407,74 @@ class RadosClient(Dispatcher):
                 return f"osd.{u}"
         raise RadosError(-5, f"pg {pool_id}.{seed:x} has no up osds")
 
+    def _read_target(self, pool_id: int, oid: str) -> tuple[str, bool]:
+        """(target, balanced) for a plain read.  Pools with
+        ``read_policy=balance`` hash (oid, client nonce) across the
+        acting set's up holders so the hot-object read load spreads;
+        ``balanced`` is True only when the pick is NOT the primary —
+        a bounced (-116) balanced read flips to the primary
+        immediately, no map wait, because our map was never the
+        problem (the holder is mid-write/behind and the primary
+        arbitrates)."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None or str(pool.ec_profile.get(
+                "read_policy", "primary")).lower() != "balance":
+            return self._primary_for(pool_id, oid), False
+        seed = self.osdmap.object_to_pg(pool_id, oid)
+        up = self.osdmap.pg_to_up_osds(pool_id, seed)
+        holders = [u for u in up if u is not None]
+        if not holders:
+            raise RadosError(-5, f"pg {pool_id}.{seed:x} has no up osds")
+        pick = holders[zlib.crc32(
+            f"{oid}/{self._client_nonce}".encode()) % len(holders)]
+        return f"osd.{pick}", pick != holders[0]
+
+    # ----------------------------------------------------- client lease cache
+    def _lease_drop(self, pool_id: int, oid: str) -> None:
+        with self._lease_lock:
+            ent = self._lease_cache.pop((pool_id, oid), None)
+            if ent is not None:
+                self._lease_cache_bytes -= len(ent[0])
+
+    def _lease_get(self, pool_id: int, oid: str, offset: int,
+                   length: int) -> bytes | None:
+        """Lease-covered object bytes (range-trimmed with the server's
+        read semantics), or None when uncached/expired.  Expiry here is
+        the HARD staleness bound: a lost revoke can serve stale bytes
+        for at most one lease window, and always a torn-free snapshot
+        (whole-object bytes cached atomically)."""
+        now = time.time()
+        with self._lease_lock:
+            ent = self._lease_cache.get((pool_id, oid))
+            if ent is None:
+                return None
+            data, expires = ent
+            if now >= expires:
+                del self._lease_cache[(pool_id, oid)]
+                self._lease_cache_bytes -= len(data)
+                return None
+            self._lease_cache.move_to_end((pool_id, oid))
+        if length:
+            return data[offset:offset + length]
+        return data[offset:] if offset else data
+
+    def _lease_put(self, pool_id: int, oid: str, data,
+                   ttl: float) -> None:
+        data = bytes(data)
+        if ttl <= 0 or len(data) > self._lease_cache_max:
+            return
+        expires = time.time() + ttl
+        with self._lease_lock:
+            old = self._lease_cache.pop((pool_id, oid), None)
+            if old is not None:
+                self._lease_cache_bytes -= len(old[0])
+            self._lease_cache[(pool_id, oid)] = (data, expires)
+            self._lease_cache_bytes += len(data)
+            while self._lease_cache_bytes > self._lease_cache_max \
+                    and self._lease_cache:
+                _k, (d, _e) = self._lease_cache.popitem(last=False)
+                self._lease_cache_bytes -= len(d)
+
     _WRITE_OPS = ("write", "write_full", "remove", "snap_rollback",
                   "multi_write")
 
@@ -398,8 +501,19 @@ class RadosClient(Dispatcher):
                      offset, length, snapid, root):
         last_error: RadosError | None = None
         auth_retried = False
+        if op in self._WRITE_OPS or op == "call":
+            # our own mutation: the cached lease bytes are dead the
+            # moment we decide to write — don't wait for the server's
+            # revoke notify to race our next read
+            self._lease_drop(pool_id, oid)
+        balance_ok = op == "read" and not snapid
+        force_primary = False
         for attempt in range(12):
-            target = self._primary_for(pool_id, oid)
+            balanced = False
+            if balance_ok and not force_primary:
+                target, balanced = self._read_target(pool_id, oid)
+            else:
+                target = self._primary_for(pool_id, oid)
             tid = next(self._tids)
             m = MOSDOp(tid, self.name, pool_id, oid, op, offset, length,
                        data, self.osdmap.epoch, snapid=snapid,
@@ -437,6 +551,10 @@ class RadosClient(Dispatcher):
                     # dies with the connection — restart at (1, 1)
                     self.qos_tracker.forget(target)
                 last_error = e
+                if balanced:
+                    # the balanced holder may be dead while the
+                    # primary is fine — fall back to it on the retry
+                    force_primary = True
                 self._wait_epoch_past(self.osdmap.epoch, self.timeout)
                 continue
             if self.qos_tracker is not None:
@@ -449,6 +567,13 @@ class RadosClient(Dispatcher):
                 last_error = RadosError(-11, "pg peering")
                 continue
             if reply.result == -116:  # ESTALE: not primary under its map
+                if balanced:
+                    # balanced-read bounce: the holder declined (object
+                    # mid-write, behind, or policy says no) — flip to
+                    # the primary NOW, no map wait; our map isn't stale
+                    force_primary = True
+                    last_error = RadosError(-116, "balanced bounce")
+                    continue
                 if reply.epoch > self.osdmap.epoch:
                     self._wait_epoch_past(reply.epoch - 1, self.timeout)
                 else:
@@ -466,6 +591,12 @@ class RadosClient(Dispatcher):
                 continue
             if reply.result < 0:
                 raise RadosError(reply.result, f"{op} {pool_name}/{oid}")
+            if op == "read" and not snapid and not offset and not length \
+                    and getattr(reply, "lease", 0.0) > 0:
+                # whole-object read under a granted lease: cache the
+                # bytes; repeat reads inside the window never leave
+                # the client
+                self._lease_put(pool_id, oid, reply.data, reply.lease)
             return reply
         raise last_error or RadosError(-5, "retries exhausted")
 
@@ -561,6 +692,13 @@ class RadosClient(Dispatcher):
              length: int = 0, snapid: int = 0) -> bytes:
         """snapid > 0 reads the object's state as of that snapshot
         (rados_ioctx_snap_set_read role)."""
+        if not snapid:
+            cached = self._lease_get(self._pool_id(pool), oid,
+                                     offset, length)
+            if cached is not None:
+                self.lease_hits += 1  # served locally: zero RADOS ops
+                return cached
+            self.lease_misses += 1
         data = self._op(pool, oid, "read", offset=offset,
                         length=length, snapid=snapid).data
         # the librados boundary promises bytes: a zero-copy carve over

@@ -2038,7 +2038,12 @@ class MonitorLite(Dispatcher):
                 # (the reference's EC min_size default)
                 min_size = min(codec.k + 1, size)
             else:
-                profile = {}
+                # replicated pools still carry pass-through pool options
+                # (read_policy etc.) in the profile mapping — same
+                # string->string coercion as the EC path so map encoding
+                # can never be poisoned
+                profile = {str(k): str(v) for k, v in
+                           (cmd.get("ec_profile") or {}).items()}
                 size = int(cmd.get("size", self.cfg["osd_pool_default_size"]))
                 min_size = max(1, size - 1)
             spec = PoolSpec(self.osdmap.next_pool_id, name, kind, size,

@@ -112,14 +112,20 @@ class MOSDOpReply(Encodable):
     # PHASE_*: 0 none/fifo, 1 reservation, 2 weight) — the feedback the
     # client-side ServiceTracker folds into its rho bookkeeping
     qphase: int = 0
+    # v3 tail: read-lease grant, seconds of validity from receipt
+    # (0 = no lease).  Granted by the serving OSD on hot whole-object
+    # reads; the client may serve the returned bytes from its local
+    # cache until revoke (watch/notify "_lease" ping) or expiry.
+    lease: float = 0.0
 
-    VERSION, COMPAT = 2, 1
+    VERSION, COMPAT = 3, 1
 
     def encode(self, enc: Encoder) -> None:
         def body(e):
             e.u64(self.tid); e.i64(self.result); e.blob(self.data)
             e.u64(self.version); e.u64(self.epoch)
             e.u8(self.qphase)                          # v2 tail
+            e.f64(self.lease)                          # v3 tail
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -128,6 +134,8 @@ class MOSDOpReply(Encodable):
             m = cls(d.u64(), d.i64(), d.blob(), d.u64(), d.u64())
             if v >= 2:
                 m.qphase = d.u8()
+            if v >= 3:
+                m.lease = d.f64()
             return m
         return dec.versioned(cls.VERSION, body)
 
@@ -151,6 +159,11 @@ class MSubWrite:
     # replica stamps its log entry with it so both sides agree on the
     # entry's interval (the eversion epoch, src/osd/osd_types.h)
     epoch: int = 0
+    # originating client op's tenant: the shard OSD queues the apply
+    # under the same dmclock tenant as the primary did, so replica-side
+    # load is shaped by the same reservation/weight knobs.  Appended
+    # with a default — old archived bytes decode compatibly.
+    tenant: str = ""
 
 
 @dataclass
@@ -180,6 +193,7 @@ class MSubPartialWrite:
     # SnapSet before applying the extents.  Empty = no snap work.
     snap: dict = field(default_factory=dict)
     trace: tuple = ()  # (trace_id, span_id) — ZTracer sub-op span parent
+    tenant: str = ""   # originating tenant (see MSubWrite.tenant)
 
 
 @dataclass
@@ -199,6 +213,7 @@ class MSubDelta:
     epoch: int = 0  # primary's minting epoch (see MSubWrite.epoch)
     snap: dict = field(default_factory=dict)  # see MSubPartialWrite.snap
     trace: tuple = ()  # see MSubPartialWrite.trace
+    tenant: str = ""   # originating tenant (see MSubWrite.tenant)
 
 
 @dataclass
@@ -669,6 +684,20 @@ class MNotifyAck:
 
     notify_id: int
     watcher: str
+
+
+@dataclass
+class MLeaseRegister:
+    """Balanced-read holder -> PG primary: I granted `client` a read
+    lease on this object, expiring at `expires` (wall-clock).  The
+    primary is the ordering point for writes, so it must know every
+    outstanding grant to fan "_lease" revokes on mutation; fire and
+    forget — a lost register is bounded by the lease TTL safety net."""
+
+    pgid: PgId
+    oid: str
+    client: str
+    expires: float
 
 
 # ------------------------------------------------------------- mgr stats

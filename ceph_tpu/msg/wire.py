@@ -141,6 +141,7 @@ MESSAGE_TYPES: list[type] = [
     M.MAuth, M.MAuthReply,                                        # 41-42
     M.MPGList, M.MPGListReply,                                    # 43-44
     M.MSubReadN, M.MSubReadReplyN,                                # 45-46
+    M.MLeaseRegister,                                             # 47
 ]
 _TYPE_IDS = {t: i + 1 for i, t in enumerate(MESSAGE_TYPES)}
 _ID_TYPES = {i: t for t, i in _TYPE_IDS.items()}

@@ -39,7 +39,8 @@ from .. import ec
 from ..ec.batcher import ECBatcher
 from ..ec.stripe import StripeInfo, plan_write
 from ..mon.maps import OSDMap
-from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
+from ..msg.messages import (MFailureReport, MLeaseRegister, MMapPush,
+                            MMonSubscribe,
                             MNotifyAck, MOSDBoot, MOSDOp, MOSDOpReply,
                             MOSDPing, MOSDPingReply, MPGInfo, MPGList,
                             MPGListReply, MPGPull,
@@ -48,7 +49,7 @@ from ..msg.messages import (MFailureReport, MMapPush, MMonSubscribe,
                             MRecoveryReserve, MStatsReport,
                             MSubDelta, MSubPartialWrite, MSubRead,
                             MSubReadN, MSubReadReply, MSubReadReplyN,
-                            MSubWrite, MSubWriteReply,
+                            MSubWrite, MSubWriteReply, MWatchNotify,
                             PgId)
 from ..utils.reserver import AsyncReserver
 from ..msg.messenger import Dispatcher, Messenger, Network, Policy
@@ -65,7 +66,7 @@ from ..msg.messages import (MScrubMap, MScrubRequest, MScrubShard)
 from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
                           StoreError, Transaction)
 from ..ec.arena import DeviceArena
-from .extent_cache import ECExtentCache
+from .extent_cache import ECExtentCache, register_read_scaleout_counters
 from .intervals import INTERVALS_KEY, Interval, LES_KEY, PastIntervals
 from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
@@ -116,6 +117,14 @@ class _PendingRead:
     want_all: bool = False
     span: object = None    # op span (traced reads): decode stage parent
     qphase: int = 0  # mclock phase served under (rides the reply)
+    # balanced (non-primary) serve: a torn/no-agreed-k-set outcome
+    # bounces ESTALE back to the client (re-target the primary) instead
+    # of the primary path's requery + EAGAIN
+    balanced: bool = False
+    # object-write sequence at fan-out (the PR-5 read barrier): the
+    # hot-tier admission fence — bytes fetched before a write landed
+    # must never be admitted as current
+    wmarker: int = 0
     stamp: float = field(default_factory=time.time)
 
 
@@ -725,7 +734,30 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # op (the BENCH_SWEEP staging wall), and every invalidation
         # path below evicts the device copy with the host one
         self._ec_arena = DeviceArena(self.cfg["ec_arena_max_bytes"])
-        self._ec_cache = ECExtentCache(arena=self._ec_arena)
+        self._ec_cache = ECExtentCache(
+            arena=self._ec_arena,
+            on_evict=lambda: self.perf.inc("ec_read_tier_evict"))
+        # hot-read tier admission state (zipf-aware second-hit
+        # promotion): an object's first read only RECORDS it here; the
+        # second read within the LRU window admits its shards into
+        # _ec_cache so later reads assemble from cache/HBM.  Bounded
+        # LRU — a scan workload churns through without admitting.
+        self._tier_seen: collections.OrderedDict = collections.OrderedDict()
+        self._tier_lock = threading.Lock()
+        # read-lease state (this OSD as the GRANTING server, primary or
+        # balanced holder): per-object read-rate EWMA drives the grant
+        # decision; _lease_grants tracks outstanding grants so a write
+        # can fan "_lease" revokes.  On a balanced holder the grant is
+        # also registered at the primary (MLeaseRegister) — the primary
+        # orders writes, so it must know every grant.
+        self._lease_lock = threading.Lock()
+        self._read_ewma: collections.OrderedDict = collections.OrderedDict()
+        self._lease_grants: dict[tuple, dict[str, float]] = {}
+        # (pgid, oid) -> count of sub-writes currently being applied on
+        # THIS shard holder (guarded by _wbar_lock): the hot-tier
+        # admission fence for balanced holders, where the primary-only
+        # _obj_locks registry can't see writes in flight
+        self._subw_inflight: dict[tuple, int] = {}
         self._hb_last: dict[int, float] = {}
         self._last_map = time.time()  # osd_beacon staleness clock
         self._hb_thread: threading.Thread | None = None
@@ -822,6 +854,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             MPGRollback: self._handle_pg_rollback,
             MRecoveryReserve: self._handle_recovery_reserve,
             MNotifyAck: self._handle_notify_ack,
+            MLeaseRegister: self._handle_lease_register,
         }
         self.perf = global_perf().create(self.name)
         # head-sampled distributed tracing: trace_sample_rate draws the
@@ -868,6 +901,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             "recovery_narrow_rebuilds",
                             "recovery_subchunk_rebuilds",
                             "recovery_wide_retries"])
+        # read scale-out: hot-tier admission telemetry, lease
+        # grant/revoke flow, balanced (non-primary) read serving —
+        # shared schema with tools/prom_rules.py's rate rules
+        register_read_scaleout_counters(self.perf)
         self.perf.add("op_lat", CounterType.TIME)
         # cross-op EC batching (ec/batcher.py): concurrent stripe
         # encodes/decodes sharing a (matrix, k, m) signature coalesce
@@ -1112,6 +1149,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             or self._op_classes.get(type(msg), "system")
         if klass not in ("client", "recovery", "scrub", "system"):
             klass = "system"  # never KeyError on a peer's future tag
+        force = False
+        if klass == "system" and isinstance(
+                msg, (MSubWrite, MSubPartialWrite, MSubDelta)) \
+                and getattr(msg, "tenant", ""):
+            # tenant-tagged replication sub-ops: the shard OSD queues
+            # the apply under the originating op's tenant so replica-
+            # side load is shaped like the primary's.  force — the
+            # commit path has no retry; a QUEUE_CAP drop would wedge
+            # the primary's pending write forever.
+            klass = "client"
+            force = True
         # tenant-tagged client ops land in per-tenant dmclock
         # sub-queues; the shipped (delta, rho) pair advances the
         # tenant's clocks multi-server-correctly (qos/dmclock.py)
@@ -1120,7 +1168,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 getattr(msg, "qrho", 0)) if tenant else None
         self.scheduler.enqueue(klass, (handler, conn, msg),
                                key=self._shard_key(msg),
-                               tenant=tenant or None, tags=tags)
+                               tenant=tenant or None, tags=tags,
+                               force=force)
         return True
 
     def _shard_key(self, msg):
@@ -1380,16 +1429,31 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 return
         seed = self.osdmap.object_to_pg(m.pool, m.oid)
         up = self.osdmap.pg_to_up_osds(m.pool, seed)
-        if self._primary_of(up) != self.osd_id:
-            conn.send(MOSDOpReply(m.tid, ESTALE, epoch=self.osdmap.epoch))
-            return
         pgid = PgId(m.pool, seed)
+        balanced = False
+        if self._primary_of(up) != self.osd_id:
+            # balanced reads (pool read_policy=balance): a non-primary
+            # shard holder serves plain head reads itself — everything
+            # else (writes, snap reads, pools that did not opt in)
+            # bounces ESTALE so the client re-targets the primary
+            if self._balanced_read_ok(m, pool, up):
+                balanced = True
+            else:
+                conn.send(MOSDOpReply(m.tid, ESTALE,
+                                      epoch=self.osdmap.epoch))
+                return
         # peering gate: block IO until inventories (and the objects we are
         # known to be behind on) have caught up — read-your-writes safety
         if pgid in self._peering or (
                 m.oid in self._stale_objects.get(pgid, ())):
             conn.send(MOSDOpReply(m.tid, EAGAIN, epoch=self.osdmap.epoch))
             return
+        if m.op in self._LEASE_REVOKE_OPS:
+            # write choke point (primary only — mutations never ride the
+            # balanced path): drop + notify every outstanding read lease
+            # on the object BEFORE the mutation dispatches, so a leased
+            # client's staleness window is revoke-latency, not TTL
+            self._lease_revoke(pgid, m.oid)
         if m.trace:
             # distributed span (tracer.h role): the op's span on THIS
             # daemon; closed when the client reply leaves, however many
@@ -1433,7 +1497,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     self._obj_lock(key, wthunk)
                 elif m.op == "read":
                     self.perf.inc("op_r")
-                    self._ec_read(conn, m, pgid, up)
+                    self._ec_read(conn, m, pgid, up, balanced=balanced)
                 elif m.op == "remove":
                     key = (pgid, m.oid)
 
@@ -1457,7 +1521,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                     full=m.op == "write_full")
                 elif m.op == "read":
                     self.perf.inc("op_r")
-                    self._rep_read(conn, m, pgid)
+                    self._rep_read(conn, m, pgid, balanced=balanced)
                 elif m.op == "remove":
                     self._rep_remove(conn, m, pgid, up)
                 elif m.op == "stat":
@@ -1617,16 +1681,24 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 MSubWrite(tid, pgid, m.oid, -1, version, op, payload,
                           attrs=dict(sub_attrs), offset=off,
                           epoch=self._entry_epoch(),
-                          trace=self._tctx(m)))
+                          trace=self._tctx(m), tenant=m.tenant))
 
-    def _rep_read(self, conn, m: MOSDOp, pgid: PgId) -> None:
+    def _rep_read(self, conn, m: MOSDOp, pgid: PgId,
+                  balanced: bool = False) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
         try:
             # snapid resolution (find_object_context): head, a clone, or
             # a whiteout'd ENOENT
             target = self._snap_resolve(cid, m.oid, m.snapid)
             if target is None:
-                conn.send(MOSDOpReply(m.tid, ENOENT,
+                # balanced: this replica may simply not have caught up
+                # (recovery lag outside the _stale_objects inventory) —
+                # bounce to the primary, whose answer is authoritative,
+                # instead of fabricating ENOENT
+                err = ESTALE if balanced else ENOENT
+                if balanced:
+                    self.perf.inc("balanced_read_bounce")
+                conn.send(MOSDOpReply(m.tid, err,
                                       epoch=self.osdmap.epoch))
                 return
             bl = self.store.read(cid, target)
@@ -1635,9 +1707,20 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 data = data[m.offset:m.offset + m.length]
             elif m.offset:
                 data = data[m.offset:]
+            if balanced:
+                self.perf.inc("balanced_read_serve")
+            lease = self._lease_maybe_grant(pgid, m.oid, m.client,
+                                            whole=not m.length
+                                            and not m.offset
+                                            and not m.snapid)
             conn.send(MOSDOpReply(m.tid, 0, data=data,
-                                  epoch=self.osdmap.epoch))
+                                  epoch=self.osdmap.epoch, lease=lease))
         except NoSuchObject:
+            if balanced:
+                self.perf.inc("balanced_read_bounce")
+                conn.send(MOSDOpReply(m.tid, ESTALE,
+                                      epoch=self.osdmap.epoch))
+                return
             conn.send(MOSDOpReply(m.tid, ENOENT, epoch=self.osdmap.epoch))
 
     def _rep_remove(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
@@ -1682,7 +1765,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 MSubWrite(tid, pgid, m.oid, -1, version, sub_op,
                           attrs=dict(sub_attrs),
                           epoch=self._entry_epoch(),
-                          trace=self._tctx(m)))
+                          trace=self._tctx(m), tenant=m.tenant))
 
     def _stat(self, conn, m: MOSDOp, pgid: PgId, shard: int) -> None:
         cid = CollectionId(pgid.pool, pgid.seed)
@@ -2442,7 +2525,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     MSubWrite(tid, pgid, m.oid, shard, version, "write",
                               data, dict(sub_attrs),
                               epoch=self._entry_epoch(),
-                              trace=self._tctx(m)))
+                              trace=self._tctx(m), tenant=m.tenant))
         if remote == 0:
             conn.send(MOSDOpReply(m.tid, 0, version=version,
                                   epoch=self.osdmap.epoch))
@@ -2551,7 +2634,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                  prev_version=prev_version,
                                  epoch=self._entry_epoch(),
                                  snap=rider or {},
-                                 trace=self._tctx(m)))
+                                 trace=self._tctx(m),
+                                 tenant=m.tenant))
         if remote == 0:
             result = EIO if local_failed else (EAGAIN if local_retry else 0)
             if result != 0:
@@ -2694,7 +2778,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                          prev_version=prev,
                                          epoch=self._entry_epoch(),
                                          snap=rider or {},
-                                         trace=self._tctx(m)))
+                                         trace=self._tctx(m),
+                                         tenant=m.tenant))
                 else:
                     # parity: one delta message covering all data deltas
                     self.messenger.send_message(
@@ -2704,7 +2789,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                   prev_version=prev,
                                   epoch=self._entry_epoch(),
                                   snap=rider or {},
-                                  trace=self._tctx(m)))
+                                  trace=self._tctx(m),
+                                  tenant=m.tenant))
             if remote_n == 0:
                 result = EIO if local_failed \
                     else (EAGAIN if local_retry else 0)
@@ -2982,6 +3068,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _handle_sub_partial_write(self, conn, m: MSubPartialWrite) -> None:
         self.perf.inc("subop_w")
         self._sub_epoch.v = m.epoch
+        self._subw_begin(m.pgid, m.oid)
         try:
             pre = (self._snap_apply_rider(m.pgid, m.oid, m.snap,
                                           shard=m.shard)
@@ -2993,6 +3080,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 prev_version=m.prev_version, pre_tx=pre)
         finally:
             self._sub_epoch.v = 0
+            self._subw_end(m.pgid, m.oid)
         if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
@@ -3006,6 +3094,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
     def _handle_sub_delta(self, conn, m: MSubDelta) -> None:
         self.perf.inc("subop_w")
         self._sub_epoch.v = m.epoch
+        self._subw_begin(m.pgid, m.oid)
         try:
             pre = (self._snap_apply_rider(m.pgid, m.oid, m.snap,
                                           shard=m.parity_shard)
@@ -3016,6 +3105,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 prev_version=m.prev_version, pre_tx=pre)
         finally:
             self._sub_epoch.v = 0
+            self._subw_end(m.pgid, m.oid)
         if code == 0:
             self._pg_versions[m.pgid] = max(
                 self._pg_versions.get(m.pgid, 0), m.version)
@@ -3026,7 +3116,179 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             conn.send(MSubWriteReply(m.tid, m.pgid, m.parity_shard,
                                      self.osd_id, code))
 
-    def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list) -> None:
+    # -- read scale-out: balanced reads + client read leases ---------------
+    # client ops that mutate object DATA bytes (and so must revoke
+    # outstanding read leases at dispatch).  omap/xattr/watch mutations
+    # deliberately absent: the leased bytes are unchanged.
+    _LEASE_REVOKE_OPS = ("write", "write_full", "remove",
+                         "snap_rollback", "multi_write", "call")
+    _LEASE_NOTIFIER = "_lease"
+    _READ_EWMA_CAP = 4096
+
+    def _read_policy(self, pool) -> str:
+        return str(pool.ec_profile.get("read_policy",
+                                       "primary")).lower()
+
+    def _balanced_read_ok(self, m: MOSDOp, pool, up: list) -> bool:
+        """Whether THIS non-primary OSD may serve m under the pool's
+        read_policy=balance: plain head reads only (snap reads bounce
+        to the primary — clone-resolution state lives there), and only
+        while the map says we hold a shard of the object's PG."""
+        return (m.op == "read" and not getattr(m, "snapid", 0)
+                and any(u == self.osd_id for u in up)
+                and self._read_policy(pool) == "balance")
+
+    def _lease_maybe_grant(self, pgid: PgId, oid: str, client: str,
+                           whole: bool = True) -> float:
+        """Advance the object's read-rate EWMA and, when it crosses
+        osd_read_lease_rate on a WHOLE-object read, grant `client` a
+        TTL lease (returned; 0.0 = no grant) and remember the grant so
+        a write can revoke it.  On a balanced holder the grant is also
+        registered at the primary — the ordering point for writes —
+        fire-and-forget (a lost register is bounded by the TTL)."""
+        ttl = float(self.cfg["osd_read_lease_ttl"])
+        if ttl <= 0.0 or not client:
+            return 0.0
+        now = time.time()
+        key = (pgid, oid)
+        with self._lease_lock:
+            rate, last = self._read_ewma.get(key, (0.0, now))
+            dt = max(now - last, 1e-6)
+            # dt-scaled EWMA with a ~1s time constant: rate converges
+            # to the instantaneous read rate within about a second of
+            # sustained traffic, so only genuinely hot objects grant
+            alpha = min(1.0, dt)
+            rate = (1.0 - alpha) * rate + alpha / dt
+            self._read_ewma[key] = (rate, now)
+            self._read_ewma.move_to_end(key)
+            while len(self._read_ewma) > self._READ_EWMA_CAP:
+                self._read_ewma.popitem(last=False)
+            if not whole or rate < float(
+                    self.cfg["osd_read_lease_rate"]):
+                return 0.0
+            self._lease_grants.setdefault(key, {})[client] = now + ttl
+        self.perf.inc("read_lease_grant")
+        if self.osdmap is not None:
+            up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
+            primary = self._primary_of(up)
+            if primary is not None and primary != self.osd_id:
+                self.messenger.send_message(
+                    f"osd.{primary}",
+                    MLeaseRegister(pgid, oid, client, now + ttl))
+        return ttl
+
+    def _handle_lease_register(self, conn, m: MLeaseRegister) -> None:
+        with self._lease_lock:
+            g = self._lease_grants.setdefault((m.pgid, m.oid), {})
+            g[m.client] = max(g.get(m.client, 0.0), m.expires)
+
+    def _lease_revoke(self, pgid: PgId, oid: str) -> None:
+        """Drop every outstanding read lease on the object and ping
+        the holders ("_lease" notify, notify_id 0 = no ack collection:
+        the TTL bounds a lost ping).  Called at the primary's write
+        choke point and by shard holders observing sub-writes."""
+        with self._lease_lock:
+            grants = self._lease_grants.pop((pgid, oid), None)
+        if not grants:
+            return
+        now = time.time()
+        for client, expires in grants.items():
+            if expires <= now:
+                continue
+            self.perf.inc("read_lease_revoke")
+            self.messenger.send_message(
+                client, MWatchNotify(0, pgid.pool, oid,
+                                     self._LEASE_NOTIFIER))
+
+    def _sweep_leases(self, now: float) -> None:
+        with self._lease_lock:
+            for key in list(self._lease_grants):
+                g = self._lease_grants[key]
+                for c in [c for c, e in g.items() if e <= now]:
+                    del g[c]
+                if not g:
+                    del self._lease_grants[key]
+
+    # -- hot-read tier: sub-write fence + second-hit admission -------------
+    def _subw_begin(self, pgid: PgId, oid: str) -> None:
+        """A sub-write apply is starting on this shard holder: block
+        hot-tier admission of the object until _subw_end publishes the
+        write (note + invalidate), closing the window where a read
+        that fetched pre-write bytes could admit them as current."""
+        key = (pgid, oid)
+        with self._wbar_lock:
+            self._subw_inflight[key] = \
+                self._subw_inflight.get(key, 0) + 1
+
+    def _subw_end(self, pgid: PgId, oid: str) -> None:
+        """Sub-write applied: publish it (write-seq note — the fence
+        balanced reads and admission check against), drop any cached
+        bytes the write outdated, revoke locally-issued leases, THEN
+        clear the in-flight mark (order matters: an admission that
+        misses the in-flight mark must see the note instead)."""
+        key = (pgid, oid)
+        self._note_obj_write(key)
+        self._ec_cache.invalidate(pgid, oid)
+        self._lease_revoke(pgid, oid)
+        with self._wbar_lock:
+            n = self._subw_inflight.get(key, 0) - 1
+            if n > 0:
+                self._subw_inflight[key] = n
+            else:
+                self._subw_inflight.pop(key, None)
+
+    def _subw_busy(self, pgid: PgId, oid: str) -> bool:
+        with self._wbar_lock:
+            return (pgid, oid) in self._subw_inflight
+
+    def _tier_on(self) -> bool:
+        return str(self.cfg["ec_read_tier"]).lower() not in (
+            "off", "false", "0", "no")
+
+    def _tier_admit_ok(self, pgid: PgId, oid: str) -> bool:
+        """Second-hit promotion (zipf-aware admission): the first read
+        of an object only RECORDS it in a bounded LRU window; a repeat
+        read while still in the window admits.  A one-pass scan churns
+        through the window without ever admitting."""
+        if not self._tier_on():
+            return False
+        key = (pgid, oid)
+        with self._tier_lock:
+            if key in self._tier_seen:
+                self._tier_seen.move_to_end(key)
+                return True
+            self._tier_seen[key] = True
+            cap = int(self.cfg["ec_read_tier_seen_cap"])
+            while len(self._tier_seen) > cap:
+                self._tier_seen.popitem(last=False)
+            return False
+
+    def _tier_admit(self, pr: "_PendingRead", pgid: PgId,
+                    streams: list, vmax: int, total: int) -> None:
+        """Admit the k data-shard streams of a just-served whole-object
+        read into the extent cache (and through it the device arena).
+        Fenced twice: skip while a sub-write apply is in flight, and
+        UNDO if the write-seq moved past the marker captured at read
+        fan-out — either way stale bytes can never sit under a
+        serveable (version, length) key."""
+        key = (pgid, pr.oid)
+        if self._subw_busy(pgid, pr.oid):
+            return
+        with self._pending_lock:
+            if self._obj_locks.get(key):
+                return  # primary-side write pipeline active
+        for shard, s in enumerate(streams):
+            self._ec_cache.write(pgid, pr.oid, shard, 0,
+                                 s.tobytes(), version=vmax,
+                                 length=total)
+        if self._subw_busy(pgid, pr.oid) or \
+                self._obj_written_since(key, pr.wmarker):
+            self._ec_cache.invalidate(pgid, pr.oid)
+        else:
+            self.perf.inc("ec_read_tier_admit")
+
+    def _ec_read(self, conn, m: MOSDOp, pgid: PgId, up: list,
+                 balanced: bool = False) -> None:
         si = self._pool_stripe(pgid.pool)
         target = m.oid
         if getattr(m, "snapid", 0):
@@ -3046,8 +3308,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             import dataclasses
             m = dataclasses.replace(m, oid=target)
         elif not getattr(m, "snapid", 0) and \
-                self._ec_read_serve_cached(conn, m, pgid, si):
+                self._ec_read_serve_cached(conn, m, pgid, si,
+                                           balanced=balanced):
             return  # hot-object read served from the extent cache
+        if not getattr(m, "snapid", 0) and self._tier_on():
+            self.perf.inc("ec_read_tier_miss")
         tid = next(self._tids)
         extents = None
         row_base = row_len = 0
@@ -3064,6 +3329,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           row_base=row_base, row_len=row_len)
         pr.span = getattr(m, "_span", None)
         pr.qphase = getattr(m, '_qos_phase', 0)
+        pr.balanced = balanced
+        pr.wmarker = self._obj_write_marker()
         self._pending_reads[tid] = pr
         if pr.span is not None:
             # the fan-out stage of a traced read: local shard reads run
@@ -3078,7 +3345,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._fan_shard_reads(tid, pgid, m.oid, up, extents=extents)
 
     def _ec_read_serve_cached(self, conn, m: MOSDOp, pgid: PgId,
-                              si: StripeInfo) -> bool:
+                              si: StripeInfo,
+                              balanced: bool = False) -> bool:
         """Serve a head-object client read entirely from the extent
         cache (the device-resident stripe plane's hot-read path): when
         every data shard's covering stream is cached at a known
@@ -3090,6 +3358,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         writes) keeps a True serve byte-identical to the store path."""
         if str(self.cfg["ec_read_cache_serve"]).lower() in (
                 "off", "false", "0", "no"):
+            return False
+        # write-seq fence (balanced holders have no _obj_locks view of
+        # the primary's pipeline, but they DO observe sub-write applies
+        # — _subw_end notes them): captured before the version check,
+        # re-checked after assembly
+        wmarker = self._obj_write_marker()
+        if self._subw_busy(pgid, m.oid):
             return False
         with self._pending_lock:
             if self._obj_locks.get((pgid, m.oid)):
@@ -3129,7 +3404,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 # after THIS check hasn't touched the cache yet, so the
                 # assembled bytes are the committed pre-write state.)
                 return False
+        if self._subw_busy(pgid, m.oid) or \
+                self._obj_written_since((pgid, m.oid), wmarker):
+            # a sub-write landed (or is landing) while we assembled:
+            # the bytes may be the outdated pre-write state
+            return False
         self.perf.inc("ec_read_cache_hit")
+        self.perf.inc("ec_read_tier_hit")
+        if balanced:
+            self.perf.inc("balanced_read_serve")
         if m.length:
             # identical trimming to _finish_ec_read's range leg
             limit = max(0, min(len(ro), total - row_base))
@@ -3139,8 +3422,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             payload = ro[:total]
             if m.offset:
                 payload = payload[m.offset:]
+        lease = self._lease_maybe_grant(pgid, m.oid, m.client,
+                                        whole=not m.length
+                                        and not m.offset)
         conn.send(MOSDOpReply(m.tid, 0, data=payload,
-                              epoch=self.osdmap.epoch))
+                              epoch=self.osdmap.epoch, lease=lease))
         return True
 
     def _ec_cached_ro(self, codec, si: StripeInfo, pgid: PgId, oid: str,
@@ -3364,9 +3650,23 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if len(agreed) < codec.k and len(chunks) >= codec.k:
                 # no complete version-agreed k-set: either a racing write
                 # (transient — its commit completes the set) or a torn
-                # stripe awaiting rollback/rebuild; kick a FULL
-                # reconciliation (lean peering hides per-object versions)
-                # and have the client retry rather than decode torn data
+                # stripe awaiting rollback/rebuild
+                if pr.balanced:
+                    # a balanced holder does not arbitrate torn state —
+                    # the usual cause is simply a write in flight, and
+                    # the primary serializes reads against its own
+                    # pipeline.  Bounce the client there; no requery
+                    # (a routine race must not trigger full peering).
+                    self.perf.inc("balanced_read_bounce")
+                    if pr.client:
+                        self.messenger.send_message(
+                            pr.client,
+                            MOSDOpReply(pr.client_tid, ESTALE,
+                                        epoch=epoch, qphase=pr.qphase))
+                    return
+                # primary: kick a FULL reconciliation (lean peering
+                # hides per-object versions) and have the client retry
+                # rather than decode torn data
                 if self.osdmap is not None:
                     seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
                     self._requery_pg(PgId(pr.pool, seed), force_full=True)
@@ -3386,9 +3686,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     total = int(a["len"])
                     break
         if len(chunks) < codec.k:
-            # no shard at all anywhere -> the object does not exist;
-            # some-but-too-few shards -> unrecoverable (EIO)
+            # no shard at all anywhere -> the object does not exist
+            # (authoritative even on a balanced holder: the fan-out
+            # covered the same acting set the primary would read);
+            # some-but-too-few shards -> unrecoverable here — a
+            # balanced holder bounces to the primary, which arbitrates
+            # (recovery may be mid-flight), instead of minting EIO
             err = ENOENT if not pr.chunks else EIO
+            if err == EIO and pr.balanced:
+                self.perf.inc("balanced_read_bounce")
+                err = ESTALE
             if pr.client:
                 self.messenger.send_message(
                     pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch,
@@ -3417,6 +3724,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                       span=pr.span)
             streams = [decoded[i] for i in data_ids]
         ro = si.ro_assemble(streams).tobytes()
+        if pr.client and not pr.row_len and total and pr.shard_vers \
+                and self.osdmap is not None:
+            # hot-read tier: second hit on a whole-object client read
+            # promotes the k data streams into the extent cache (and
+            # lazily the device arena) at the agreed version
+            seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
+            tpg = PgId(pr.pool, seed)
+            if self._tier_admit_ok(tpg, pr.oid):
+                self._tier_admit(pr, tpg, streams,
+                                 max(pr.shard_vers.values()),
+                                 int(total))
         if pr.row_len:
             # range read: ro covers [row_base, row_base + len(ro))
             limit = len(ro) if total is None \
@@ -3432,10 +3750,18 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             elif pr.offset:
                 payload = payload[pr.offset:]
         if pr.client:
+            if pr.balanced:
+                self.perf.inc("balanced_read_serve")
+            lease = 0.0
+            if self.osdmap is not None and not pr.offset \
+                    and not pr.length:
+                seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
+                lease = self._lease_maybe_grant(
+                    PgId(pr.pool, seed), pr.oid, pr.client)
             self.messenger.send_message(
                 pr.client,
                 MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch,
-                            qphase=pr.qphase))
+                            qphase=pr.qphase, lease=lease))
 
     def _ec_total_len(self, pr: _PendingRead) -> int | None:
         if "len" in pr.attrs:
@@ -3486,7 +3812,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                               "whiteout" if whiteout else "remove",
                               attrs=dict(sub_attrs),
                               epoch=self._entry_epoch(),
-                              trace=self._tctx(m)))
+                              trace=self._tctx(m), tenant=m.tenant))
         if remote == 0:
             def _finish_local() -> None:
                 conn.send(MOSDOpReply(m.tid, 0, version=version,
@@ -3556,6 +3882,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             conn.send(MSubWriteReply(m.tid, m.pgid, m.shard, self.osd_id))
             return
         self._sub_epoch.v = m.epoch
+        # omap mutations leave the object's DATA bytes unchanged: no
+        # lease revoke, no extent-cache invalidation, no read fence
+        mutates = not m.op.startswith("omap")
+        if mutates:
+            self._subw_begin(m.pgid, m.oid)
         try:
             if m.trace:
                 # per-sub-op child span + the store-commit grandchild
@@ -3573,6 +3904,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 self._do_sub_write(conn, m)
         finally:
             self._sub_epoch.v = 0
+            if mutates:
+                self._subw_end(m.pgid, m.oid)
 
     def _do_sub_write(self, conn, m: MSubWrite) -> None:
         attrs = dict(m.attrs)
@@ -3833,6 +4166,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._finish_ec_read(pr)  # decodes if >= k arrived, else err
         self._read_agg.sweep(now, max_age)
         self._sweep_notifies(now, max_age)
+        self._sweep_leases(now)
         self._sweep_reservations(now)
 
     # --------------------------------------- flight recorder / telemetry

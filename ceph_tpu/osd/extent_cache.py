@@ -31,6 +31,24 @@ from __future__ import annotations
 import collections
 import threading
 
+#: the read scale-out counter schema (hot-tier admission telemetry,
+#: lease grant/revoke flow, balanced non-primary serving) — registered
+#: zeroed at OSD boot so the exporter and the prom recording rules see
+#: a standing series per daemon before any read lands
+READ_SCALEOUT_COUNTERS = (
+    "ec_read_tier_hit", "ec_read_tier_miss",
+    "ec_read_tier_admit", "ec_read_tier_evict",
+    "read_lease_grant", "read_lease_revoke",
+    "balanced_read_serve", "balanced_read_bounce")
+
+
+def register_read_scaleout_counters(perf) -> None:
+    """Register the read scale-out counters on ``perf`` (idempotent:
+    re-adding an existing counter would RESET it)."""
+    for name in READ_SCALEOUT_COUNTERS:
+        if not perf.has(name):
+            perf.add(name)
+
 
 class _Extents:
     """Non-overlapping sorted (off, bytearray, gen) runs for one shard.
@@ -106,9 +124,15 @@ class _Extents:
 
 
 class ECExtentCache:
-    def __init__(self, max_bytes: int = 8 << 20, arena=None):
+    def __init__(self, max_bytes: int = 8 << 20, arena=None,
+                 on_evict=None):
         self._max = max_bytes
         self._bytes = 0
+        # eviction telemetry hook: called once per whole-object LRU
+        # eviction (capacity pressure only — invalidations are not
+        # evictions).  Must be cheap and lock-free; fired OUTSIDE the
+        # cache lock.
+        self._on_evict = on_evict
         self._lock = threading.Lock()
         # key: (pgid, oid) -> shard -> _Extents; LRU by key
         self._lru: collections.OrderedDict = collections.OrderedDict()
@@ -216,6 +240,7 @@ class ECExtentCache:
             if length is not None:
                 self._len[key] = length
             self._lru.move_to_end(key)
+            evictions = 0
             while self._bytes > self._max and self._lru:
                 k, dropped = self._lru.popitem(last=False)
                 self._ver.pop(k, None)
@@ -224,9 +249,13 @@ class ECExtentCache:
                 # host LRU evicted the whole object: every arena mirror
                 # of it (any shard/run/gen) goes with it
                 drop_objs.add((k[0], k[1]))
+                evictions += 1
         if self._arena is not None and (drop_prefixes or drop_objs):
             self._arena.drop_where(
                 lambda k: k[:4] in drop_prefixes or k[:2] in drop_objs)
+        if self._on_evict is not None:
+            for _ in range(evictions):
+                self._on_evict()
 
     def drop_shards(self, pgid, oid: str, shards) -> None:
         """Drop specific shards' cached runs (host AND device mirrors),

@@ -148,7 +148,8 @@ class ObjOpsMixin:
             self.messenger.send_message(
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, shard, version, m.op,
-                          m.data, epoch=self._entry_epoch()))
+                          m.data, epoch=self._entry_epoch(),
+                          tenant=m.tenant))
 
     def _apply_omap(self, pgid: PgId, oid: str, op: str, payload,
                     version: int, create_ok: bool = False,
@@ -314,7 +315,8 @@ class ObjOpsMixin:
                 f"osd.{peer}",
                 MSubWrite(tid, pgid, m.oid, shard, version,
                           "cls_effects", _pack(effects),
-                          epoch=self._entry_epoch()))
+                          epoch=self._entry_epoch(),
+                          tenant=m.tenant))
 
     def _apply_cls_effects(self, pgid: PgId, oid: str, effects: dict,
                            version: int, shard: int = -1) -> None:
@@ -627,7 +629,8 @@ class ObjOpsMixin:
                 MSubWrite(tid, pgid, m.oid, shard, version,
                           "multi_effects", payload,
                           attrs=dict(sub_attrs),
-                          epoch=self._entry_epoch()))
+                          epoch=self._entry_epoch(),
+                          tenant=m.tenant))
 
     def _apply_multi_effects(self, pgid: PgId, oid: str, eff: dict,
                              version: int, pre_tx=None,

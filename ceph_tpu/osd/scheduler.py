@@ -360,9 +360,17 @@ class MClockScheduler:
             r_tag = max(t["r"], now - 1.0 / p.reservation) \
                 + rho / p.reservation
             t["r"] = r_tag
-        p_tag = t["p"] + delta / max(p.weight, 1e-9)
+        # arrival-time proportional tag: advanced here for EVERY op,
+        # with the increment REMEMBERED so a reservation-phase serve
+        # can refund it (dmclock's P-tag compensation: service paid
+        # for by the reservation clock must not also consume the
+        # tenant's proportional share — without the refund a tenant
+        # whose burst rode its reservation starts every later
+        # weight-phase round behind tenants that never reserved)
+        p_cost = delta / max(p.weight, 1e-9)
+        p_tag = t["p"] + p_cost
         t["p"] = p_tag
-        q.append((item, now, r_tag, p_tag))
+        q.append((item, now, r_tag, p_tag, p_cost))
         self._ttouch[tenant] = now
         if self._perf is not None:
             self._perf.inc(f"mclock_depth_{self.CLIENT}")
@@ -403,7 +411,7 @@ class MClockScheduler:
             if p.limit > 0 and t["l"] > now:
                 wake = t["l"] if wake is None else min(wake, t["l"])
                 continue
-            _item, _stamp, r_tag, p_tag = q[0]
+            _item, _stamp, r_tag, p_tag, _pc = q[0]
             if r_tag is not None:
                 if r_tag <= now and (best_r is None
                                      or r_tag < best_r[0]):
@@ -631,10 +639,27 @@ class MClockScheduler:
             kind, who, sub_phase = self._client_choice
             if kind == "tenant":
                 q = self._tqueues[who]
-                item, stamp, _r, _p = q.popleft()
+                item, stamp, _r, _p, _pc = q.popleft()
                 tenant = who
                 phase_code = sub_phase
-                self._client_vtime = max(self._client_vtime, _p)
+                if sub_phase == PHASE_RESERVATION and _pc > 0.0:
+                    # P-tag compensation (the dmclock rule the class
+                    # level already applies in _account): this op was
+                    # served by the RESERVATION clock, so refund the
+                    # proportional advance its arrival charged — from
+                    # the tenant's stored tag AND from every op still
+                    # queued behind it (their tags were computed on
+                    # top of the refunded increment).  The round clock
+                    # (_client_vtime) does not advance either: the op
+                    # consumed no proportional share.
+                    t = self._ttags[who]
+                    t["p"] -= _pc
+                    if q:
+                        self._tqueues[who] = collections.deque(
+                            (it, st, r, pt - _pc, pc)
+                            for it, st, r, pt, pc in q)
+                else:
+                    self._client_vtime = max(self._client_vtime, _p)
                 self._account(klass, res, now)
                 self._account_tenant(who, sub_phase, now)
                 self.served[klass] += 1

@@ -198,6 +198,18 @@ def configs() -> list[dict]:
                 "extract": ["trace_overhead_gbps",
                             "trace_overhead_pct_at_001",
                             "trace_overhead_ok", "digest_verified"]})
+    # 8d. the hot-object read scale-out gate (ISSUE 16): zipf-1.2 read
+    # storm on a no-spare k=2+m=1 MiniCluster — per-OSD served-read
+    # spread under read_policy=balance vs the primary baseline (gated
+    # <= 1.5x by bench.py's exit code), the repeat-reader client
+    # lease-cache hit rate (gated >= 50%, zero RADOS ops for hits),
+    # the mid-leg write-under-lease revoke and byte-identity on every
+    # leg, plus the reader-x10 scaling row
+    out.append({"id": "read_storm", "tool": "bench_root",
+                "argv": ["--read-storm"],
+                "extract": ["value", "vs_baseline", "spread",
+                            "lease_hit_rate", "legs", "gates",
+                            "digest_verified"]})
     # 9. the many-client saturation harness (ISSUE 7): multi-process
     # load through librados over TCP, mclock reservation sweep, gated
     # on structural invariants — the compact SLO row ("millions of

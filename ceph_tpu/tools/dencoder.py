@@ -109,6 +109,8 @@ def message_samples() -> dict:
             3, 0, [("osd", b"ticket", b"sealed", b"n" * 16)], 600.0),
         M.MPGList: M.MPGList(4, pg, 9, b"t" * 8, b"p" * 16),
         M.MPGListReply: M.MPGListReply(4, pg, 0, ["a", "b"], 9),
+        M.MLeaseRegister: M.MLeaseRegister(pg, "obj", "client.1",
+                                           1234567.5),
     }
 
 

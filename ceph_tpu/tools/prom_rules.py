@@ -64,11 +64,23 @@ QUANTILES = (0.50, 0.99)
 #: KV maintenance/cache counters ride the same rate-rule shape:
 #: flush/compact rates say how hard the LSM is working, the cache
 #: hit:miss ratio is the block cache's value on a dashboard
+#: Read scale-out counters (osd/extent_cache.py's shared schema,
+#: registered zeroed at OSD boot): balanced_read_serve/bounce say how
+#: much read traffic the non-primary holders absorb (and how often a
+#: holder had to decline back to the primary), read_lease_grant/revoke
+#: track the client-cache lease churn (a revoke rate near the grant
+#: rate means the working set is write-hot and leases are wasted), and
+#: the ec_read_tier_* quartet is the HBM hot-read tier's admission
+#: telemetry (hit:miss is the tier's value, admit:evict its churn)
 COUNTERS = ("trace_sampled", "trace_dropped",
             "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
             "msg_rx_copy_bytes", "msg_rx_copy_copies",
             "kv_flush", "kv_compact",
-            "kv_cache_hit", "kv_cache_miss")
+            "kv_cache_hit", "kv_cache_miss",
+            "balanced_read_serve", "balanced_read_bounce",
+            "read_lease_grant", "read_lease_revoke",
+            "ec_read_tier_hit", "ec_read_tier_miss",
+            "ec_read_tier_admit", "ec_read_tier_evict")
 
 #: the metrics-history liveness gauge the exporter emits per daemon
 #: (seconds since the mon merged that daemon's newest snapshot); the

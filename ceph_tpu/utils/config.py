@@ -292,6 +292,33 @@ OPTIONS: list[Option] = [
            "wire fetches queued per peer that force an immediate "
            "MSubReadN flush before the window expires", min=1,
            max=65536, see_also=("ec_read_coalesce",)),
+    Option("ec_read_tier", str, "on", OptionLevel.ADVANCED,
+           "hot-read tier: admit whole-object client EC reads into the "
+           "extent cache (and through it the device arena) on their "
+           "SECOND read within the admission window — zipf-aware "
+           "second-hit promotion, so a one-pass scan never admits — "
+           "letting later reads assemble from cache/HBM via "
+           "ec_read_cache_serve without a store or wire fan-out",
+           enum_values=("on", "off"),
+           see_also=("ec_read_cache_serve", "ec_read_tier_seen_cap")),
+    Option("ec_read_tier_seen_cap", int, 4096, OptionLevel.ADVANCED,
+           "objects remembered by the hot-read tier's first-hit LRU "
+           "(the admission window: a re-read after eviction from this "
+           "window counts as a first hit again)", min=16,
+           max=1 << 20, see_also=("ec_read_tier",)),
+    Option("osd_read_lease_ttl", float, 2.0, OptionLevel.ADVANCED,
+           "seconds a client read lease stays valid (0 disables lease "
+           "grants).  A client holding a lease serves repeat reads of "
+           "the object from its local cache — zero RADOS ops — until "
+           "a write-revoke notify or expiry; a client that misses the "
+           "revoke serves at most this many seconds of staleness, "
+           "never a torn read", min=0.0, max=300.0,
+           see_also=("osd_read_lease_rate",)),
+    Option("osd_read_lease_rate", float, 10.0, OptionLevel.ADVANCED,
+           "per-object read rate (reads/s, EWMA) above which the "
+           "serving OSD starts granting read leases — leases only pay "
+           "off on objects hot enough to be re-read within the TTL",
+           min=0.0, see_also=("osd_read_lease_ttl",)),
     Option("osd_ec_stripe_unit", int, 4096, OptionLevel.ADVANCED,
            "EC chunk size (bytes per shard per stripe row); must be a "
            "multiple of 4096 (the EC_ALIGN_SIZE page-alignment contract, "

@@ -72,6 +72,10 @@ def test_rules_reference_only_emitted_metrics():
     # flush/compact/cache rate rules)
     from ceph_tpu.osd.kvstore import register_kv_counters
     register_kv_counters(qos_probe)
+    # the read scale-out schema (balanced_read_* / read_lease_* /
+    # ec_read_tier_* rate rules — registered zeroed at OSD boot)
+    from ceph_tpu.osd.extent_cache import register_read_scaleout_counters
+    register_read_scaleout_counters(qos_probe)
     Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
     import time as _time
     store = MetricsHistoryStore()
@@ -96,9 +100,9 @@ def test_rules_reference_only_emitted_metrics():
 def test_rules_shape_and_rendering():
     rules = recording_rules()
     # one rule per (histogram, quantile) + one rate rule per tracer /
-    # messenger-copy / kv-maintenance counter + the staleness max,
-    # records namespaced
-    assert len(rules) == 39
+    # messenger-copy / kv-maintenance / read-scale-out counter + the
+    # staleness max, records namespaced
+    assert len(rules) == 47
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
     assert len(hist) == 28
@@ -121,7 +125,15 @@ def test_rules_shape_and_rendering():
         "ceph_tpu:daemon_kv_flush:rate5m",
         "ceph_tpu:daemon_kv_compact:rate5m",
         "ceph_tpu:daemon_kv_cache_hit:rate5m",
-        "ceph_tpu:daemon_kv_cache_miss:rate5m"}
+        "ceph_tpu:daemon_kv_cache_miss:rate5m",
+        "ceph_tpu:daemon_balanced_read_serve:rate5m",
+        "ceph_tpu:daemon_balanced_read_bounce:rate5m",
+        "ceph_tpu:daemon_read_lease_grant:rate5m",
+        "ceph_tpu:daemon_read_lease_revoke:rate5m",
+        "ceph_tpu:daemon_ec_read_tier_hit:rate5m",
+        "ceph_tpu:daemon_ec_read_tier_miss:rate5m",
+        "ceph_tpu:daemon_ec_read_tier_admit:rate5m",
+        "ceph_tpu:daemon_ec_read_tier_evict:rate5m"}
     assert all("rate(" in r["expr"] and "by (daemon)" in r["expr"]
                for r in rates)
     stale = [r for r in rules
@@ -130,8 +142,8 @@ def test_rules_shape_and_rendering():
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 39
-    assert text.count("    expr: ") == 39
+    assert text.count("  - record: ") == 47
+    assert text.count("    expr: ") == 47
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms

@@ -6,9 +6,11 @@ IO (ref src/osd/scheduler/mClockScheduler.cc + dmclock).
 import time
 
 import numpy as np
+import pytest
 
 from ceph_tpu.client.rados import RadosError
 from ceph_tpu.osd.scheduler import ClassParams, MClockScheduler
+from ceph_tpu.qos.dmclock import PHASE_RESERVATION, PHASE_WEIGHT
 from ceph_tpu.tools.vstart import MiniCluster
 from tests.test_cluster import make_cfg
 
@@ -307,3 +309,101 @@ def test_sharded_scheduler_ordering_and_parallelism():
         assert sum(s.served.values()) == 300
     finally:
         s.shutdown()
+
+
+# ------------------------------------------- tenant P-tag compensation
+def make_tenant_sched(tenant_profiles):
+    clock = [100.0]
+    s = MClockScheduler(lambda k, i: None,
+                        {"client": ClassParams(0.0, 1.0, 0.0)},
+                        clock=lambda: clock[0],
+                        tenant_profiles=tenant_profiles)
+    return s, clock
+
+
+def test_reservation_serve_refunds_tenant_p_tag():
+    """dmclock P-tag compensation: an op served by the RESERVATION
+    clock must hand back the proportional advance its arrival charged —
+    from the tenant's stored tag AND from every op still queued behind
+    it — and must not advance the shared round clock."""
+    s, clock = make_tenant_sched({
+        "gold": ClassParams(50.0, 1.0, 0.0),  # reserved tenant
+    })
+    with s._cv:
+        for _ in range(3):
+            s._enqueue_tenant_locked("gold", object(), (1, 1), clock[0])
+    t = s._ttags["gold"]
+    p_cost = 1.0 / 1.0
+    assert t["p"] == pytest.approx(3 * p_cost)
+    vtime0 = s._client_vtime
+    # serve the whole burst: every pick must run on the tenant's
+    # reservation clock (r tags become eligible every 1/R), and every
+    # serve must refund the arrival's proportional charge
+    for left in (2, 1, 0):
+        klass, res = s._pick(clock[0])
+        assert klass == "client"
+        kind, who, phase = s._client_choice
+        assert (kind, who, phase) == ("tenant", "gold",
+                                      PHASE_RESERVATION)
+        with s._cv:
+            s._dequeue_locked(klass, res, clock[0])
+        assert t["p"] == pytest.approx(left * p_cost), \
+            "reservation serve did not refund the P increment"
+        if left:
+            # queued ops' tags were rebuilt on top of the refund: the
+            # head sits exactly one increment above the stored tag's
+            # pre-arrival base
+            assert s._tqueues["gold"][0][3] == \
+                pytest.approx(p_cost)
+        clock[0] += 1.0 / 50.0
+    assert s._client_vtime == vtime0, \
+        "reservation service advanced the proportional round clock"
+
+
+def test_reserved_tenant_keeps_weight_share_under_load():
+    """The observable unfairness the refund fixes.  A and C are
+    equal-(small-)weight tenants crowded by heavyweight B, so their
+    weight-phase trickle sits BELOW A's reservation rate — A's r-tag
+    ladder stays reachable and the reservation phase tops A up
+    continuously.  dmclock's promise: that top-up must not cost A its
+    weight share, so A and C must still split the weight-phase
+    trickle evenly.  Without the P-tag refund every reservation serve
+    also charges A a full proportional round (1/W = 10 here) and A's
+    weight share collapses to ~zero."""
+    s, clock = make_tenant_sched({
+        "A": ClassParams(50.0, 0.1, 0.0),    # reserved + small weight
+        "C": ClassParams(0.0, 0.1, 0.0),     # A's reservation-free twin
+        "B": ClassParams(0.0, 1.0, 0.0),     # the heavyweight crowd
+    })
+    with s._cv:
+        for _ in range(300):
+            s._enqueue_tenant_locked("A", object(), (1, 1), clock[0])
+        for _ in range(300):
+            s._enqueue_tenant_locked("C", object(), (1, 1), clock[0])
+        for _ in range(600):
+            s._enqueue_tenant_locked("B", object(), (1, 1), clock[0])
+    weight_served = {"A": 0, "B": 0, "C": 0}
+    reserved = 0
+    for _ in range(500):                     # 2s of virtual time
+        klass, res = s._pick(clock[0])
+        assert klass == "client"
+        kind, who, phase = s._client_choice
+        with s._cv:
+            s._dequeue_locked(klass, res, clock[0])
+        if phase == PHASE_RESERVATION:
+            reserved += 1
+            assert who == "A"  # only A holds a reservation
+        else:
+            weight_served[who] += 1
+        clock[0] += 1.0 / 250.0              # server capacity 250/s
+    # the reservation phase really ran (~2s * (50 - weight trickle))
+    assert reserved >= 30, (reserved, weight_served)
+    # the fairness claim: A's weight-phase share matches its
+    # reservation-free twin's
+    assert weight_served["A"] > 0.6 * weight_served["C"], \
+        (weight_served, reserved)
+    assert weight_served["C"] > 0.6 * weight_served["A"], \
+        (weight_served, reserved)
+    # and B's heavyweight share was untouched by A's reservation ride
+    assert weight_served["B"] > 5 * weight_served["C"], \
+        (weight_served, reserved)
