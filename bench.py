@@ -188,6 +188,16 @@ def _wire_path_leg() -> dict:
                 "rx_copy_bytes": rxc["msg_rx_copy_bytes"]
                 - rx0["msg_rx_copy_bytes"],
                 "flatten_copies_per_mib": round(flat_c / mib, 4),
+                "syscalls_tx_per_op": round(
+                    (txc["msg_syscalls_tx"]
+                     - tx0["msg_syscalls_tx"]) / n_msgs, 3),
+                "syscalls_rx_per_op": round(
+                    (rxc["msg_syscalls_rx"]
+                     - rx0["msg_syscalls_rx"]) / n_msgs, 3),
+                "sqe_batches": txc["msg_uring_sqe_batch"]
+                - tx0["msg_uring_sqe_batch"],
+                "reg_buf_recycled": rxc["msg_uring_reg_buf_recycled"]
+                - rx0["msg_uring_reg_buf_recycled"],
                 "delivered": seen[0] >= n_msgs,
             }
         finally:
@@ -202,7 +212,7 @@ def _wire_path_leg() -> dict:
           and plain["rx_copy_copies_per_op"] == 0
           and secure["tx_flatten_copies_per_op"] <= 2
           and secure["rx_copy_copies_per_op"] <= 1)
-    return {
+    out = {
         "wire_gbps": plain["gbps"],
         "wire_msg_mib": 1,
         "wire_tx_flatten_copies_per_op":
@@ -216,6 +226,50 @@ def _wire_path_leg() -> dict:
             secure["rx_copy_copies_per_op"],
         "wire_zero_copy_ok": ok,
     }
+    # ---- per-stack sweep (ISSUE 17): the SAME plaintext leg on each
+    # transport stack.  The structural gate is the syscall/copy
+    # counter contract, not the GB/s (a loopback socket pair on a
+    # small box is kernel-copy bound either way): the uring stack
+    # must batch its SQE chains (tx kernel entries per frame < 1,
+    # sqe_batches booked) and keep the Python-side rx copy count at
+    # the posix stack's zero.  Where io_uring is unavailable the gate
+    # records SKIPPED — never a failure — and posix numbers stand.
+    from ceph_tpu.msg import uring as _uring
+    out.update({
+        "wire_stack_posix_gbps": plain["gbps"],
+        "wire_stack_posix_syscalls_tx_per_op":
+            plain["syscalls_tx_per_op"],
+        "wire_stack_posix_syscalls_rx_per_op":
+            plain["syscalls_rx_per_op"],
+        "wire_uring_active": False,
+        "wire_stack_gate": "skipped",
+        "wire_stack_ok": True,
+    })
+    if _uring.available():
+        u = leg(48, stack="uring")
+        contracts = (u["delivered"]
+                     and u["syscalls_tx_per_op"] < 1.0
+                     and u["tx_flatten_copies_per_op"] == 0
+                     and u["rx_copy_copies_per_op"] == 0
+                     and u["sqe_batches"] >= 1)
+        out.update({
+            "wire_uring_active": True,
+            "wire_stack_uring_gbps": u["gbps"],
+            "wire_stack_uring_syscalls_tx_per_op":
+                u["syscalls_tx_per_op"],
+            "wire_stack_uring_syscalls_rx_per_op":
+                u["syscalls_rx_per_op"],
+            "wire_stack_uring_sqe_batches": u["sqe_batches"],
+            "wire_stack_uring_reg_buf_recycled":
+                u["reg_buf_recycled"],
+            "wire_stack_speedup_vs_posix": round(
+                u["gbps"] / max(plain["gbps"], 1e-9), 3),
+            "wire_stack_gate": "passed" if contracts else "failed",
+            "wire_stack_ok": bool(contracts),
+        })
+    else:
+        out["wire_stack_skip_reason"] = _uring.unavailable_reason()
+    return out
 
 
 def _store_commit_leg() -> dict:
@@ -1008,6 +1062,7 @@ def ec_batch_bench(trace: bool = False) -> int:
     }))
     return 0 if verified and single_copy and trace_overhead_ok \
         and wire["wire_zero_copy_ok"] \
+        and wire["wire_stack_ok"] \
         and store_leg["store_commit_ok"] \
         and kv_leg["kv_maint_ok"] else 1
 

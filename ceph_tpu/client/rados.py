@@ -88,13 +88,17 @@ class RadosClient(Dispatcher):
         # object across different holders while ONE client stays
         # sticky (cache-friendly on the serving OSD)
         self._client_nonce = zlib.crc32(name.encode())
-        # lease-covered object bytes, (pool_id, oid) -> (bytes,
-        # expires): byte-budgeted LRU; repeat reads under a live lease
-        # are served HERE — zero RADOS ops.  Dropped on the server's
+        # lease-covered object bytes: byte-budgeted LRU; repeat reads
+        # under a live lease are served HERE — zero RADOS ops.  Keys
+        # are (pool_id, oid) for whole-object entries and (pool_id,
+        # oid, offset, length) for ranged entries riding the object's
+        # grant; _lease_index maps (pool_id, oid) -> its range keys so
+        # one revoke drops every entry.  Dropped on the server's
         # "_lease" write-revoke notify, on this client's own writes,
         # and at expiry (the hard staleness bound).
         self._lease_cache: collections.OrderedDict = \
             collections.OrderedDict()
+        self._lease_index: dict[tuple, set] = {}
         self._lease_cache_bytes = 0
         self._lease_cache_max = int(lease_cache_bytes)
         self._lease_lock = threading.Lock()
@@ -430,50 +434,80 @@ class RadosClient(Dispatcher):
         return f"osd.{pick}", pick != holders[0]
 
     # ----------------------------------------------------- client lease cache
+    def _lease_pop_locked(self, key: tuple):
+        """Remove one cache entry (whole or ranged key) and keep the
+        byte budget and the per-object range index consistent."""
+        ent = self._lease_cache.pop(key, None)
+        if ent is None:
+            return None
+        self._lease_cache_bytes -= len(ent[0])
+        if len(key) == 4:
+            idx = self._lease_index.get(key[:2])
+            if idx is not None:
+                idx.discard(key)
+                if not idx:
+                    del self._lease_index[key[:2]]
+        return ent
+
     def _lease_drop(self, pool_id: int, oid: str) -> None:
         with self._lease_lock:
-            ent = self._lease_cache.pop((pool_id, oid), None)
-            if ent is not None:
-                self._lease_cache_bytes -= len(ent[0])
+            self._lease_pop_locked((pool_id, oid))
+            for key in list(self._lease_index.get((pool_id, oid), ())):
+                self._lease_pop_locked(key)
 
     def _lease_get(self, pool_id: int, oid: str, offset: int,
                    length: int) -> bytes | None:
         """Lease-covered object bytes (range-trimmed with the server's
-        read semantics), or None when uncached/expired.  Expiry here is
-        the HARD staleness bound: a lost revoke can serve stale bytes
-        for at most one lease window, and always a torn-free snapshot
-        (whole-object bytes cached atomically)."""
+        read semantics), or None when uncached/expired.  A whole-object
+        entry serves ANY range; a ranged read missing it may still hit
+        its exact (offset, length) entry from a prior ride.  Expiry
+        here is the HARD staleness bound: a lost revoke can serve stale
+        bytes for at most one lease window, and always a torn-free
+        snapshot (entry bytes cached atomically)."""
         now = time.time()
         with self._lease_lock:
             ent = self._lease_cache.get((pool_id, oid))
-            if ent is None:
-                return None
-            data, expires = ent
-            if now >= expires:
-                del self._lease_cache[(pool_id, oid)]
-                self._lease_cache_bytes -= len(data)
-                return None
-            self._lease_cache.move_to_end((pool_id, oid))
-        if length:
-            return data[offset:offset + length]
-        return data[offset:] if offset else data
+            if ent is not None:
+                data, expires = ent
+                if now >= expires:
+                    self._lease_pop_locked((pool_id, oid))
+                else:
+                    self._lease_cache.move_to_end((pool_id, oid))
+                    if length:
+                        return data[offset:offset + length]
+                    return data[offset:] if offset else data
+            if offset or length:
+                key = (pool_id, oid, offset, length)
+                ent = self._lease_cache.get(key)
+                if ent is not None:
+                    data, expires = ent
+                    if now >= expires:
+                        self._lease_pop_locked(key)
+                    else:
+                        self._lease_cache.move_to_end(key)
+                        return data
+        return None
 
     def _lease_put(self, pool_id: int, oid: str, data,
-                   ttl: float) -> None:
+                   ttl: float, offset: int = 0,
+                   length: int = 0) -> None:
         data = bytes(data)
         if ttl <= 0 or len(data) > self._lease_cache_max:
             return
+        ranged = bool(offset or length)
+        key = (pool_id, oid, offset, length) if ranged \
+            else (pool_id, oid)
         expires = time.time() + ttl
         with self._lease_lock:
-            old = self._lease_cache.pop((pool_id, oid), None)
-            if old is not None:
-                self._lease_cache_bytes -= len(old[0])
-            self._lease_cache[(pool_id, oid)] = (data, expires)
+            self._lease_pop_locked(key)
+            self._lease_cache[key] = (data, expires)
             self._lease_cache_bytes += len(data)
+            if ranged:
+                self._lease_index.setdefault(
+                    (pool_id, oid), set()).add(key)
             while self._lease_cache_bytes > self._lease_cache_max \
                     and self._lease_cache:
-                _k, (d, _e) = self._lease_cache.popitem(last=False)
-                self._lease_cache_bytes -= len(d)
+                self._lease_pop_locked(next(iter(self._lease_cache)))
 
     _WRITE_OPS = ("write", "write_full", "remove", "snap_rollback",
                   "multi_write")
@@ -591,12 +625,15 @@ class RadosClient(Dispatcher):
                 continue
             if reply.result < 0:
                 raise RadosError(reply.result, f"{op} {pool_name}/{oid}")
-            if op == "read" and not snapid and not offset and not length \
+            if op == "read" and not snapid \
                     and getattr(reply, "lease", 0.0) > 0:
                 # whole-object read under a granted lease: cache the
                 # bytes; repeat reads inside the window never leave
-                # the client
-                self._lease_put(pool_id, oid, reply.data, reply.lease)
+                # the client.  A RANGED reply carrying a lease rode an
+                # existing grant — cached under its exact range key,
+                # revoked together with the whole object.
+                self._lease_put(pool_id, oid, reply.data, reply.lease,
+                                offset=offset, length=length)
             return reply
         raise last_error or RadosError(-5, "retries exhausted")
 

@@ -498,7 +498,8 @@ class MonitorLite(Dispatcher):
         # merge here, served by dump_metrics_history / metrics_query
         # and the perf_history CLI; staleness feeds the exporter gauge
         self.metrics_history = MetricsHistoryStore(
-            keep=self.cfg["mon_metrics_history_keep"])
+            keep=self.cfg["mon_metrics_history_keep"],
+            downsample_age=self.cfg["metrics_history_downsample_age"])
         # batch-thrash health feed: (merge-monotonic ts, daemon) per
         # `batch` channel event while the check is ENABLED (nothing
         # accumulates at the count=0 default), pruned to the warn

@@ -38,10 +38,19 @@ from ..utils.throttle import Throttle
 #: every receive-side payload copy (decrypt, decompress) is counted —
 #: plaintext data frames book ZERO on both, the kernel's iovec
 #: gather/scatter being the only remaining copy.
+#: The msg_syscalls_{tx,rx} pair is the transport-stack half of the
+#: same story: kernel entries per direction (sendmsg/recv_into on the
+#: posix stack, io_uring_enter on the uring stack — where batched SQE
+#: submission drives tx syscalls-per-frame below 1), and the
+#: msg_uring_* pair counts SQE batches submitted and registered
+#: rx-pool slots recycled (a recycle == every carved view over the
+#: slot died, i.e. the zero-copy rx landed and was consumed in place).
 MSG_COUNTERS = ("msg_dispatched", "msg_drop_wire",
                 "msg_drop_backpressure",
                 "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
-                "msg_rx_copy_bytes", "msg_rx_copy_copies")
+                "msg_rx_copy_bytes", "msg_rx_copy_copies",
+                "msg_syscalls_tx", "msg_syscalls_rx",
+                "msg_uring_sqe_batch", "msg_uring_reg_buf_recycled")
 MSG_HISTOGRAMS = ("msg_dispatch_us",)
 MSG_TIMES = ("msg_throttle_wait_time",)
 MSG_GAUGES = ("msg_queue_depth",)

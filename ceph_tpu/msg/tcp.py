@@ -33,6 +33,11 @@ import secrets
 from ..utils.codec import SEG_REF_MIN
 from ..utils.log import dout
 from .messenger import Network
+# transport seam: low-level IO + the pluggable stacks live in stack.py;
+# _IOV_CAP/_recv_into/_sendmsg_all are re-exported here for the tests
+# and services that import them from tcp
+from .stack import (_IOV_CAP, PosixTransport,  # noqa: F401 - re-export
+                    _recv_into, _sendmsg_all, make_stack)
 from .wire import decode_frame, frame_encoder
 
 _AUTH_MAGIC = b"CTPX1\0"
@@ -41,7 +46,6 @@ _TAG_LEN = 16
 _RING_MAX = 512          # replayable frames kept per session
 _RING_MAX_BYTES = 32 << 20  # payload-byte budget per session ring
 _STASH_MAX = 64          # dead sessions kept for resume
-_IOV_CAP = 512           # segments per sendmsg call (under IOV_MAX)
 #: frames up to this size are received into ONE reusable buffer (and
 #: decoded fully-detached); larger frames get a fresh buffer so decode
 #: can carve zero-copy views that stay valid by refcount after the
@@ -55,49 +59,11 @@ def _mac(key: bytes, *parts) -> bytes:
     return hmac.new(key, b"".join(parts), hashlib.sha256).digest()
 
 
-def _recv_into(sock: socket.socket, mv: memoryview) -> bool:
-    """Fill mv exactly from the socket (recv_into: no per-chunk
-    accumulation copies).  False on EOF/reset."""
-    got, n = 0, len(mv)
-    while got < n:
-        try:
-            r = sock.recv_into(mv[got:])
-        except OSError:  # peer reset / socket closed under us
-            return False
-        if not r:
-            return False
-        got += r
-    return True
-
-
 def _recv_exact(sock: socket.socket, n: int) -> bytes | None:
     buf = bytearray(n)
     if not _recv_into(sock, memoryview(buf)):
         return None
     return bytes(buf)
-
-
-def _sendmsg_all(sock: socket.socket, segs: list) -> None:
-    """Vectored sendall: gather the segment list straight from the
-    callers' buffers (scatter-gather IO — the kernel's iovec copy is
-    the only one), resuming mid-segment on partial sends.  Raises
-    OSError on a dead peer like sendall."""
-    if getattr(sock, "sendmsg", None) is None:
-        # non-POSIX socket (or a test stub): assemble and stream
-        sock.sendall(b"".join(segs))
-        return
-    mvs = [memoryview(s) for s in segs if len(s)]
-    i = 0
-    while i < len(mvs):
-        sent = sock.sendmsg(mvs[i:i + _IOV_CAP])
-        while sent > 0:
-            seg = mvs[i]
-            if sent >= len(seg):
-                sent -= len(seg)
-                i += 1
-            else:
-                mvs[i] = seg[sent:]
-                sent = 0
 
 
 def _payload_nbytes(plain) -> int:
@@ -158,13 +124,17 @@ class _SessState:
 
 
 class _Conn:
-    """One live socket + send lock (shared by both directions)."""
+    """One live connection + send lock (shared by both directions).
+    Holds a TRANSPORT (stack.py) rather than a raw socket; a raw
+    socket is accepted and wrapped as a posix transport so handshake
+    code and tests can build one directly."""
 
-    __slots__ = ("sock", "lock", "alive", "session_key", "state",
+    __slots__ = ("t", "lock", "alive", "session_key", "state",
                  "enc_send", "enc_recv", "enc_send_n", "enc_recv_n")
 
-    def __init__(self, sock: socket.socket):
-        self.sock = sock
+    def __init__(self, sock):
+        self.t = (sock if hasattr(sock, "sendv")
+                  else PosixTransport(sock))
         self.lock = threading.Lock()
         self.alive = True
         self.session_key: bytes | None = None  # cephx-lite session
@@ -174,6 +144,20 @@ class _Conn:
         self.enc_recv: bytes | None = None
         self.enc_send_n = 0
         self.enc_recv_n = 0
+
+    @property
+    def sock(self) -> socket.socket:
+        """The underlying socket — handshakes (auth, session resume)
+        run on it directly, before the stack's framed fast path is
+        activated."""
+        return self.t.sock
+
+    @sock.setter
+    def sock(self, sock) -> None:
+        # tests swap the socket out from under a live conn; rewrap it
+        self.t = (sock if hasattr(sock, "sendv")
+                  else PosixTransport(sock, sink=getattr(
+                      self.t, "sink", None)))
 
     def arm_secure(self, role: str) -> None:
         """Derive per-direction ChaCha20 keys from the cephx session key
@@ -269,17 +253,16 @@ class _Conn:
                 segs = [struct.pack("<Q", seq)] + segs
             segs, flat_b, flat_c = self.seal_segments(segs)
             total = sum(len(s) for s in segs)
-            if len(segs) > 1 and \
-                    getattr(self.sock, "sendmsg", None) is None:
-                # no vectored IO on this socket: _sendmsg_all's
-                # fallback joins the frame — count the assembly
+            if len(segs) > 1 and not self.t.vectored:
+                # no vectored IO on this transport: the sendv fallback
+                # joins the frame — count the assembly
                 flat_b += total
                 flat_c += 1
             if flat_c and on_flatten is not None:
                 on_flatten(flat_b, flat_c)
             try:
-                _sendmsg_all(self.sock,
-                             [struct.pack("<I", total | flags)] + segs)
+                self.t.sendv(
+                    [struct.pack("<I", total | flags)] + segs)
                 return self.SENT, seq
             except OSError:
                 self.alive = False
@@ -300,7 +283,7 @@ class _Conn:
                 return False
             with self.state.lock:
                 pending = list(self.state.ring)
-            no_vec = getattr(self.sock, "sendmsg", None) is None
+            no_vec = not self.t.vectored
             for seq, flags, plain in pending:
                 if seq <= last_recv:
                     continue
@@ -316,8 +299,7 @@ class _Conn:
                 if flat_c and on_flatten is not None:
                     on_flatten(flat_b, flat_c)
                 try:
-                    _sendmsg_all(
-                        self.sock,
+                    self.t.sendv(
                         [struct.pack("<I", total | flags)] + segs)
                 except OSError:
                     self.alive = False
@@ -326,14 +308,7 @@ class _Conn:
 
     def close(self) -> None:
         self.alive = False
-        try:
-            self.sock.shutdown(socket.SHUT_RDWR)
-        except OSError:
-            pass
-        try:
-            self.sock.close()
-        except OSError:
-            pass
+        self.t.close()
 
 
 _COMPRESSED = 0x8000_0000  # frame-length flag bit (msgr v2
@@ -345,9 +320,15 @@ class TcpNetwork(Network):
                  compress: str = "none", compress_min: int = 4096,
                  auth_secret: bytes | None = None,
                  secure: bool = False, resume: bool = True,
-                 auth_rotation: float = 0.0, clock=None):
+                 auth_rotation: float = 0.0, clock=None,
+                 stack: str = "posix"):
         super().__init__(seed)
         self._host = host
+        # pluggable transport stack (ms_stack: posix|uring|auto); an
+        # unsatisfiable request degrades to posix with a logged event
+        # and the reason recorded — byte-identical wire either way
+        self._stack, self.stack_fallback = make_stack(stack)
+        self.stack_name = self._stack.name
         # msgr2 secure mode (crypto_onwire role): ChaCha20 per-direction
         # streams keyed from the cephx session key, under the existing
         # per-frame HMAC tag (encrypt-then-MAC)
@@ -526,7 +507,7 @@ class TcpNetwork(Network):
                              name=f"tcp-read-{owner}", daemon=True).start()
 
     def _serve_conn(self, sock: socket.socket, owner: str) -> None:
-        conn = _Conn(sock)
+        conn = _Conn(self._stack.wrap(sock))
         if self._auth_secret is not None:
             key = self._auth_server(sock)
             if key is None:
@@ -539,6 +520,11 @@ class TcpNetwork(Network):
         if self._resume and not self._resume_server(conn, owner):
             conn.close()
             return
+        # handshakes done: upgrade to the stack's framed fast path
+        # (posix: identity; uring: rings + registered buffers).  Sends
+        # on this conn are replies from the listener's owner — book
+        # their tx syscalls there.
+        conn.t = self._stack.activate(conn.t, self._perf_sink(owner))
         self._read_loop(conn)
 
     def _perf_flatten(self, name: str):
@@ -552,6 +538,19 @@ class TcpNetwork(Network):
             m.perf.inc("msg_tx_flatten_bytes", nbytes)
             m.perf.inc("msg_tx_flatten_copies", copies)
         return flatten
+
+    def _perf_sink(self, name: str | None):
+        """Transport syscall-counter callback booked against a local
+        entity's messenger registry (None when not local) — the tx
+        half of the stack telemetry (msg_syscalls_tx and friends)."""
+        m = self.lookup(name) if name else None
+        if m is None:
+            return None
+        perf = m.perf
+
+        def sink(counter: str, n: int) -> None:
+            perf.inc(counter, n)
+        return sink
 
     # -- session resume handshake -----------------------------------------
     # client: RESM | peer_cookie(16, zeros=fresh) | last_recv(u64)
@@ -634,17 +633,21 @@ class TcpNetwork(Network):
     MAX_FRAME = 256 << 20  # recovery pushes batch objects; cap garbage
 
     def _read_loop(self, conn: _Conn) -> None:
-        sock = conn.sock
+        t = conn.t
         head = memoryview(bytearray(4))
         # small-frame reuse buffer: acks/heartbeats/map chatter recv
         # into ONE buffer (no per-frame alloc) and decode fully
         # detached; payload-bearing frames (> _RECV_REUSE_MAX) recv
-        # into a FRESH buffer so decode can carve zero-copy views over
-        # it — the views refcount-pin the buffer, and this loop never
-        # touches it again (the carve ownership contract)
+        # into a transport-provided FRESH buffer (posix: heap; uring: a
+        # registered-pool slice) so decode can carve zero-copy views
+        # over it — the views refcount-pin the buffer, and this loop
+        # never touches it again (the carve ownership contract)
         reuse = memoryview(bytearray(_RECV_REUSE_MAX))
+        rx_ctr = t.rx_counters
         while not self._stopping and conn.alive:
-            if not _recv_into(sock, head):
+            sys0 = rx_ctr["msg_syscalls_rx"]
+            rec0 = rx_ctr["msg_uring_reg_buf_recycled"]
+            if not t.recv_head(head):
                 break
             (length,) = struct.unpack("<I", head)
             compressed = bool(length & _COMPRESSED)
@@ -658,9 +661,9 @@ class TcpNetwork(Network):
                 mv = reuse[:length]
                 owned = False  # reused next frame: decode must detach
             else:
-                mv = memoryview(bytearray(length))
+                mv = t.get_rx_buffer(length)
                 owned = True   # fresh buffer: decode may carve views
-            if not _recv_into(sock, mv):
+            if not t.recv_body(mv):
                 break
             rx_b = rx_c = 0  # receive-side payload copies (counted)
             # verify-and-strip signature + decrypt (cephx signing /
@@ -729,11 +732,18 @@ class TcpNetwork(Network):
                 if rx_c:
                     target.perf.inc("msg_rx_copy_bytes", rx_b)
                     target.perf.inc("msg_rx_copy_copies", rx_c)
+                d_sys = rx_ctr["msg_syscalls_rx"] - sys0
+                d_rec = rx_ctr["msg_uring_reg_buf_recycled"] - rec0
+                if d_sys:
+                    target.perf.inc("msg_syscalls_rx", d_sys)
+                if d_rec:
+                    target.perf.inc("msg_uring_reg_buf_recycled", d_rec)
                 target._enqueue(src, msg)
             else:
                 dout("msg", 10)("tcp: no local entity %s for %s", dst,
                                 type(msg).__name__)
         conn.close()
+        t.release_rx()  # this thread is the rx ring's only user
         with self._net_lock:
             for k in [k for k, v in self._routes.items() if v is conn]:
                 del self._routes[k]
@@ -750,14 +760,15 @@ class TcpNetwork(Network):
                     self._stash.pop(next(iter(self._stash)))
 
     # -- send side ---------------------------------------------------------
-    def _connect(self, addr: str, on_flatten=None) -> _Conn | None:
+    def _connect(self, addr: str, on_flatten=None,
+                 src: str | None = None) -> _Conn | None:
         host, _, port = addr.rpartition(":")
         try:
             sock = socket.create_connection((host, int(port)), timeout=5)
         except OSError:
             return None
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        conn = _Conn(sock)
+        conn = _Conn(self._stack.wrap(sock))
         if self._auth_secret is not None:
             key = self._auth_client(sock)
             if key is None:
@@ -772,12 +783,19 @@ class TcpNetwork(Network):
             dout("msg", 1)("tcp: resume handshake to %s failed", addr)
             conn.close()
             return None
+        # handshakes (and any resume replay) done on the blocking
+        # socket: upgrade to the stack's framed fast path.  Tx syscalls
+        # book against the dialing entity — exact for dedicated pipes,
+        # an attribution approximation on shared ones (same caveat as
+        # replay_from's flatten booking).
+        conn.t = self._stack.activate(conn.t, self._perf_sink(src))
         # outgoing pipes are bidirectional: replies come back on them
         threading.Thread(target=self._read_loop, args=(conn,),
                          name=f"tcp-read-out-{addr}", daemon=True).start()
         return conn
 
-    def _conn_for(self, dst: str, on_flatten=None) -> _Conn | None:
+    def _conn_for(self, dst: str, on_flatten=None,
+                  src: str | None = None) -> _Conn | None:
         with self._net_lock:
             route = self._routes.get(dst)
             if route is not None and route.alive:
@@ -795,7 +813,7 @@ class TcpNetwork(Network):
                 conn = self._out.get(addr)
                 if conn is not None and conn.alive:
                     return conn
-            conn = self._connect(addr, on_flatten)
+            conn = self._connect(addr, on_flatten, src)
             if conn is None:
                 return None
             with self._net_lock:
@@ -840,7 +858,7 @@ class TcpNetwork(Network):
                 segs = [payload]
         else:
             segs = enc.segments()
-        conn = self._conn_for(dst, flatten)
+        conn = self._conn_for(dst, flatten, src)
         if conn is None:
             return False
         rc, seq = conn.send_payload(flags, segs, on_flatten=flatten)
@@ -853,7 +871,7 @@ class TcpNetwork(Network):
             for table in (self._routes, self._out):
                 for k in [k for k, v in table.items() if v is conn]:
                     del table[k]
-        conn2 = self._conn_for(dst, flatten)
+        conn2 = self._conn_for(dst, flatten, src)
         if conn2 is None:
             return False
         if rc == _Conn.RINGED:

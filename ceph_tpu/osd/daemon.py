@@ -875,7 +875,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # heartbeat tick and shipped inside the stats reports for the
         # mon to merge (utils/metrics_history.py)
         self.metrics_history = MetricsHistory(
-            keep=self.cfg["metrics_history_keep"])
+            keep=self.cfg["metrics_history_keep"],
+            downsample_age=self.cfg["metrics_history_downsample_age"])
         self._metrics_sampled_at = 0.0
         # admin-socket directory for cross-daemon trace collection
         # (the PR-7 shared resolver); set by the harness / osd_main
@@ -3143,7 +3144,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         """Advance the object's read-rate EWMA and, when it crosses
         osd_read_lease_rate on a WHOLE-object read, grant `client` a
         TTL lease (returned; 0.0 = no grant) and remember the grant so
-        a write can revoke it.  On a balanced holder the grant is also
+        a write can revoke it.  A RANGED read never starts a lease but
+        RIDES one the object already carries: the client joins the
+        existing grant window (max outstanding expiry — never extended)
+        so its cached range stays revocable, and the reply carries the
+        remaining time.  On a balanced holder the grant is also
         registered at the primary — the ordering point for writes —
         fire-and-forget (a lost register is bounded by the TTL)."""
         ttl = float(self.cfg["osd_read_lease_ttl"])
@@ -3163,19 +3168,29 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._read_ewma.move_to_end(key)
             while len(self._read_ewma) > self._READ_EWMA_CAP:
                 self._read_ewma.popitem(last=False)
-            if not whole or rate < float(
-                    self.cfg["osd_read_lease_rate"]):
-                return 0.0
-            self._lease_grants.setdefault(key, {})[client] = now + ttl
-        self.perf.inc("read_lease_grant")
+            if not whole:
+                g = self._lease_grants.get(key)
+                horizon = max(g.values()) if g else 0.0
+                if horizon <= now:
+                    return 0.0
+                # ride: join the object's live window, don't extend it
+                g[client] = max(g.get(client, 0.0), horizon)
+                expires = horizon
+            else:
+                if rate < float(self.cfg["osd_read_lease_rate"]):
+                    return 0.0
+                expires = now + ttl
+                self._lease_grants.setdefault(key, {})[client] = expires
+        self.perf.inc("read_lease_ride" if not whole
+                      else "read_lease_grant")
         if self.osdmap is not None:
             up = self.osdmap.pg_to_up_osds(pgid.pool, pgid.seed)
             primary = self._primary_of(up)
             if primary is not None and primary != self.osd_id:
                 self.messenger.send_message(
                     f"osd.{primary}",
-                    MLeaseRegister(pgid, oid, client, now + ttl))
-        return ttl
+                    MLeaseRegister(pgid, oid, client, expires))
+        return expires - now
 
     def _handle_lease_register(self, conn, m: MLeaseRegister) -> None:
         with self._lease_lock:
@@ -3753,11 +3768,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if pr.balanced:
                 self.perf.inc("balanced_read_serve")
             lease = 0.0
-            if self.osdmap is not None and not pr.offset \
-                    and not pr.length:
+            if self.osdmap is not None:
                 seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
                 lease = self._lease_maybe_grant(
-                    PgId(pr.pool, seed), pr.oid, pr.client)
+                    PgId(pr.pool, seed), pr.oid, pr.client,
+                    whole=not pr.offset and not pr.length)
             self.messenger.send_message(
                 pr.client,
                 MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch,

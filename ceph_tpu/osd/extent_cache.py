@@ -38,7 +38,7 @@ import threading
 READ_SCALEOUT_COUNTERS = (
     "ec_read_tier_hit", "ec_read_tier_miss",
     "ec_read_tier_admit", "ec_read_tier_evict",
-    "read_lease_grant", "read_lease_revoke",
+    "read_lease_grant", "read_lease_ride", "read_lease_revoke",
     "balanced_read_serve", "balanced_read_bounce")
 
 

@@ -147,6 +147,26 @@ def configs() -> list[dict]:
                             "wire_secure_tx_flatten_copies_per_op",
                             "wire_secure_rx_copy_copies_per_op",
                             "wire_zero_copy_ok", "digest_verified"]})
+    # 8a2b. the transport-stack sweep (ISSUE 17): the same plaintext
+    # wire leg per stack (posix blocking syscalls vs io_uring batched
+    # SQE chains + registered rx buffers).  Syscalls-per-frame is the
+    # headline number; the gate is the counter contract (uring tx
+    # kernel entries per frame < 1, zero Python-side rx copies) and
+    # records "skipped" — never failure — where io_uring is absent.
+    # Shares the cached --ec-batch run with the wire_path row above.
+    out.append({"id": "wire_path_stack", "tool": "bench_root",
+                "argv": ["--ec-batch"],
+                "extract": ["wire_stack_posix_gbps",
+                            "wire_stack_posix_syscalls_tx_per_op",
+                            "wire_stack_posix_syscalls_rx_per_op",
+                            "wire_stack_uring_gbps",
+                            "wire_stack_uring_syscalls_tx_per_op",
+                            "wire_stack_uring_syscalls_rx_per_op",
+                            "wire_stack_uring_sqe_batches",
+                            "wire_stack_uring_reg_buf_recycled",
+                            "wire_stack_speedup_vs_posix",
+                            "wire_uring_active", "wire_stack_gate",
+                            "wire_stack_ok", "digest_verified"]})
     # 8a3. the async group-commit store pipeline (ISSUE 14): 8-writer
     # 1 MiB burst on a real BlueStore, async kv-sync/finisher pipeline
     # vs the inline fsync-per-txn baseline — fsyncs-per-transaction

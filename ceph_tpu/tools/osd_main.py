@@ -56,7 +56,8 @@ def main(argv=None) -> int:
     secret = bytes.fromhex(args.auth_secret_hex) \
         if args.auth_secret_hex is not None else None
     net = TcpNetwork(host=args.bind_ip, auth_secret=secret,
-                     compress=args.compress, secure=args.secure)
+                     compress=args.compress, secure=args.secure,
+                     stack=cfg["ms_stack"])
     net.set_addr(args.mon_name, args.mon_addr)
     store_kw = {"path": args.store_path} if args.store_path else {}
     store = ObjectStore.create(args.store, **store_kw)

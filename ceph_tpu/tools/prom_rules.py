@@ -60,7 +60,13 @@ QUANTILES = (0.50, 0.99)
 #: frame's payload, msg_rx_copy_* every receive-side payload copy —
 #: standing series keep the zero-copy wire path's "copies per hop"
 #: claim a measured number (0 in plaintext mode) instead of a
-#: code-reading exercise
+#: code-reading exercise.  msg_syscalls_{tx,rx} count the transport's
+#: actual kernel entries (sendmsg/recv or io_uring_enter) so
+#: syscalls-per-frame — the uring stack's headline claim — is a
+#: dashboard ratio; msg_uring_sqe_batch books each batched SQE-chain
+#: submit and msg_uring_reg_buf_recycled each registered rx-buffer
+#: reuse (recycle rate ~ large-frame rate means the pinned pool is
+#: actually absorbing the big receives)
 #: KV maintenance/cache counters ride the same rate-rule shape:
 #: flush/compact rates say how hard the LSM is working, the cache
 #: hit:miss ratio is the block cache's value on a dashboard
@@ -75,6 +81,8 @@ QUANTILES = (0.50, 0.99)
 COUNTERS = ("trace_sampled", "trace_dropped",
             "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
             "msg_rx_copy_bytes", "msg_rx_copy_copies",
+            "msg_syscalls_tx", "msg_syscalls_rx",
+            "msg_uring_sqe_batch", "msg_uring_reg_buf_recycled",
             "kv_flush", "kv_compact",
             "kv_cache_hit", "kv_cache_miss",
             "balanced_read_serve", "balanced_read_bounce",

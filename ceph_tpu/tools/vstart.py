@@ -58,7 +58,8 @@ class MiniCluster:
             from ..msg.tcp import TcpNetwork
             self.network = TcpNetwork(auth_secret=tcp_auth_secret,
                                       compress=tcp_compress,
-                                      secure=tcp_secure)
+                                      secure=tcp_secure,
+                                      stack=self.cfg["ms_stack"])
         elif transport == "local":
             self.network = LocalNetwork()
         else:

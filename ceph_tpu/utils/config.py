@@ -526,6 +526,15 @@ OPTIONS: list[Option] = [
            "(ms_async_op_threads role): peers pin to one worker so "
            "per-peer ordering holds while different peers dispatch "
            "concurrently", min=1),
+    Option("ms_stack", str, "posix", OptionLevel.ADVANCED,
+           "messenger transport stack (ms_async_transport_type role): "
+           "'posix' = blocking sendmsg/recv_into syscalls per frame; "
+           "'uring' = io_uring registered-buffer backend (batched SQE "
+           "chains, <1 syscall/frame) where the native extension and "
+           "kernel support it, logged fallback to posix where not; "
+           "'auto' = uring when the probe passes, silently posix "
+           "otherwise", enum_values=("posix", "uring", "auto"),
+           startup=True),
     # cluster event journal + progress (LogClient/LogMonitor + mgr
     # progress module roles)
     Option("osd_event_log_size", int, 1024, OptionLevel.ADVANCED,
@@ -581,6 +590,14 @@ OPTIONS: list[Option] = [
            "metrics-history store (dump_metrics_history / "
            "metrics_query window)", min=2, max=1 << 20,
            see_also=("metrics_history_keep",)),
+    Option("metrics_history_downsample_age", float, 300.0,
+           OptionLevel.ADVANCED,
+           "snapshots older than this many seconds migrate to the "
+           "coarse long-horizon tier (every 8th sample kept) so the "
+           "same byte budget covers ~8x the window; 0 disables the "
+           "coarse tier (pure fine ring)", min=0.0, max=86400.0,
+           see_also=("metrics_history_keep",
+                     "metrics_history_interval_s")),
     Option("mon_clog_persist_interval_s", float, 2.0,
            OptionLevel.ADVANCED,
            "min seconds between journaling the monitor's in-memory "

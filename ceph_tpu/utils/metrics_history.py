@@ -121,18 +121,66 @@ def query_samples(samples: list[dict], counter: str) -> dict:
 
 class _HistoryRings:
     """Shared ring machinery: bounded per-registry snapshot deques +
-    the dump/window/query read surface."""
+    the dump/window/query read surface.
 
-    def __init__(self, keep: int = 600):
+    With ``downsample_age > 0`` each registry grows a COARSE
+    long-horizon tier: samples aging past the threshold migrate out of
+    the fine ring, every ``_STRIDE``-th surviving (the rest dropped),
+    under the SAME total budget — ``len(fine) + len(coarse) <= keep``,
+    enforced by evicting the coarse tier's oldest.  The retrospective
+    window stretches toward ~``_STRIDE``x at unchanged memory; queries
+    read both tiers seamlessly (a coarse edge sample still baselines a
+    long window, just at stride-coarse time resolution).  Counters are
+    cumulative, so differencing across coarse edges stays exact — only
+    the achievable edge placement coarsens."""
+
+    _STRIDE = 8
+
+    def __init__(self, keep: int = 600, downsample_age: float = 0.0):
         self.keep = max(2, int(keep))
+        self.downsample_age = max(0.0, float(downsample_age))
         self._lock = threading.Lock()
         self._rings: dict[str, deque] = {}
+        self._coarse: dict[str, deque] = {}
+        self._coarse_n: dict[str, int] = {}
 
     def _ring(self, registry: str) -> deque:
         ring = self._rings.get(registry)
         if ring is None:
             ring = self._rings[registry] = deque(maxlen=self.keep)
+            self._coarse[registry] = deque(maxlen=self.keep)
         return ring
+
+    def _migrate_locked(self, registry: str) -> None:
+        """Age fine samples past ``downsample_age`` (relative to the
+        ring's NEWEST stamp — deterministic under replayed clocks) into
+        the coarse tier, keeping every ``_STRIDE``-th.  Caller holds
+        _lock and must call this BEFORE appending so the fine deque's
+        maxlen backstop never silently drops a migratable sample."""
+        if self.downsample_age <= 0.0:
+            return
+        fine = self._rings.get(registry)
+        if not fine:
+            return
+        coarse = self._coarse[registry]
+        cutoff = float(fine[-1]["ts"]) - self.downsample_age
+        while fine and float(fine[0]["ts"]) < cutoff:
+            s = fine.popleft()
+            n = self._coarse_n.get(registry, 0)
+            self._coarse_n[registry] = n + 1
+            if n % self._STRIDE == 0:
+                coarse.append(s)
+        # total budget, with one slot reserved for the append the
+        # caller is about to do (migration always precedes it)
+        while len(fine) + len(coarse) >= self.keep and coarse:
+            coarse.popleft()
+
+    def _rows_locked(self, registry: str) -> list[dict]:
+        """Both tiers, oldest first (coarse strictly precedes fine:
+        migration is in ts order)."""
+        coarse = self._coarse.get(registry)
+        fine = self._rings.get(registry)
+        return list(coarse or ()) + list(fine or ())
 
     def registries(self) -> list[str]:
         with self._lock:
@@ -151,18 +199,21 @@ class _HistoryRings:
         now = time.time() if now is None else now
         lo, hi = now - float(since_s), now - float(until_s)
         with self._lock:
-            ring = self._rings.get(registry)
-            if not ring:
+            rows = self._rows_locked(registry)
+            if not rows:
                 return []
-            inside = [s for s in ring if lo < s["ts"] <= hi]
-            before = [s for s in ring if s["ts"] <= lo]
+            inside = [s for s in rows if lo < s["ts"] <= hi]
+            before = [s for s in rows if s["ts"] <= lo]
         baseline = [max(before, key=lambda s: s["ts"])] if before else []
         return baseline + inside
 
     def last_ts(self, registry: str) -> float:
         with self._lock:
             ring = self._rings.get(registry)
-            return float(ring[-1]["ts"]) if ring else 0.0
+            if ring:
+                return float(ring[-1]["ts"])
+            coarse = self._coarse.get(registry)
+            return float(coarse[-1]["ts"]) if coarse else 0.0
 
     def query(self, registry: str, counter: str, since_s: float = 60.0,
               until_s: float = 0.0, now: float | None = None,
@@ -196,11 +247,12 @@ class _HistoryRings:
             names = [registry] if registry else sorted(self._rings)
             out = {}
             for n in names:
-                rows = list(self._rings.get(n, ()))
+                rows = self._rows_locked(n)
                 if max_samples and len(rows) > int(max_samples):
                     rows = rows[-int(max_samples):]
                 out[n] = rows
-        return {"registries": out, "keep": self.keep}
+        return {"registries": out, "keep": self.keep,
+                "downsample_age": self.downsample_age}
 
 
 class MetricsHistory(_HistoryRings):
@@ -212,8 +264,8 @@ class MetricsHistory(_HistoryRings):
     delivery signal is trusted; the event journal pioneered this
     contract)."""
 
-    def __init__(self, keep: int = 600):
-        super().__init__(keep)
+    def __init__(self, keep: int = 600, downsample_age: float = 0.0):
+        super().__init__(keep, downsample_age)
         self._seq = 0
 
     def sample(self, registries: dict, ts: float | None = None) -> int:
@@ -224,6 +276,7 @@ class MetricsHistory(_HistoryRings):
         with self._lock:
             self._seq += 1
             for name, counters in dumps.items():
+                self._migrate_locked(name)
                 self._ring(name).append(
                     {"ts": ts, "seq": self._seq, "counters": counters})
         return self._seq
@@ -252,8 +305,9 @@ class MetricsHistoryStore(_HistoryRings):
     (bounded by ``keep`` regardless) and a returning daemon merges
     fresh — only the gauge entry and the seq floors age out."""
 
-    def __init__(self, keep: int = 600, expire_after: float = 600.0):
-        super().__init__(keep)
+    def __init__(self, keep: int = 600, expire_after: float = 600.0,
+                 downsample_age: float = 0.0):
+        super().__init__(keep, downsample_age)
         self.expire_after = float(expire_after)
         # (daemon, registry) -> highest merged seq (reset on daemon
         # boot, mirroring the event journal's lseq contract)
@@ -306,6 +360,10 @@ class MetricsHistoryStore(_HistoryRings):
                         self._daemon_ts[daemon] = max(
                             self._daemon_ts.get(daemon, 0.0), float(ts))
                 self._merged_seq[key] = seen
+                # after the batch, not before it: a shipped window
+                # appends many rows under one lock hold, and the
+                # budget must hold at every merge() exit
+                self._migrate_locked(str(registry))
         return merged
 
     def staleness(self, now: float | None = None) -> dict:

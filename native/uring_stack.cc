@@ -1,0 +1,401 @@
+// io_uring transport backend for the messenger Stack seam
+// (ceph_tpu/msg/stack.py's UringStack).  Raw-syscall ring management —
+// no liburing dependency: the ring is set up with io_uring_setup(2),
+// SQEs are written straight into the mmap'd submission queue, and one
+// io_uring_enter(2) both submits a batch and waits for completions.
+//
+// Scope is deliberately small: the Python side owns ALL protocol state
+// (framing, ordering, retries, buffer pinning); this file only knows
+// how to queue SENDMSG/RECV SQEs, submit, and drain CQEs.  Per-op
+// contexts (the msghdr + iovec storage a SENDMSG needs alive until
+// completion) are malloc'd at prep and freed at reap, keyed by the
+// CQE user_data.
+//
+// The file compiles to an empty translation unit where <linux/io_uring.h>
+// is absent (the Makefile additionally gates the object like the AVX2
+// one), and every entry point degrades to -ENOSYS so a mismatched build
+// still falls back cleanly in Python.
+
+#if defined(__linux__) && defined(__has_include)
+#if __has_include(<linux/io_uring.h>)
+#define CT_URING_BUILD 1
+#endif
+#endif
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+#ifdef CT_URING_BUILD
+
+#include <linux/io_uring.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+int sys_io_uring_setup(unsigned entries, struct io_uring_params *p) {
+    return (int)syscall(__NR_io_uring_setup, entries, p);
+}
+
+int sys_io_uring_enter(int fd, unsigned to_submit, unsigned min_complete,
+                       unsigned flags) {
+    return (int)syscall(__NR_io_uring_enter, fd, to_submit, min_complete,
+                        flags, nullptr, 0);
+}
+
+int sys_io_uring_register(int fd, unsigned opcode, const void *arg,
+                          unsigned nr_args) {
+    return (int)syscall(__NR_io_uring_register, fd, opcode, arg, nr_args);
+}
+
+// per-op context: keeps the msghdr + iovec array alive until the CQE
+// is reaped (the kernel reads them asynchronously for SENDMSG).  RECV
+// ops use it only for the token round-trip.
+struct ct_op {
+    struct msghdr mh;
+    unsigned long long token;
+    struct iovec iov[];  // flexible: n entries for sendmsg, 0 for recv
+};
+
+struct ct_ring {
+    int fd;
+    unsigned sq_entries;
+    unsigned cq_entries;
+    // sq ring (mmap'd)
+    unsigned *sq_head;
+    unsigned *sq_tail;
+    unsigned *sq_mask;
+    unsigned *sq_array;
+    struct io_uring_sqe *sqes;
+    // cq ring
+    unsigned *cq_head;
+    unsigned *cq_tail;
+    unsigned *cq_mask;
+    struct io_uring_cqe *cqes;
+    // mmap bookkeeping
+    void *sq_ptr;
+    size_t sq_len;
+    void *cq_ptr;  // == sq_ptr under IORING_FEAT_SINGLE_MMAP
+    size_t cq_len;
+    void *sqe_ptr;
+    size_t sqe_len;
+    unsigned to_submit;     // prepped, not yet passed to enter
+    pthread_mutex_t mu;     // guards SQ prep + CQ reap + to_submit
+};
+
+struct io_uring_sqe *get_sqe(struct ct_ring *r) {
+    unsigned head = __atomic_load_n(r->sq_head, __ATOMIC_ACQUIRE);
+    unsigned tail = *r->sq_tail;
+    if (tail - head >= r->sq_entries)
+        return nullptr;  // SQ full: caller must submit first
+    unsigned idx = tail & *r->sq_mask;
+    struct io_uring_sqe *sqe = &r->sqes[idx];
+    memset(sqe, 0, sizeof(*sqe));
+    r->sq_array[idx] = idx;
+    __atomic_store_n(r->sq_tail, tail + 1, __ATOMIC_RELEASE);
+    r->to_submit++;
+    return sqe;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Quick availability probe: can this kernel/process set up a ring at
+// all (seccomp filters and old kernels say no)?  0 on success, -errno.
+int ct_uring_probe(void) {
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    int fd = sys_io_uring_setup(4, &p);
+    if (fd < 0)
+        return -errno;
+    close(fd);
+    return 0;
+}
+
+void *ct_uring_create(unsigned entries) {
+    struct ct_ring *r = (struct ct_ring *)calloc(1, sizeof(*r));
+    if (!r)
+        return nullptr;
+    struct io_uring_params p;
+    memset(&p, 0, sizeof(p));
+    r->fd = sys_io_uring_setup(entries, &p);
+    if (r->fd < 0) {
+        free(r);
+        return nullptr;
+    }
+    r->sq_entries = p.sq_entries;
+    r->cq_entries = p.cq_entries;
+    r->sq_len = p.sq_off.array + p.sq_entries * sizeof(unsigned);
+    r->cq_len = p.cq_off.cqes + p.cq_entries * sizeof(struct io_uring_cqe);
+    bool single = (p.features & IORING_FEAT_SINGLE_MMAP) != 0;
+    if (single && r->cq_len > r->sq_len)
+        r->sq_len = r->cq_len;
+    r->sq_ptr = mmap(nullptr, r->sq_len, PROT_READ | PROT_WRITE,
+                     MAP_SHARED | MAP_POPULATE, r->fd, IORING_OFF_SQ_RING);
+    if (r->sq_ptr == MAP_FAILED)
+        goto fail;
+    if (single) {
+        r->cq_ptr = r->sq_ptr;
+    } else {
+        r->cq_ptr = mmap(nullptr, r->cq_len, PROT_READ | PROT_WRITE,
+                         MAP_SHARED | MAP_POPULATE, r->fd,
+                         IORING_OFF_CQ_RING);
+        if (r->cq_ptr == MAP_FAILED)
+            goto fail;
+    }
+    r->sqe_len = p.sq_entries * sizeof(struct io_uring_sqe);
+    r->sqe_ptr = mmap(nullptr, r->sqe_len, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, r->fd, IORING_OFF_SQES);
+    if (r->sqe_ptr == MAP_FAILED)
+        goto fail;
+    r->sq_head = (unsigned *)((char *)r->sq_ptr + p.sq_off.head);
+    r->sq_tail = (unsigned *)((char *)r->sq_ptr + p.sq_off.tail);
+    r->sq_mask = (unsigned *)((char *)r->sq_ptr + p.sq_off.ring_mask);
+    r->sq_array = (unsigned *)((char *)r->sq_ptr + p.sq_off.array);
+    r->sqes = (struct io_uring_sqe *)r->sqe_ptr;
+    r->cq_head = (unsigned *)((char *)r->cq_ptr + p.cq_off.head);
+    r->cq_tail = (unsigned *)((char *)r->cq_ptr + p.cq_off.tail);
+    r->cq_mask = (unsigned *)((char *)r->cq_ptr + p.cq_off.ring_mask);
+    r->cqes = (struct io_uring_cqe *)((char *)r->cq_ptr + p.cq_off.cqes);
+    pthread_mutex_init(&r->mu, nullptr);
+    return r;
+fail:
+    if (r->sqe_ptr && r->sqe_ptr != MAP_FAILED)
+        munmap(r->sqe_ptr, r->sqe_len);
+    if (r->cq_ptr && r->cq_ptr != MAP_FAILED && r->cq_ptr != r->sq_ptr)
+        munmap(r->cq_ptr, r->cq_len);
+    if (r->sq_ptr && r->sq_ptr != MAP_FAILED)
+        munmap(r->sq_ptr, r->sq_len);
+    close(r->fd);
+    free(r);
+    return nullptr;
+}
+
+void ct_uring_destroy(void *h) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r)
+        return;
+    // drain unreaped op contexts so a torn-down connection leaks
+    // nothing (closing the ring fd cancels in-flight ops kernel-side)
+    pthread_mutex_lock(&r->mu);
+    unsigned head = *r->cq_head;
+    unsigned tail = __atomic_load_n(r->cq_tail, __ATOMIC_ACQUIRE);
+    while (head != tail) {
+        struct io_uring_cqe *cqe = &r->cqes[head & *r->cq_mask];
+        free((void *)(uintptr_t)cqe->user_data);
+        head++;
+    }
+    __atomic_store_n(r->cq_head, head, __ATOMIC_RELEASE);
+    pthread_mutex_unlock(&r->mu);
+    munmap(r->sqe_ptr, r->sqe_len);
+    if (r->cq_ptr != r->sq_ptr)
+        munmap(r->cq_ptr, r->cq_len);
+    munmap(r->sq_ptr, r->sq_len);
+    close(r->fd);
+    pthread_mutex_destroy(&r->mu);
+    free(r);
+}
+
+// Pin a buffer pool with IORING_REGISTER_BUFFERS (pages pinned once
+// for the ring's lifetime — the pool's recycle story).  0 or -errno;
+// failure is non-fatal Python-side (ops still run on the memory).
+int ct_uring_register_buffers(void *h, const unsigned long long *addrs,
+                              const unsigned long long *lens, unsigned n) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r || n == 0 || n > 64)
+        return -EINVAL;
+    struct iovec iov[64];
+    for (unsigned i = 0; i < n; i++) {
+        iov[i].iov_base = (void *)(uintptr_t)addrs[i];
+        iov[i].iov_len = (size_t)lens[i];
+    }
+    int rc = sys_io_uring_register(r->fd, IORING_REGISTER_BUFFERS, iov, n);
+    return rc < 0 ? -errno : 0;
+}
+
+// Queue one SENDMSG SQE gathering n (addr, len) segments.  MSG_WAITALL
+// makes the kernel retry short sends internally, so one CQE means the
+// whole gather hit the socket (short completions remain possible on
+// error paths and are handled by the Python resubmit).  No syscall
+// here — the batch goes out on the next ct_uring_submit.
+int ct_uring_prep_sendmsg(void *h, int fd, const unsigned long long *addrs,
+                          const unsigned long long *lens, unsigned n,
+                          unsigned long long token) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r || n == 0 || n > 1024)
+        return -EINVAL;
+    struct ct_op *op =
+        (struct ct_op *)malloc(sizeof(*op) + n * sizeof(struct iovec));
+    if (!op)
+        return -ENOMEM;
+    memset(&op->mh, 0, sizeof(op->mh));
+    for (unsigned i = 0; i < n; i++) {
+        op->iov[i].iov_base = (void *)(uintptr_t)addrs[i];
+        op->iov[i].iov_len = (size_t)lens[i];
+    }
+    op->mh.msg_iov = op->iov;
+    op->mh.msg_iovlen = n;
+    op->token = token;
+    pthread_mutex_lock(&r->mu);
+    struct io_uring_sqe *sqe = get_sqe(r);
+    if (!sqe) {
+        pthread_mutex_unlock(&r->mu);
+        free(op);
+        return -EBUSY;
+    }
+    sqe->opcode = IORING_OP_SENDMSG;
+    sqe->fd = fd;
+    sqe->addr = (unsigned long long)(uintptr_t)&op->mh;
+    sqe->msg_flags = MSG_NOSIGNAL | MSG_WAITALL;
+    sqe->user_data = (unsigned long long)(uintptr_t)op;
+    pthread_mutex_unlock(&r->mu);
+    return 0;
+}
+
+// Queue one RECV SQE into [addr, addr+len).  waitall sets MSG_WAITALL
+// (complete only when the buffer is full, or error/EOF); link sets
+// IOSQE_IO_LINK so the NEXT prepped SQE starts only after this one
+// completes — the read loop links "body of frame i" -> "header of
+// frame i+1" to pipeline both into one enter.
+int ct_uring_prep_recv(void *h, int fd, unsigned long long addr,
+                       unsigned long long len, int waitall, int link,
+                       unsigned long long token) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r)
+        return -EINVAL;
+    struct ct_op *op = (struct ct_op *)malloc(sizeof(*op));
+    if (!op)
+        return -ENOMEM;
+    memset(&op->mh, 0, sizeof(op->mh));
+    op->token = token;
+    pthread_mutex_lock(&r->mu);
+    struct io_uring_sqe *sqe = get_sqe(r);
+    if (!sqe) {
+        pthread_mutex_unlock(&r->mu);
+        free(op);
+        return -EBUSY;
+    }
+    sqe->opcode = IORING_OP_RECV;
+    sqe->fd = fd;
+    sqe->addr = addr;
+    sqe->len = (unsigned)len;
+    sqe->msg_flags = waitall ? MSG_WAITALL : 0;
+    sqe->flags = link ? IOSQE_IO_LINK : 0;
+    sqe->user_data = (unsigned long long)(uintptr_t)op;
+    pthread_mutex_unlock(&r->mu);
+    return 0;
+}
+
+// A NOP SQE: wakes a thread blocked in ct_uring_submit(h, wait_nr=1)
+// (connection teardown).
+int ct_uring_prep_nop(void *h, unsigned long long token) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r)
+        return -EINVAL;
+    struct ct_op *op = (struct ct_op *)malloc(sizeof(*op));
+    if (!op)
+        return -ENOMEM;
+    memset(&op->mh, 0, sizeof(op->mh));
+    op->token = token;
+    pthread_mutex_lock(&r->mu);
+    struct io_uring_sqe *sqe = get_sqe(r);
+    if (!sqe) {
+        pthread_mutex_unlock(&r->mu);
+        free(op);
+        return -EBUSY;
+    }
+    sqe->opcode = IORING_OP_NOP;
+    sqe->fd = -1;
+    sqe->user_data = (unsigned long long)(uintptr_t)op;
+    pthread_mutex_unlock(&r->mu);
+    return 0;
+}
+
+// THE syscall: submit everything prepped since the last call and, when
+// wait_nr > 0, wait for that many completions — both in one enter.
+// Returns the number of SQEs submitted (>= 0) or -errno.  Called via
+// ctypes (which drops the GIL), so a wait here never blocks Python.
+int ct_uring_submit(void *h, unsigned wait_nr) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r)
+        return -EINVAL;
+    pthread_mutex_lock(&r->mu);
+    unsigned n = r->to_submit;
+    r->to_submit = 0;
+    pthread_mutex_unlock(&r->mu);
+    for (;;) {
+        int rc = sys_io_uring_enter(r->fd, n, wait_nr,
+                                    wait_nr ? IORING_ENTER_GETEVENTS : 0);
+        if (rc >= 0)
+            return rc;
+        if (errno == EINTR)
+            continue;  // nothing consumed on EINTR: safe to retry
+        if (n) {
+            pthread_mutex_lock(&r->mu);
+            r->to_submit += n;  // submission failed: keep the batch
+            pthread_mutex_unlock(&r->mu);
+        }
+        return -errno;
+    }
+}
+
+// Drain up to max CQEs (pure memory reads — no syscall).  Fills
+// tokens[i]/results[i], frees the op contexts, returns the count.
+int ct_uring_reap(void *h, unsigned long long *tokens, long long *results,
+                  unsigned max) {
+    struct ct_ring *r = (struct ct_ring *)h;
+    if (!r)
+        return -EINVAL;
+    unsigned out = 0;
+    pthread_mutex_lock(&r->mu);
+    unsigned head = *r->cq_head;
+    unsigned tail = __atomic_load_n(r->cq_tail, __ATOMIC_ACQUIRE);
+    while (head != tail && out < max) {
+        struct io_uring_cqe *cqe = &r->cqes[head & *r->cq_mask];
+        struct ct_op *op = (struct ct_op *)(uintptr_t)cqe->user_data;
+        tokens[out] = op ? op->token : 0;
+        results[out] = cqe->res;
+        free(op);
+        out++;
+        head++;
+    }
+    __atomic_store_n(r->cq_head, head, __ATOMIC_RELEASE);
+    pthread_mutex_unlock(&r->mu);
+    return (int)out;
+}
+
+}  // extern "C"
+
+#else  // !CT_URING_BUILD — stubs so a forced compile still links
+
+extern "C" {
+int ct_uring_probe(void) { return -ENOSYS; }
+void *ct_uring_create(unsigned) { return nullptr; }
+void ct_uring_destroy(void *) {}
+int ct_uring_register_buffers(void *, const unsigned long long *,
+                              const unsigned long long *, unsigned) {
+    return -ENOSYS;
+}
+int ct_uring_prep_sendmsg(void *, int, const unsigned long long *,
+                          const unsigned long long *, unsigned,
+                          unsigned long long) {
+    return -ENOSYS;
+}
+int ct_uring_prep_recv(void *, int, unsigned long long, unsigned long long,
+                       int, int, unsigned long long) {
+    return -ENOSYS;
+}
+int ct_uring_prep_nop(void *, unsigned long long) { return -ENOSYS; }
+int ct_uring_submit(void *, unsigned) { return -ENOSYS; }
+int ct_uring_reap(void *, unsigned long long *, long long *, unsigned) {
+    return -ENOSYS;
+}
+}
+#endif  // CT_URING_BUILD

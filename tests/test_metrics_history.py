@@ -167,3 +167,53 @@ def test_store_forgets_silent_daemons():
     assert store.merge("osd.9", {"probe": [
         {"ts": 2000.0, "seq": 1, "counters": {"ops": 2}}]}) == 1
     assert "osd.9" in store.staleness(now=2001.0)
+
+
+def test_downsample_coarse_tier_extends_window_at_same_budget():
+    """With downsample_age set, samples aging past the threshold
+    migrate into a coarse tier (every 8th kept) under the SAME total
+    budget: len(fine) + len(coarse) never exceeds keep, the oldest
+    retained sample reaches far beyond what a pure ring could hold,
+    and window queries difference seamlessly across the tier seam
+    (counters are cumulative, so the math stays exact)."""
+    pc = _probe_registry()
+    keep = 40
+    h = MetricsHistory(keep=keep, downsample_age=20.0)
+    for i in range(200):            # 1 Hz for 200 s, ops == ts + 1
+        pc.inc("ops")
+        h.sample({"probe": pc}, ts=float(i))
+    dump = h.dump()
+    assert dump["downsample_age"] == 20.0
+    rows = dump["registries"]["probe"]
+    assert len(rows) <= keep        # budget holds ACROSS both tiers
+    ts = [s["ts"] for s in rows]
+    assert ts == sorted(ts)         # coarse strictly precedes fine
+    # fine tier: full rate inside the age threshold
+    fine = [s["ts"] for s in h._rings["probe"]]
+    assert len(fine) >= 20
+    assert all(round(b - a) == 1 for a, b in zip(fine, fine[1:]))
+    # coarse tier: stride-8 history far beyond the pure-ring horizon
+    # (keep=40 at 1 Hz would cover only 40 s)
+    coarse = [s["ts"] for s in h._coarse["probe"]]
+    assert coarse and ts[0] < 199.0 - float(keep)
+    assert all(round(b - a) == 8 for a, b in zip(coarse, coarse[1:]))
+    # a long window spanning the seam still answers exactly: ops
+    # advances 1/s, so delta == span for ANY achievable edge pair
+    q = h.query("probe", "ops", since_s=150, now=199.0)
+    assert q["samples"] >= 2
+    assert q["delta"] == q["t1"] - q["t0"]
+    # the mon-side store grows the same tier through merge()
+    store = MetricsHistoryStore(keep=keep, downsample_age=20.0)
+    for i in range(0, 200, 10):     # ship in 10-sample windows
+        store.merge("osd.0", {"probe": rows_between(h, i, i + 10)})
+    srows = store.dump()["registries"]["probe"]
+    assert len(srows) <= keep
+    sts = [s["ts"] for s in srows]
+    assert sts == sorted(sts) and sts[0] < sts[-1] - float(keep)
+
+
+def rows_between(h, lo, hi):
+    """Shipping-window helper: h's samples with lo <= ts < hi (the
+    merge path wants seq-ordered lists, which sample() guarantees)."""
+    return [{"ts": float(t), "seq": t + 1,
+             "counters": {"ops": t + 1}} for t in range(lo, hi)]

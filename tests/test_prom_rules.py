@@ -102,7 +102,7 @@ def test_rules_shape_and_rendering():
     # one rule per (histogram, quantile) + one rate rule per tracer /
     # messenger-copy / kv-maintenance / read-scale-out counter + the
     # staleness max, records namespaced
-    assert len(rules) == 47
+    assert len(rules) == 51
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
     assert len(hist) == 28
@@ -122,6 +122,10 @@ def test_rules_shape_and_rendering():
         "ceph_tpu:daemon_msg_tx_flatten_copies:rate5m",
         "ceph_tpu:daemon_msg_rx_copy_bytes:rate5m",
         "ceph_tpu:daemon_msg_rx_copy_copies:rate5m",
+        "ceph_tpu:daemon_msg_syscalls_tx:rate5m",
+        "ceph_tpu:daemon_msg_syscalls_rx:rate5m",
+        "ceph_tpu:daemon_msg_uring_sqe_batch:rate5m",
+        "ceph_tpu:daemon_msg_uring_reg_buf_recycled:rate5m",
         "ceph_tpu:daemon_kv_flush:rate5m",
         "ceph_tpu:daemon_kv_compact:rate5m",
         "ceph_tpu:daemon_kv_cache_hit:rate5m",
@@ -142,8 +146,8 @@ def test_rules_shape_and_rendering():
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 47
-    assert text.count("    expr: ") == 47
+    assert text.count("  - record: ") == 51
+    assert text.count("    expr: ") == 51
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms

@@ -336,3 +336,44 @@ def test_replicated_pool_balanced_reads_byte_identity():
         assert _counter_sum(c, "balanced_read_serve") > 0
     finally:
         c.stop()
+
+
+def test_ranged_read_rides_existing_lease(lease_cluster):
+    """A RANGED read never starts a lease, but on an object already
+    lease-covered it RIDES the standing grant: the reply carries the
+    remaining window, the client caches the exact range (zero RADOS
+    ops on repeats), and a write revokes the ranged entry through the
+    same grant map."""
+    c, cl = lease_cluster
+    data = bytes(RNG.integers(0, 256, OBJ_SIZE, dtype=np.uint8))
+    cl.write_full("ecs", "ride", data)
+    rdr = c.client()
+    # warm whole-object reads until the grant lands client-side
+    deadline = time.time() + 10
+    while not rdr._lease_cache and time.time() < deadline:
+        assert rdr.read("ecs", "ride") == data
+    assert rdr._lease_cache, "no lease was ever granted"
+    # drop only the CLIENT cache entry — the server-side grant stays
+    # live (ttl 30s) — so the next ranged read goes back to the wire
+    rdr._lease_drop(rdr._pool_id("ecs"), "ride")
+    assert rdr.read("ecs", "ride", offset=64, length=512) == \
+        data[64:576]
+    assert any(len(k) == 4 for k in rdr._lease_cache), \
+        "ranged reply did not ride the standing grant"
+    assert _counter_sum(c, "read_lease_ride") >= 1
+    # repeats of the exact range are served locally: zero RADOS ops
+    calls = _count_ops(rdr)
+    for _ in range(10):
+        assert rdr.read("ecs", "ride", offset=64, length=512) == \
+            data[64:576]
+    assert calls[0] == 0, f"{calls[0]} ranged ops escaped to RADOS"
+    # a write revokes the rider too (it joined the grant map): fresh
+    # range bytes arrive inside the 30 s window only via the notify
+    new = bytes(reversed(data))
+    cl.write_full("ecs", "ride", new)
+    deadline = time.time() + 5
+    got = rdr.read("ecs", "ride", offset=64, length=512)
+    while got != new[64:576] and time.time() < deadline:
+        time.sleep(0.02)
+        got = rdr.read("ecs", "ride", offset=64, length=512)
+    assert got == new[64:576], "revoke never reached the rider"
