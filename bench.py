@@ -836,9 +836,9 @@ def ec_batch_bench(trace: bool = False) -> int:
     otr = _OTracer("bench-overhead")
     overhead_rates = (0.0, 0.01, 1.0)
 
-    def sampled_burst(rate: float) -> float:
+    def sampled_burst(rate: float, perf=None) -> float:
         otr.set_sample_rate(rate)
-        b = ECBatcher(window_us=2000, max_bytes=64 << 20)
+        b = ECBatcher(window_us=2000, max_bytes=64 << 20, perf=perf)
         barrier = threading.Barrier(writers + 1)
 
         def writer(w):
@@ -874,12 +874,38 @@ def ec_batch_bench(trace: bool = False) -> int:
         (overhead_dt[0.01] / overhead_dt[0.0] - 1) * 100, 2)
     trace_overhead_ok = overhead_dt[0.01] <= overhead_dt[0.0] * 1.05
 
+    # exemplars-on point (ISSUE 18): the same burst with a perf
+    # registry attached, so every sampled op's trace_id is captured
+    # into the wait/flush histogram bucket reservoirs.  Gate: the 1%
+    # exemplar leg within the SAME 5% budget of its own perf-attached
+    # rate-0 baseline — capture cost must ride the sampled branch
+    # only; the unsampled fast path books a plain hinc (exemplar=None,
+    # zero allocation).
+    from ceph_tpu.utils.perf import PerfCounters as _OPerf
+    ex_perf = _OPerf("bench-overhead-ex")
+    ex_dt = {0.0: float("inf"), 0.01: float("inf")}
+    sampled_burst(0.0, perf=ex_perf)  # warm
+    for _ in range(3):
+        for r in ex_dt:
+            ex_dt[r] = min(ex_dt[r], sampled_burst(r, perf=ex_perf))
+    exemplar_overhead_pct = round(
+        (ex_dt[0.01] / ex_dt[0.0] - 1) * 100, 2)
+    exemplar_overhead_ok = ex_dt[0.01] <= ex_dt[0.0] * 1.05
+    # the capture must actually work: one untimed fully-sampled pass
+    # (1% of a small burst can legitimately sample zero ops) must
+    # leave trace_id exemplars in the wait histogram's dump
+    sampled_burst(1.0, perf=ex_perf)
+    ex_dump = ex_perf.dump().get("ec_batch_wait_us", {})
+    exemplar_overhead_ok = exemplar_overhead_ok and bool(
+        ex_dump.get("exemplars"))
+
     # --trace leg: sample traced ops through a batched burst and report
     # the per-stage latency decomposition (ec-op = the op's whole
     # encode, ec-batch-wait = queued->flushed, ec-flush = the folded
     # launch incl. host sync) — the stage table every later perf PR is
     # graded against
     trace_stages = None
+    trace_blame = None
     if trace:
         from ceph_tpu.tools.trace_tool import (format_stage_table,
                                                stage_stats)
@@ -917,6 +943,14 @@ def ec_batch_bench(trace: bool = False) -> int:
               f"({writers}x{ops_per} traced ops, batched burst):",
               file=sys.stderr)
         print(format_stage_table(trace_stages), file=sys.stderr)
+        # blame column (ISSUE 18): which stage OWNS the blocked time
+        # along each op's critical path, aggregated over the burst
+        from ceph_tpu.utils.critical_path import (blame,
+                                                  format_blame_table)
+        trace_blame = blame(traces)
+        print("bench: critical-path blame (blocking-chain self-time):",
+              file=sys.stderr)
+        print(format_blame_table(trace_blame), file=sys.stderr)
 
     # ---- wire-path leg (ISSUE 13): the segmented frame path over a
     # real socket pair — payload GB/s + the copies-per-hop counters
@@ -1035,6 +1069,12 @@ def ec_batch_bench(trace: bool = False) -> int:
         "trace_overhead_gbps": overhead_gbps,
         "trace_overhead_pct_at_001": trace_overhead_pct,
         "trace_overhead_ok": trace_overhead_ok,
+        # exemplars-on point (ISSUE 18): 1% sampling WITH bucket
+        # exemplar capture vs its own perf-attached rate-0 baseline,
+        # same 5% budget; also asserts a fully-sampled pass actually
+        # left trace_id exemplars in ec_batch_wait_us
+        "exemplar_overhead_pct_at_001": exemplar_overhead_pct,
+        "exemplar_overhead_ok": exemplar_overhead_ok,
         "staging_h2d_gbps": (round(staging_gbps, 3)
                              if staging_gbps is not None else None),
         "stage_h2d_bytes": h2d_bytes,
@@ -1057,10 +1097,12 @@ def ec_batch_bench(trace: bool = False) -> int:
         # (GATED: zero inline maintenance in the kv-sync thread, bg
         # p99 < inline p99, cache hits > 0, byte-identity)
         **kv_leg,
-        **({"trace_stages": trace_stages}
+        **({"trace_stages": trace_stages,
+            "trace_blame": trace_blame}
            if trace_stages is not None else {}),
     }))
     return 0 if verified and single_copy and trace_overhead_ok \
+        and exemplar_overhead_ok \
         and wire["wire_zero_copy_ok"] \
         and wire["wire_stack_ok"] \
         and store_leg["store_commit_ok"] \
@@ -1634,6 +1676,7 @@ def ec_read_bench(trace: bool = False) -> int:
     results: dict[str, dict] = {}
     verified = True
     trace_stages = None
+    trace_blame = None
     for mode in ("coalesced", "perop"):
         c, cl = build(coalesce=mode == "coalesced")
         try:
@@ -1692,6 +1735,12 @@ def ec_read_bench(trace: bool = False) -> int:
                       f"({len(roots)} traced degraded reads):",
                       file=sys.stderr)
                 print(format_stage_table(trace_stages), file=sys.stderr)
+                from ceph_tpu.utils.critical_path import (
+                    blame, format_blame_table)
+                trace_blame = blame(traces)
+                print("bench: critical-path blame (degraded reads):",
+                      file=sys.stderr)
+                print(format_blame_table(trace_blame), file=sys.stderr)
             results[mode] = legs
         finally:
             c.stop()
@@ -1717,7 +1766,8 @@ def ec_read_bench(trace: bool = False) -> int:
             "coalesced": co["degraded"]["decode_launches_per_op"],
             "perop": po["degraded"]["decode_launches_per_op"]},
         "digest_verified": verified,
-        **({"trace_stages": trace_stages}
+        **({"trace_stages": trace_stages,
+            "trace_blame": trace_blame}
            if trace_stages is not None else {}),
     }))
     return 0 if verified else 1

@@ -110,7 +110,11 @@ COUNTERS = ("ec_batch_launches", "ec_batch_coalesced_ops",
             "ec_batch_sharded_launches")
 HISTOGRAMS = ("ec_batch_ops_per_launch", "ec_batch_bytes_per_launch",
               "ec_batch_sharded_devices_per_launch",
-              "ec_batch_sharded_shard_bytes")
+              "ec_batch_sharded_shard_bytes",
+              # latency decomposition (microseconds, exemplar-linked
+              # when the op rides a sampled trace): queued -> taken by
+              # a flusher, and taken -> launch complete
+              "ec_batch_wait_us", "ec_batch_flush_us")
 #: settable gauges (CounterType.U64): the live adaptive-window value
 GAUGES = ("ec_batch_window_us_now",)
 
@@ -156,8 +160,8 @@ class _PendingOp:
 
     __slots__ = ("codec", "streams", "chunks", "want", "length",
                  "with_csums", "callback", "deadline", "submitted",
-                 "taken", "done", "parity", "csums", "decoded", "error",
-                 "tspan", "dev", "dev_owned")
+                 "taken", "taken_at", "done", "parity", "csums",
+                 "decoded", "error", "tspan", "dev", "dev_owned")
 
     def __init__(self, codec, *, streams=None, chunks=None, want=None,
                  length=0, with_csums=False, callback=None):
@@ -171,6 +175,7 @@ class _PendingOp:
         self.deadline = 0.0
         self.submitted = 0.0
         self.taken = False          # removed from the queue by a flusher
+        self.taken_at = 0.0         # monotonic instant of the take
         self.done = False
         self.parity = None
         self.csums = None
@@ -324,9 +329,9 @@ class ECBatcher:
             flush = self._flush_encode
         op = _PendingOp(codec, streams=data_chunks, length=L,
                         with_csums=with_csums, callback=callback)
+        self._trace_submit(op, trace, sig)
         if kind == "plain":
             self._stage_encode_op(op, sig[-1])
-        self._trace_submit(op, trace, sig)
         self._submit(sig, op, data_chunks.nbytes, flush)
         if op.error is not None:
             raise op.error
@@ -383,9 +388,9 @@ class ECBatcher:
         # the callback is fired below by THIS thread, after present
         # shards merge back in — not by the flusher
         op = _PendingOp(codec, chunks=arrays, want=need, length=L)
+        self._trace_submit(op, trace, sig)
         if kind == "plain":
             self._stage_decode_op(op, sig)
-        self._trace_submit(op, trace, sig)
         nbytes = sum(c.nbytes for c in arrays.values())
         self._submit(sig, op, nbytes, flush)
         if op.error is not None:
@@ -464,7 +469,8 @@ class ECBatcher:
                 if L < bucket:
                     data = np.pad(data, ((0, 0), (0, bucket - L)))
                 op.dev = staging.device_put_landed(
-                    np.ascontiguousarray(data), force=False)
+                    np.ascontiguousarray(data), force=False,
+                    exemplar=self._op_exemplar(op))
                 op.dev_owned = True
             else:
                 if L < bucket:
@@ -503,7 +509,8 @@ class ECBatcher:
                     arr = np.pad(arr,
                                  ((0, 0), (0, bucket - op.length)))
                 op.dev = staging.device_put_landed(
-                    np.ascontiguousarray(arr), force=False)
+                    np.ascontiguousarray(arr), force=False,
+                    exemplar=self._op_exemplar(op))
             else:
                 import jax.numpy as jnp
                 stacked = jnp.stack([jnp.asarray(r) for r in rows])
@@ -624,13 +631,40 @@ class ECBatcher:
     def _take_locked(self, sig: tuple) -> list[_PendingOp]:
         ops = self._groups.pop(sig, [])
         self._group_bytes.pop(sig, None)
+        now = time.monotonic()
         for o in ops:
             o.taken = True
+            o.taken_at = now
         return ops
+
+    @staticmethod
+    def _op_exemplar(op: _PendingOp):
+        """The op's sampled trace_id (exemplar), or None."""
+        sp = op.tspan
+        return sp.trace_id if sp is not None and sp.sampled else None
 
     def _complete(self, ops: list[_PendingOp], src_bytes: int,
                   reason: str, n_shard: int = 1,
                   shard_bytes: int = 0) -> None:
+        p = self._perf
+        if p is not None and ops:
+            # wait (queued -> taken) per op, flush (taken -> done) once
+            # per launch; sampled ops pin their trace_id on the bucket
+            now = time.monotonic()
+            lead_ex = None
+            for o in ops:
+                ex = self._op_exemplar(o)
+                if lead_ex is None:
+                    lead_ex = ex
+                if o.taken_at:
+                    p.hinc("ec_batch_wait_us",
+                           max(0.0, o.taken_at - o.submitted) * 1e6,
+                           exemplar=ex)
+            t0 = min((o.taken_at for o in ops if o.taken_at),
+                     default=0.0)
+            if t0:
+                p.hinc("ec_batch_flush_us", max(0.0, now - t0) * 1e6,
+                       exemplar=lead_ex)
         self._account(len(ops), src_bytes, reason, n_shard, shard_bytes)
         self._adapt(ops)
         with self._cv:

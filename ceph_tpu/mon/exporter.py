@@ -22,7 +22,7 @@ def _sanitize(name: str) -> str:
                    for ch in name)
 
 
-def render_metrics(mon=None) -> str:
+def render_metrics(mon=None, openmetrics: bool = False) -> str:
     """The prometheus text format body (flat counters + labeled
     per-daemon series, sum/count pairs for timers).
 
@@ -30,18 +30,27 @@ def render_metrics(mon=None) -> str:
     text exposition format requires every sample of a metric in one
     group under a single HELP/TYPE header — the old per-daemon outer
     loop interleaved one metric's series across daemons, which strict
-    parsers (promtool, the client_python text parser) reject."""
-    # metric -> {"help": str, "type": str, "samples": [(labels, value)]}
+    parsers (promtool, the client_python text parser) reject.
+
+    ``openmetrics=True`` renders the OpenMetrics 1.0 flavor instead:
+    identical families and sample lines, a ``# EOF`` terminator, and
+    histogram ``_bucket`` lines annotated with their bucket's newest
+    exemplar (``# {trace_id="..."} value ts``) — the classic 0.0.4
+    exposition never carries exemplars, so exemplar-free scrapes stay
+    byte-identical to the pre-exemplar schema."""
+    # metric -> {"help", "type", "samples": [(labels, value, exemplar)]}
     groups: dict[str, dict] = {}
 
     def emit(metric: str, value, labels: dict | None = None,
-             help_: str | None = None, typ: str = "gauge"):
+             help_: str | None = None, typ: str = "gauge",
+             exemplar: tuple | None = None):
         m = f"{_PREFIX}_{_sanitize(metric)}"
         g = groups.get(m)
         if g is None:
             g = groups[m] = {"help": help_ or f"{metric}",
                              "type": typ, "samples": []}
-        g["samples"].append((dict(labels) if labels else {}, value))
+        g["samples"].append((dict(labels) if labels else {}, value,
+                             exemplar))
 
     if mon is not None:
         # snapshot under the monitor lock: the HTTP thread must not
@@ -90,6 +99,17 @@ def render_metrics(mon=None) -> str:
                      {"daemon": daemon},
                      help_="seconds since the daemon's newest merged "
                            "metrics-history snapshot", typ="gauge")
+        # per-daemon clock skew estimated from stats-report send
+        # stamps (mon receive time - daemon sent_at, one-way): the
+        # offset trace_tool subtracts when merging cross-daemon
+        # waterfalls
+        skew = getattr(mon, "clock_skew", None)
+        if callable(skew):
+            for daemon, off in sorted(skew().items()):
+                emit("daemon_clock_skew_s", off, {"daemon": daemon},
+                     help_="estimated daemon wall-clock offset vs the "
+                           "monitor (stats-report one-way delay "
+                           "included)", typ="gauge")
         # progress gauges (the mgr progress module's exporter face):
         # one series per derived item, present while the item is live
         # (or lingering complete), GONE once it clears
@@ -121,14 +141,18 @@ def render_metrics(mon=None) -> str:
                     # +Inf series is emitted even for an empty histogram
                     # so the metric NAME exists in every scrape (the
                     # recording rules reference a stable schema).
+                    exs = {int(k): v for k, v in
+                           (val.get("exemplars") or {}).items()}
                     acc = 0
                     for b, n in sorted(val["buckets_pow2"].items()):
                         acc += n
+                        ring = exs.get(b)
                         emit(f"{base}_bucket", acc,
                              {"daemon": daemon, "le": str(2 ** b)},
                              help_=f"perf histogram {cname} cumulative "
                                    "pow-2 buckets",
-                             typ="counter")
+                             typ="counter",
+                             exemplar=(ring[-1] if ring else None))
                     emit(f"{base}_bucket", val.get("count", acc),
                          {"daemon": daemon, "le": "+Inf"},
                          help_=f"perf histogram {cname} cumulative "
@@ -145,7 +169,7 @@ def render_metrics(mon=None) -> str:
     for m, g in groups.items():
         lines.append(f"# HELP {m} {g['help']}")
         lines.append(f"# TYPE {m} {g['type']}")
-        for labels, value in g["samples"]:
+        for labels, value, exemplar in g["samples"]:
             lab = ""
             if labels:
                 pairs = ",".join(f'{k}="{v}"' for k, v in sorted(
@@ -156,10 +180,17 @@ def render_metrics(mon=None) -> str:
             # go wrong)
             if isinstance(value, bool):
                 value = int(value)
-            if isinstance(value, int):
-                lines.append(f"{m}{lab} {value}")
-            else:
-                lines.append(f"{m}{lab} {float(value)!r}")
+            line = f"{m}{lab} {value}" if isinstance(value, int) \
+                else f"{m}{lab} {float(value)!r}"
+            if openmetrics and exemplar is not None:
+                # OpenMetrics exemplar suffix on the bucket line:
+                # `# {trace_id="..."} observed_value capture_ts`
+                line += (f' # {{trace_id="{exemplar["trace_id"]}"}}'
+                         f' {float(exemplar["value"])!r}'
+                         f' {float(exemplar["ts"])!r}')
+            lines.append(line)
+    if openmetrics:
+        lines.append("# EOF")
     return "\n".join(lines) + "\n"
 
 
@@ -171,14 +202,26 @@ class MetricsExporter:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 - stdlib API
-                if self.path.rstrip("/") not in ("", "/metrics"):
+                path, _, query = self.path.partition("?")
+                if path.rstrip("/") not in ("", "/metrics"):
                     self.send_response(404)
                     self.end_headers()
                     return
-                body = render_metrics(exporter.mon).encode("utf-8")
+                # content negotiation: OpenMetrics (exemplar-bearing)
+                # on an explicit Accept or ?openmetrics=1; classic
+                # 0.0.4 otherwise — exemplar syntax would break 0.0.4
+                # parsers
+                om = ("application/openmetrics-text"
+                      in (self.headers.get("Accept") or "")) \
+                    or "openmetrics=1" in query
+                body = render_metrics(
+                    exporter.mon, openmetrics=om).encode("utf-8")
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header(
+                    "Content-Type",
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8" if om
+                    else "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

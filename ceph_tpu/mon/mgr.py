@@ -498,6 +498,128 @@ class QosModule(MgrModule):
         raise KeyError(cmd)
 
 
+@register_module("slo")
+class SloModule(MgrModule):
+    """SLO burn-rate health (the slo/objectives.py host): each tick,
+    evaluate every configured latency objective over a fast AND a slow
+    ``metrics_query`` window (Google-SRE multiwindow: the slow window
+    proves the burn is not a blip, the fast window proves it is still
+    happening) and drive the ``SLO_BURN`` check through the monitor's
+    health mux.  The check detail carries the worst offending bucket's
+    exemplar trace_ids, so the alert itself is the entry point into
+    ``trace_tool --exemplar``; raise/clear transitions journal to the
+    cluster log's ``slo`` channel (the health mux additionally
+    journals the HEALTH transition itself).
+
+    Inert while ``slo_objectives`` is empty.  A malformed objective
+    string journals ONCE per distinct value and disables evaluation
+    until the config changes — a config typo must not take the mgr
+    tick thread down or flap the log."""
+
+    TICK_EVERY = 1.0
+
+    def __init__(self, mgr):
+        super().__init__(mgr)
+        self._alerting: dict[str, dict] = {}  # objective -> last eval
+        self._spec: str | None = None         # last parsed config value
+        self._objs: list = []
+        self.last: list | None = None
+
+    def _objectives(self) -> list:
+        spec = str(self.mgr.mon.cfg["slo_objectives"])
+        if spec == self._spec:
+            return self._objs
+        from ..slo.objectives import parse_objectives
+        self._spec = spec
+        try:
+            self._objs = parse_objectives(spec)
+        except ValueError as e:
+            self._objs = []
+            self._journal(f"slo_objectives rejected: {e}",
+                          severity="warn", error=str(e))
+        return self._objs
+
+    def _journal(self, message: str, severity: str = "info",
+                 **fields) -> None:
+        from ..utils.event_log import make_event
+        mon = self.mgr.mon
+        mon.cluster_log.append(make_event(
+            mon.name, "slo", message, severity, **fields))
+
+    def tick(self) -> None:
+        mon = self.mgr.mon
+        objs = self._objectives()
+        store = getattr(mon, "metrics_history", None)
+        if not objs or store is None:
+            if self._alerting:
+                for name in sorted(self._alerting):
+                    self._journal(f"SLO_BURN cleared: {name} "
+                                  "(objectives removed)", check=name)
+                self._alerting = {}
+            mon.set_health_check("SLO_BURN", None)
+            return
+        from ..slo.objectives import evaluate_objective
+        cfg = mon.cfg
+        fast_s = cfg["slo_fast_window_s"]
+        slow_s = cfg["slo_slow_window_s"]
+        thr = cfg["slo_burn_threshold"]
+        results = [evaluate_objective(o, store, fast_s, slow_s)
+                   for o in objs]
+        self.last = results
+        # both windows must burn over threshold, on real observations
+        # (an empty window burns nothing — a quiet cluster is healthy)
+        cur = {r["objective"]: r for r in results
+               if r["fast"]["observations"] > 0
+               and r["slow"]["observations"] > 0
+               and r["fast"]["burn"] >= thr
+               and r["slow"]["burn"] >= thr}
+        for name in sorted(set(cur) - set(self._alerting)):
+            r = cur[name]
+            tids = [e["trace_id"] for e in r.get("exemplars") or []]
+            self._journal(
+                f"SLO_BURN raised: {name} burning "
+                f"{r['fast']['burn']:g}x fast / "
+                f"{r['slow']['burn']:g}x slow", severity="warn",
+                check=name, burn_fast=float(r["fast"]["burn"]),
+                burn_slow=float(r["slow"]["burn"]),
+                exemplar_trace_ids=",".join(str(t) for t in tids))
+        for name in sorted(set(self._alerting) - set(cur)):
+            self._journal(f"SLO_BURN cleared: {name}", check=name)
+        self._alerting = cur
+        if not cur:
+            mon.set_health_check("SLO_BURN", None)
+            return
+        detail = []
+        for name, r in sorted(cur.items()):
+            line = (f"{name}: burn {r['fast']['burn']:g}x over "
+                    f"{fast_s:g}s / {r['slow']['burn']:g}x over "
+                    f"{slow_s:g}s "
+                    f"({r['fast']['observations']} obs)")
+            tids = [str(e["trace_id"])
+                    for e in r.get("exemplars") or []]
+            if tids:
+                line += f"; exemplar traces: {', '.join(tids)}"
+            detail.append(line)
+        mon.set_health_check("SLO_BURN", {
+            "severity": "HEALTH_WARN",
+            "summary": (f"{len(cur)} SLO objective(s) burning error "
+                        f"budget >= {thr:g}x in both windows"),
+            "detail": detail})
+
+    def command(self, cmd: str, **kw):
+        if cmd == "status":
+            return {"objectives": [o.name for o in self._objectives()],
+                    "alerting": sorted(self._alerting),
+                    "fast_window_s":
+                        self.mgr.mon.cfg["slo_fast_window_s"],
+                    "slow_window_s":
+                        self.mgr.mon.cfg["slo_slow_window_s"],
+                    "burn_threshold":
+                        self.mgr.mon.cfg["slo_burn_threshold"],
+                    "last": self.last}
+        raise KeyError(cmd)
+
+
 @register_module("balancer")
 class BalancerModule(MgrModule):
     """Automatic upmap balancing (pybind/mgr/balancer role): when

@@ -513,6 +513,15 @@ class MonitorLite(Dispatcher):
         self.progress = ProgressTracker(
             linger=self.cfg["mgr_progress_linger"])
         self._last_health: dict[str, str] = {}  # check -> severity
+        # externally-registered health checks (mgr modules — the slo
+        # module's SLO_BURN lands here): name -> check dict, merged
+        # into _health_checks so raise/clear transitions journal
+        # through the same mux as the built-ins
+        self._ext_health: dict[str, dict] = {}
+        # per-daemon clock-skew estimate from stats-report send stamps
+        # (receive_time - sent_at; includes the one-way wire delay,
+        # fine for waterfall alignment at ms granularity)
+        self._clock_skew: dict[str, float] = {}
         # per-daemon highest journal lseq merged: daemons RE-SHIP their
         # pending window with every report (silent wire drops make a
         # delivery signal untrustworthy), so the log dedupes here
@@ -1387,7 +1396,8 @@ class MonitorLite(Dispatcher):
     _READONLY_CMDS = frozenset({"status", "osd dump", "osd stats",
                                 "auth list", "dump_cluster_log",
                                 "progress", "dump_metrics_history",
-                                "metrics_query", "osd qos ls"})
+                                "metrics_query", "osd qos ls",
+                                "clock_skew"})
 
     def _mon_cmd_denied(self, m: MMonCommand):
         """(errno, detail) if the command must be refused, else None.
@@ -1706,6 +1716,11 @@ class MonitorLite(Dispatcher):
                 max_events=int(cmd.get("max", 0) or 0))
         if prefix == "progress":
             return 0, self.progress.ls()
+        if prefix == "clock_skew":
+            # the offsets trace_tool subtracts when merging
+            # cross-daemon waterfalls (also the daemon_clock_skew_s
+            # exporter gauge feed)
+            return 0, self.clock_skew()
         if prefix == "dump_metrics_history":
             # the merged in-cluster time series (perf_history source)
             return 0, self.metrics_history.dump(
@@ -1888,7 +1903,32 @@ class MonitorLite(Dispatcher):
                                 f"in the last "
                                 f"{self.cfg['mon_batch_thrash_warn_window_s']:g}s"),
                     "detail": hot}
+        # externally-registered checks (mgr modules) merge last; the
+        # registrant owns raise/clear by setting/clearing its entry
+        checks.update({n: dict(c)
+                       for n, c in self._ext_health.items()})
         return checks
+
+    def set_health_check(self, name: str, check: dict | None) -> None:
+        """Raise (check dict with severity/summary/detail) or clear
+        (None) an externally-owned health check — the mgr modules'
+        entry into the health mux.  Transitions journal through
+        _note_health exactly like the built-ins."""
+        with self._lock:
+            if check is None:
+                self._ext_health.pop(name, None)
+            else:
+                self._ext_health[name] = dict(check)
+            self._note_health()
+
+    def clock_skew(self) -> dict:
+        """Per-daemon clock-skew estimates (seconds; positive = the
+        daemon's clock reads BEHIND the mon's by that much plus the
+        one-way delay).  Lock-free snapshot: callers include
+        _run_command (which already holds _lock) and the exporter's
+        HTTP thread (which does not) — a plain dict copy is atomic
+        enough for a telemetry gauge."""
+        return dict(self._clock_skew)
 
     def _clog(self, channel: str, message: str, severity: str = "info",
               **fields) -> None:
@@ -1929,7 +1969,17 @@ class MonitorLite(Dispatcher):
         metrics = stats.pop("metrics", None)
         if metrics:
             self.metrics_history.merge(f"osd.{m.osd_id}", metrics)
+        sent_at = stats.pop("sent_at", None)
         with self._lock:
+            if isinstance(sent_at, (int, float)):
+                # receive-time minus send-stamp: wall-clock offset plus
+                # the one-way wire delay (small in-cluster); smoothed
+                # lightly so one delayed report doesn't jerk waterfall
+                # alignment
+                raw = time.time() - float(sent_at)
+                prev = self._clock_skew.get(f"osd.{m.osd_id}")
+                self._clock_skew[f"osd.{m.osd_id}"] = round(
+                    raw if prev is None else 0.5 * prev + 0.5 * raw, 6)
             self._osd_stats[m.osd_id] = stats
             seen = self._event_lseq.get(m.osd_id, 0)
             now = time.time()

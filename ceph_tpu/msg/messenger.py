@@ -352,8 +352,10 @@ class Messenger:
             finally:
                 self.worker_dispatched[worker] += 1
                 self.perf.inc("msg_dispatched")
+                tr = getattr(msg, "trace", None)
                 self.perf.hinc("msg_dispatch_us",
-                               (time.perf_counter() - t0) * 1e6)
+                               (time.perf_counter() - t0) * 1e6,
+                               exemplar=tr[0] if tr else None)
                 self.perf.inc("msg_queue_depth", -1)
                 if self._throttle and throttled:
                     self._throttle.put()

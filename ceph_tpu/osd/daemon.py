@@ -907,6 +907,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # shared schema with tools/prom_rules.py's rate rules
         register_read_scaleout_counters(self.perf)
         self.perf.add("op_lat", CounterType.TIME)
+        # end-to-end client-op latency as a pow2 histogram (the SLO
+        # `client_op` signal); sampled ops pin exemplars on buckets.
+        # The tracker predates the registry, hence the late bind.
+        self.perf.add("op_lat_us", CounterType.HISTOGRAM)
+        self.op_tracker.bind_perf(self.perf, "op_lat_us")
         # cross-op EC batching (ec/batcher.py): concurrent stripe
         # encodes/decodes sharing a (matrix, k, m) signature coalesce
         # into ONE folded kernel launch within a small window; engaged
@@ -1024,6 +1029,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self.hb_messenger.shutdown()
         if self._use_mclock:
             self.scheduler.shutdown()
+        # leave the global collection like the messengers and KV tier
+        # do: a later daemon reusing this name (same-process restart,
+        # or the next test cluster) must start from zeroed counters,
+        # not inherit this incarnation's trace_sampled/op counts
+        global_perf().remove(self.name)
 
     # -------------------------------------------------- admin socket verbs
     def admin_command(self, cmd: str, **kw):
@@ -1167,10 +1177,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         tenant = getattr(msg, "tenant", "") if klass == "client" else ""
         tags = (getattr(msg, "qdelta", 0),
                 getattr(msg, "qrho", 0)) if tenant else None
+        # sampled-trace ops stamp their trace_id on the queue-wait
+        # entry so the mclock_qwait_us_* bucket they land in carries
+        # the exemplar
+        tr = getattr(msg, "trace", None)
         self.scheduler.enqueue(klass, (handler, conn, msg),
                                key=self._shard_key(msg),
                                tenant=tenant or None, tags=tags,
-                               force=force)
+                               force=force,
+                               trace_id=tr[0] if tr else None)
         return True
 
     def _shard_key(self, msg):
@@ -4290,6 +4305,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                          {"pgs": pgs, "objects": objects, "bytes": nbytes,
                           "pool_objects": pool_objects,
                           "partial": partial,
+                          # daemon wall clock at send: the mon's skew
+                          # estimate (receive_time - sent_at, one-way)
+                          # feeds the daemon_clock_skew_s gauge and
+                          # trace_tool's waterfall normalization
+                          "sent_at": time.time(),
                           "op_w": self.perf.get("op_w"),
                           "op_r": self.perf.get("op_r"),
                           "recovery_push": self.perf.get("recovery_push"),

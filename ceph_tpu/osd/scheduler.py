@@ -234,10 +234,10 @@ class MClockScheduler:
         with self._cv:
             self._stop = True
             # reconcile the depth gauges for items dying in the queues:
-            # the daemon's perf registry OUTLIVES a kill/revive cycle
-            # (global_perf().create returns the existing registry), so
-            # an unreconciled gauge would stay inflated forever on the
-            # revived daemon's scrapes
+            # the registry is injected and may outlive this scheduler
+            # (an embedding daemon shutting the scheduler down without
+            # dying itself), so an unreconciled gauge would stay
+            # inflated forever on later scrapes
             for c, q in self._queues.items():
                 if q and self._perf is not None:
                     self._perf.inc(f"mclock_depth_{c}", -len(q))
@@ -325,7 +325,8 @@ class MClockScheduler:
         return min(floors) if floors else None
 
     def _enqueue_tenant_locked(self, tenant: str, item,
-                               tags, now: float) -> bool:
+                               tags, now: float,
+                               trace_id=None) -> bool:
         """Queue one tenant-tagged client op with arrival-time dmclock
         tags.  Returns False when the op should ride the untagged
         stream instead (tenant table full of busy streams)."""
@@ -370,7 +371,7 @@ class MClockScheduler:
         p_cost = delta / max(p.weight, 1e-9)
         p_tag = t["p"] + p_cost
         t["p"] = p_tag
-        q.append((item, now, r_tag, p_tag, p_cost))
+        q.append((item, now, r_tag, p_tag, p_cost, trace_id))
         self._ttouch[tenant] = now
         if self._perf is not None:
             self._perf.inc(f"mclock_depth_{self.CLIENT}")
@@ -411,7 +412,7 @@ class MClockScheduler:
             if p.limit > 0 and t["l"] > now:
                 wake = t["l"] if wake is None else min(wake, t["l"])
                 continue
-            _item, _stamp, r_tag, p_tag, _pc = q[0]
+            _item, _stamp, r_tag, p_tag, _pc, _tid = q[0]
             if r_tag is not None:
                 if r_tag <= now and (best_r is None
                                      or r_tag < best_r[0]):
@@ -450,18 +451,24 @@ class MClockScheduler:
 
     # ---------------------------------------------------------------- API
     def enqueue(self, klass: str, item, tenant: str | None = None,
-                tags: tuple | None = None, force: bool = False) -> None:
+                tags: tuple | None = None, force: bool = False,
+                trace_id=None) -> None:
         """``force`` bypasses the lossy QUEUE_CAP drop: completion
         continuations (store commit acks/replies) have no retry path —
         dropping one would wedge its object lock forever — and their
-        count is bounded by in-flight ops, not by hostile senders."""
+        count is bounded by in-flight ops, not by hostile senders.
+
+        ``trace_id`` rides the queue-wait stamp when the op belongs to
+        a SAMPLED trace, landing as the bucket exemplar on the
+        ``mclock_qwait_us_*`` histogram at dequeue."""
         with self._cv:
             now = self._clock()
             self._class_catchup_locked(klass)
             if klass == self.CLIENT and tenant \
                     and tenant != DEFAULT_TENANT:
                 if self._enqueue_tenant_locked(tenant, item, tags,
-                                               now):
+                                               now,
+                                               trace_id=trace_id):
                     return
                 # fold-through: ride the untagged stream below
             q = self._queues[klass]
@@ -484,7 +491,7 @@ class MClockScheduler:
                     DEFAULT_TENANT, {"r": 0.0, "p": 0.0, "l": 0.0})
                 td["p"] = max(td["p"], floor)
             q.append(item)
-            self._stamps[klass].append(now)
+            self._stamps[klass].append((now, trace_id))
             if self._perf is not None:
                 self._perf.inc(f"mclock_depth_{klass}")
             self._cv.notify()
@@ -615,7 +622,7 @@ class MClockScheduler:
                 t["p"] = t["p"] + 1.0 / max(p.weight, 1e-9)
 
     def _book_service_locked(self, tenant: str, stamp: float | None,
-                             now: float) -> None:
+                             now: float, exemplar=None) -> None:
         self.tenant_served[tenant] = \
             self.tenant_served.get(tenant, 0) + 1
         if self._perf is not None:
@@ -625,7 +632,8 @@ class MClockScheduler:
                 self._perf.inc(f"mclock_depth_tenant_{key}", -1)
             if stamp is not None:
                 self._perf.hinc(f"mclock_qwait_us_tenant_{key}",
-                                max(0.0, now - stamp) * 1e6)
+                                max(0.0, now - stamp) * 1e6,
+                                exemplar=exemplar)
 
     def _dequeue_locked(self, klass: str, res: str, now: float):
         """Pop + account the op the class-level pick chose.  Returns
@@ -639,7 +647,7 @@ class MClockScheduler:
             kind, who, sub_phase = self._client_choice
             if kind == "tenant":
                 q = self._tqueues[who]
-                item, stamp, _r, _p, _pc = q.popleft()
+                item, stamp, _r, _p, _pc, tid = q.popleft()
                 tenant = who
                 phase_code = sub_phase
                 if sub_phase == PHASE_RESERVATION and _pc > 0.0:
@@ -656,8 +664,8 @@ class MClockScheduler:
                     t["p"] -= _pc
                     if q:
                         self._tqueues[who] = collections.deque(
-                            (it, st, r, pt - _pc, pc)
-                            for it, st, r, pt, pc in q)
+                            (it, st, r, pt - _pc, pc, ti)
+                            for it, st, r, pt, pc, ti in q)
                 else:
                     self._client_vtime = max(self._client_vtime, _p)
                 self._account(klass, res, now)
@@ -668,8 +676,10 @@ class MClockScheduler:
                     self._perf.inc(f"mclock_depth_{klass}", -1)
                     if stamp is not None:
                         self._perf.hinc(f"mclock_qwait_us_{klass}",
-                                        max(0.0, now - stamp) * 1e6)
-                self._book_service_locked(who, stamp, now)
+                                        max(0.0, now - stamp) * 1e6,
+                                        exemplar=tid)
+                self._book_service_locked(who, stamp, now,
+                                          exemplar=tid)
                 return item, phase_code, tenant
             # untagged pick: fall through to the plain pop below,
             # using the sub-pick's phase for the default stream
@@ -683,17 +693,20 @@ class MClockScheduler:
                 self._client_vtime,
                 self._ttags[DEFAULT_TENANT]["p"])
         self.served[klass] += 1
+        tid = None
         if self._perf is not None:
             self._perf.inc(f"mclock_served_{klass}")
             self._perf.inc(f"mclock_depth_{klass}", -1)
             if self._stamps[klass]:
-                stamp = self._stamps[klass].popleft()
+                stamp, tid = self._stamps[klass].popleft()
                 self._perf.hinc(f"mclock_qwait_us_{klass}",
-                                max(0.0, now - stamp) * 1e6)
+                                max(0.0, now - stamp) * 1e6,
+                                exemplar=tid)
         elif self._stamps[klass]:
-            stamp = self._stamps[klass].popleft()
+            stamp, tid = self._stamps[klass].popleft()
         if klass == self.CLIENT:
-            self._book_service_locked(DEFAULT_TENANT, stamp, now)
+            self._book_service_locked(DEFAULT_TENANT, stamp, now,
+                                      exemplar=tid)
             tenant = DEFAULT_TENANT
         return item, phase_code, tenant
 
@@ -782,11 +795,12 @@ class ShardedScheduler:
 
     def enqueue(self, klass: str, item, key=None,
                 tenant: str | None = None,
-                tags: tuple | None = None, force: bool = False) -> None:
+                tags: tuple | None = None, force: bool = False,
+                trace_id=None) -> None:
         shard = self.shards[hash(key) % len(self.shards)] \
             if key is not None else self.shards[0]
         shard.enqueue(klass, item, tenant=tenant, tags=tags,
-                      force=force)
+                      force=force, trace_id=trace_id)
 
     def queue_depth(self, klass: str | None = None) -> int:
         return sum(s.queue_depth(klass) for s in self.shards)

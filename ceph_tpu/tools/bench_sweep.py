@@ -217,7 +217,10 @@ def configs() -> list[dict]:
                 "argv": ["--ec-batch"],
                 "extract": ["trace_overhead_gbps",
                             "trace_overhead_pct_at_001",
-                            "trace_overhead_ok", "digest_verified"]})
+                            "trace_overhead_ok",
+                            "exemplar_overhead_pct_at_001",
+                            "exemplar_overhead_ok",
+                            "digest_verified"]})
     # 8d. the hot-object read scale-out gate (ISSUE 16): zipf-1.2 read
     # storm on a no-spare k=2+m=1 MiniCluster — per-OSD served-read
     # spread under read_policy=balance vs the primary baseline (gated

@@ -112,6 +112,12 @@ def show(asok: str, registry: str, counter: str,
     if "p50" in q:
         lines.append(f"  p50 {q['p50']:.1f}   p99 {q['p99']:.1f}   "
                      f"count_delta {q.get('count_delta', 0)}")
+    # bucket exemplars: sampled trace_ids captured in-window, the
+    # metrics->traces pivot (feed these to trace_tool --exemplar)
+    for b, ring in sorted((q.get("exemplars") or {}).items()):
+        ids = ", ".join(f"{e['trace_id']:016x}@{e['value']:.0f}us"
+                        for e in ring[:3])
+        lines.append(f"  exemplar le=2^{b}: {ids}")
     if rates:
         lines.append(f"  rate/interval |{sparkline(rates, width)}| "
                      f"max {max(rates):g}/s")

@@ -42,6 +42,11 @@ PREFIX = "ceph_tpu"
 #: write-stall time writers paid while maintenance was behind (the
 #: p99 cliff the background seam removes), kv_wal_compact_us = the
 #: wal backend's snapshot-compaction wall
+#: ...plus the exemplar-era op-path histograms (ISSUE 18): op_lat_us =
+#: whole-op latency from the OpTracker (the client_op SLO signal),
+#: ec_batch_wait_us / ec_batch_flush_us = the batcher's queued->flushed
+#: wait and the folded launch wall (per-op and per-flush halves of the
+#: coalescing trade)
 HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
               "msg_dispatch_us",
               "mclock_qwait_us_client", "mclock_qwait_us_recovery",
@@ -49,7 +54,8 @@ HISTOGRAMS = ("kernel_compile_us", "kernel_device_us", "kernel_sync_us",
               "mclock_qwait_us_tenant_default",
               "store_commit_us", "store_queue_us",
               "kv_flush_us", "kv_compact_us", "kv_stall_us",
-              "kv_wal_compact_us")
+              "kv_wal_compact_us",
+              "op_lat_us", "ec_batch_wait_us", "ec_batch_flush_us")
 QUANTILES = (0.50, 0.99)
 
 #: per-daemon tracer head-sampling counters (trace_sample_rate draws):
@@ -86,9 +92,17 @@ COUNTERS = ("trace_sampled", "trace_dropped",
             "kv_flush", "kv_compact",
             "kv_cache_hit", "kv_cache_miss",
             "balanced_read_serve", "balanced_read_bounce",
-            "read_lease_grant", "read_lease_revoke",
+            "read_lease_grant", "read_lease_ride", "read_lease_revoke",
             "ec_read_tier_hit", "ec_read_tier_miss",
             "ec_read_tier_admit", "ec_read_tier_evict")
+
+#: SLO_BURN-aligned bad-fraction recording rules: fraction of
+#: observations ABOVE the bound over the rate window — the PromQL
+#: twin of slo/objectives.py's bad_fraction (burn = ratio / (1 -
+#: target) with the target applied at alerting time).  The le bound
+#: must be an exporter bucket edge (a power of two): 16384 us is the
+#: bucket floor of a ~20 ms client_op objective.
+SLO_BAD_RATIOS = (("client_op", "op_lat_us", 16384),)
 
 #: the metrics-history liveness gauge the exporter emits per daemon
 #: (seconds since the mon merged that daemon's newest snapshot); the
@@ -97,10 +111,12 @@ STALENESS_GAUGE = "metrics_history_staleness_s"
 
 
 def recording_rules(histograms=HISTOGRAMS, quantiles=QUANTILES,
-                    counters=COUNTERS, window: str = "5m") -> list[dict]:
+                    counters=COUNTERS, slo_ratios=SLO_BAD_RATIOS,
+                    window: str = "5m") -> list[dict]:
     """One rule per (histogram, quantile) over the cumulative
-    le-buckets, one rate rule per tracer counter, plus the
-    metrics-history staleness max."""
+    le-buckets, one rate rule per tracer counter, one SLO bad-fraction
+    ratio per SLO_BAD_RATIOS entry, plus the metrics-history staleness
+    max."""
     rules = []
     for h in histograms:
         metric = f"{PREFIX}_daemon_{h}_bucket"
@@ -116,6 +132,15 @@ def recording_rules(histograms=HISTOGRAMS, quantiles=QUANTILES,
             "record": f"{PREFIX}:daemon_{c}:rate{window}",
             "expr": (f"sum by (daemon) "
                      f"(rate({PREFIX}_daemon_{c}[{window}]))"),
+        })
+    for sig, h, le in slo_ratios:
+        metric = f"{PREFIX}_daemon_{h}_bucket"
+        rules.append({
+            "record": f"{PREFIX}:slo_{sig}_bad:ratio_rate{window}",
+            "expr": (f'1 - (sum(rate({metric}'
+                     f'{{le="{le}"}}[{window}])) '
+                     f'/ sum(rate({metric}'
+                     f'{{le="+Inf"}}[{window}])))'),
         })
     rules.append({
         "record": f"{PREFIX}:{STALENESS_GAUGE}:max",

@@ -17,8 +17,14 @@ CLI::
     python -m ceph_tpu.tools.trace_tool --asok-dir /tmp/asok \
         --trace-id 123456
 
-queries every ``*.asok`` in the directory, merges the rings, prints the
-waterfall and the per-stage table.  The library half (merge_spans /
+queries every ``*.asok`` in the directory, merges the rings (clock
+skew normalized via the mon's ``clock_skew`` estimates), prints the
+waterfall, the per-stage table, and the critical-path blocking chain.
+``--exemplar <trace_id>`` is the metrics->traces pivot: feed it a
+trace_id straight out of a histogram bucket exemplar
+(``metrics_query`` / perf_history / the OpenMetrics scrape).
+``--blame`` aggregates every complete trace in the rings into the
+per-stage critical-path blame table (utils/critical_path.py).  The library half (merge_spans /
 waterfall / stage_stats) is what ``bench.py --ec-batch --trace`` and
 the tests drive directly.
 """
@@ -31,18 +37,29 @@ import json
 import os
 import sys
 
+from ..utils.critical_path import (blame, critical_path,
+                                   format_blame_table)
 from ..utils.tracer import build_tree
 
 
-def merge_spans(span_lists) -> list[dict]:
+def merge_spans(span_lists, skew: dict | None = None) -> list[dict]:
     """Merge per-daemon/per-client span dumps for one trace, dropping
-    duplicates (a collector may see the same ring twice)."""
+    duplicates (a collector may see the same ring twice).  ``skew``
+    maps service names to estimated wall-clock offsets in seconds
+    (mon ``clock_skew`` command / ``daemon_clock_skew_s`` gauge) —
+    each span's timestamps are shifted onto the monitor's clock, so a
+    cross-daemon waterfall's bars line up even when daemon clocks
+    drift (span dicts are copied; the source rings stay untouched)."""
     seen: set[int] = set()
     out: list[dict] = []
     for spans in span_lists:
         for s in spans:
             if s["span_id"] not in seen:
                 seen.add(s["span_id"])
+                off = (skew or {}).get(s.get("service"))
+                if off:
+                    s = dict(s, start=s["start"] - off,
+                             end=(s["end"] - off) if s["end"] else 0.0)
                 out.append(s)
     return out
 
@@ -152,13 +169,35 @@ def format_stage_table(stats: dict[str, dict]) -> str:
     return "\n".join(lines)
 
 
-def collect_from_asok(asok_dir: str, trace_id: int,
-                      skip: tuple = ()) -> list[dict]:
+def collect_skew(asok_dir: str) -> dict[str, float]:
+    """Fetch the monitor's per-daemon clock-skew estimates (the
+    ``clock_skew`` mon command, fed by stats-report send stamps) from
+    whichever socket in the directory answers it.  Daemon sockets
+    raise on the unknown verb and are skipped; no mon = no
+    normalization (empty dict)."""
+    from ..utils.admin_socket import admin_request
+    for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
+        try:
+            doc = admin_request(path, "clock_skew")
+        except (OSError, RuntimeError):
+            continue
+        if isinstance(doc, list) and len(doc) == 2 \
+                and isinstance(doc[0], int):
+            # mon command shape: (errno, data)
+            doc = doc[1] if doc[0] == 0 else None
+        if isinstance(doc, dict):
+            return {str(k): float(v) for k, v in doc.items()}
+    return {}
+
+
+def collect_from_asok(asok_dir: str, trace_id: int, skip: tuple = (),
+                      skew: dict | None = None) -> list[dict]:
     """Query every daemon admin socket in the directory for its local
     spans of one trace and merge (the operator-facing collector).
     ``skip`` names socket basenames to leave out — a daemon collecting
     a trace for its own flight recorder already has its local ring and
-    must not round-trip to itself."""
+    must not round-trip to itself.  ``skew`` (service -> seconds, see
+    ``collect_skew``) aligns per-daemon clocks in the merge."""
     from ..utils.admin_socket import admin_request
     dumps = []
     for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
@@ -174,7 +213,41 @@ def collect_from_asok(asok_dir: str, trace_id: int,
             # detail) pair — also a list; only span dicts merge
             dumps.append([s for s in spans
                           if isinstance(s, dict) and "span_id" in s])
-    return merge_spans(dumps)
+    return merge_spans(dumps, skew=skew)
+
+
+def collect_all_traces(asok_dir: str,
+                       skew: dict | None = None) -> list[list[dict]]:
+    """Every COMPLETE trace currently held in the cluster's span rings
+    (the ``--blame`` population): dump each daemon's full ring, merge
+    with skew alignment, group by trace_id, and keep traces whose root
+    span finished — in-flight ops would blame their current stage for
+    time it has not lost yet."""
+    from ..utils.admin_socket import admin_request
+    dumps = []
+    for path in sorted(glob.glob(os.path.join(asok_dir, "*.asok"))):
+        try:
+            spans = admin_request(path, "dump_tracing")
+        except (OSError, RuntimeError):
+            continue
+        if isinstance(spans, list):
+            dumps.append([s for s in spans
+                          if isinstance(s, dict) and "span_id" in s])
+    by_trace: dict[int, list[dict]] = {}
+    for s in merge_spans(dumps, skew=skew):
+        by_trace.setdefault(s["trace_id"], []).append(s)
+    out = []
+    for tid in sorted(by_trace):
+        spans = by_trace[tid]
+        # roots as build_tree sees them: true roots plus orphans whose
+        # parent lives in an uncollected ring (the client tracer has
+        # no admin socket, so its children promote to roots here)
+        ids = {s["span_id"] for s in spans}
+        roots = [s for s in spans
+                 if not s["parent_id"] or s["parent_id"] not in ids]
+        if roots and all(s["end"] for s in roots):
+            out.append(spans)
+    return out
 
 
 def slow_op_report(asok: str, max_ops: int = 0) -> list[dict]:
@@ -215,6 +288,17 @@ def main(argv=None) -> int:
     p.add_argument("--asok-dir",
                    help="directory of daemon *.asok admin sockets")
     p.add_argument("--trace-id", type=int)
+    p.add_argument("--exemplar", type=int, metavar="TRACE_ID",
+                   help="replay an exemplar trace_id (from a histogram "
+                        "bucket / metrics_query): waterfall + the "
+                        "critical-path blocking chain")
+    p.add_argument("--blame", action="store_true",
+                   help="aggregate every complete trace in the span "
+                        "rings into a per-stage critical-path blame "
+                        "table")
+    p.add_argument("--no-skew", action="store_true",
+                   help="skip mon clock-skew normalization of merged "
+                        "span timestamps")
     p.add_argument("--slow-ops", metavar="ASOK",
                    help="an OSD admin socket: print every historic "
                         "slow op with its retained trace waterfall")
@@ -228,20 +312,39 @@ def main(argv=None) -> int:
         else:
             print(format_slow_ops(entries))
         return 0 if entries else 1
-    if not args.asok_dir or args.trace_id is None:
-        p.error("--asok-dir and --trace-id required (or --slow-ops)")
-    spans = collect_from_asok(args.asok_dir, args.trace_id)
+    if args.exemplar is not None and args.trace_id is None:
+        args.trace_id = args.exemplar
+    if not args.asok_dir or (args.trace_id is None and not args.blame):
+        p.error("--asok-dir and --trace-id/--exemplar required "
+                "(or --blame / --slow-ops)")
+    skew = {} if args.no_skew else collect_skew(args.asok_dir)
+    if args.blame:
+        traces = collect_all_traces(args.asok_dir, skew=skew)
+        table = blame(traces)
+        if args.json:
+            print(json.dumps({"traces": len(traces), "blame": table}))
+        else:
+            print(f"blame over {len(traces)} complete traces:")
+            print(format_blame_table(table))
+        return 0 if traces else 1
+    spans = collect_from_asok(args.asok_dir, args.trace_id, skew=skew)
     if not spans:
         print(f"no spans for trace {args.trace_id}", file=sys.stderr)
         return 1
     stats = stage_stats([spans])
+    path = critical_path(spans)
     if args.json:
-        print(json.dumps({"spans": spans, "stages": stats},
-                         default=str))
+        print(json.dumps({"spans": spans, "stages": stats,
+                          "critical_path": path}, default=str))
     else:
         print(waterfall(spans))
         print()
         print(format_stage_table(stats))
+        print()
+        print("critical path (blocking chain, self-time each):")
+        for e in path:
+            print(f"  {e['name']:<24} {e['service']:<10} "
+                  f"{e['self_ms']:>9.3f}ms")
     return 0
 
 

@@ -598,6 +598,32 @@ OPTIONS: list[Option] = [
            "coarse tier (pure fine ring)", min=0.0, max=86400.0,
            see_also=("metrics_history_keep",
                      "metrics_history_interval_s")),
+    # SLO burn-rate health (mgr slo module): latency objectives over
+    # the metrics history, multiwindow burn alerting with exemplars
+    Option("slo_objectives", str, "", OptionLevel.ADVANCED,
+           "comma-separated latency objectives the mgr slo module "
+           "evaluates, '<signal><=<num><us|ms|s>@<pct>%' each (e.g. "
+           "'client_op_p99<=20ms@99%'; signals: client_op, "
+           "qwait_client, qwait_recovery, msg_dispatch, ec_batch_wait, "
+           "or an explicit 'registry_prefix:counter').  Empty = module "
+           "inert",
+           see_also=("slo_fast_window_s", "slo_burn_threshold")),
+    Option("slo_fast_window_s", float, 60.0, OptionLevel.ADVANCED,
+           "fast metrics_query window for SLO burn evaluation (the "
+           "'still happening' half of the multiwindow rule)",
+           min=1.0, max=86400.0,
+           see_also=("slo_slow_window_s", "slo_burn_threshold")),
+    Option("slo_slow_window_s", float, 600.0, OptionLevel.ADVANCED,
+           "slow metrics_query window for SLO burn evaluation (the "
+           "'not a blip' half of the multiwindow rule)",
+           min=1.0, max=86400.0,
+           see_also=("slo_fast_window_s", "slo_burn_threshold")),
+    Option("slo_burn_threshold", float, 2.0, OptionLevel.ADVANCED,
+           "error-budget burn multiple at which SLO_BURN raises: both "
+           "windows must burn at least this many times faster than "
+           "the objective's budget allows (burn 1.0 = spending the "
+           "(1-target) budget exactly)", min=0.1, max=1e6,
+           see_also=("slo_objectives",)),
     Option("mon_clog_persist_interval_s", float, 2.0,
            OptionLevel.ADVANCED,
            "min seconds between journaling the monitor's in-memory "

@@ -36,7 +36,7 @@ import time
 from collections import deque
 
 __all__ = ["MetricsHistory", "MetricsHistoryStore", "counter_delta",
-           "pow2_quantile", "query_samples"]
+           "pow2_quantile", "query_samples", "window_exemplars"]
 
 
 def pow2_quantile(bucket_delta: dict, q: float) -> float:
@@ -93,6 +93,35 @@ def counter_delta(first, last) -> dict:
     return {"delta": max(0.0, _num(last) - _num(first))}
 
 
+def window_exemplars(samples: list[dict], counter: str,
+                     t0: float, t1: float) -> dict:
+    """Per-bucket exemplars whose capture ts falls inside (t0, t1],
+    collected across every snapshot in the window (snapshots carry the
+    reservoir's CURRENT contents, so later snapshots supersede —
+    newest capture wins, deduped by trace_id per bucket)."""
+    out: dict[int, list] = {}
+    for s in samples:
+        c = (s.get("counters") or {}).get(counter)
+        if not isinstance(c, dict):
+            continue
+        for b, exs in (c.get("exemplars") or {}).items():
+            if not isinstance(exs, list):
+                continue
+            bucket = int(b)  # JSON round-trips stringify the key
+            for e in exs:
+                ts = float(e.get("ts", 0.0))
+                if not (t0 < ts <= t1):
+                    continue
+                ring = out.setdefault(bucket, [])
+                tid = e.get("trace_id")
+                ring[:] = [x for x in ring
+                           if x.get("trace_id") != tid]
+                ring.append({"trace_id": tid,
+                             "value": e.get("value"), "ts": ts})
+    return {b: sorted(v, key=lambda e: -e["ts"])
+            for b, v in sorted(out.items())}
+
+
 def query_samples(samples: list[dict], counter: str) -> dict:
     """Delta/rate (+ histogram quantiles) of ``counter`` across a
     window of snapshots (oldest first).  Needs >= 2 samples to
@@ -116,6 +145,13 @@ def query_samples(samples: list[dict], counter: str) -> dict:
         out["buckets_delta"] = dict(d["buckets_delta"])
         out["p50"] = pow2_quantile(d["buckets_delta"], 0.50)
         out["p99"] = pow2_quantile(d["buckets_delta"], 0.99)
+        # bucket exemplars captured inside the window ride along, so a
+        # quantile spike resolves directly to trace_ids — the key is
+        # present only when something was captured (schema parity with
+        # the exemplar-free dump)
+        exs = window_exemplars(rows, counter, out["t0"], out["t1"])
+        if exs:
+            out["exemplars"] = exs
     return out
 
 

@@ -35,8 +35,15 @@ def pow2_bucket(value: float) -> int:
                if value >= 1 else 0)
 
 
+#: exemplars retained per histogram bucket (newest win; the reservoir
+#: is a recency ring, not a uniform sample — a p99 investigation wants
+#: the most recent offending traces, not January's)
+EXEMPLAR_KEEP = 4
+
+
 class _Counter:
-    __slots__ = ("name", "type", "desc", "value", "sum", "count", "buckets")
+    __slots__ = ("name", "type", "desc", "value", "sum", "count", "buckets",
+                 "exemplars")
 
     def __init__(self, name: str, ctype: CounterType, desc: str):
         self.name = name
@@ -46,6 +53,10 @@ class _Counter:
         self.sum = 0.0
         self.count = 0
         self.buckets = [0] * 64 if ctype == CounterType.HISTOGRAM else None
+        # bucket -> deque[(trace_id, value, ts)]; lazily allocated on
+        # the first SAMPLED observation so unsampled histograms carry
+        # zero exemplar state
+        self.exemplars = None
 
 
 class PerfCounters:
@@ -110,13 +121,28 @@ class PerfCounters:
 
         return _Timer()
 
-    def hinc(self, name: str, value: float) -> None:
+    def hinc(self, name: str, value: float, exemplar=None) -> None:
+        """Record one histogram observation.  ``exemplar`` is an
+        optional trace_id linking this observation to a SAMPLED
+        distributed trace: when given, the (trace_id, value, ts)
+        triple joins the bucket's small recency reservoir so a later
+        p99 spike resolves to concrete waterfalls.  The ``exemplar is
+        None`` path (unsampled ops, rate 0) allocates nothing and
+        touches no exemplar state."""
         c = self._get(name)
         b = pow2_bucket(value)
         with self._lock:
             c.buckets[b] += 1
             c.count += 1
             c.sum += value
+            if exemplar is not None:
+                ex = c.exemplars
+                if ex is None:
+                    ex = c.exemplars = {}
+                ring = ex.get(b)
+                if ring is None:
+                    ring = ex[b] = deque(maxlen=EXEMPLAR_KEEP)
+                ring.append((int(exemplar), value, time.time()))
 
     def avg(self, name: str) -> float:
         c = self._get(name)
@@ -149,8 +175,19 @@ class PerfCounters:
                     # (zeroed) series per histogram even before any
                     # sample lands — and can derive a mean rate
                     nz = {i: v for i, v in enumerate(c.buckets) if v}
-                    out[n] = {"buckets_pow2": nz, "count": c.count,
-                              "sum": c.sum}
+                    d = {"buckets_pow2": nz, "count": c.count,
+                         "sum": c.sum}
+                    # exemplars key appears ONLY when a reservoir holds
+                    # something: the no-exemplar dump shape (and hence
+                    # the exporter's classic exposition) stays
+                    # byte-identical to the pre-exemplar schema
+                    if c.exemplars:
+                        d["exemplars"] = {
+                            b: [{"trace_id": t, "value": v, "ts": ts}
+                                for t, v, ts in ring]
+                            for b, ring in sorted(c.exemplars.items())
+                            if ring}
+                    out[n] = d
         return out
 
 

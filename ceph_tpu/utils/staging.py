@@ -76,27 +76,29 @@ def stage_perf() -> PerfCounters:
     return pc
 
 
-def note_h2d(nbytes: int, seconds: float | None = None) -> None:
+def note_h2d(nbytes: int, seconds: float | None = None,
+             exemplar=None) -> None:
     """``seconds=None`` books bytes + the copy count but NOT latency:
     an unforced ``device_put`` on an async backend returns at dispatch,
     so timing it would pollute the histogram (and any bandwidth
-    derived from it) with numbers far above the real transfer."""
+    derived from it) with numbers far above the real transfer.
+    ``exemplar`` is the staging op's sampled trace_id (or None)."""
     pc = stage_perf()
     pc.inc("ec_stage_h2d_bytes", int(nbytes))
     pc.inc("ec_stage_h2d_copies")
     if seconds is not None:
-        pc.hinc("ec_stage_h2d_us", seconds * 1e6)
+        pc.hinc("ec_stage_h2d_us", seconds * 1e6, exemplar=exemplar)
 
 
-def note_d2h(nbytes: int, seconds: float) -> None:
+def note_d2h(nbytes: int, seconds: float, exemplar=None) -> None:
     pc = stage_perf()
     pc.inc("ec_stage_d2h_bytes", int(nbytes))
     pc.inc("ec_stage_d2h_copies")
-    pc.hinc("ec_stage_d2h_us", seconds * 1e6)
+    pc.hinc("ec_stage_d2h_us", seconds * 1e6, exemplar=exemplar)
 
 
 def device_put_landed(host: np.ndarray, *, force: bool = True,
-                      record: bool = True):
+                      record: bool = True, exemplar=None):
     """Stage a host buffer to the default device and (optionally) force
     it to actually LAND — a one-element fetch, because over the axon
     tunnel ``block_until_ready`` returns before the transfer completes
@@ -117,7 +119,8 @@ def device_put_landed(host: np.ndarray, *, force: bool = True,
         # an async backend times DISPATCH, not the copy
         dt = (time.perf_counter() - t0
               if force or backend_is_cpu() else None)
-        note_h2d(getattr(host, "nbytes", len(host)), dt)
+        note_h2d(getattr(host, "nbytes", len(host)), dt,
+                 exemplar=exemplar)
     return dev
 
 

@@ -73,11 +73,16 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, history_size: int = 256, slow_op_seconds: float = 5.0,
-                 on_slow=None):
+                 on_slow=None, perf=None, lat_counter: str = "op_lat_us"):
         """``on_slow(op)`` fires at most once per op, OUTSIDE the
         tracker lock, the first time the op is seen past the complaint
         threshold (at finish, or mid-flight from note_inflight_slow) —
-        the daemon's hook for journaling the ``slow_op`` event."""
+        the daemon's hook for journaling the ``slow_op`` event.
+
+        ``perf``/``lat_counter`` name a pow2 histogram every finished
+        op's end-to-end latency lands in (the SLO ``client_op``
+        signal); sampled-trace ops attach their trace_id as the bucket
+        exemplar so the p99 bucket resolves to waterfalls."""
         self._ids = itertools.count(1)
         self._inflight: dict[int, TrackedOp] = {}
         self._history: collections.deque[dict] = collections.deque(
@@ -85,7 +90,16 @@ class OpTracker:
         self._slow_threshold = slow_op_seconds
         self._slow_count = 0
         self._on_slow = on_slow
+        self._perf = perf
+        self._lat_counter = lat_counter
         self._lock = threading.Lock()
+
+    def bind_perf(self, perf, lat_counter: str | None = None) -> None:
+        """Late-bind the latency registry (the daemon builds its
+        tracker before its perf registry exists)."""
+        self._perf = perf
+        if lat_counter is not None:
+            self._lat_counter = lat_counter
 
     def create(self, desc: str, span=None) -> TrackedOp:
         op = TrackedOp(self, next(self._ids), desc, span=span)
@@ -114,11 +128,18 @@ class OpTracker:
 
     def _finish(self, op: TrackedOp) -> None:
         newly_slow = False
+        age = op.age()
         with self._lock:
             self._inflight.pop(op.op_id, None)
-            if op.age() >= self._slow_threshold:
+            if age >= self._slow_threshold:
                 newly_slow = self._note_slow(op)
             self._history.append(op.dump())
+        if self._perf is not None:
+            span = op.span
+            self._perf.hinc(
+                self._lat_counter, age * 1e6,
+                exemplar=span.trace_id
+                if span is not None and span.sampled else None)
         if newly_slow:
             self._retain_trace(op)
             if self._on_slow is not None:

@@ -662,6 +662,216 @@ def test_sampling_off_zero_tracer_cost(tmp_path):
             assert osd.perf.get("trace_dropped") == 0
     finally:
         c.stop()
+    # stop() retires the daemons' registries from the global
+    # collection, so a later same-process cluster (the next test)
+    # starts from zeroed counters instead of inheriting these
+    from ceph_tpu.utils.perf import global_perf
+    live = global_perf().registries()
+    assert not any(n in live for n in ("osd.0", "osd.1", "osd.2"))
+
+
+def test_counter_schema_lint_one_strict_scrape(obs_cluster):
+    """The counter-schema lint: EVERY counter of every live registry
+    (daemons, messengers, stores, the kernel profiler) renders in ONE
+    strict scrape with its documented exporter faces — zeroed schema
+    included (the exporter emits a histogram's +Inf bucket and
+    sum/count at zero samples).  A counter registered but dropped by
+    the renderer — or renamed on one side only — fails here, not on a
+    dashboard weeks later."""
+    from ceph_tpu.mon.exporter import _sanitize
+    from ceph_tpu.utils.perf import global_perf
+
+    c, _ = obs_cluster
+    # enumerate BEFORE the scrape: anything registered by then must
+    # render (late registrants after this snapshot are out of scope)
+    expected = {daemon: reg.dump()
+                for daemon, reg in global_perf().registries().items()}
+    assert expected, "no live registries to lint"
+    conn = http.client.HTTPConnection("127.0.0.1", c.exporter.port,
+                                      timeout=5)
+    conn.request("GET", "/metrics")
+    body = conn.getresponse().read().decode()
+    conn.close()
+    parsed = _parse_exposition_strict(body)
+
+    def assert_series(family: str, daemon: str, cname: str,
+                      extra: str = ""):
+        fam = parsed.get(family)
+        assert fam is not None, \
+            f"{daemon}:{cname}: family {family} missing from the scrape"
+        assert any(f'daemon="{daemon}"' in s and extra in s
+                   for s in fam["samples"]), \
+            f"{daemon}:{cname}: no {family}{{{extra}}} series"
+
+    checked = 0
+    for daemon, counters in expected.items():
+        for cname, val in counters.items():
+            base = f"ceph_tpu_daemon_{_sanitize(cname)}"
+            if isinstance(val, dict):
+                for sub in ("sum", "count", "sum_seconds"):
+                    if sub in val:
+                        assert_series(f"{base}_{sub}", daemon, cname)
+                if "buckets_pow2" in val:
+                    # the zeroed-schema contract: +Inf exists even for
+                    # an empty histogram
+                    assert_series(f"{base}_bucket", daemon, cname,
+                                  extra='le="+Inf"')
+            else:
+                assert_series(base, daemon, cname)
+            checked += 1
+    # the lint actually covered the fleet: four OSDs' worth of
+    # registries plus messenger/kernel planes
+    assert checked > 100, f"suspiciously few counters linted: {checked}"
+    assert len(expected) >= 5, sorted(expected)
+
+
+def test_exemplar_blame_slo_burn_end_to_end(tmp_path, capsys):
+    """ISSUE 18 acceptance, end to end on a live cluster: an injected
+    stall's op lands an exemplar in its latency bucket; ``metrics_query``
+    on the mon surfaces the trace_id; ``trace_tool --exemplar`` resolves
+    it to a merged skew-aligned waterfall whose critical path blames the
+    stalled stage; the SLO mgr module raises ``SLO_BURN`` carrying that
+    trace_id in the health detail and journals the transition; the
+    check clears on its own once the stall stops and the fast window
+    drains."""
+    from ceph_tpu.mon.mgr import MgrDaemon
+    from ceph_tpu.tools import trace_tool
+    from ceph_tpu.utils.critical_path import critical_path
+
+    cfg = make_cfg(trace_sample_rate=1.0, osd_op_complaint_time=0.08,
+                   metrics_history_interval_s=0.1,
+                   slo_objectives="client_op_p99<=20ms@99%",
+                   slo_fast_window_s=5.0, slo_slow_window_s=30.0,
+                   slo_burn_threshold=2.0)
+    c = MiniCluster(n_osds=4, cfg=cfg,
+                    admin_dir=str(tmp_path / "asok")).start()
+    mgr = None
+    try:
+        client = c.client()
+        client.create_pool("p", kind="ec", pg_num=1,
+                           ec_profile={"plugin": "jerasure", "k": "2",
+                                       "m": "1", "backend": "numpy"})
+        client.write_full("p", "obj", b"a" * 4096)
+        pool_id = next(pid for pid, p in c.mon.osdmap.pools.items()
+                       if p.name == "p")
+        seed = c.mon.osdmap.object_to_pg(pool_id, "obj")
+        primary = next(o for o in
+                       c.mon.osdmap.pg_to_up_osds(pool_id, seed)
+                       if o is not None)
+        posd = c.osds[primary]
+        orig = posd._ec_write
+
+        def stalled(*a, **kw):
+            time.sleep(0.2)  # >> the 20ms objective threshold
+            return orig(*a, **kw)
+
+        posd._ec_write = stalled
+        try:
+            client.write_full("p", "obj", b"b" * 8192)
+        finally:
+            posd._ec_write = orig
+        asok_dir = str(tmp_path / "asok")
+        mon_asok = str(tmp_path / "asok" / "mon.0.asok")
+        reg = f"osd.{primary}"
+
+        # 1) the stalled op's bucket exemplar via the mon metrics_query
+        # (bucket hi > 100ms: only the injected stall lives up there)
+        tid = None
+        deadline = time.time() + 25
+        while time.time() < deadline and tid is None:
+            res, data = admin_request(mon_asok, "metrics_query",
+                                      registry=reg,
+                                      counter="op_lat_us", since_s=60.0)
+            assert res == 0, data
+            for b, ring in sorted(
+                    (data.get("exemplars") or {}).items(),
+                    key=lambda kv: -int(kv[0])):
+                if 2.0 ** int(b) > 100_000.0 and ring:
+                    tid = int(ring[0]["trace_id"])
+                    break
+            if tid is None:
+                time.sleep(0.05)
+        assert tid is not None, "stall exemplar never reached the mon"
+
+        # 2) trace_tool --exemplar: the trace_id resolves to a merged,
+        # skew-aligned waterfall crossing daemons
+        skew = trace_tool.collect_skew(asok_dir)
+        assert reg in skew  # the mon has a skew estimate per reporter
+        spans = trace_tool.collect_from_asok(asok_dir, tid, skew=skew)
+        assert spans, "exemplar trace_id resolved to no spans"
+        assert any(s["name"].startswith("osd-op") for s in spans)
+        assert reg in {s["service"] for s in spans}
+        assert trace_tool.main(
+            ["--asok-dir", asok_dir, "--exemplar", str(tid)]) == 0
+        out = capsys.readouterr().out
+        assert "critical path" in out and "osd-op" in out
+
+        # 3) the critical path blames the stalled stage: the injected
+        # sleep is the osd-op span's own (un-childed) time
+        cp = critical_path(spans)
+        top = max(cp, key=lambda e: e["self_ms"])
+        assert top["name"].startswith("osd-op"), cp
+        assert top["service"] == reg
+        assert top["self_ms"] >= 150.0, cp
+        assert trace_tool.main(
+            ["--asok-dir", asok_dir, "--blame", "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["traces"] >= 1
+        # the stage owning the most blocked time cluster-wide is the
+        # stalled op dispatch
+        assert next(iter(doc["blame"])).startswith("osd-op")
+
+        # 4) SLO_BURN raises with the exemplar trace_id in the detail
+        mgr = MgrDaemon(c.mon, modules=("slo",))
+        slo = mgr.module("slo")
+        check = None
+        deadline = time.time() + 25
+        while time.time() < deadline:
+            slo.tick()
+            checks = client.status().get("checks", {})
+            if "SLO_BURN" in checks:
+                check = checks["SLO_BURN"]
+                break
+            time.sleep(0.1)
+        assert check, "SLO_BURN never raised"
+        assert check["severity"] == "HEALTH_WARN"
+        detail = "\n".join(check["detail"])
+        assert "client_op_p99<=20ms@99%" in detail
+        assert str(tid) in detail, \
+            f"exemplar trace {tid} not in detail: {detail}"
+        # ...and the raise is journaled on the slo channel with the
+        # exemplar trace ids
+        res, data = admin_request(mon_asok, "dump_cluster_log",
+                                  channel="slo")
+        assert res == 0
+        raised = [e for e in data["events"]
+                  if "SLO_BURN raised" in e["message"]]
+        assert raised
+        assert str(tid) in raised[-1]["fields"]["exemplar_trace_ids"]
+
+        # 5) the stall is over: good traffic refills the fast window,
+        # the burn drops, the check clears and journals the clear
+        cleared = False
+        deadline = time.time() + 30
+        i = 0
+        while time.time() < deadline:
+            client.write_full("p", f"g{i}", b"c" * 1024)
+            i += 1
+            slo.tick()
+            if "SLO_BURN" not in client.status().get("checks", {}):
+                cleared = True
+                break
+            time.sleep(0.2)
+        assert cleared, "SLO_BURN never cleared after the stall"
+        res, data = admin_request(mon_asok, "dump_cluster_log",
+                                  channel="slo")
+        assert res == 0
+        assert any("SLO_BURN cleared" in e["message"]
+                   for e in data["events"])
+    finally:
+        if mgr is not None:
+            mgr.stop()
+        c.stop()
 
 
 def test_batch_thrash_health_warn_appears_and_clears(tmp_path):

@@ -76,6 +76,12 @@ def test_rules_reference_only_emitted_metrics():
     # ec_read_tier_* rate rules — registered zeroed at OSD boot)
     from ceph_tpu.osd.extent_cache import register_read_scaleout_counters
     register_read_scaleout_counters(qos_probe)
+    # the exemplar-era op-path histograms (op_lat_us from the
+    # OpTracker bind, ec_batch_{wait,flush}_us from the batcher) —
+    # registered zeroed at daemon/batcher construction
+    from ceph_tpu.utils.perf import CounterType
+    for h in ("op_lat_us", "ec_batch_wait_us", "ec_batch_flush_us"):
+        qos_probe.add(h, CounterType.HISTOGRAM)
     Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
     import time as _time
     store = MetricsHistoryStore()
@@ -101,11 +107,11 @@ def test_rules_shape_and_rendering():
     rules = recording_rules()
     # one rule per (histogram, quantile) + one rate rule per tracer /
     # messenger-copy / kv-maintenance / read-scale-out counter + the
-    # staleness max, records namespaced
-    assert len(rules) == 51
+    # SLO bad-fraction ratio + the staleness max, records namespaced
+    assert len(rules) == 59
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
-    assert len(hist) == 28
+    assert len(hist) == 34
     assert all("by (daemon, le)" in r["expr"] for r in hist)
     quantiles = {r["record"].rsplit(":", 1)[1] for r in hist}
     assert quantiles == {"p50", "p99"}
@@ -133,6 +139,7 @@ def test_rules_shape_and_rendering():
         "ceph_tpu:daemon_balanced_read_serve:rate5m",
         "ceph_tpu:daemon_balanced_read_bounce:rate5m",
         "ceph_tpu:daemon_read_lease_grant:rate5m",
+        "ceph_tpu:daemon_read_lease_ride:rate5m",
         "ceph_tpu:daemon_read_lease_revoke:rate5m",
         "ceph_tpu:daemon_ec_read_tier_hit:rate5m",
         "ceph_tpu:daemon_ec_read_tier_miss:rate5m",
@@ -144,10 +151,19 @@ def test_rules_shape_and_rendering():
              if r["record"] == "ceph_tpu:metrics_history_staleness_s:max"]
     assert len(stale) == 1
     assert stale[0]["expr"] == "max(ceph_tpu_metrics_history_staleness_s)"
+    # the SLO_BURN-aligned bad-fraction ratio: observations over the
+    # bucket bound as a fraction of all (slo/objectives.py's
+    # bad_fraction in PromQL; burn = ratio / (1 - target))
+    slo = [r for r in rules if r["record"].startswith("ceph_tpu:slo_")]
+    assert len(slo) == 1
+    assert slo[0]["record"] == "ceph_tpu:slo_client_op_bad:ratio_rate5m"
+    assert 'le="16384"' in slo[0]["expr"] \
+        and 'le="+Inf"' in slo[0]["expr"] \
+        and "ceph_tpu_daemon_op_lat_us_bucket" in slo[0]["expr"]
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 51
-    assert text.count("    expr: ") == 51
+    assert text.count("  - record: ") == 59
+    assert text.count("    expr: ") == 59
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms

@@ -33,6 +33,108 @@ def test_merge_spans_dedups():
     assert len(merged) == len(spans)
 
 
+def test_merge_spans_skew_normalizes_clocks():
+    """The clock-skew satellite: a daemon whose wall clock runs fast
+    has its spans shifted back onto the monitor's clock in the merge;
+    services without an estimate (and in-flight end=0 sentinels) stay
+    untouched, and the source dicts are never mutated."""
+    spans = _trace()
+    ahead = [dict(s, service="osd.9", span_id=s["span_id"] + 100,
+                  start=s["start"] + 0.5,
+                  end=(s["end"] + 0.5) if s["end"] else 0.0)
+             for s in spans]
+    ahead[3] = dict(ahead[3], end=0.0, in_flight=True)
+    before = [dict(s) for s in ahead]
+    merged = merge_spans([spans, ahead], skew={"osd.9": 0.5})
+    by_id = {s["span_id"]: s for s in merged}
+    for orig in spans:
+        shifted = by_id[orig["span_id"] + 100]
+        assert abs(shifted["start"] - orig["start"]) < 1e-9
+        if shifted.get("in_flight"):
+            assert shifted["end"] == 0.0  # sentinel survives the shift
+        else:
+            assert abs(shifted["end"] - orig["end"]) < 1e-9
+        # the un-skewed service is untouched
+        assert by_id[orig["span_id"]]["start"] == orig["start"]
+    assert ahead == before  # sources copied, not mutated
+
+
+def test_critical_path_partitions_root_wall_time():
+    """The blocking chain: per-stage self-times along the path sum to
+    the root's wall time, and a child leaking past its parent (the
+    flush runs after its wait parent ends) is clamped out rather than
+    double-counted."""
+    from ceph_tpu.utils.critical_path import critical_path
+    cp = critical_path(_trace())
+    by_name = {e["name"]: e for e in cp}
+    # op self = 10 - encode's 6; encode self = 6 - wait's 3; the wait's
+    # flush child lies entirely past the wait's end -> wait owns its 3
+    assert abs(by_name["osd-op write"]["self_ms"] - 4.0) < 1e-3
+    assert abs(by_name["ec-encode"]["self_ms"] - 3.0) < 1e-3
+    assert abs(by_name["ec-batch-wait"]["self_ms"] - 3.0) < 1e-3
+    assert "ec-flush" not in by_name  # clamped off the chain
+    assert abs(sum(e["self_ms"] for e in cp) - 10.0) < 1e-3
+    # chronological order (start-time ties keep the deeper span first
+    # — the sort is stable over the walk's child-first appends)
+    assert cp[0]["name"] == "osd-op write"
+    assert {e["name"] for e in cp[1:]} == {"ec-encode", "ec-batch-wait"}
+    assert all(e["service"] == "osd.0" for e in cp)
+    assert critical_path([]) == []
+
+
+def test_critical_path_gap_blames_parent_not_sibling():
+    """Two sequential children with a gap between them: the gap is the
+    PARENT's critical-path self-time (it was the one not running
+    anything), and a concurrent sibling overlapping the chain
+    contributes nothing."""
+    from ceph_tpu.utils.critical_path import blame, critical_path
+    t0 = 100.0
+    spans = [
+        _span(1, 0, "osd-op write", t0, t0 + 0.010),
+        _span(2, 1, "stage-a", t0 + 0.001, t0 + 0.004),
+        _span(3, 1, "stage-b", t0 + 0.006, t0 + 0.010),
+        # concurrent with stage-b, ends earlier: not blocking
+        _span(4, 1, "shadow", t0 + 0.006, t0 + 0.008),
+    ]
+    by_name = {e["name"]: e for e in critical_path(spans)}
+    # parent: [0,1) before stage-a + the (4,6) gap = 3ms
+    assert abs(by_name["osd-op write"]["self_ms"] - 3.0) < 1e-3
+    assert abs(by_name["stage-a"]["self_ms"] - 3.0) < 1e-3
+    assert abs(by_name["stage-b"]["self_ms"] - 4.0) < 1e-3
+    assert "shadow" not in by_name
+    # blame aggregates shares over many traces
+    table = blame([spans, _trace()])
+    assert table["osd-op write"]["count"] == 2
+    assert abs(table["osd-op write"]["self_total_ms"] - 7.0) < 1e-3
+    grand = sum(s["self_total_ms"] for s in table.values())
+    assert abs(sum(s["share"] for s in table.values()) - 1.0) < 0.01
+    assert abs(grand - 20.0) < 1e-2  # both roots fully attributed
+
+
+def test_critical_path_in_flight_span_owns_its_age():
+    """A hung stage (end=0, dur_ms = its age at dump time) owns its
+    elapsed time on the path instead of vanishing."""
+    from ceph_tpu.utils.critical_path import critical_path
+    t0 = 100.0
+    spans = [
+        _span(1, 0, "osd-op write", t0, t0 + 0.010),
+        dict(_span(2, 1, "stuck-stage", t0 + 0.002, 0.0),
+             end=0.0, in_flight=True, dur_ms=8.0),
+    ]
+    by_name = {e["name"]: e for e in critical_path(spans)}
+    assert abs(by_name["stuck-stage"]["self_ms"] - 8.0) < 1e-3
+    assert abs(by_name["osd-op write"]["self_ms"] - 2.0) < 1e-3
+
+
+def test_format_blame_table_renders():
+    from ceph_tpu.utils.critical_path import blame, format_blame_table
+    out = format_blame_table(blame([_trace()]))
+    lines = out.splitlines()
+    assert "self_total" in lines[0] and "share" in lines[0]
+    # biggest owner of blocked time leads
+    assert lines[2].startswith("osd-op write")
+
+
 def test_self_times_subtract_children():
     rows = {r["name"]: r for r in self_times(_trace())}
     assert abs(rows["osd-op write"]["dur_ms"] - 10.0) < 1e-3
