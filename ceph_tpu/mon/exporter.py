@@ -119,6 +119,30 @@ def render_metrics(mon=None, openmetrics: bool = False) -> str:
                 emit("progress_percent", pct, {"item": item_id},
                      help_="recovery/backfill progress percent "
                            "(mgr progress item)", typ="gauge")
+        # perf-query AGGREGATES only, labeled by query id: the scrape
+        # surface is bounded by the number of standing queries, never
+        # by the key cardinality inside them (a hostile tenant-name
+        # churn grows a query's overflow fold, not the exporter) —
+        # named rows live behind `perf query report` / top_tool
+        pq = getattr(mon, "perf_queries", None)
+        if pq is not None:
+            for qid, a in sorted(pq.aggregates().items()):
+                lab = {"query": str(qid)}
+                emit("perf_query_ops_total", a["ops"], lab,
+                     help_="total ops matched by the standing perf "
+                           "query (all keys + overflow)",
+                     typ="counter")
+                emit("perf_query_bytes_total",
+                     a["bytes_in"] + a["bytes_out"], lab,
+                     help_="total bytes moved under the standing perf "
+                           "query", typ="counter")
+                emit("perf_query_keys", a["keys"], lab,
+                     help_="distinct named keys currently tracked "
+                           "(top-N bounded)", typ="gauge")
+                emit("perf_query_overflow_ops", a["overflow_ops"],
+                     lab,
+                     help_="ops folded into the overflow bucket past "
+                           "the query's top-N bound", typ="counter")
     # per-daemon perf counters (the MMgrReport/DaemonMetricCollector feed)
     for daemon, reg in sorted(global_perf().registries().items()):
         counters = reg.dump()

@@ -96,11 +96,31 @@ class OsdInfo(Encodable):
         return dec.versioned(cls.VERSION, body)
 
 
+def _enc_pq_spec(e: Encoder, qid: int, spec: dict) -> None:
+    """One perf-query spec on the wire (shared by the full map's v5
+    tail and the incremental's v3 tail): explicit scalar fields, no
+    pickled dicts."""
+    e.u64(int(qid))
+    e.seq([str(k) for k in spec.get("key_by", ())], Encoder.string)
+    e.seq([str(c) for c in spec.get("counters", ())], Encoder.string)
+    e.u32(int(spec.get("top_n", 32)))
+    e.u32(int(spec.get("prefix_len", 8)))
+
+
+def _dec_pq_spec(d: Decoder) -> tuple[int, dict]:
+    qid = d.u64()
+    return qid, {"qid": qid,
+                 "key_by": d.seq(Decoder.string),
+                 "counters": d.seq(Decoder.string),
+                 "top_n": d.u32(),
+                 "prefix_len": d.u32()}
+
+
 class OSDMapIncremental(Encodable):
     """One epoch's worth of map change (OSDMap::Incremental,
     src/osd/OSDMap.h): changed records only, applied in epoch order."""
 
-    VERSION, COMPAT = 2, 1
+    VERSION, COMPAT = 3, 1
 
     def __init__(self, base_epoch: int = 0, new_epoch: int = 0):
         self.base_epoch = base_epoch
@@ -118,6 +138,10 @@ class OSDMapIncremental(Encodable):
         # v2 tail: tenant QoS profile changes (qos/profiles.py)
         self.qos_set: dict[str, dict] = {}   # name -> {res, wgt, lim}
         self.qos_rm: list[str] = []
+        # v3 tail: dynamic perf-query changes (telemetry/perf_query):
+        # qid -> spec dict (PerfQuerySpec.to_dict shape)
+        self.pq_set: dict[int, dict] = {}
+        self.pq_rm: list[int] = []
 
     def encode(self, enc: Encoder) -> None:
         def kv_list(e, items, val_enc):
@@ -153,6 +177,10 @@ class OSDMapIncremental(Encodable):
                                   ee.f64(float(kv[1].get("lim",
                                                          0.0)))))
             e.seq(sorted(self.qos_rm), Encoder.string)
+            # v3 tail: perf-query deltas
+            e.seq(sorted(self.pq_set.items()),
+                  lambda ee, kv: _enc_pq_spec(ee, kv[0], kv[1]))
+            e.seq(sorted(self.pq_rm), Encoder.u64)
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -187,6 +215,9 @@ class OSDMapIncremental(Encodable):
                                          "lim": dd.f64()}
                 inc.qos_set = dict(d.seq(qos_item))
                 inc.qos_rm = d.seq(Decoder.string)
+            if v >= 3:
+                inc.pq_set = dict(d.seq(_dec_pq_spec))
+                inc.pq_rm = d.seq(Decoder.u64)
             return inc
         return dec.versioned(cls.VERSION, body)
 
@@ -213,7 +244,7 @@ def apply_map_push(current, msg):
 class OSDMap(Encodable):
     """Epoch-versioned cluster map; placement is a pure function of it."""
 
-    VERSION, COMPAT = 4, 1
+    VERSION, COMPAT = 5, 1
 
     def __init__(self):
         self.epoch = 0
@@ -225,6 +256,11 @@ class OSDMap(Encodable):
         # like pool options — the mon commits `osd qos set-profile`
         # here, every OSD converges its scheduler on the next push
         self.qos_profiles: dict[str, dict] = {}
+        # dynamic perf queries (telemetry/perf_query): qid -> spec
+        # dict, distributed exactly like qos_profiles — the mon
+        # commits `perf query add/rm`, every OSD converges its
+        # PerfQuerySet on the next push
+        self.perf_queries: dict[int, dict] = {}
         # explicit placement overrides (the pg_upmap/read-balancer
         # machinery, ref OSDMap.cc upmap handling): (pool, seed) -> osds
         self.pg_upmap: dict[tuple[int, int], list[int]] = {}
@@ -403,6 +439,11 @@ class OSDMap(Encodable):
                 inc.qos_set[name] = dict(prof)
         inc.qos_rm = [n for n in old.qos_profiles
                       if n not in self.qos_profiles]
+        for qid, spec in self.perf_queries.items():
+            if old.perf_queries.get(qid) != spec:
+                inc.pq_set[qid] = dict(spec)
+        inc.pq_rm = [q for q in old.perf_queries
+                     if q not in self.perf_queries]
         return inc
 
     def apply_incremental(self, inc: "OSDMapIncremental") -> None:
@@ -431,6 +472,10 @@ class OSDMap(Encodable):
             self.qos_profiles[name] = dict(prof)
         for name in getattr(inc, "qos_rm", ()):
             self.qos_profiles.pop(name, None)
+        for qid, spec in getattr(inc, "pq_set", {}).items():
+            self.perf_queries[qid] = dict(spec)
+        for qid in getattr(inc, "pq_rm", ()):
+            self.perf_queries.pop(qid, None)
         self.epoch = inc.new_epoch
 
     def up_osds(self) -> list[int]:
@@ -466,6 +511,9 @@ class OSDMap(Encodable):
                                   ee.f64(float(kv[1].get("wgt", 1.0))),
                                   ee.f64(float(kv[1].get("lim",
                                                          0.0)))))
+            # v5 tail: dynamic perf queries
+            e.seq(sorted(self.perf_queries.items()),
+                  lambda ee, kv: _enc_pq_spec(ee, kv[0], kv[1]))
         enc.versioned(self.VERSION, self.COMPAT, body)
 
     @classmethod
@@ -500,5 +548,8 @@ class OSDMap(Encodable):
                                          "lim": dd.f64()}
                 for name, prof in d.seq(qos_item):
                     m.qos_profiles[name] = prof
+            if v >= 5:
+                for qid, spec in d.seq(_dec_pq_spec):
+                    m.perf_queries[qid] = spec
             return m
         return dec.versioned(cls.VERSION, body)

@@ -364,6 +364,35 @@ class MetricsModule(MgrModule):
         raise KeyError(cmd)
 
 
+@register_module("perf_query")
+class PerfQueryModule(MgrModule):
+    """Operator face of the dynamic perf-query subsystem: queries
+    themselves are OSDMap state (mon ``perf query add/rm/ls``) and the
+    per-daemon partials merge monitor-side into the PerfQueryStore as
+    stats reports land — this module is the read surface ``top_tool``
+    polls (``report``), like the metrics module is for the history
+    store."""
+
+    def command(self, cmd: str, **kw):
+        mon = self.mgr.mon
+        store = getattr(mon, "perf_queries", None)
+        if store is None:
+            return {"queries": {}, "reporting": []}
+        if cmd == "ls":
+            with mon._lock:
+                queries = {str(q): dict(spec) for q, spec in sorted(
+                    getattr(mon.osdmap, "perf_queries", {}).items())}
+            return {"queries": queries, "reporting": store.daemons()}
+        if cmd == "report":
+            qid = int(kw["qid"])
+            with mon._lock:
+                if qid not in getattr(mon.osdmap, "perf_queries", {}):
+                    raise KeyError(f"no perf query {qid}")
+            return store.report(qid, sort=kw.get("sort", "ops"),
+                                limit=int(kw.get("limit", 0) or 0))
+        raise KeyError(cmd)
+
+
 @register_module("qos")
 class QosModule(MgrModule):
     """The adaptive recovery-reservation controller's host (the
@@ -409,7 +438,9 @@ class QosModule(MgrModule):
             p99_high_us=cfg["qos_controller_p99_high_ms"] * 1e3,
             hold=cfg["qos_controller_hold_ticks"],
             cooldown=cfg["qos_controller_cooldown_ticks"],
-            lim_factor=cfg["qos_recovery_lim_factor"])
+            lim_factor=cfg["qos_recovery_lim_factor"],
+            burn_high=cfg["qos_controller_burn_high"],
+            burn_low=cfg["qos_controller_burn_low"])
         return ReservationController(knobs, res0=res0)
 
     # ------------------------------------------------------------ sensing
@@ -463,6 +494,41 @@ class QosModule(MgrModule):
             else False
         return backlog, active
 
+    def _slo_burn_fast(self) -> float | None:
+        """Worst fast-window SLO burn across configured objectives —
+        the ``qos_controller_sense=slo`` signal.  Prefers the slo
+        module's last evaluation (same tick cadence, already paid
+        for); falls back to evaluating directly when that module is
+        not enabled.  None until real observations exist, which
+        ``observe_burn`` treats like quiet."""
+        results = None
+        slo = self.mgr._modules.get("slo")
+        if slo is not None and getattr(slo, "last", None):
+            results = slo.last
+        else:
+            store = getattr(self.mgr.mon, "metrics_history", None)
+            cfg = self.mgr.mon.cfg
+            if store is None:
+                return None
+            from ..slo.objectives import (evaluate_objective,
+                                          parse_objectives)
+            try:
+                objs = parse_objectives(str(cfg["slo_objectives"]))
+            except ValueError:
+                return None
+            results = [evaluate_objective(o, store,
+                                          cfg["slo_fast_window_s"],
+                                          cfg["slo_slow_window_s"])
+                       for o in objs]
+        worst = None
+        for r in results or []:
+            if r["fast"]["observations"] <= 0:
+                continue
+            b = float(r["fast"]["burn"])
+            if worst is None or b > worst:
+                worst = b
+        return worst
+
     # ----------------------------------------------------------- the loop
     def tick(self) -> None:
         cfg = self.mgr.mon.cfg
@@ -472,7 +538,12 @@ class QosModule(MgrModule):
             self._ctl = self._make_controller(None)
         p99 = self._client_p99_us()
         backlog, active = self._recovery_state()
-        move = self._ctl.observe(p99, backlog, active)
+        if cfg["qos_controller_sense"] == "slo":
+            burn = self._slo_burn_fast()
+            move = self._ctl.observe_burn(burn, backlog, active,
+                                          p99_us=p99)
+        else:
+            move = self._ctl.observe(p99, backlog, active)
         if move is None:
             return
         res, lim = move
@@ -486,12 +557,15 @@ class QosModule(MgrModule):
             f"{res:g}/{lim:g} ops/s",
             reason=last.reason, res=float(res), lim=float(lim),
             p99_us=float(p99) if p99 is not None else -1.0,
-            backlog=int(backlog)))
+            backlog=int(backlog),
+            **({"burn": float(last.burn)}
+               if last.burn is not None else {})))
 
     def command(self, cmd: str, **kw):
         if cmd == "status":
             return {"enabled":
                     self.mgr.mon.cfg["qos_controller"] == "on",
+                    "sense": self.mgr.mon.cfg["qos_controller_sense"],
                     "bound": self._apply is not None,
                     "controller": (self._ctl.status()
                                    if self._ctl is not None else None)}
@@ -582,7 +656,9 @@ class SloModule(MgrModule):
                 f"{r['slow']['burn']:g}x slow", severity="warn",
                 check=name, burn_fast=float(r["fast"]["burn"]),
                 burn_slow=float(r["slow"]["burn"]),
-                exemplar_trace_ids=",".join(str(t) for t in tids))
+                exemplar_trace_ids=",".join(str(t) for t in tids),
+                **({"worst_series": str(r["worst_series"])}
+                   if r.get("worst_series") else {}))
         for name in sorted(set(self._alerting) - set(cur)):
             self._journal(f"SLO_BURN cleared: {name}", check=name)
         self._alerting = cur
@@ -595,6 +671,10 @@ class SloModule(MgrModule):
                     f"{fast_s:g}s / {r['slow']['burn']:g}x over "
                     f"{slow_s:g}s "
                     f"({r['fast']['observations']} obs)")
+            if r.get("worst_series"):
+                # wildcard objective: the alert names the tenant
+                # series actually burning, not just the pattern
+                line += f"; worst series: {r['worst_series']}"
             tids = [str(e["trace_id"])
                     for e in r.get("exemplars") or []]
             if tids:

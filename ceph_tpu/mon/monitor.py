@@ -500,6 +500,16 @@ class MonitorLite(Dispatcher):
         self.metrics_history = MetricsHistoryStore(
             keep=self.cfg["mon_metrics_history_keep"],
             downsample_age=self.cfg["metrics_history_downsample_age"])
+        # dynamic perf queries (telemetry/perf_query): per-daemon
+        # cumulative snapshots ride the stats reports and merge here
+        # (newest-seq-wins), served by `perf query report` and
+        # tools/top_tool.py; a pgid-keyed standing query additionally
+        # persists per-PG load vectors into the metrics-history store
+        # (registry "pg_load") for the balancer to sense
+        from ..telemetry.perf_query import PerfQueryStore
+        self.perf_queries = PerfQueryStore()
+        self._pg_load_seq = 0
+        self._pg_load_persisted_at = 0.0
         # batch-thrash health feed: (merge-monotonic ts, daemon) per
         # `batch` channel event while the check is ENABLED (nothing
         # accumulates at the count=0 default), pruned to the warn
@@ -1284,6 +1294,11 @@ class MonitorLite(Dispatcher):
             self._event_lseq.pop(m.osd_id, None)
             # ...and its metrics-history sample seq likewise
             self.metrics_history.reset_daemon(f"osd.{m.osd_id}")
+            # ...and its perf-query snapshot: the revived daemon's
+            # rows restart from zero, and dropping the pre-crash
+            # cumulative snapshot here is what keeps a kill/revive
+            # from double-counting in `perf query report`
+            self.perf_queries.reset_daemon(f"osd.{m.osd_id}")
             self._clog("cluster", f"osd.{m.osd_id} boot (host "
                                   f"{m.host})", osd=m.osd_id)
             self._commit_map(f"osd.{m.osd_id} boot")
@@ -1397,7 +1412,8 @@ class MonitorLite(Dispatcher):
                                 "auth list", "dump_cluster_log",
                                 "progress", "dump_metrics_history",
                                 "metrics_query", "osd qos ls",
-                                "clock_skew"})
+                                "clock_skew", "perf query ls",
+                                "perf query report"})
 
     def _mon_cmd_denied(self, m: MMonCommand):
         """(errno, detail) if the command must be refused, else None.
@@ -1678,6 +1694,62 @@ class MonitorLite(Dispatcher):
                 return 0, {"profiles": {n: dict(p) for n, p in
                                         sorted(self.osdmap
                                                .qos_profiles.items())}}
+        if prefix == "perf query add":
+            # dynamic perf query (telemetry/perf_query): committed
+            # into the OSDMap like qos profiles — every OSD's
+            # PerfQuerySet converges on the next map push
+            from ..telemetry.perf_query import PerfQuerySpec
+            key_by = cmd.get("key_by") or "tenant"
+            if isinstance(key_by, str):
+                key_by = [k.strip() for k in key_by.split(",")
+                          if k.strip()]
+            counters = cmd.get("counters")
+            if isinstance(counters, str):
+                counters = [c.strip() for c in counters.split(",")
+                            if c.strip()]
+            with self._lock:
+                qid = 1 + max(self.osdmap.perf_queries, default=0)
+                try:
+                    spec = PerfQuerySpec(
+                        qid=qid, key_by=tuple(key_by),
+                        counters=tuple(counters) if counters
+                        else ("ops", "bytes_in", "bytes_out", "lat"),
+                        top_n=int(cmd.get("top_n", 32)),
+                        prefix_len=int(cmd.get("prefix_len", 8)))
+                except (TypeError, ValueError) as e:
+                    return -22, {"error": f"bad perf query: {e}"}
+                self.osdmap.perf_queries[qid] = spec.to_dict()
+                self._clog("perf", f"perf query {qid} added "
+                                   f"(key_by {','.join(spec.key_by)})",
+                           qid=qid)
+                self._commit_map(f"perf query {qid} added")
+            return 0, {"qid": qid, "spec": spec.to_dict()}
+        if prefix == "perf query rm":
+            qid = int(cmd["qid"])
+            with self._lock:
+                if self.osdmap.perf_queries.pop(qid, None) is None:
+                    return -2, {"error": f"no perf query {qid}"}
+                self._clog("perf", f"perf query {qid} removed",
+                           qid=qid)
+                self._commit_map(f"perf query {qid} removed")
+            return 0, {}
+        if prefix == "perf query ls":
+            with self._lock:
+                return 0, {"queries": {str(q): dict(s) for q, s in
+                                       sorted(self.osdmap
+                                              .perf_queries.items())},
+                           "reporting": self.perf_queries.daemons()}
+        if prefix == "perf query report":
+            qid = int(cmd["qid"])
+            with self._lock:
+                if qid not in self.osdmap.perf_queries:
+                    return -2, {"error": f"no perf query {qid}"}
+            try:
+                return 0, self.perf_queries.report(
+                    qid, sort=str(cmd.get("sort", "ops")),
+                    limit=int(cmd.get("limit", 0) or 0))
+            except ValueError as e:
+                return -22, {"error": str(e)}
         if prefix == "balancer optimize":
             return self._balancer_optimize(int(cmd.get("max_moves", 10)))
         if prefix == "osd dump":
@@ -1969,6 +2041,12 @@ class MonitorLite(Dispatcher):
         metrics = stats.pop("metrics", None)
         if metrics:
             self.metrics_history.merge(f"osd.{m.osd_id}", metrics)
+        # dynamic perf-query partials: newest-seq-wins per daemon
+        # (cumulative snapshots, so re-delivery replaces exactly)
+        pq = stats.pop("perf_queries", None)
+        if pq:
+            if self.perf_queries.merge(f"osd.{m.osd_id}", pq):
+                self._maybe_persist_pg_load()
         sent_at = stats.pop("sent_at", None)
         with self._lock:
             if isinstance(sent_at, (int, float)):
@@ -2006,6 +2084,31 @@ class MonitorLite(Dispatcher):
             self._event_lseq[m.osd_id] = seen
             self._note_health()
             self._maybe_persist_clog()
+
+    def _maybe_persist_pg_load(self, force: bool = False) -> None:
+        """Persist the merged per-PG load view of any pgid-keyed
+        standing query into the metrics-history store (daemon "mon",
+        registry "pg_load": pg_ops_<pgid>/pg_bytes_<pgid> flat
+        counters) — the load-sensing feed the upmap balancer reads
+        through the SAME metrics_query surface as every other series.
+        Debounced by mon_pg_load_persist_interval_s (0 disables)."""
+        interval = self.cfg["mon_pg_load_persist_interval_s"]
+        if interval <= 0:
+            return
+        now = time.monotonic()
+        if not force and now - self._pg_load_persisted_at < interval:
+            return
+        load: dict[str, int] = {}
+        for qid, spec in self.osdmap.perf_queries.items():
+            if tuple(spec.get("key_by", ())) == ("pgid",):
+                load.update(self.perf_queries.pg_load(qid))
+        if not load:
+            return
+        self._pg_load_persisted_at = now
+        self._pg_load_seq += 1
+        self.metrics_history.merge("mon", {"pg_load": [
+            {"seq": self._pg_load_seq, "ts": time.time(),
+             "counters": load}]})
 
     def _maybe_persist_clog(self, force: bool = False) -> None:
         """Journal the in-memory cluster log through the paxos store

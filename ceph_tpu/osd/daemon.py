@@ -89,6 +89,7 @@ class _PendingWrite:
     lock_key: tuple | None = None  # per-object write lock to release
     span: object = None  # op span closed when the client reply leaves
     qphase: int = 0  # mclock phase served under (rides the reply)
+    pq_ctx: object = None  # perf-query booking (async reply drains)
     stamp: float = field(default_factory=time.time)
 
 
@@ -117,6 +118,7 @@ class _PendingRead:
     want_all: bool = False
     span: object = None    # op span (traced reads): decode stage parent
     qphase: int = 0  # mclock phase served under (rides the reply)
+    pq_ctx: object = None  # perf-query booking (async reply drains)
     # balanced (non-primary) serve: a torn/no-agreed-k-set outcome
     # bounces ESTALE back to the client (re-target the primary) instead
     # of the primary path's requery + EAGAIN
@@ -157,6 +159,63 @@ class _PhaseConn:
     def send(self, msg) -> bool:
         if isinstance(msg, MOSDOpReply) and not msg.qphase:
             msg.qphase = self._phase
+        return self._conn.send(msg)
+
+
+class _PerfQueryCtx:
+    """One client op's perf-query attribution record, shared between
+    the wrapped conn (direct ``conn.send`` replies) and the pending
+    write/read drains (``_handle_sub_write_reply`` / ``_finish_ec_read``
+    reply via ``messenger.send_message`` and never see the wrapped
+    conn — the same split the span/qphase stashes exist for).
+    ``finish`` is one-shot: whichever reply edge fires books the op,
+    the other finds ``_done`` set — no double count however the op
+    completes.  Allocated ONLY when queries are active — the unqueried
+    dispatch path stays a single ``pq.active`` attribute check with
+    zero allocations (the exemplar/tracer discipline, gated by
+    bench.py --ec-batch)."""
+
+    __slots__ = ("_pq", "_tenant", "_pool", "_pgid", "_op", "_oid",
+                 "_bytes_in", "_t0", "_done")
+
+    def __init__(self, pq, tenant: str, pool: int, pgid,
+                 op: str, oid: str, bytes_in: int):
+        self._pq = pq
+        self._tenant = tenant
+        self._pool = pool
+        self._pgid = pgid
+        self._op = op
+        self._oid = oid
+        self._bytes_in = bytes_in
+        self._t0 = time.perf_counter()
+        self._done = False
+
+    def finish(self, bytes_out: int) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._pq.observe(
+            self._tenant, self._pool, self._pgid, self._op, self._oid,
+            self._bytes_in, bytes_out,
+            (time.perf_counter() - self._t0) * 1e6)
+
+
+class _PerfQueryConn:
+    """Send-handle that books a client op into the active perf queries
+    when its reply goes out over the dispatch conn: the reply edge is
+    the one point where latency AND bytes_out are both known.  Async
+    drains (which bypass the conn) finish the same one-shot ctx off
+    the pending entry instead."""
+
+    __slots__ = ("_conn", "_ctx")
+
+    def __init__(self, conn, ctx: _PerfQueryCtx):
+        self._conn = conn
+        self._ctx = ctx
+
+    def send(self, msg) -> bool:
+        if isinstance(msg, MOSDOpReply):
+            self._ctx.finish(len(msg.data))
         return self._conn.send(msg)
 
 
@@ -878,6 +937,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             keep=self.cfg["metrics_history_keep"],
             downsample_age=self.cfg["metrics_history_downsample_age"])
         self._metrics_sampled_at = 0.0
+        # dynamic perf queries (telemetry/perf_query): the attribution
+        # accumulator bank on the client-op dispatch path, converged
+        # from the OSDMap's perf_queries tail; snapshots ride the
+        # stats reports for the mon to merge
+        from ..telemetry.perf_query import PerfQuerySet
+        self.perf_queries = PerfQuerySet()
         # admin-socket directory for cross-daemon trace collection
         # (the PR-7 shared resolver); set by the harness / osd_main
         # when admin sockets exist
@@ -1089,6 +1154,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if cmd == "dump_kernel_profile":
             from ..utils.perf import kernel_profiler
             return kernel_profiler().dump()
+        if cmd == "dump_perf_queries":
+            # the dynamic perf-query accumulator bank: active specs +
+            # this daemon's cumulative rows (the mon's merged view is
+            # `perf query report`)
+            return self.perf_queries.dump()
         if cmd == "dump_events":
             return self.events.recent(
                 n=int(kw["max"]) if kw.get("max") else None,
@@ -1254,6 +1324,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             from ..qos.profiles import params_from_map
             self.scheduler.set_tenant_profiles(
                 params_from_map(new_profiles))
+        # dynamic perf queries converge the same way: the committed
+        # query set rides the map; unchanged specs keep their
+        # accumulators counting
+        new_queries = getattr(newmap, "perf_queries", {})
+        if old is None or getattr(old, "perf_queries",
+                                  {}) != new_queries:
+            self.perf_queries.set_queries(new_queries)
         # drop cached extents only for CACHED PGs whose membership
         # actually changed (an unrelated epoch bump must not cold the
         # cache, and the check is O(cached PGs), not O(cluster PGs))
@@ -1495,6 +1572,16 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             # dmclock feedback: the reply carries the phase this op was
             # served under, whichever async path eventually sends it
             conn = _PhaseConn(conn, qphase)
+        if self.perf_queries.active:
+            # dynamic perf queries: attribution accumulates at the
+            # reply edge; queries-off cost is this one attr check.
+            # The ctx ALSO rides the op (m._pq_ctx -> pending entry)
+            # so async drains that reply via the messenger book it.
+            pq_ctx = _PerfQueryCtx(self.perf_queries, m.tenant,
+                                   m.pool, pgid, m.op, m.oid,
+                                   len(m.data))
+            m._pq_ctx = pq_ctx
+            conn = _PerfQueryConn(conn, pq_ctx)
         self.perf.inc("op_rw_bytes", len(m.data))
         with self.op_tracker.create(f"{m.op} {m.oid}", span=span) as op:
             if pool.kind == "ec":
@@ -1687,6 +1774,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             m.client, m.tid, len(peers) + 1, version)
         self._pending_writes[tid].span = getattr(m, '_span', None)
         self._pending_writes[tid].qphase = getattr(m, '_qos_phase', 0)
+        self._pending_writes[tid].pq_ctx = getattr(m, '_pq_ctx', None)
         self._local_commit_ack(tid, pgid)
         sub_attrs = dict(extra_attrs)
         if rider is not None:
@@ -1774,6 +1862,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             m.client, m.tid, len(peers) + 1, version)
         self._pending_writes[tid].span = getattr(m, '_span', None)
         self._pending_writes[tid].qphase = getattr(m, '_qos_phase', 0)
+        self._pending_writes[tid].pq_ctx = getattr(m, '_pq_ctx', None)
         self._local_commit_ack(tid, pgid)
         for peer in peers:
             self.messenger.send_message(
@@ -1817,6 +1906,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                                if u is not None),
                               stat_only=True)
             pr.qphase = getattr(m, '_qos_phase', 0)
+            pr.pq_ctx = getattr(m, '_pq_ctx', None)
             self._pending_reads[tid] = pr
             self._fan_shard_reads(tid, pgid, m.oid, up)
             return
@@ -2495,6 +2585,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
             pw.qphase = getattr(m, '_qos_phase', 0)
+            pw.pq_ctx = getattr(m, '_pq_ctx', None)
             self._pending_writes[tid] = pw
         for shard, osd in enumerate(up):
             if osd is None:
@@ -2592,6 +2683,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
             pw.qphase = getattr(m, '_qos_phase', 0)
+            pw.pq_ctx = getattr(m, '_pq_ctx', None)
             self._pending_writes[tid] = pw
         local_failed = local_retry = 0
         for shard, osd in enumerate(up):
@@ -2658,6 +2750,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 self._ec_cache.invalidate(pgid, m.oid)
 
             def _finish_local() -> None:
+                # parity-delta fallback arrives over a bare _ClientConn
+                # (no dispatch wrappers): book the one-shot ctx here —
+                # harmless when conn IS wrapped (finish dedups)
+                ctx = getattr(m, "_pq_ctx", None)
+                if ctx is not None:
+                    ctx.finish(0)
                 conn.send(MOSDOpReply(m.tid, result, version=version,
                                       epoch=self.osdmap.epoch))
                 self._obj_unlock(lock_key)
@@ -2682,6 +2780,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
         def on_old(pr) -> None:
             if pr is None or any(s not in pr.chunks for s in per_shard):
+                ctx = getattr(m, "_pq_ctx", None)
+                if ctx is not None:
+                    ctx.finish(0)
                 self.messenger.send_message(
                     m.client, MOSDOpReply(m.tid, EIO,
                                           epoch=self.osdmap.epoch))
@@ -2710,6 +2811,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                    version, lock_key=lock_key)
                 pw.span = getattr(m, '_span', None)
                 pw.qphase = getattr(m, '_qos_phase', 0)
+                pw.pq_ctx = getattr(m, '_pq_ctx', None)
                 self._pending_writes[wtid] = pw
             deltas: dict[int, list[tuple[int, bytes]]] = {}
             news: dict[int, list[tuple[int, bytes]]] = {}
@@ -3359,6 +3461,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           row_base=row_base, row_len=row_len)
         pr.span = getattr(m, "_span", None)
         pr.qphase = getattr(m, '_qos_phase', 0)
+        pr.pq_ctx = getattr(m, '_pq_ctx', None)
         pr.balanced = balanced
         pr.wmarker = self._obj_write_marker()
         self._pending_reads[tid] = pr
@@ -3689,6 +3792,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     # (a routine race must not trigger full peering).
                     self.perf.inc("balanced_read_bounce")
                     if pr.client:
+                        if pr.pq_ctx is not None:
+                            pr.pq_ctx.finish(0)
                         self.messenger.send_message(
                             pr.client,
                             MOSDOpReply(pr.client_tid, ESTALE,
@@ -3701,6 +3806,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                     seed = self.osdmap.object_to_pg(pr.pool, pr.oid)
                     self._requery_pg(PgId(pr.pool, seed), force_full=True)
                 if pr.client:
+                    if pr.pq_ctx is not None:
+                        pr.pq_ctx.finish(0)
                     self.messenger.send_message(
                         pr.client, MOSDOpReply(pr.client_tid, EAGAIN,
                                                epoch=epoch,
@@ -3727,6 +3834,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 self.perf.inc("balanced_read_bounce")
                 err = ESTALE
             if pr.client:
+                if pr.pq_ctx is not None:
+                    pr.pq_ctx.finish(0)
                 self.messenger.send_message(
                     pr.client, MOSDOpReply(pr.client_tid, err, epoch=epoch,
                                            qphase=pr.qphase))
@@ -3734,6 +3843,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if pr.stat_only:
             if pr.client:
                 size = int(total or 0)
+                if pr.pq_ctx is not None:
+                    pr.pq_ctx.finish(8)
                 self.messenger.send_message(
                     pr.client,
                     MOSDOpReply(pr.client_tid, 0,
@@ -3788,6 +3899,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 lease = self._lease_maybe_grant(
                     PgId(pr.pool, seed), pr.oid, pr.client,
                     whole=not pr.offset and not pr.length)
+            if pr.pq_ctx is not None:
+                pr.pq_ctx.finish(len(payload))
             self.messenger.send_message(
                 pr.client,
                 MOSDOpReply(pr.client_tid, 0, data=payload, epoch=epoch,
@@ -3821,6 +3934,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                                lock_key=lock_key)
             pw.span = getattr(m, '_span', None)
             pw.qphase = getattr(m, '_qos_phase', 0)
+            pw.pq_ctx = getattr(m, '_pq_ctx', None)
             self._pending_writes[tid] = pw
         sub_attrs = {"_snap": rider} if rider is not None else {}
         for shard, osd in enumerate(up):
@@ -4071,11 +4185,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         if pw.span is not None:
             pw.span.tag("result", result)
             pw.span.finish()
+        rdata = getattr(pw, "reply_data", b"") if result == 0 else b""
+        if pw.pq_ctx is not None:
+            # perf-query booking: this drain replies via the messenger,
+            # so the dispatch-time conn wrapper never sees it
+            pw.pq_ctx.finish(len(rdata))
         self.messenger.send_message(
             pw.client,
-            MOSDOpReply(pw.client_tid, result,
-                        data=getattr(pw, "reply_data", b"")
-                        if result == 0 else b"",
+            MOSDOpReply(pw.client_tid, result, data=rdata,
                         version=pw.version,
                         epoch=self.osdmap.epoch if self.osdmap else 0,
                         qphase=pw.qphase))
@@ -4188,6 +4305,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             self._note_obj_write(pw.lock_key)  # possibly-torn write
             if pw.lock_key is not None:
                 self._ec_cache.invalidate(*pw.lock_key)
+            if pw.pq_ctx is not None:
+                pw.pq_ctx.finish(0)
             self.messenger.send_message(
                 pw.client, MOSDOpReply(pw.client_tid, EIO,
                                        version=pw.version, epoch=epoch))
@@ -4328,7 +4447,15 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                           # metrics-history increments ride the same
                           # at-least-once window (seq-deduped mon-side)
                           "metrics": self.metrics_history.pending(
-                              self.cfg["osd_event_resend_s"])}))
+                              self.cfg["osd_event_resend_s"]),
+                          # dynamic perf-query partials: cumulative
+                          # seq-tagged snapshots, re-shipped whole
+                          # every report (newest-seq-wins mon-side);
+                          # key absent entirely when no query is active
+                          **({"perf_queries": pq_snap}
+                             if (pq_snap :=
+                                 self.perf_queries.snapshot())
+                             else {})}))
         self.events.prune(self.cfg["osd_event_resend_s"])
 
     def _handle_ping(self, conn, m: MOSDPing) -> None:

@@ -54,6 +54,8 @@ class ControllerKnobs:
     hold: int = 2               # consecutive ticks before acting
     cooldown: int = 2           # ticks of silence after an apply
     lim_factor: float = 2.0     # limit = res * lim_factor (0 = no lim)
+    burn_high: float = 2.0      # slo sense: back off above this burn
+    burn_low: float = 0.5       # slo sense: grow only below this burn
 
 
 @dataclass
@@ -66,6 +68,7 @@ class Retune:
     reason: str                  # "grow" | "backoff"
     p99_us: float | None = None
     backlog: int = 0
+    burn: float | None = None    # slo sense: fast-window burn at retune
 
 
 class ReservationController:
@@ -100,10 +103,32 @@ class ReservationController:
         ``recovery_active``: a storm is live (progress items open).
         Returns (res, lim) when a retune should be applied."""
         k = self.knobs
-        self._tick += 1
         hot = p99_us is not None and p99_us > k.p99_high_us
         cold = ((p99_us is None or p99_us < k.p99_low_us)
                 and (recovery_active or backlog > 0))
+        return self._step(hot, cold, p99_us, backlog, None)
+
+    def observe_burn(self, burn_fast: float | None, backlog: int,
+                     recovery_active: bool,
+                     p99_us: float | None = None
+                     ) -> tuple[float, float] | None:
+        """One tick sensing on SLO burn instead of raw p99: back off
+        when the fast-window error-budget burn exceeds ``burn_high``
+        (the budget is being eaten faster than the objective allows),
+        grow only when burn is comfortably under ``burn_low`` and
+        recovery wants headroom.  ``burn_fast`` None = SLO module has
+        no samples yet -> treated like quiet (grow-eligible when
+        backlog exists), matching ``observe``'s no-samples stance."""
+        k = self.knobs
+        hot = burn_fast is not None and burn_fast > k.burn_high
+        cold = ((burn_fast is None or burn_fast < k.burn_low)
+                and (recovery_active or backlog > 0))
+        return self._step(hot, cold, p99_us, backlog, burn_fast)
+
+    def _step(self, hot: bool, cold: bool, p99_us, backlog,
+              burn) -> tuple[float, float] | None:
+        k = self.knobs
+        self._tick += 1
         # hysteresis counters advance even through cooldown, so a
         # persistent condition acts the instant the cooldown lifts
         if hot:
@@ -119,17 +144,18 @@ class ReservationController:
             return None
         if self._hot >= k.hold and self.res > k.res_min:
             self.res = max(k.res_min, self.res * k.backoff)
-            return self._applied("backoff", p99_us, backlog)
+            return self._applied("backoff", p99_us, backlog, burn)
         if self._cold >= k.hold and self.res < k.res_max:
             self.res = min(k.res_max, self.res + k.step)
-            return self._applied("grow", p99_us, backlog)
+            return self._applied("grow", p99_us, backlog, burn)
         return None
 
-    def _applied(self, reason: str, p99_us, backlog
+    def _applied(self, reason: str, p99_us, backlog, burn=None
                  ) -> tuple[float, float]:
         lim = self.limit()
         self.history.append(Retune(self._tick, self.res, lim, reason,
-                                   p99_us, int(backlog)))
+                                   p99_us, int(backlog),
+                                   None if burn is None else float(burn)))
         self._cooldown = self.knobs.cooldown
         self._hot = self._cold = 0
         return self.res, lim
@@ -165,5 +191,7 @@ class ReservationController:
             "history": [
                 {"tick": r.tick, "res": r.res, "lim": r.lim,
                  "reason": r.reason, "p99_us": r.p99_us,
-                 "backlog": r.backlog} for r in self.history],
+                 "backlog": r.backlog,
+                 **({"burn": r.burn} if r.burn is not None else {})}
+                for r in self.history],
         }

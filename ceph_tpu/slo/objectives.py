@@ -39,7 +39,8 @@ from dataclasses import dataclass
 
 __all__ = ["SIGNALS", "Objective", "parse_objective",
            "parse_objectives", "bad_fraction", "burn_rate",
-           "evaluate_objective", "worst_bucket_exemplars"]
+           "evaluate_objective", "expand_counters",
+           "worst_bucket_exemplars"]
 
 #: signal aliases -> (registry prefix, pow2 histogram counter).  The
 #: registry prefix matches against the metrics-history store's
@@ -55,7 +56,7 @@ SIGNALS: dict[str, tuple[str, str]] = {
 _UNIT_US = {"us": 1.0, "ms": 1e3, "s": 1e6}
 
 _RE = re.compile(
-    r"^(?P<signal>[A-Za-z0-9_.:]+?)(?:_p\d+)?"
+    r"^(?P<signal>[A-Za-z0-9_.:*]+?)(?:_p\d+)?"
     r"<=(?P<num>\d+(?:\.\d+)?)(?P<unit>us|ms|s)"
     r"@(?P<target>\d+(?:\.\d+)?)%$")
 
@@ -83,6 +84,18 @@ def parse_objective(text: str) -> Objective:
     signal = m.group("signal")
     if ":" in signal:
         prefix, counter = signal.split(":", 1)
+        if "*" in prefix:
+            raise ValueError(
+                f"SLO wildcard only allowed in the counter part: {text!r}")
+    elif "*" in signal:
+        # Metric wildcard: one objective per counter the store has
+        # actually seen (e.g. 'mclock_qwait_us_tenant_*_p99<=50ms@99%'
+        # stands one objective per discovered tenant series).  The
+        # _pNN suffix the regex stripped is cosmetic, so the wildcard
+        # pattern is the bare signal.  Expansion happens at evaluate
+        # time against the live store; parse just records the pattern
+        # over the default OSD registries.
+        prefix, counter = "osd.", signal
     else:
         pair = SIGNALS.get(signal)
         if pair is None:
@@ -138,6 +151,27 @@ def burn_rate(bad: float, target: float) -> float:
     return min(1e6, bad / max(1e-9, 1.0 - target))
 
 
+def expand_counters(pattern: str, store, registry_prefix: str
+                    ) -> list[str]:
+    """Expand a ``*`` counter pattern against the counter names the
+    store's matching registries actually carry.  ``*`` matches one
+    metric-name segment run ([A-Za-z0-9_]+), so a hostile tenant name
+    cannot smuggle dots or colons into a synthesized objective."""
+    rx = re.compile(
+        "^" + re.escape(pattern).replace(r"\*", "[A-Za-z0-9_]+") + "$")
+    names: set[str] = set()
+    counters_of = getattr(store, "counters", None)
+    if counters_of is None:
+        return []
+    for reg in store.registries():
+        if not reg.startswith(registry_prefix):
+            continue
+        for name in counters_of(reg):
+            if rx.match(name):
+                names.add(name)
+    return sorted(names)
+
+
 def worst_bucket_exemplars(exemplars: dict, threshold_us: float,
                            keep: int = 4) -> list[dict]:
     """Exemplars from the highest bucket whose RANGE exceeds the
@@ -165,6 +199,39 @@ def evaluate_objective(obj: Objective, store, fast_s: float,
     the worst bucket's exemplars from the fast window.  Pure read —
     no health decisions here (the mgr module owns thresholds and
     hysteresis)."""
+    if "*" in obj.counter:
+        # Wildcard objective: expand per discovered counter, evaluate
+        # each concrete sub-objective, and report AS the worst series
+        # (highest fast burn) so the mgr's thresholding is unchanged —
+        # the alert fires when the worst tenant burns, and the detail
+        # names it.  Nothing discovered yet -> inert zero-burn result.
+        series = []
+        for name in expand_counters(obj.counter, store,
+                                    obj.registry_prefix):
+            sub = Objective(name=obj.name, registry_prefix=obj.registry_prefix,
+                            counter=name, threshold_us=obj.threshold_us,
+                            target=obj.target)
+            series.append(evaluate_objective(sub, store, fast_s, slow_s))
+        if not series:
+            zero = {"window_s": 0.0, "observations": 0,
+                    "bad_fraction": 0.0, "burn": 0.0}
+            return {"objective": obj.name, "counter": obj.counter,
+                    "threshold_us": obj.threshold_us, "target": obj.target,
+                    "registries": [], "fast": dict(zero, window_s=fast_s),
+                    "slow": dict(zero, window_s=slow_s), "exemplars": [],
+                    "worst_series": None, "series": []}
+        worst = max(series, key=lambda s: (s["fast"]["burn"],
+                                           s["slow"]["burn"],
+                                           s["counter"]))
+        out = dict(worst, objective=obj.name)
+        out["worst_series"] = worst["counter"]
+        out["series"] = [
+            {"counter": s["counter"],
+             "fast_burn": s["fast"]["burn"],
+             "slow_burn": s["slow"]["burn"],
+             "observations": s["fast"]["observations"]}
+            for s in series]
+        return out
     windows = {"fast": float(fast_s), "slow": float(slow_s)}
     out = {"objective": obj.name, "counter": obj.counter,
            "threshold_us": obj.threshold_us, "target": obj.target,

@@ -17,6 +17,7 @@ a dashboard on a dead series.
 
 from __future__ import annotations
 
+import json
 import re
 import sys
 
@@ -168,6 +169,100 @@ def render(rules: list[dict], group: str = "ceph_tpu_latency") -> str:
     return "\n".join(lines) + "\n"
 
 
+#: exporter-emitted perf-query aggregate series the dashboard's
+#: attribution panel reads — labeled only by query id (the bounded
+#: surface mon/exporter.py emits; named rows stay behind
+#: `perf query report` / top_tool)
+PERF_QUERY_METRICS = ("perf_query_ops_total", "perf_query_bytes_total",
+                      "perf_query_keys", "perf_query_overflow_ops")
+
+
+def dashboard(rules: list[dict] | None = None,
+              window: str = "5m") -> dict:
+    """Grafana dashboard JSON pinned to the emitted rule names: every
+    recorded series a panel reads is checked against the actual
+    recording_rules() output, so a rule rename breaks generation here
+    (and the schema test) instead of stranding a live dashboard on a
+    dead series."""
+    rules = recording_rules(window=window) if rules is None else rules
+    records = {r["record"] for r in rules}
+
+    def rec(name: str) -> str:
+        if name not in records:
+            raise KeyError(
+                f"dashboard references unemitted rule {name!r}")
+        return name
+
+    panels: list[dict] = []
+
+    def panel(title: str, targets: list[tuple], unit: str = "µs",
+              typ: str = "timeseries") -> None:
+        i = len(panels)
+        panels.append({
+            "id": i + 1, "title": title, "type": typ,
+            "datasource": {"type": "prometheus",
+                           "uid": "${DS_PROMETHEUS}"},
+            "gridPos": {"h": 8, "w": 12,
+                        "x": 12 * (i % 2), "y": 8 * (i // 2)},
+            "fieldConfig": {"defaults": {"unit": unit},
+                            "overrides": []},
+            "targets": [
+                {"refId": chr(ord("A") + j), "expr": expr,
+                 "legendFormat": legend,
+                 **({"exemplar": True} if exemplar else {})}
+                for j, (expr, legend, exemplar)
+                in enumerate(targets)],
+        })
+
+    panel("Client op latency (p50/p99)", [
+        (rec(f"{PREFIX}:daemon_op_lat_us:p50"), "p50 {{daemon}}",
+         False),
+        # the exemplar-linked panel: Grafana resolves the bucket
+        # exemplars the OpenMetrics scrape carries into trace_id dots
+        (rec(f"{PREFIX}:daemon_op_lat_us:p99"), "p99 {{daemon}}",
+         True),
+    ])
+    panel("mClock queue wait p99 by class", [
+        (rec(f"{PREFIX}:daemon_mclock_qwait_us_client:p99"),
+         "client {{daemon}}", False),
+        (rec(f"{PREFIX}:daemon_mclock_qwait_us_recovery:p99"),
+         "recovery {{daemon}}", False),
+        (rec(f"{PREFIX}:daemon_mclock_qwait_us_tenant_default:p99"),
+         "tenant:default {{daemon}}", False),
+    ])
+    panel("SLO client_op bad fraction (burn feed)", [
+        (rec(f"{PREFIX}:slo_client_op_bad:ratio_rate{window}"),
+         "bad fraction", False),
+    ], unit="percentunit")
+    panel("Metrics-history staleness (max over daemons)", [
+        (rec(f"{PREFIX}:{STALENESS_GAUGE}:max"), "staleness", False),
+    ], unit="s")
+    panel("Perf-query attribution (top standing queries)", [
+        (f"topk(5, sum by (query) "
+         f"(rate({PREFIX}_perf_query_ops_total[{window}])))",
+         "query {{query}} ops/s", False),
+        (f"sum by (query) "
+         f"(rate({PREFIX}_perf_query_overflow_ops[{window}]))",
+         "query {{query}} overflow ops/s", False),
+    ], unit="ops")
+    panel("Messenger dispatch p99", [
+        (rec(f"{PREFIX}:daemon_msg_dispatch_us:p99"), "{{daemon}}",
+         False),
+    ])
+    return {
+        "title": "ceph_tpu overview",
+        "uid": "ceph-tpu-overview",
+        "schemaVersion": 39,
+        "tags": ["ceph_tpu", "generated"],
+        "time": {"from": "now-1h", "to": "now"},
+        "refresh": "10s",
+        "templating": {"list": [
+            {"name": "DS_PROMETHEUS", "type": "datasource",
+             "query": "prometheus"}]},
+        "panels": panels,
+    }
+
+
 def tenant_histograms(tenants) -> tuple:
     """Histogram names for a deployment's NAMED tenants (the dynamic
     half of the per-tenant family: the default anchor is always in
@@ -189,13 +284,21 @@ def main(argv=None) -> int:
                          "per-tenant mclock_qwait p50/p99 rules for "
                          "(the default-tenant anchor is always "
                          "included)")
+    ap.add_argument("--dashboard", action="store_true",
+                    help="emit the Grafana dashboard JSON (panels "
+                         "pinned to the emitted rule names) instead "
+                         "of the rule-file YAML")
     args = ap.parse_args(argv)
     hists = HISTOGRAMS
     if args.tenants:
         names = [t.strip() for t in args.tenants.split(",")
                  if t.strip()]
         hists = HISTOGRAMS + tenant_histograms(names)
-    print(render(recording_rules(histograms=hists)), end="")
+    rules = recording_rules(histograms=hists)
+    if args.dashboard:
+        print(json.dumps(dashboard(rules), indent=2))
+    else:
+        print(render(rules), end="")
     return 0
 
 

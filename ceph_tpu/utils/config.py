@@ -484,6 +484,23 @@ OPTIONS: list[Option] = [
            OptionLevel.ADVANCED,
            "ticks of silence after every applied retune", min=0,
            max=100, see_also=("qos_controller",)),
+    Option("qos_controller_sense", str, "p99", OptionLevel.ADVANCED,
+           "what the controller senses: 'p99' = raw client p99 "
+           "queue-wait vs the watermark band; 'slo' = the slo "
+           "module's fast-window error-budget burn (needs "
+           "slo_objectives set) — backoff above "
+           "qos_controller_burn_high, grow below "
+           "qos_controller_burn_low, retunes journaled with the burn "
+           "value", enum_values=("p99", "slo"),
+           see_also=("qos_controller", "slo_objectives")),
+    Option("qos_controller_burn_high", float, 2.0, OptionLevel.ADVANCED,
+           "slo-sense: fast-window burn multiple above which recovery "
+           "backs off (burn 1.0 = spending the error budget exactly)",
+           min=0.1, max=1e6, see_also=("qos_controller_sense",)),
+    Option("qos_controller_burn_low", float, 0.5, OptionLevel.ADVANCED,
+           "slo-sense: fast-window burn multiple below which recovery "
+           "may grow (the hysteresis band's bottom)", min=0.0,
+           max=1e6, see_also=("qos_controller_burn_high",)),
     Option("qos_recovery_res_min", float, 4.0, OptionLevel.ADVANCED,
            "controller clamp: recovery reservation floor (ops/s) — "
            "the hand-tuned sweep's low endpoint", min=0.1,
@@ -598,6 +615,13 @@ OPTIONS: list[Option] = [
            "coarse tier (pure fine ring)", min=0.0, max=86400.0,
            see_also=("metrics_history_keep",
                      "metrics_history_interval_s")),
+    Option("mon_pg_load_persist_interval_s", float, 5.0,
+           OptionLevel.ADVANCED,
+           "min seconds between persisting a pgid-keyed standing perf "
+           "query's merged per-PG load vector into the metrics-history "
+           "store (daemon 'mon', registry 'pg_load' — the balancer's "
+           "load-sensing feed); 0 disables persistence", min=0.0,
+           max=3600.0, see_also=("mon_metrics_history_keep",)),
     # SLO burn-rate health (mgr slo module): latency objectives over
     # the metrics history, multiwindow burn alerting with exemplars
     Option("slo_objectives", str, "", OptionLevel.ADVANCED,
@@ -605,8 +629,10 @@ OPTIONS: list[Option] = [
            "evaluates, '<signal><=<num><us|ms|s>@<pct>%' each (e.g. "
            "'client_op_p99<=20ms@99%'; signals: client_op, "
            "qwait_client, qwait_recovery, msg_dispatch, ec_batch_wait, "
-           "or an explicit 'registry_prefix:counter').  Empty = module "
-           "inert",
+           "or an explicit 'registry_prefix:counter'; a '*' in the "
+           "counter name expands per discovered series — e.g. "
+           "'mclock_qwait_us_tenant_*_p99<=50ms@99%' stands one "
+           "objective per tenant).  Empty = module inert",
            see_also=("slo_fast_window_s", "slo_burn_threshold")),
     Option("slo_fast_window_s", float, 60.0, OptionLevel.ADVANCED,
            "fast metrics_query window for SLO burn evaluation (the "

@@ -222,6 +222,21 @@ class _HistoryRings:
         with self._lock:
             return sorted(self._rings)
 
+    def counters(self, registry: str) -> list[str]:
+        """Counter names the registry's NEWEST sample carries — the
+        discovery surface SLO metric wildcards expand against (e.g.
+        mclock_qwait_us_tenant_* -> one objective per live tenant
+        series)."""
+        with self._lock:
+            ring = self._rings.get(registry)
+            newest = ring[-1] if ring else None
+            if newest is None:
+                coarse = self._coarse.get(registry)
+                newest = coarse[-1] if coarse else None
+            if newest is None:
+                return []
+            return sorted((newest.get("counters") or {}).keys())
+
     def window(self, registry: str, since_s: float,
                until_s: float = 0.0, now: float | None = None
                ) -> list[dict]:

@@ -277,3 +277,77 @@ def test_evaluate_objective_aggregates_registries():
         assert r[w]["burn"] == pytest.approx(50.0)
     assert {e["trace_id"] for e in r["exemplars"]} == {0x111, 0x222}
     assert all(e["bucket"] == 17 for e in r["exemplars"])
+
+
+def test_parse_wildcard_objective():
+    from ceph_tpu.slo.objectives import parse_objective
+    o = parse_objective("mclock_qwait_us_tenant_*_p99<=50ms@99%")
+    assert o.registry_prefix == "osd."
+    assert o.counter == "mclock_qwait_us_tenant_*"  # _p99 is cosmetic
+    assert o.threshold_us == 50_000.0 and o.target == 0.99
+    # explicit prefix:counter spelling carries the wildcard too
+    o2 = parse_objective("msg.:msg_dispatch_*<=1ms@95%")
+    assert (o2.registry_prefix, o2.counter) == ("msg.", "msg_dispatch_*")
+    # ...but never in the registry prefix (that would let one objective
+    # fan out across unrelated daemon classes)
+    with pytest.raises(ValueError):
+        parse_objective("os*.:op_lat_us<=1ms@95%")
+
+
+def test_expand_counters_matches_discovered_series_only():
+    """Wildcard expansion answers from counter names the store has
+    actually seen; ``*`` spans one [A-Za-z0-9_]+ run, so a hostile
+    name cannot smuggle dots/colons into a synthesized objective."""
+    from ceph_tpu.slo.objectives import expand_counters
+    from ceph_tpu.utils.metrics_history import MetricsHistoryStore
+    store = MetricsHistoryStore()
+    store.merge("osd.0", {"osd.0": [{"ts": 1.0, "seq": 1, "counters": {
+        "mclock_qwait_us_tenant_a": {}, "mclock_qwait_us_tenant_b": {},
+        "mclock_qwait_us_tenant_evil.x": {}, "op_lat_us": {}}}]})
+    store.merge("osd.1", {"osd.1": [{"ts": 1.0, "seq": 1, "counters": {
+        "mclock_qwait_us_tenant_b": {}, "mclock_qwait_us_tenant_c": {}}}]})
+    # a registry outside the prefix never contributes
+    store.merge("mon", {"msg.mon": [{"ts": 1.0, "seq": 1, "counters": {
+        "mclock_qwait_us_tenant_z": {}}}]})
+    got = expand_counters("mclock_qwait_us_tenant_*", store, "osd.")
+    assert got == ["mclock_qwait_us_tenant_a", "mclock_qwait_us_tenant_b",
+                   "mclock_qwait_us_tenant_c"]
+    assert expand_counters("nothing_*", store, "osd.") == []
+
+
+def test_evaluate_wildcard_reports_worst_tenant_series():
+    """A wildcard objective evaluates every discovered series and
+    reports AS the worst one (highest fast burn): the mgr's burn
+    thresholding is unchanged, and the detail names the tenant."""
+    from ceph_tpu.slo.objectives import evaluate_objective, parse_objective
+    from ceph_tpu.utils.metrics_history import (MetricsHistory,
+                                                MetricsHistoryStore)
+    store = MetricsHistoryStore()
+    pc = PerfCounters("osd.0")
+    pc.add("mclock_qwait_us_tenant_good", CounterType.HISTOGRAM)
+    pc.add("mclock_qwait_us_tenant_noisy", CounterType.HISTOGRAM)
+    h = MetricsHistory()
+    h.sample({"osd.0": pc})
+    for _ in range(4):
+        pc.hinc("mclock_qwait_us_tenant_good", 5_000.0)    # under 50ms
+        pc.hinc("mclock_qwait_us_tenant_noisy", 5_000.0)
+        pc.hinc("mclock_qwait_us_tenant_noisy", 400_000.0)  # way over
+    h.sample({"osd.0": pc})
+    store.merge("osd.0", json.loads(json.dumps(h.pending(60.0))))
+    obj = parse_objective("mclock_qwait_us_tenant_*<=50ms@99%")
+    r = evaluate_objective(obj, store, fast_s=60.0, slow_s=120.0)
+    assert r["objective"] == obj.name
+    assert r["worst_series"] == "mclock_qwait_us_tenant_noisy"
+    assert r["counter"] == "mclock_qwait_us_tenant_noisy"
+    assert r["fast"]["bad_fraction"] == pytest.approx(0.5)
+    assert r["fast"]["burn"] == pytest.approx(50.0)
+    by_name = {s["counter"]: s for s in r["series"]}
+    assert set(by_name) == {"mclock_qwait_us_tenant_good",
+                            "mclock_qwait_us_tenant_noisy"}
+    assert by_name["mclock_qwait_us_tenant_good"]["fast_burn"] == 0.0
+    assert by_name["mclock_qwait_us_tenant_noisy"]["observations"] == 8
+    # nothing discovered yet -> inert zero-burn result, not an error
+    empty = evaluate_objective(obj, MetricsHistoryStore(),
+                               fast_s=60.0, slow_s=120.0)
+    assert empty["fast"]["burn"] == 0.0 and empty["slow"]["burn"] == 0.0
+    assert empty["worst_series"] is None and empty["series"] == []

@@ -212,6 +212,65 @@ def test_downsample_coarse_tier_extends_window_at_same_budget():
     assert sts == sorted(sts) and sts[0] < sts[-1] - float(keep)
 
 
+def test_coarse_tier_boundary_windows_tile_exactly():
+    """Adjacent DISJOINT windows laid across the fine/coarse migration
+    seam tile: each window's baseline edge is the previous window's end
+    edge, so the per-window deltas sum to the whole-span delta with no
+    op counted twice or dropped — even though the coarse tier keeps
+    only every 8th sample.  This is the contract dashboards differencing
+    consecutive scrapes rely on."""
+    pc = _probe_registry()
+    h = MetricsHistory(keep=40, downsample_age=20.0)
+    for i in range(200):            # 1 Hz, cumulative ops == ts + 1
+        pc.inc("ops")
+        h.sample({"probe": pc}, ts=float(i))
+    # the seam sits downsample_age behind the newest stamp (199 - 20);
+    # tile 30s windows across [139, 199] so window edges land on both
+    # sides of it
+    assert h._coarse["probe"] and h._rings["probe"]
+    seam = float(h._rings["probe"][0]["ts"])
+    assert 139.0 < seam <= 179.0
+    qa = h.query("probe", "ops", start_ts=139.0, end_ts=169.0)
+    qb = h.query("probe", "ops", start_ts=169.0, end_ts=199.0)
+    qall = h.query("probe", "ops", start_ts=139.0, end_ts=199.0)
+    # end edge of A IS the baseline of B: spans meet with no gap
+    assert qa["t1"] == qb["t0"]
+    assert qa["delta"] + qb["delta"] == qall["delta"]
+    # cumulative counters make every achievable delta exact: 1 op/s
+    assert qa["delta"] == qa["t1"] - qa["t0"]
+    assert qb["delta"] == qb["t1"] - qb["t0"]
+    # a window ENTIRELY inside the coarse tier still answers (stride-8
+    # edges only, but the cumulative difference stays exact)
+    qc = h.query("probe", "ops", start_ts=10.0, end_ts=80.0)
+    assert qc["samples"] >= 2 and qc["delta"] == qc["t1"] - qc["t0"]
+    # window() exposes the same tiling at the row level
+    wa = h.window("probe", since_s=60.0, until_s=30.0, now=199.0)
+    wb = h.window("probe", since_s=30.0, until_s=0.0, now=199.0)
+    assert wa[-1]["ts"] == wb[0]["ts"]
+
+
+def test_counters_discovery_tracks_newest_sample():
+    """counters() lists the NEWEST sample's counter names — the
+    discovery surface SLO wildcards expand against — so per-tenant
+    series appear as soon as a sample carries them and the answer
+    follows churn instead of accreting forever."""
+    pc = PerfCounters("mclock")
+    pc.add("qwait_us_tenant_a", CounterType.HISTOGRAM)
+    h = MetricsHistory(keep=10)
+    assert h.counters("mclock") == []       # empty ring -> empty list
+    h.sample({"mclock": pc}, ts=1.0)
+    assert h.counters("mclock") == ["qwait_us_tenant_a"]
+    pc.add("qwait_us_tenant_b", CounterType.HISTOGRAM)
+    h.sample({"mclock": pc}, ts=2.0)
+    assert h.counters("mclock") == ["qwait_us_tenant_a",
+                                    "qwait_us_tenant_b"]
+    # the store-side face answers identically after a merge
+    store = MetricsHistoryStore(keep=10)
+    store.merge("osd.0", h.pending(max_age=60.0, now=2.0))
+    assert store.counters("mclock") == ["qwait_us_tenant_a",
+                                        "qwait_us_tenant_b"]
+
+
 def rows_between(h, lo, hi):
     """Shipping-window helper: h's samples with lo <= ts < hi (the
     merge path wants seq-ordered lists, which sample() guarantees)."""

@@ -725,6 +725,72 @@ def test_counter_schema_lint_one_strict_scrape(obs_cluster):
     assert len(expected) >= 5, sorted(expected)
 
 
+def test_perf_query_scrape_series_bounded_under_tenant_churn():
+    """Counter-schema lint for the perf-query scrape face: a standing
+    query fed 500 distinct HOSTILE tenant names still renders exactly
+    four aggregate families labeled only by query id — no tenant-named
+    series, no label-breaking characters, series count bounded by the
+    number of standing queries (never by key cardinality; churn past
+    top-N lands in the overflow fold, and totals stay conserved)."""
+    import threading
+
+    from ceph_tpu.mon.exporter import render_metrics
+    from ceph_tpu.mon.maps import OSDMap
+    from ceph_tpu.telemetry.perf_query import (PerfQuerySet,
+                                               PerfQuerySpec,
+                                               PerfQueryStore)
+
+    class StubMon:
+        def __init__(self, pq_store):
+            self._lock = threading.Lock()
+            self.osdmap = OSDMap()
+            self.is_leader = True
+            self._osd_stats = {}
+            self.progress = None
+            self.metrics_history = None
+            self.perf_queries = pq_store
+
+    pq = PerfQuerySet()
+    pq.set_queries({1: PerfQuerySpec(qid=1, key_by=("tenant",),
+                                     top_n=8),
+                    2: PerfQuerySpec(qid=2, key_by=("pool",))})
+    for i in range(500):
+        hostile = f'ten{{ant}}"\n{"x" * (i % 90)}-{i}'
+        pq.observe(hostile, 0, (1, i % 4), "write", f"obj-{i}",
+                   4096, 0, 100.0)
+    store = PerfQueryStore()
+    assert store.merge("osd.0", pq.snapshot())
+    body = render_metrics(StubMon(store))
+    parsed = _parse_exposition_strict(body)
+    fams = {n: m for n, m in parsed.items() if "perf_query" in n}
+    assert set(fams) == {"ceph_tpu_perf_query_ops_total",
+                         "ceph_tpu_perf_query_bytes_total",
+                         "ceph_tpu_perf_query_keys",
+                         "ceph_tpu_perf_query_overflow_ops"}
+    # exactly one series per (family, standing query) — 500 tenants in,
+    # 8 series out
+    for name, fam in fams.items():
+        assert sorted(fam["samples"]) == [f'{name}{{query="1"}}',
+                                          f'{name}{{query="2"}}']
+    samples = parsed["ceph_tpu_perf_query_ops_total"]["samples"]
+    assert samples['ceph_tpu_perf_query_ops_total{query="1"}'] == 500.0
+    keys = parsed["ceph_tpu_perf_query_keys"]["samples"]
+    assert keys['ceph_tpu_perf_query_keys{query="1"}'] <= 8.0
+    assert keys['ceph_tpu_perf_query_keys{query="2"}'] == 1.0
+    overflow = parsed["ceph_tpu_perf_query_overflow_ops"]["samples"]
+    assert overflow['ceph_tpu_perf_query_overflow_ops{query="1"}'] \
+        == 500.0 - keys['ceph_tpu_perf_query_keys{query="1"}']
+    # no tenant fragment leaks into any perf-query metric line: every
+    # sample is exactly name{query="N"} value
+    import re as _re
+    pq_lines = [ln for ln in body.splitlines()
+                if "perf_query" in ln and not ln.startswith("#")]
+    assert pq_lines
+    assert all(_re.fullmatch(
+        r'ceph_tpu_perf_query_\w+\{query="\d+"\} [\d.e+-]+', ln)
+        for ln in pq_lines), pq_lines
+
+
 def test_exemplar_blame_slo_burn_end_to_end(tmp_path, capsys):
     """ISSUE 18 acceptance, end to end on a live cluster: an injected
     stall's op lands an exemplar in its latency bucket; ``metrics_query``

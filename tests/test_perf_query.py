@@ -1,0 +1,339 @@
+"""Dynamic perf queries: spec/wire units, accumulator bounds, store
+merge semantics, and the e2e attribution loop on a MiniCluster
+(DynamicPerfStats.h + `osd perf query` + `rbd perf iotop` roles)."""
+
+import time
+
+import pytest
+
+from ceph_tpu.telemetry.perf_query import (
+    MAX_TOP_N, OVERFLOW_KEY, PerfQueryAccumulator, PerfQuerySet,
+    PerfQuerySpec, PerfQueryStore, op_class_of)
+
+
+# ------------------------------------------------------------- spec units
+def test_spec_validation_rejects_unknown_keys_and_counters():
+    with pytest.raises(ValueError):
+        PerfQuerySpec(qid=1, key_by=("tenant", "nope"))
+    with pytest.raises(ValueError):
+        PerfQuerySpec(qid=1, key_by=())
+    with pytest.raises(ValueError):
+        PerfQuerySpec(qid=1, counters=("ops", "nope"))
+    # top_n clamps to the hard cardinality ceiling
+    assert PerfQuerySpec(qid=1, top_n=10_000).top_n == MAX_TOP_N
+    assert PerfQuerySpec(qid=1, top_n=0).top_n == 1
+
+
+def test_spec_dict_round_trip():
+    spec = PerfQuerySpec(qid=3, key_by=("tenant", "op_class"),
+                         counters=("ops", "lat"), top_n=7, prefix_len=4)
+    assert PerfQuerySpec.from_dict(spec.to_dict()) == spec
+
+
+def test_op_class_collapse():
+    assert op_class_of("write") == "write"
+    assert op_class_of("write_full") == "write"
+    assert op_class_of("remove") == "write"
+    assert op_class_of("read") == "read"
+    assert op_class_of("stat") == "read"
+
+
+# ------------------------------------------------- accumulator bounds
+def _observe(pq, tenant, op="write", oid="obj-1", bytes_in=100,
+             bytes_out=0, lat_us=500.0):
+    pq.observe(tenant, 1, "1.0", op, oid, bytes_in, bytes_out, lat_us)
+
+
+def test_top_n_lru_evicts_into_overflow_fold():
+    acc = PerfQueryAccumulator(
+        PerfQuerySpec(qid=1, key_by=("tenant",), top_n=2))
+    fields = lambda t: (t, "1", "1.0", "write", "obj")  # noqa: E731
+    acc.observe(fields("a"), 10, 0, 100.0)
+    acc.observe(fields("b"), 10, 0, 100.0)
+    acc.observe(fields("a"), 10, 0, 100.0)   # refresh a's recency
+    acc.observe(fields("c"), 10, 0, 100.0)   # evicts b (LRU), not a
+    assert set(acc.rows) == {("a",), ("c",)}
+    assert acc.overflow.ops == 1 and acc.overflow.bytes_in == 10
+    # the bound holds under unbounded key churn
+    for i in range(500):
+        acc.observe(fields(f"churn{i}"), 1, 0, 50.0)
+    assert len(acc.rows) <= 2
+    snap = acc.snapshot()
+    total = sum(r["ops"] for r in snap["rows"]) + snap["overflow"]["ops"]
+    assert total == 504  # nothing lost to the fold, only de-named
+
+
+def test_queries_off_is_inert_and_set_queries_toggles_active():
+    pq = PerfQuerySet()
+    assert pq.active is False
+    assert pq.snapshot() is None
+    pq.set_queries({1: PerfQuerySpec(qid=1)})
+    assert pq.active is True
+    _observe(pq, "tenant-a")
+    pq.set_queries({})
+    assert pq.active is False and pq.snapshot() is None
+
+
+def test_accumulator_survives_unrelated_map_churn():
+    pq = PerfQuerySet()
+    spec = PerfQuerySpec(qid=1, key_by=("tenant",))
+    pq.set_queries({1: spec})
+    _observe(pq, "a")
+    # same spec re-pushed (map churn): cumulative rows survive
+    pq.set_queries({1: spec.to_dict()})
+    _observe(pq, "a")
+    snap = pq.snapshot()
+    assert snap["queries"]["1"]["rows"][0]["ops"] == 2
+    # changed spec: rows restart from zero
+    pq.set_queries({1: PerfQuerySpec(qid=1, key_by=("op_class",))})
+    snap = pq.snapshot()
+    assert snap["queries"]["1"]["rows"] == []
+
+
+def test_hostile_key_values_are_sanitized_and_bounded():
+    pq = PerfQuerySet()
+    pq.set_queries({1: PerfQuerySpec(qid=1, key_by=("tenant",))})
+    _observe(pq, 'evil"} bad{x="y')
+    _observe(pq, "x" * 500)
+    _observe(pq, "_overflow")  # cannot spoof the fold bucket's key
+    snap = pq.snapshot()
+    keys = [r["key"][0] for r in snap["queries"]["1"]["rows"]]
+    for k in keys:
+        assert len(k) <= 64
+        assert all(c.isalnum() or c in "._-" for c in k)
+        assert not k.startswith("_")
+    assert OVERFLOW_KEY not in keys
+
+
+# --------------------------------------------------------- store merge
+def _snap(seq, ops, key=("a",), qid="1"):
+    return {"seq": seq, "queries": {qid: {
+        "spec": PerfQuerySpec(qid=int(qid)).to_dict(),
+        "rows": [{"key": list(key), "ops": ops, "bytes_in": ops * 10,
+                  "bytes_out": 0, "lat": {"10": ops},
+                  "lat_sum": ops * 700.0}],
+        "overflow": {"ops": 0, "bytes_in": 0, "bytes_out": 0,
+                     "lat": {}, "lat_sum": 0.0}}}}
+
+
+def test_store_newest_seq_wins_and_redelivery_dedupes():
+    store = PerfQueryStore()
+    assert store.merge("osd.0", _snap(1, 5)) is True
+    assert store.merge("osd.0", _snap(1, 5)) is False   # re-shipped
+    assert store.merge("osd.0", _snap(3, 8)) is True    # cumulative
+    assert store.merge("osd.0", _snap(2, 6)) is False   # stale
+    rep = store.report(1)
+    assert rep["rows"][0]["ops"] == 8  # replaced, never summed
+
+
+def test_store_sums_across_daemons_and_reset_forgets():
+    store = PerfQueryStore()
+    store.merge("osd.0", _snap(1, 5))
+    store.merge("osd.1", _snap(4, 7))
+    rep = store.report(1)
+    assert rep["daemons"] == ["osd.0", "osd.1"]
+    assert rep["rows"][0]["ops"] == 12
+    assert rep["rows"][0]["p99_us"] > 0
+    # reboot: the revived daemon restarts seq at 1 — reset first, so
+    # its fresh snapshot merges and pre-crash rows never double-count
+    store.reset_daemon("osd.1")
+    assert store.merge("osd.1", _snap(1, 2)) is True
+    assert store.report(1)["rows"][0]["ops"] == 7
+
+
+def test_store_report_sort_and_limit():
+    store = PerfQueryStore()
+    store.merge("osd.0", {"seq": 1, "queries": {"1": {
+        "spec": PerfQuerySpec(qid=1).to_dict(),
+        "rows": [
+            {"key": ["many"], "ops": 9, "bytes_in": 10, "bytes_out": 0,
+             "lat": {"8": 9}, "lat_sum": 9 * 200.0},
+            {"key": ["big"], "ops": 2, "bytes_in": 9000, "bytes_out": 0,
+             "lat": {"14": 2}, "lat_sum": 2 * 12000.0}],
+        "overflow": {"ops": 0, "bytes_in": 0, "bytes_out": 0,
+                     "lat": {}, "lat_sum": 0.0}}}})
+    assert store.report(1, sort="ops")["rows"][0]["key"] == ["many"]
+    assert store.report(1, sort="bytes")["rows"][0]["key"] == ["big"]
+    assert store.report(1, sort="p99")["rows"][0]["key"] == ["big"]
+    assert len(store.report(1, limit=1)["rows"]) == 1
+    with pytest.raises(ValueError):
+        store.report(1, sort="nope")
+
+
+def test_store_aggregates_bound_exporter_surface():
+    store = PerfQueryStore()
+    store.merge("osd.0", _snap(1, 5))
+    store.merge("osd.1", _snap(2, 3, key=("b",)))
+    agg = store.aggregates()
+    assert set(agg) == {1}
+    assert agg[1]["ops"] == 8
+    assert agg[1]["keys"] == 2
+    assert agg[1]["overflow_ops"] == 0
+
+
+def test_pg_load_vector_from_pgid_keyed_query():
+    store = PerfQueryStore()
+    store.merge("osd.0", {"seq": 1, "queries": {"2": {
+        "spec": PerfQuerySpec(qid=2, key_by=("pgid",)).to_dict(),
+        "rows": [{"key": ["1.0"], "ops": 4, "bytes_in": 100,
+                  "bytes_out": 50, "lat": {}, "lat_sum": 0.0}],
+        "overflow": {"ops": 0, "bytes_in": 0, "bytes_out": 0,
+                     "lat": {}, "lat_sum": 0.0}}}})
+    load = store.pg_load(2)
+    assert load == {"pg_ops_1_0": 4, "pg_bytes_1_0": 150}
+
+
+# ------------------------------------------------------------ wire units
+def test_osdmap_tail_and_incremental_round_trip():
+    from ceph_tpu.mon.maps import OSDMap, OSDMapIncremental
+    from ceph_tpu.utils.codec import Decoder, Encoder
+
+    m = OSDMap()
+    m.epoch = 7
+    spec = PerfQuerySpec(qid=1, key_by=("tenant", "pool")).to_dict()
+    m.perf_queries[1] = spec
+    e = Encoder()
+    m.encode(e)
+    m2 = OSDMap.decode(Decoder(e.tobytes()))
+    assert m2.perf_queries == {1: spec}
+
+    # incremental: add + change + remove travel the v3 tail
+    old = OSDMap.decode(Decoder(e.tobytes()))
+    new = OSDMap.decode(Decoder(e.tobytes()))
+    new.epoch = 8
+    spec2 = PerfQuerySpec(qid=2, key_by=("pgid",)).to_dict()
+    new.perf_queries = {2: spec2}
+    inc = new.diff_from(old)
+    assert inc.pq_set == {2: spec2} and inc.pq_rm == [1]
+    ei = Encoder()
+    inc.encode(ei)
+    inc2 = OSDMapIncremental.decode(Decoder(ei.tobytes()))
+    old.apply_incremental(inc2)
+    assert old.perf_queries == {2: spec2}
+
+
+def test_render_top_sorts_and_rejects_bad_sort():
+    from ceph_tpu.tools.top_tool import render_top
+    report = {"qid": 1, "key_by": ["tenant"], "daemons": ["osd.0"],
+              "rows": [
+                  {"key": ["a"], "ops": 2, "bytes_in": 10, "bytes_out": 0,
+                   "lat_count": 2, "avg_us": 5.0, "p50_us": 4.0,
+                   "p99_us": 9.0},
+                  {"key": ["b"], "ops": 1, "bytes_in": 9000,
+                   "bytes_out": 0, "lat_count": 1, "avg_us": 50.0,
+                   "p50_us": 40.0, "p99_us": 90.0}]}
+    out = render_top(report, sort="bytes")
+    lines = out.splitlines()
+    assert lines[0].startswith("perf query 1")
+    assert lines[3].startswith("b")  # bytes sort puts b first
+    out = render_top(report, sort="ops", limit=1)
+    assert "b" not in out.splitlines()[-1]
+    with pytest.raises(ValueError):
+        render_top(report, sort="nope")
+
+
+# ----------------------------------------------------------- e2e leg
+def _make_cluster():
+    from ceph_tpu.tools.vstart import MiniCluster
+    from ceph_tpu.utils.config import default_config
+    cfg = default_config()
+    cfg.apply_dict({"osd_heartbeat_interval": 0.05,
+                    "osd_heartbeat_grace": 0.5,
+                    "ec_backend": "native",
+                    "osd_op_num_shards": 2})
+    return MiniCluster(n_osds=4, cfg=cfg).start()
+
+
+def test_e2e_attribution_totals_and_kill_revive():
+    """The tier-1 e2e: a tenant-grouped standing query registered at
+    the mon reaches every OSD through the map, two tenants' ops are
+    attributed at the reply edge (direct conn sends AND async EC
+    drains), partials merge to totals matching the client op counts,
+    the hot tenant tops the report, and an OSD kill/revive neither
+    wedges the merge nor double-counts."""
+    from ceph_tpu.client.rados import RadosClient
+    from ceph_tpu.tools.top_tool import render_top
+
+    c = _make_cluster()
+    try:
+        admin = c.client()
+        admin.create_pool("pool0", kind="ec", pg_num=4,
+                          ec_profile={"plugin": "jerasure", "k": "2",
+                                      "m": "1", "backend": "numpy"})
+        doc = admin.mon_command({"prefix": "perf query add",
+                                 "key_by": "tenant", "top_n": 16})
+        qid = doc["qid"]
+
+        hot = RadosClient(c.network, "client.hot", mons=c.mon_names,
+                          tenant="hot").connect()
+        cold = RadosClient(c.network, "client.cold", mons=c.mon_names,
+                           tenant="cold").connect()
+        data = b"x" * 4096
+        for i in range(12):
+            hot.write_full("pool0", f"hot-{i}", data)
+        for i in range(3):
+            cold.write_full("pool0", f"cold-{i}", data)
+        for i in range(6):
+            assert hot.read("pool0", f"hot-{i}") == data
+
+        # partials ship on the stats cadence; merge within a report
+        # interval (the ISSUE's visibility bound)
+        deadline = time.time() + 10
+        rep = None
+        while time.time() < deadline:
+            rep = admin.mon_command({"prefix": "perf query report",
+                                     "qid": qid})
+            if rep["rows"] and sum(r["ops"] for r in rep["rows"]) >= 21:
+                break
+            time.sleep(0.2)
+        rows = {tuple(r["key"]): r for r in rep["rows"]}
+        assert rows[("hot",)]["ops"] == 18          # 12 writes + 6 reads
+        assert rows[("cold",)]["ops"] == 3
+        assert rows[("hot",)]["bytes_in"] == 12 * 4096
+        assert rows[("hot",)]["bytes_out"] == 6 * 4096
+        assert rows[("hot",)]["p99_us"] > 0
+        top = max(rep["rows"], key=lambda r: r["ops"])
+        assert top["key"] == ["hot"]                # hot tenant tops
+        assert "hot" in render_top(rep, sort="ops")
+
+        # kill/revive: spare fills the hole, degraded IO still
+        # attributes, and the revived daemon's reset seq never
+        # double-counts pre-crash rows
+        epoch = c.mon.osdmap.epoch
+        store = c.kill_osd(2)
+        c.wait_for_epoch(epoch + 1)
+        c.settle(0.5)
+        from ceph_tpu.client.rados import RadosError
+        done = 0
+        deadline = time.time() + 30
+        while done < 4 and time.time() < deadline:
+            try:
+                hot.write_full("pool0", f"hk-{done}", data)
+                done += 1
+            except RadosError:
+                time.sleep(0.25)
+        assert done == 4
+        c.revive_osd(2, store)
+        c.wait_for_up(4)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            rep2 = admin.mon_command({"prefix": "perf query report",
+                                      "qid": qid})
+            r2 = {tuple(r["key"]): r for r in rep2["rows"]}
+            if r2.get(("hot",), {}).get("ops") == 22:
+                break
+            time.sleep(0.2)
+        assert r2[("hot",)]["ops"] == 22            # 18 + 4, exactly once
+        assert r2[("cold",)]["ops"] == 3
+
+        # rm converges: every OSD drops back to the zero-alloc path
+        ls = admin.mon_command({"prefix": "perf query ls"})
+        assert str(qid) in ls["queries"]
+        admin.mon_command({"prefix": "perf query rm", "qid": qid})
+        deadline = time.time() + 5
+        while time.time() < deadline and any(
+                o.perf_queries.active for o in c.osds.values()):
+            time.sleep(0.1)
+        assert not any(o.perf_queries.active for o in c.osds.values())
+    finally:
+        c.stop()

@@ -178,6 +178,56 @@ def test_rules_shape_and_rendering():
             in recs)
 
 
+def test_dashboard_pinned_to_emitted_rule_names():
+    """The generated Grafana dashboard may reference ONLY series that
+    exist: recorded rule names from recording_rules() plus the
+    exporter's bounded perf-query aggregates (PERF_QUERY_METRICS) — a
+    rule rename must break generation here, not strand a live panel
+    on a dead series."""
+    import json
+
+    import pytest
+
+    from ceph_tpu.tools.prom_rules import (PERF_QUERY_METRICS, dashboard,
+                                           main)
+    dash = json.loads(json.dumps(dashboard()))   # valid JSON document
+    assert dash["uid"] == "ceph-tpu-overview"
+    assert dash["panels"], "dashboard has no panels"
+    records = {r["record"] for r in recording_rules()}
+    raw_ok = {f"ceph_tpu_{m}" for m in PERF_QUERY_METRICS}
+    seen_raw = set()
+    ids = [p["id"] for p in dash["panels"]]
+    assert len(ids) == len(set(ids))
+    for p in dash["panels"]:
+        assert p["datasource"]["uid"] == "${DS_PROMETHEUS}"
+        refids = [t["refId"] for t in p["targets"]]
+        assert len(refids) == len(set(refids))
+        for t in p["targets"]:
+            for token in re.findall(r"ceph_tpu[A-Za-z0-9_:]*",
+                                    t["expr"]):
+                assert token in records or token in raw_ok, \
+                    f"panel {p['title']!r} references unknown " \
+                    f"series {token!r}"
+                if token in raw_ok:
+                    seen_raw.add(token)
+    # the attribution panel really reads the perf-query aggregates
+    assert f"ceph_tpu_perf_query_ops_total" in seen_raw
+    # the exemplar-linked target: client op p99 resolves trace dots
+    p99 = [t for p in dash["panels"] for t in p["targets"]
+           if t["expr"].endswith("op_lat_us:p99")]
+    assert p99 and p99[0].get("exemplar") is True
+    # a panel referencing a rule that was renamed away fails LOUDLY
+    with pytest.raises(KeyError):
+        dashboard(rules=[])
+    # the CLI face emits the same parseable document
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        main(["--dashboard"])
+    assert json.loads(buf.getvalue())["uid"] == "ceph-tpu-overview"
+
+
 def test_exporter_histogram_buckets_are_cumulative_le():
     """The rule expressions only work over CUMULATIVE le-labeled
     buckets — pin the exporter's rendering contract."""

@@ -510,6 +510,123 @@ def test_controller_mgr_module_applies_and_journals():
     assert applied2 == []   # stale backlog sensed as none -> steady
 
 
+def test_controller_observe_burn_slo_sense():
+    """observe_burn steps the same AIMD machine on SLO error-budget
+    burn: back off above burn_high, grow below burn_low when recovery
+    wants headroom, steady in the mid-band; every retune journals the
+    sensed burn."""
+    k = ControllerKnobs(res_min=4.0, res_max=128.0, step=8.0,
+                        backoff=0.5, hold=2, cooldown=1, lim_factor=2.0,
+                        burn_high=2.0, burn_low=0.5)
+    c = ReservationController(k, res0=32.0)
+    # burning 5x: one hot tick holds (hysteresis), second backs off
+    assert c.observe_burn(5.0, backlog=10, recovery_active=True) is None
+    assert c.observe_burn(5.0, 10, True) == (16.0, 32.0)
+    assert c.history[-1].reason == "backoff"
+    assert c.history[-1].burn == 5.0
+    # mid-band burn (low < 1.0 < high): steady forever
+    for _ in range(6):
+        assert c.observe_burn(1.0, 10, True) is None
+    # comfortably under burn_low with a live backlog: grow after hold
+    assert c.observe_burn(0.1, 10, True) is None
+    assert c.observe_burn(0.1, 10, True) == (24.0, 48.0)
+    assert c.history[-1].reason == "grow" and c.history[-1].burn == 0.1
+    assert c.status()["history"][-1]["burn"] == 0.1
+    # burn None (SLO module has no samples yet) = quiet: grow-eligible
+    # only when recovery actually wants headroom
+    c2 = ReservationController(k, res0=16.0)
+    assert c2.observe_burn(None, 0, False) is None
+    assert c2.observe_burn(None, 0, False) is None   # no backlog: steady
+    assert c2.observe_burn(None, 5, True) is None
+    assert c2.observe_burn(None, 5, True) == (24.0, 48.0)
+    assert c2.history[-1].burn is None
+    assert "burn" not in c2.status()["history"][-1]
+
+
+def test_qos_module_slo_sense_journals_burn():
+    """qos_controller_sense=slo: the mgr module senses the worst
+    fast-window SLO burn (evaluating slo_objectives directly when the
+    slo module is off), backs off a burning cluster, grows a quiet one
+    with recovery backlog, and journals the burn on every retune."""
+    import threading
+
+    from ceph_tpu.mon.mgr import MgrDaemon, QosModule
+    from ceph_tpu.utils.config import default_config
+    from ceph_tpu.utils.event_log import ClusterLog
+    from ceph_tpu.utils.metrics_history import MetricsHistoryStore
+
+    class StubProgress:
+        def active(self):
+            return [{"id": "recovery/x"}]
+
+    class StubMon:
+        def __init__(self):
+            self.cfg = default_config()
+            self.name = "mon.stub"
+            self._lock = threading.RLock()
+            self.metrics_history = MetricsHistoryStore()
+            self.progress = StubProgress()
+            self.cluster_log = ClusterLog()
+            self.cfg.apply_dict({"qos_controller": "on",
+                                 "qos_controller_sense": "slo",
+                                 "qos_controller_hold_ticks": 1,
+                                 "qos_controller_cooldown_ticks": 0,
+                                 "slo_objectives": "client_op<=20ms@99%"})
+
+    def bind_module(mon, res0):
+        applied = []
+        mgr = MgrDaemon.__new__(MgrDaemon)  # no tick thread
+        mgr.mon = mon
+        mgr._modules = {}
+        mod = QosModule(mgr)
+        mod.bind(lambda res, lim: applied.append((res, lim)), res0=res0)
+        return mod, applied
+
+    # a cluster burning 100x its 1% budget -> multiplicative backoff
+    hot = StubMon()
+    now = time.time()
+    hot.metrics_history.merge("osd.0", {"osd.0": [
+        {"ts": now - 2.0, "seq": 1, "counters": {
+            "op_lat_us": {"buckets_pow2": {}, "count": 0, "sum": 0.0}}},
+        {"ts": now, "seq": 2, "counters": {
+            "op_lat_us": {"buckets_pow2": {"17": 50}, "count": 50,
+                          "sum": 50 * 100_000.0}}},
+    ]})
+    mod, applied = bind_module(hot, res0=16.0)
+    mod.tick()
+    assert applied == [(8.0, 16.0)]
+    ev = hot.cluster_log.dump(channel="qos")["events"][-1]
+    assert ev["fields"]["reason"] == "backoff"
+    assert ev["fields"]["burn"] == pytest.approx(100.0)
+    assert mod.command("status")["sense"] == "slo"
+    # burn comfortably under burn_low + recovery backlog -> grow, and
+    # the journaled burn is the (zero) sensed value, not omitted
+    quiet = StubMon()
+    now = time.time()
+    quiet.metrics_history.merge("osd.0", {"osd.0": [
+        {"ts": now - 2.0, "seq": 1, "counters": {
+            "op_lat_us": {"buckets_pow2": {}, "count": 0, "sum": 0.0},
+            "mclock_depth_recovery": 0}},
+        {"ts": now, "seq": 2, "counters": {
+            "op_lat_us": {"buckets_pow2": {"10": 50}, "count": 50,
+                          "sum": 50 * 1_000.0},
+            "mclock_depth_recovery": 30}},
+    ]})
+    mod2, applied2 = bind_module(quiet, res0=4.0)
+    mod2.tick()
+    assert applied2 == [(12.0, 24.0)]
+    ev2 = quiet.cluster_log.dump(channel="qos")["events"][-1]
+    assert ev2["fields"]["reason"] == "grow"
+    assert ev2["fields"]["burn"] == 0.0
+    # when the slo module IS enabled, its last evaluation is reused
+    # (same tick cadence, already paid for) instead of re-evaluating
+    mod2.mgr._modules["slo"] = type("S", (), {"last": [
+        {"fast": {"observations": 4, "burn": 3.5}},
+        {"fast": {"observations": 0, "burn": 999.0}},  # empty: ignored
+    ]})()
+    assert mod2._slo_burn_fast() == 3.5
+
+
 # ----------------------------------------------------------- e2e legs
 def _make_cluster():
     from ceph_tpu.tools.vstart import MiniCluster
