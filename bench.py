@@ -2248,6 +2248,139 @@ def saturate_tenants_bench(args) -> int:
     return 0 if row["ok"] else 1
 
 
+def scrub_bench(args) -> int:
+    """`--scrub` mode: full-store folded deep scrub vs the per-object
+    python verify loop, plus the inline-compression gates.  ONE JSON
+    row.
+
+    The folded path is the OSD's background-scrub engine verbatim:
+    objects grouped into pow2 length buckets, zero-padded rows stacked
+    into one launch per bucket through ECBatcher.verify, EXPECTED
+    padded digests derived host-side from the stored digests via the
+    CRC32C zero-extension operator.  The baseline is the per-object
+    pure-python reference loop (crc32c_ref) — the unfolded shape the
+    paper's claim is against.
+
+    Gates: zero false mismatches over a clean store; an injected
+    bit-flip detected by BOTH modes on the SAME object; folded >= 10x
+    the loop when a fused backend (device or native C sweep) is
+    available, >= 1.0 (no-regression) on the pure-python fallback;
+    compression: czlib ratio <= 0.6 on compressible data,
+    incompressible falls through via required_ratio, byte-exact
+    round-trip."""
+    import numpy as np
+
+    on_cpu = _force_bench_cpu()
+    from ceph_tpu.ec.batcher import ECBatcher
+    from ceph_tpu.ec.verify import CrcVerifier
+    from ceph_tpu.ops import native
+    from ceph_tpu.ops.checksum import crc32c_extend_zeros, crc32c_ref
+    from ceph_tpu.osd.compression import CompressionPolicy, decompress
+
+    n_objects = int(os.environ.get("BENCH_SCRUB_OBJECTS", "384"))
+    rng = np.random.default_rng(11)
+    sizes = rng.integers(1024, 48 * 1024, n_objects)
+    objs = [rng.integers(0, 256, int(s), dtype=np.uint8).tobytes()
+            for s in sizes]
+    try:
+        digests = [native.crc32c(o) for o in objs]
+        host_crc, host = native.crc32c, "native"
+    except Exception:  # noqa: BLE001 - ctypes lib unavailable
+        digests = [crc32c_ref(o) for o in objs]
+        host_crc, host = crc32c_ref, "ref"
+    total_bytes = sum(len(o) for o in objs)
+
+    def python_loop(data, digs):
+        bad = [i for i, (o, d) in enumerate(zip(data, digs))
+               if crc32c_ref(o) != d]
+        return bad
+
+    def folded(data, digs, ver, batcher):
+        buckets: dict[int, list] = {}
+        for i, o in enumerate(data):
+            n = len(o)
+            b = 4 if n <= 4 else 1 << (n - 1).bit_length()
+            buckets.setdefault(b, []).append(i)
+        candidates = []
+        for blen, idxs in sorted(buckets.items()):
+            rows = np.zeros((len(idxs), blen), dtype=np.uint8)
+            expected = np.empty(len(idxs), dtype=np.uint32)
+            for r, i in enumerate(idxs):
+                o = data[i]
+                rows[r, :len(o)] = np.frombuffer(o, dtype=np.uint8)
+                expected[r] = crc32c_extend_zeros(digs[i],
+                                                  blen - len(o))
+            got = batcher.verify(ver, rows)
+            candidates += [idxs[int(r)]
+                           for r in np.nonzero(got != expected)[0]]
+        # candidates confirm against a host CRC (zero-false-mismatch
+        # contract): a surviving candidate is a real mismatch
+        return [i for i in candidates if host_crc(data[i]) != digs[i]]
+
+    ver = CrcVerifier("auto")
+    batcher = ECBatcher(window_us=0.0)
+    fused = ver._backend != "ref" or host == "native"
+    folded(objs[:16], digests[:16], ver, batcher)  # warm/compile
+
+    t0 = time.perf_counter()
+    loop_bad = python_loop(objs, digests)
+    t_loop = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    fold_bad = folded(objs, digests, ver, batcher)
+    t_fold = time.perf_counter() - t0
+    false_mismatches = len(fold_bad) + len(loop_bad)
+
+    # corruption leg: flip one byte, both modes must flag that object
+    victim = n_objects // 3
+    flipped = bytearray(objs[victim])
+    flipped[len(flipped) // 2] ^= 0x40
+    corrupted = list(objs)
+    corrupted[victim] = bytes(flipped)
+    loop_hit = python_loop(corrupted, digests)
+    fold_hit = folded(corrupted, digests, ver, batcher)
+    detect_ok = loop_hit == [victim] and fold_hit == [victim]
+
+    # compression gates (czlib through the pool-policy seam)
+    pol = CompressionPolicy("aggressive", "czlib", 0.875, 4096)
+    compressible = (b"the quick brown fox jumps over the lazy dog " *
+                    2000)
+    comp = pol.maybe_compress(compressible)
+    ratio = (len(comp[0]) / len(compressible)) if comp else 1.0
+    rt_ok = comp is not None and decompress(
+        comp[0], comp[1]["cz"], comp[1]["crl"]) == compressible
+    incompressible = rng.integers(0, 256, 64 * 1024,
+                                  dtype=np.uint8).tobytes()
+    falls_through = pol.maybe_compress(incompressible) is None
+
+    speedup = t_loop / max(t_fold, 1e-9)
+    need = 10.0 if fused else 1.0
+    ok = (false_mismatches == 0 and detect_ok and speedup >= need
+          and rt_ok and ratio <= 0.6 and falls_through)
+    print(json.dumps({
+        "metric": (f"folded deep-scrub verify MB/s ({n_objects} ragged "
+                   f"objects, {ver._backend} fold backend, {host} host "
+                   "recheck, vs per-object crc32c_ref loop; "
+                   "+ czlib inline-compression gates)"),
+        "value": round(total_bytes / max(t_fold, 1e-9) / 1e6, 1),
+        "unit": "MB/s",
+        "vs_baseline": round(speedup, 1),
+        "objects": n_objects,
+        "bytes": int(total_bytes),
+        "loop_s": round(t_loop, 4),
+        "folded_s": round(t_fold, 4),
+        "fold_backend": ver._backend,
+        "on_cpu": on_cpu,
+        "speedup_required": need,
+        "false_mismatches": false_mismatches,
+        "corruption_detected_both": detect_ok,
+        "compress_ratio": round(ratio, 3),
+        "compress_roundtrip_ok": rt_ok,
+        "incompressible_falls_through": falls_through,
+        "ok": ok,
+    }))
+    return 0 if ok else 1
+
+
 def headline_bench() -> int:
     cpu = cpu_baseline_gbps()
     print(f"bench: cpu single-thread baseline {cpu:.2f} GB/s", file=sys.stderr)
@@ -2324,6 +2457,11 @@ def main() -> int:
                            "reads vs primary (per-OSD spread gate), "
                            "client lease-cache hit-rate gate, mid-leg "
                            "write-under-lease revoke, reader-x10 leg")
+    mode.add_argument("--scrub", action="store_true",
+                      help="folded deep-scrub verify vs per-object "
+                           "python loop (zero-false-mismatch + "
+                           "corruption-detection gates) + inline-"
+                           "compression ratio/round-trip gates")
     ap.add_argument("--trace", action="store_true",
                     help="with --ec-batch/--ec-read: print the per-"
                          "stage latency decomposition table")
@@ -2383,6 +2521,8 @@ def main() -> int:
         return saturate_bench(args)
     if args.read_storm:
         return read_storm_bench(args)
+    if args.scrub:
+        return scrub_bench(args)
     return headline_bench()
 
 

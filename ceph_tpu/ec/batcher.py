@@ -436,6 +436,31 @@ class ECBatcher:
             raise op.error
         return op.decoded
 
+    def verify(self, verifier, rows: np.ndarray, *,
+               trace: tuple | None = None) -> np.ndarray:
+        """Batched digest verification (deep scrub, ec/verify.py):
+        concurrent scrub chunks whose objects padded to the same
+        length bucket fold into ONE CRC launch — (n, L) uint8 rows in,
+        (n,) uint32 standard CRC32C out, rows scattered back per op.
+        The ``verifier`` rides the codec slot (it carries the same
+        ``_backend`` / ``fold_sig`` protocol surface) but no coding
+        matrix — replicated pools verify through the same seam."""
+        rows = np.ascontiguousarray(rows, dtype=np.uint8)
+        n, L = rows.shape
+        if n == 0:
+            return np.zeros(0, dtype=np.uint32)
+        if self.window_us <= 0:
+            out = verifier.digests(rows)
+            self._account(1, rows.nbytes, FLUSH_IDLE)
+            return out
+        sig = ("ver", verifier.fold_sig(), L)
+        op = _PendingOp(verifier, streams=rows, length=L)
+        self._trace_submit(op, trace, sig)
+        self._submit(sig, op, rows.nbytes, self._flush_verify)
+        if op.error is not None:
+            raise op.error
+        return op.decoded
+
     def pending_ops(self) -> int:
         """Ops queued and not yet taken by a flusher (0 when quiescent)."""
         with self._cv:
@@ -541,6 +566,8 @@ class ECBatcher:
         whole coding matrix): kind/codec/k.m/length-bucket."""
         if sig[0] == "rep":
             return f"rep/{sig[1][0]}/lost{sig[2]}/L{sig[-1]}"
+        if sig[0] == "ver":
+            return f"ver/{sig[1][0]}/L{sig[-1]}"
         return f"{sig[0]}/{sig[1][0]}/k{sig[3]}m{sig[4]}/L{sig[-1]}"
 
     def _trace_submit(self, op: _PendingOp, trace: tuple | None,
@@ -1206,6 +1233,36 @@ class ECBatcher:
                 fspan, bucket=L, src_cols=sum(o.length for o in ops),
                 padded_cols=padded_cols, n_shard=ns)
             self._complete(ops, src_bytes, reason, ns, shard_bytes)
+
+    def _flush_verify(self, sig: tuple, ops: list[_PendingOp],
+                      reason: str) -> None:
+        """Folded digest flush: every op's (n_i, L) rows concatenate
+        into one (sum n_i, L) buffer — a single CRC pass (device tree
+        or native sweep, ec/verify.py) whose result rows scatter back
+        per op.  No stripe-count padding: the CRC tree's shape depends
+        only on L, so any row count compiles once per bucket."""
+        ver = ops[0].codec
+        src_bytes = sum(o.streams.nbytes for o in ops)
+        n_rows = sum(o.streams.shape[0] for o in ops)
+        fspan = self._trace_flush(sig, ops, reason)
+        try:
+            folded = (ops[0].streams if len(ops) == 1
+                      else np.concatenate([o.streams for o in ops]))
+            with self._launch_ctx(ver):
+                digs = ver.digests(folded)
+            row = 0
+            for o in ops:
+                n = o.streams.shape[0]
+                o.decoded = digs[row:row + n]
+                row += n
+        except BaseException as e:
+            for o in ops:
+                o.error = e
+        finally:
+            self._trace_flush_done(fspan, bucket=sig[-1],
+                                   src_cols=n_rows, padded_cols=n_rows,
+                                   n_shard=1)
+            self._complete(ops, src_bytes, reason)
 
     def _flush_repair(self, sig: tuple, ops: list[_PendingOp],
                       reason: str) -> None:

@@ -72,6 +72,10 @@ class ScenarioConfig:
     #: writes re-opening storms) and to slow points catching up later —
     #: served-ops-in-window is what the reservation/limit knob shapes
     qos_window_s: float = 3.0
+    #: force one background deep-scrub cycle per OSD at the head of
+    #: the steady leg (the scrub-while-loaded leg: the cycle runs
+    #: under the scrub mclock class and the client envelope must hold)
+    scrub: bool = True
     mclock: dict = field(default_factory=dict)  # osd_mclock_* overrides
     seed: int = 0
     #: "rados" = librados directly; "rgw" = the RgwGateway PUT/GET
@@ -261,6 +265,22 @@ def _run_point_on(c, cfg: ScenarioConfig) -> dict:
     sampler = threading.Thread(target=monitor, daemon=True)
     sampler.start()
 
+    scrub_info = {"forced": False, "cycles": 0, "verified_bytes": 0}
+    if getattr(cfg, "scrub", True):
+        # scrub-while-loaded: force one background deep-scrub cycle on
+        # every OSD at the head of the steady leg — chunks queue under
+        # the scrub mclock class while client load saturates, and the
+        # point's client invariants must hold regardless
+        s_start, _s_end = times["steady"]
+        if (d := s_start + 0.2 - time.time()) > 0:
+            time.sleep(d)
+        for o in list(c.osds.values()):
+            o._scrub_tick(time.time())
+            for st in o._scrub_auto.values():
+                st["due"] = 0.0
+            o._scrub_tick(time.time())
+        scrub_info["forced"] = True
+
     thrash_info = {"killed": False, "revived": False,
                    "kill_t": None, "victim": None}
     pre_thrash = None
@@ -366,11 +386,29 @@ def _run_point_on(c, cfg: ScenarioConfig) -> dict:
     closed_progressed = all(
         legs[l.name].achieved > 0 for l in cfg.legs()
         if l.mode == "closed")
+    if scrub_info["forced"]:
+        # the forced cycles must have finished (the drain loop above
+        # already waited out the scrub-class queue); count them from
+        # the OSDs still alive — the thrash victim restarts at zero
+        sdl = time.time() + 10.0
+        while time.time() < sdl:
+            live = list(c.osds.values())
+            if all(not st["running"] for o in live
+                   for st in o._scrub_auto.values()):
+                break
+            time.sleep(0.1)
+        live = list(c.osds.values())
+        scrub_info["cycles"] = sum(o.perf.get("scrubs") for o in live)
+        scrub_info["verified_bytes"] = sum(
+            o.perf.get("scrub_verified_bytes") for o in live)
+
     invariants = {
         "no_deadlock": merged["ok"] and closed_progressed,
         "queues_bounded": drained,
         "recovery_completes": recovery["completed"],
     }
+    if scrub_info["forced"]:
+        invariants["scrub_completes"] = scrub_info["cycles"] > 0
     row = {
         "id": cfg.point_id,
         "mclock": dict(cfg.mclock),
@@ -381,6 +419,7 @@ def _run_point_on(c, cfg: ScenarioConfig) -> dict:
         "msgs_per_op": msgs_per_op,
         "slow_ops_trips": _slow_ops_trips(c),
         "recovery": recovery,
+        "scrub": scrub_info,
         "invariants": invariants,
         "worker_errors": merged["worker_errors"],
     }

@@ -62,10 +62,15 @@ class ProgressTracker:
         self._done: list[dict] = []
         self._count = 0  # item-id sequence: one id per STORM, ever
 
+    #: event kinds -> (verb, display label); scrub storms (the OSD's
+    #: background deep-scrub cycles) ride the same machinery as
+    #: recovery — one item per cycle, monotonic percent, linger+clear
+    VERBS = {"recovery": "Recovery", "scrub": "Deep scrub"}
+
     @staticmethod
-    def _key(ev: dict) -> tuple:
+    def _key(ev: dict, verb: str) -> tuple:
         f = ev.get("fields") or {}
-        return (ev.get("daemon", "?"), f.get("pg", "?"),
+        return (verb, ev.get("daemon", "?"), f.get("pg", "?"),
                 round(float(f.get("start_ts") or ev.get("ts") or 0), 6))
 
     def on_event(self, ev: dict) -> None:
@@ -80,22 +85,23 @@ class ProgressTracker:
 
     def _on_event(self, ev: dict) -> None:
         f = ev.get("fields") or {}
-        kind = f.get("event")
-        if kind not in ("recovery_start", "recovery_progress",
-                        "recovery_done"):
+        kind = str(f.get("event") or "")
+        verb, _, phase = kind.partition("_")
+        if verb not in self.VERBS or phase not in ("start", "progress",
+                                                   "done"):
             return
-        key = self._key(ev)
+        key = self._key(ev, verb)
         now = float(ev.get("ts") or time.time())
         with self._lock:
             it = self._active.get(key)
             if it is None:
-                if kind == "recovery_done" or key in \
+                if phase == "done" or key in \
                         {i["key"] for i in self._done}:
                     # a straggling duplicate of a completed storm —
                     # never resurrect it as a 0% item
                     it = next((i for i in self._done
                                if i["key"] == key), None)
-                    if it is None and kind != "recovery_done":
+                    if it is None and phase != "done":
                         return
                 if it is None:
                     self._count += 1
@@ -104,10 +110,11 @@ class ProgressTracker:
                     # its gauge series must not splice into (and zigzag
                     # under) the finished one's
                     it = {"key": key,
-                          "id": f"recovery/{f.get('pg', '?')}/"
+                          "id": f"{verb}/{f.get('pg', '?')}/"
                                 f"{ev.get('daemon', '?')}"
                                 f"#{self._count}",
-                          "message": f"Recovery pg {f.get('pg', '?')} "
+                          "message": f"{self.VERBS[verb]} "
+                                     f"pg {f.get('pg', '?')} "
                                      f"({ev.get('daemon', '?')})",
                           "started": now, "updated": now,
                           "done": 0, "total": 0, "percent": 0.0,
@@ -135,7 +142,7 @@ class ProgressTracker:
             it["eta_seconds"] = (round(remaining / it["rate_eps"], 1)
                                  if it["rate_eps"] > 0 and remaining > 0
                                  else (0.0 if not remaining else None))
-            if kind == "recovery_done" and it["completed"] is None:
+            if phase == "done" and it["completed"] is None:
                 it["percent"] = 100.0
                 it["eta_seconds"] = 0.0
                 it["completed"] = time.time()

@@ -1635,6 +1635,31 @@ class MonitorLite(Dispatcher):
                     f"pool {pool.name} pg_num {old_num} -> {new} "
                     f"({verb})")
             return 0, {"pg_num": new}
+        if prefix == "osd pool set-compression":
+            # per-pool compression options ride the pool's profile
+            # mapping in the OSDMap (same channel as read_policy):
+            # every OSD's write path converges on the next map push.
+            # Objects already stored keep their on-disk form — the
+            # policy only governs writes from here on.
+            from ..osd.compression import POOL_OPTS, validate_pool_opts
+            with self._lock:
+                pool = self._pool_by_name(cmd["pool"])
+                if pool is None:
+                    return -2, {"error": f"no pool {cmd['pool']!r}"}
+                prof = dict(pool.ec_profile or {})
+                for opt in POOL_OPTS:
+                    if opt in cmd:
+                        prof[opt] = str(cmd[opt])
+                try:
+                    validate_pool_opts(prof)
+                except (ValueError, TypeError) as e:
+                    return -22, {"error": f"bad compression options: {e}"}
+                pool.ec_profile = prof
+                self._commit_map(
+                    f"pool {pool.name} compression "
+                    f"{prof.get('compression_mode', 'none')}")
+            return 0, {opt: prof[opt] for opt in POOL_OPTS
+                       if opt in prof}
         if prefix == "osd pool selfmanaged-snap-create":
             # mint a pool-unique snap id (pg_pool_t::snap_seq role)
             with self._lock:
@@ -2072,7 +2097,7 @@ class MonitorLite(Dispatcher):
                 # feed the NORMALIZED copy append() returns — the raw
                 # report dict may carry junk a tracker should not see
                 norm = self.cluster_log.append(ev)
-                if norm["channel"] == "recovery":
+                if norm["channel"] in ("recovery", "scrub"):
                     self.progress.on_event(norm)
                 elif norm["channel"] == "batch" and \
                         self.cfg["mon_batch_thrash_warn_count"] > 0:
@@ -2200,6 +2225,15 @@ class MonitorLite(Dispatcher):
                            (cmd.get("ec_profile") or {}).items()}
                 size = int(cmd.get("size", self.cfg["osd_pool_default_size"]))
                 min_size = max(1, size - 1)
+            # per-pool compression options (compression_mode/algorithm/
+            # required_ratio/min_blob_size) validate at create time — a
+            # bad algorithm name must fail THIS command, not every
+            # OSD's write path at first IO
+            try:
+                from ..osd.compression import validate_pool_opts
+                validate_pool_opts(profile)
+            except (ValueError, TypeError) as e:
+                return -22, {"error": f"bad compression options: {e}"}
             spec = PoolSpec(self.osdmap.next_pool_id, name, kind, size,
                             min_size, pg_num, profile)
             self.osdmap.add_pool(spec)

@@ -737,11 +737,16 @@ class MScrubRequest:
 
 @dataclass
 class MScrubShard:
-    """Primary -> shard member: send me your scrub map for this PG."""
+    """Primary -> shard member: send me your scrub map for this PG.
+
+    Carries its QoS class so the member's dispatcher queues the map
+    generation under the scrub mclock reservation (a message-carried
+    ``klass`` wins over the static per-type table)."""
 
     tid: int
     pgid: PgId
     deep: bool
+    klass: str = "scrub"
 
 
 @dataclass

@@ -100,6 +100,50 @@ def _zero_operator(nbytes: int) -> np.ndarray:
     return out
 
 
+#: M^{2^j} ladder (j-th entry appends 2^j zero bytes), built once by
+#: repeated squaring; 48 rungs cover pads past 256 TiB
+_POW2_ZERO_OPS: list[np.ndarray] = []
+
+
+def _pow2_zero_ops() -> list[np.ndarray]:
+    if not _POW2_ZERO_OPS:
+        ops = [_zero_operator(1)]
+        for _ in range(47):
+            ops.append(_gf2_matmul(ops[-1], ops[-1]))
+        _POW2_ZERO_OPS.extend(ops)
+    return _POW2_ZERO_OPS
+
+
+def crc32c_extend_zeros(crc: int, nzeros: int) -> int:
+    """Standard CRC32C of `data || 0^nzeros` given crc32c(data).
+
+    Appending zero bytes injects no message bits, so the raw state
+    evolves purely linearly: raw' = M^nzeros · raw.  Converting the
+    standard crc to raw (xor 0xFFFFFFFF twice around the operator)
+    gives the folded-scrub identity — a stored whole-object digest can
+    be re-expressed as the digest of the object padded to any bucket
+    length without touching the bytes.
+
+    Per-call cost is popcount(nzeros) matrix-VECTOR products through
+    the shared pow2 operator ladder — no per-pad-length matrix builds,
+    so a full-store scrub's ragged pad counts cost microseconds each
+    instead of a fresh squaring chain per distinct length."""
+    v = (crc ^ 0xFFFFFFFF) & 0xFFFFFFFF
+    if nzeros > 0:
+        ops = _pow2_zero_ops()
+        j = 0
+        while nzeros:
+            if nzeros & 1:
+                op, acc = ops[j], 0
+                for b in range(32):
+                    if v >> b & 1:
+                        acc ^= int(op[b])
+                v = acc
+            nzeros >>= 1
+            j += 1
+    return (v ^ 0xFFFFFFFF) & 0xFFFFFFFF
+
+
 class CrcPlan:
     """Precomputed constants for device CRC32C over fixed-length
     chunks (nbytes = n_words * 4, n_words a power of two)."""

@@ -68,6 +68,7 @@ from .objectstore import (CollectionId, NoSuchObject, ObjectId, ObjectStore,
 from ..ec.arena import DeviceArena
 from .extent_cache import ECExtentCache, register_read_scaleout_counters
 from .intervals import INTERVALS_KEY, Interval, LES_KEY, PastIntervals
+from . import compression
 from .objops import ObjOpsMixin
 from .pglog import PGLOG_OID, LogEntry, PGLog
 from .scheduler import (ClassParams, PHASE_NONE, ShardedScheduler,
@@ -851,6 +852,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         self._requery_at: dict[tuple, float] = {}
         self._requery_timers: dict[tuple, object] = {}
         self._pending_scrubs: dict = {}
+        # background deep-scrub state per hosted PG (cursor persists in
+        # the PG's scrub meta object; this is only the pacing side)
+        self._scrub_auto: dict = {}
         # recovery reservations + initiation throttle (AsyncReserver /
         # osd_max_backfills / osd_recovery_max_active roles): bulk
         # recovery data movement queues behind a per-PG local
@@ -966,7 +970,17 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                             "recovery_rebuilt_bytes",
                             "recovery_narrow_rebuilds",
                             "recovery_subchunk_rebuilds",
-                            "recovery_wide_retries"])
+                            "recovery_wide_retries",
+                            # continuous folded deep scrub (osd/scrub.py
+                            # auto-scrub scheduler + the ECBatcher
+                            # verify op kind)
+                            "scrub_verified_bytes",
+                            "scrub_verify_launches",
+                            "scrub_mismatches",
+                            "scrub_digest_missing",
+                            "scrub_auto_chunks"])
+        # inline store compression decision/ratio telemetry
+        self.perf.add_many(compression.COUNTERS)
         # read scale-out: hot-tier admission telemetry, lease
         # grant/revoke flow, balanced (non-primary) read serving —
         # shared schema with tools/prom_rules.py's rate rules
@@ -1741,8 +1755,8 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         was_whiteout = existed and self._head_whiteout(cid, m.oid)
         extra_attrs = {"wh": 0} if was_whiteout else {}
         partial = not full and (m.offset > 0 or (
-            existed and m.offset + len(m.data) < self.store.stat(
-                cid, ObjectId(m.oid))["size"]))
+            existed and m.offset + len(m.data) < self._obj_raw_size(
+                cid, ObjectId(m.oid))))
         if partial:
             self._apply_partial(pgid, m.oid, -1, [(m.offset, m.data)],
                                 version, create_ok=True, pre_tx=snap_tx,
@@ -1752,8 +1766,9 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             else:
                 # object just created here: replicas may lack it entirely,
                 # so replicate the full (zero-prefixed) content instead of
-                # a partial they could not apply
-                payload = self.store.read(cid, ObjectId(m.oid)).to_bytes()
+                # a partial they could not apply (raw: the wire never
+                # carries compressed bytes)
+                payload, _ = self._read_obj_raw(cid, ObjectId(m.oid))
                 op, off = "write", 0
         else:
             op, payload, off = "write", m.data, 0
@@ -1805,8 +1820,14 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 conn.send(MOSDOpReply(m.tid, err,
                                       epoch=self.osdmap.epoch))
                 return
-            bl = self.store.read(cid, target)
-            data = bl.to_bytes()
+            try:
+                data = self._inflate(
+                    self.store.read(cid, target).to_bytes(),
+                    self.store.getattrs(cid, target))
+            except ValueError:
+                conn.send(MOSDOpReply(m.tid, EIO,
+                                      epoch=self.osdmap.epoch))
+                return
             if m.length:
                 data = data[m.offset:m.offset + m.length]
             elif m.offset:
@@ -3104,6 +3125,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             if prev_version >= 0 and \
                     int(old_attrs.get("v", 0)) != prev_version:
                 return EAGAIN
+            # extent writes (and their rollback pre-images) operate in
+            # raw space: a compressed stored blob rewrites raw first
+            # and stays raw until the next whole-object ingest
+            old_attrs = self._inflate_in_place(cid, obj, old_attrs)
         # stash the pre-images being overwritten (the PGLog rollback
         # generation role): a torn partial write rolls back via these
         rollback = []
@@ -3161,10 +3186,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         obj = ObjectId(oid, shard=parity_shard)
         if not self.store.exists(cid, obj):
             return ENOENT
-        if prev_version >= 0:
-            cur = int(self.store.getattrs(cid, obj).get("v", 0))
-            if cur != prev_version:
-                return EAGAIN
+        cur_attrs = dict(self.store.getattrs(cid, obj))
+        if prev_version >= 0 and int(cur_attrs.get("v", 0)) != \
+                prev_version:
+            return EAGAIN
+        # delta folds are raw-space extent arithmetic: inflate a
+        # compressed parity chunk before folding into it
+        self._inflate_in_place(cid, obj, cur_attrs)
         # fold deltas over ONE union-range buffer: extents from different
         # data shards overlap in parity space (same stripe row), and the
         # folds must accumulate — read the covering range once, fold all,
@@ -3672,8 +3700,28 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         cid = CollectionId(pgid.pool, pgid.seed)
         obj = to_oid(oid, shard)  # vname-aware (clone shards)
         try:
-            data = self._read_shard_slices(cid, obj, extents)
             attrs = dict(self.store.getattrs(cid, obj))
+            if "cz" in attrs:
+                # compressed shard: inflate (whole blob — compressed
+                # extents have no ranged form) and serve RAW slices;
+                # the wire carries raw bytes only, so the extent
+                # metadata stays home
+                raw = self._inflate(self.store.read(cid, obj).to_bytes(),
+                                    attrs)
+                attrs.pop("cz")
+                attrs.pop("crl", None)
+                if extents:
+                    parts = []
+                    for off, ln in extents:
+                        seg = raw[off:off + ln]
+                        if len(seg) < ln:
+                            seg += b"\0" * (ln - len(seg))
+                        parts.append(seg)
+                    data = b"".join(parts)
+                else:
+                    data = raw
+            else:
+                data = self._read_shard_slices(cid, obj, extents)
             if extents is None:
                 # whole-shard reads serve recovery: the object's
                 # replicated omap rides along so a rebuilt shard lands
@@ -3689,9 +3737,10 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             return 0, data, attrs
         except NoSuchObject:
             return ENOENT, b"", {}
-        except StoreError:
-            # checksum-poisoned shard (FileStore csum verify): report
-            # EIO promptly so decode proceeds from the remaining shards
+        except (StoreError, ValueError):
+            # checksum-poisoned shard (FileStore csum verify) or a
+            # compressed blob that no longer inflates: report EIO
+            # promptly so decode proceeds from the remaining shards
             return EIO, b"", {}
 
     def _deliver_local_shard_read(self, tid, pgid, oid, shard,
@@ -3966,6 +4015,79 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         else:
             self._local_commit_ack(tid, pgid)
 
+    # -- inline compression (osd/compression.py) ---------------------------
+    def _compression_policy(self, pool: int):
+        """The pool's resolved at-rest compression policy (None = store
+        raw).  Cached per (pool, map epoch); a malformed profile on a
+        live map degrades to raw rather than failing every write."""
+        pm = getattr(self, "_comp_policies", None)
+        if pm is None:
+            pm = self._comp_policies = {}
+        epoch = self.osdmap.epoch if self.osdmap is not None else 0
+        hit = pm.get(pool)
+        if hit is not None and hit[0] == epoch:
+            return hit[1]
+        pol = None
+        try:
+            spec = self.osdmap.pools.get(pool) if self.osdmap else None
+            if spec is not None:
+                pol = compression.CompressionPolicy.from_pool(
+                    spec, self.cfg)
+        except Exception as e:  # noqa: BLE001 - bad profile: store raw
+            dout("osd", 1)("%s: pool %d compression profile invalid "
+                           "(%r); storing raw", self.name, pool, e)
+        pm[pool] = (epoch, pol)
+        return pol
+
+    def _inflate(self, data: bytes, attrs: dict) -> bytes:
+        """Raw bytes of one stored blob (identity when uncompressed)."""
+        if "cz" not in attrs:
+            return data
+        return compression.decompress(data, attrs["cz"],
+                                      int(attrs["crl"]), perf=self.perf)
+
+    def _read_obj_raw(self, cid, obj) -> tuple[bytes, dict]:
+        """(raw bytes, stored attrs) of one object — the helper every
+        read seam that needs RAW content goes through (wire payloads,
+        extent arithmetic, cls/op contexts).  Attrs are returned as
+        stored: callers shipping them strip cz/crl via _push_attrs."""
+        attrs = dict(self.store.getattrs(cid, obj))
+        data = self.store.read(cid, obj).to_bytes()
+        return self._inflate(data, attrs), attrs
+
+    def _obj_raw_size(self, cid, obj) -> int:
+        """Logical (raw) size of a stored object: the recorded raw
+        length when compressed, else the store's stat size."""
+        try:
+            attrs = self.store.getattrs(cid, obj)
+        except NoSuchObject:
+            attrs = {}
+        if "cz" in attrs:
+            return int(attrs["crl"])
+        return self.store.stat(cid, obj)["size"]
+
+    def _inflate_in_place(self, cid, obj, attrs: dict) -> dict:
+        """Rewrite a compressed stored object RAW (same version) so the
+        extent paths — partial writes, parity delta folds, rollback
+        pre-images — operate in raw space.  Every replica/shard runs
+        the same inflate on the same op, so stores stay byte-identical.
+        Returns the refreshed attrs."""
+        if "cz" not in attrs:
+            return attrs
+        raw = self._inflate(self.store.read(cid, obj).to_bytes(), attrs)
+        attrs = dict(attrs)
+        attrs.pop("cz")
+        attrs.pop("crl", None)
+        attrs["d"] = native_crc32c(raw)
+        tx = Transaction()
+        tx.truncate(cid, obj, 0)
+        tx.write(cid, obj, 0, raw)
+        tx.rmattr(cid, obj, "cz")
+        tx.rmattr(cid, obj, "crl")
+        tx.setattrs(cid, obj, {"d": attrs["d"]})
+        self.store.queue_transaction(tx)
+        return attrs
+
     # -- sub-op handling (shard/replica side) ------------------------------
     def _apply_write(self, pgid: PgId, oid: str, shard: int, data: bytes,
                      attrs: dict, omap: dict | None = None,
@@ -3977,8 +4099,26 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         # a device-computed csum from the fused encode pass arrives as
         # "dcsum" and skips the CPU re-sweep (scrub still re-verifies)
         dc = attrs.get("dcsum")
-        attrs = dict(attrs, d=int(dc) if dc is not None
-                     else native_crc32c(data))
+        # inline compression: whole-shard replace is the store's ingest
+        # boundary, and the decision is a pure function of (pool
+        # policy, raw bytes) — every holder of these bytes lands the
+        # SAME stored form regardless of how they arrived (client op,
+        # recovery push, scrub repair), which replica digest compare
+        # relies on.  The wire always carries raw bytes.
+        comp = None
+        if data:
+            pol = self._compression_policy(pgid.pool)
+            if pol is not None and pol.mode == "aggressive":
+                comp = pol.maybe_compress(data, perf=self.perf)
+        if comp is not None:
+            data, cattrs = comp
+            # the stored digest covers the STORED bytes (scrub never
+            # inflates); the fused-graph dcsum covered the raw bytes,
+            # so it cannot stand in here
+            attrs = dict(attrs, d=native_crc32c(data), **cattrs)
+        else:
+            attrs = dict(attrs, d=int(dc) if dc is not None
+                         else native_crc32c(data))
         attrs.pop("dcsum", None)
         # entry epoch: a recovery push carries the authority's stamp in
         # "ev" (it must survive verbatim or the re-pushed entry forks
@@ -3993,6 +4133,11 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
         tx.touch(cid, obj)
         tx.truncate(cid, obj, 0)
         tx.write(cid, obj, 0, data)
+        if "cz" not in attrs:
+            # a raw overwrite of a previously-compressed object must
+            # not leave stale extent metadata behind (setattrs merges)
+            tx.rmattr(cid, obj, "cz")
+            tx.rmattr(cid, obj, "crl")
         tx.setattrs(cid, obj, {k: v for k, v in attrs.items()})
         if omap is not None:
             # recovery pushes carry the object's omap: REPLACE ours
@@ -4284,6 +4429,13 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 except Exception as e:  # noqa: BLE001
                     dout("osd", 1)("%s: stats report failed: %r",
                                    self.name, e)
+            # background deep scrub: arm due PGs (chunks run on the
+            # shard threads under the scrub mclock class, not here)
+            try:
+                self._scrub_tick(now)
+            except Exception as e:  # noqa: BLE001
+                dout("osd", 1)("%s: scrub tick failed: %r",
+                               self.name, e)
 
     def _sweep_pending(self, now: float, max_age: float | None = None) -> None:
         """Fail ops whose sub-ops never completed (peer died mid-op) so
@@ -5314,8 +5466,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 for name, v in names.items():
                     obj = to_oid(name)
                     try:
-                        data = self.store.read(cid, obj).to_bytes()
-                        attrs = self.store.getattrs(cid, obj)
+                        data, attrs = self._read_obj_raw(cid, obj)
                         push[name] = (int(attrs.get("v", v)), data, None,
                                       self.store.omap_get(cid, obj),
                                       self._push_attrs(attrs))
@@ -5371,8 +5522,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
                 for name, shard in push:
                     obj = to_oid(name, shard)
                     try:
-                        data = self.store.read(cid, obj).to_bytes()
-                        attrs = self.store.getattrs(cid, obj)
+                        data, attrs = self._read_obj_raw(cid, obj)
                         out[name] = (int(attrs.get("v", 0)), data, None,
                                      self.store.omap_get(cid, obj),
                                      self._push_attrs(attrs))
@@ -5422,8 +5572,7 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
             for name in m.names:
                 obj = to_oid(name)
                 try:
-                    data = self.store.read(cid, obj).to_bytes()
-                    attrs = self.store.getattrs(cid, obj)
+                    data, attrs = self._read_obj_raw(cid, obj)
                     push[name] = (int(attrs.get("v", 0)), data, None,
                                   self.store.omap_get(cid, obj),
                                   self._push_attrs(attrs))
@@ -6072,10 +6221,12 @@ class OSDDaemon(ObjOpsMixin, ScrubMixin, SnapMixin, Dispatcher):
 
     def _push_attrs(self, attrs: dict) -> dict:
         """Attrs worth carrying on a recovery push: everything the apply
-        side does not recompute (v/len/d) — SnapSets, whiteouts, user
-        attrs survive recovery this way."""
+        side does not recompute (v/len/d, and the compression extent
+        metadata cz/crl — pushes ship raw bytes and the receiver's
+        _apply_write re-decides compression for its own store) —
+        SnapSets, whiteouts, user attrs survive recovery this way."""
         return {k: v for k, v in attrs.items()
-                if k not in ("v", "len", "d")}
+                if k not in ("v", "len", "d", "cz", "crl")}
 
     def _handle_pg_push(self, conn, m: MPGPush) -> None:
         # per-push child span of the sender's storm root (the carried

@@ -171,12 +171,18 @@ class ObjOpsMixin:
             have = set(self.store.omap_get(cid, obj))
             tx.omap_rmkeys(cid, obj, [k for k in keys if k in have])
         data = self.store.read(cid, obj).to_bytes() if exists else b""
+        # d covers the STORED bytes (compressed or not) — content is
+        # untouched by an omap op, so this recompute is a no-op refresh
         attrs = {"v": version, "d": _crc32c(data)}
         if shard >= 0 and exists:
             # EC shard convention: "len" holds the TOTAL object length
             # (set by the stripe write path) — preserve it
             old_len = self.store.getattrs(cid, obj).get("len")
             attrs["len"] = old_len if old_len is not None else len(data)
+        elif exists:
+            # replicated "len" keeps RAW semantics (a compressed blob's
+            # stored size is not its logical size)
+            attrs["len"] = self._obj_raw_size(cid, obj)
         else:
             attrs["len"] = len(data)
         tx.setattrs(cid, obj, attrs)
@@ -265,7 +271,7 @@ class ObjOpsMixin:
         is_ec = self._is_ec(pgid)
         obj = self._local_obj(pgid, m.oid)
         exists = self.store.exists(cid, obj)
-        data = (self.store.read(cid, obj).to_bytes()
+        data = (self._read_obj_raw(cid, obj)[0]
                 if exists and not is_ec else b"")
         omap = self.store.omap_get(cid, obj) if exists else {}
         ctx = cls_mod.ClsContext(data, omap, exists)
@@ -330,6 +336,10 @@ class ObjOpsMixin:
         if effects.get("data") is not None:
             tx.truncate(cid, obj, 0)
             tx.write(cid, obj, 0, effects["data"])
+            # raw rewrite of a possibly-compressed blob: drop the
+            # stale extent metadata (setattrs merges)
+            tx.rmattr(cid, obj, "cz")
+            tx.rmattr(cid, obj, "crl")
             data = bytes(effects["data"])
         else:
             data = self.store.read(cid, obj).to_bytes() if exists \
@@ -347,6 +357,8 @@ class ObjOpsMixin:
         if shard >= 0 and exists and effects.get("data") is None:
             old_len = self.store.getattrs(cid, obj).get("len")
             attrs["len"] = old_len if old_len is not None else len(data)
+        elif exists and effects.get("data") is None:
+            attrs["len"] = self._obj_raw_size(cid, obj)
         else:
             attrs["len"] = len(data)
         tx.setattrs(cid, obj, attrs)
@@ -393,7 +405,7 @@ class ObjOpsMixin:
         def cur() -> bytes:
             nonlocal data
             if data is None:
-                data = (self.store.read(cid, obj).to_bytes()
+                data = (self._read_obj_raw(cid, obj)[0]
                         if exists else b"")
             return data
 
@@ -480,7 +492,7 @@ class ObjOpsMixin:
         def cur() -> bytes:
             nonlocal data, loaded
             if not loaded:
-                data = self.store.read(cid, obj).to_bytes()
+                data = self._read_obj_raw(cid, obj)[0]
                 loaded = True
             return data
 
@@ -658,6 +670,8 @@ class ObjOpsMixin:
         if eff.get("data") is not None:
             tx.truncate(cid, obj, 0)
             tx.write(cid, obj, 0, bytes(eff["data"]))
+            tx.rmattr(cid, obj, "cz")
+            tx.rmattr(cid, obj, "crl")
             data = bytes(eff["data"])
         else:
             data = None  # content untouched: existing d/len stay valid

@@ -187,7 +187,8 @@ class SnapMixin:
             written = (m.offset, len(m.data))
         elif m.op in ("write_full", "remove", "snap_rollback"):
             try:
-                old_size = self.store.stat(cid, head)["size"]
+                # raw (logical) size: overlap intervals live in raw space
+                old_size = self._obj_raw_size(cid, head)
             except (NoSuchObject, NoSuchCollection):
                 old_size = 0
             written = (0, max(old_size, len(getattr(m, "data", b""))))
@@ -198,7 +199,7 @@ class SnapMixin:
             cloneid = newest
             clone = ObjectId(name, generation=cloneid)
             tx.clone(cid, head, clone)
-            size = self.store.stat(cid, head)["size"]
+            size = self._obj_raw_size(cid, head)
             ss["clones"] = sorted(set(ss["clones"]) | {cloneid})
             ss["sz"][cloneid] = size
             ss["ov"][cloneid] = [[0, size]]
@@ -434,17 +435,23 @@ class SnapMixin:
         if pre_tx is not None:  # make_writeable clone of the current head
             tx.append(pre_tx)
         data = self.store.read(cid, clone).to_bytes()
+        clone_attrs = dict(self.store.getattrs(cid, clone))
         if self.store.exists(cid, head):
             tx.remove(cid, head)
         tx.clone(cid, clone, head)
         # the clone's copied attrs carry a STALE SnapSet and version:
         # restamp with the live ones (and clear any whiteout).  EC
         # shards carry the WHOLE-object length in "len" (the snapshot's
-        # size from the SnapSet), not the shard-stream length.
+        # size from the SnapSet), not the shard-stream length.  The
+        # clone op copies the STORED bytes (and their cz/crl extent
+        # metadata): d covers them as stored, and a compressed clone's
+        # logical length is its recorded raw length.
+        rep_len = (int(clone_attrs["crl"]) if "cz" in clone_attrs
+                   else len(data))
         tx.setattrs(cid, head, {"ss": ss_b, "v": version, "wh": 0,
                                 "len": (total_len if shard >= 0
                                         and total_len >= 0
-                                        else len(data)),
+                                        else rep_len),
                                 "d": _crc32c(data)})
         self._log_apply(tx, pgid, LogEntry(version, "write", name, shard,
                                            prev_version=-1))

@@ -264,6 +264,21 @@ def configs() -> list[dict]:
                             "controller_final_res",
                             "controller_convergence_error",
                             "qos_events", "invariants", "ok"]})
+    # 11. folded deep scrub + inline compression (ISSUE 20): the
+    # full-store folded-verify throughput vs the per-object python
+    # loop, the zero-false-mismatch/corruption-detection gates, and
+    # the czlib compression ratio — scrub_throughput is the MB/s the
+    # background scrubber sustains through the batching seam
+    out.append({"id": "scrub_throughput", "tool": "bench_root",
+                "argv": ["--scrub"],
+                "extract": ["value", "vs_baseline", "fold_backend",
+                            "objects", "bytes", "loop_s", "folded_s",
+                            "false_mismatches",
+                            "corruption_detected_both", "ok"]})
+    out.append({"id": "compress_ratio", "tool": "bench_root",
+                "argv": ["--scrub"],
+                "extract": ["compress_ratio", "compress_roundtrip_ok",
+                            "incompressible_falls_through", "ok"]})
     return out
 
 

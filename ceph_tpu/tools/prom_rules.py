@@ -85,6 +85,29 @@ QUANTILES = (0.50, 0.99)
 #: rate means the working set is write-hot and leases are wasted), and
 #: the ec_read_tier_* quartet is the HBM hot-read tier's admission
 #: telemetry (hit:miss is the tier's value, admit:evict its churn)
+#: Background-scrub counters (osd/scrub.py auto-scrub engine,
+#: registered zeroed at OSD boot): verified_bytes over verify_launches
+#: is the folded-verify batching win (bytes folded per device launch);
+#: mismatches is the alertable corruption rate (host-confirmed, never
+#: the raw folded candidates); digest_missing counts objects scrub had
+#: to skip for lack of a stored digest (should trend to zero once
+#: write-time digests cover the store); auto_chunks is the scheduler's
+#: work cadence under the scrub mclock class.
+SCRUB_COUNTERS = ("scrubs", "scrub_errors",
+                  "scrub_verified_bytes", "scrub_verify_launches",
+                  "scrub_mismatches", "scrub_digest_missing",
+                  "scrub_auto_chunks")
+
+#: Inline-compression counters (osd/compression.py COUNTERS schema):
+#: the BlueStore-named pair bluestore_compressed_{original,allocated}
+#: makes the at-rest ratio a dashboard division; compress_rejected
+#: counts required_ratio fall-throughs (incompressible data staying
+#: raw), compress_decompress the transparent read-side inflates.
+COMPRESS_COUNTERS = ("compress_blobs", "compress_rejected",
+                     "compress_decompress",
+                     "bluestore_compressed_original",
+                     "bluestore_compressed_allocated")
+
 COUNTERS = ("trace_sampled", "trace_dropped",
             "msg_tx_flatten_bytes", "msg_tx_flatten_copies",
             "msg_rx_copy_bytes", "msg_rx_copy_copies",
@@ -95,7 +118,30 @@ COUNTERS = ("trace_sampled", "trace_dropped",
             "balanced_read_serve", "balanced_read_bounce",
             "read_lease_grant", "read_lease_ride", "read_lease_revoke",
             "ec_read_tier_hit", "ec_read_tier_miss",
-            "ec_read_tier_admit", "ec_read_tier_evict")
+            "ec_read_tier_admit", "ec_read_tier_evict") \
+    + SCRUB_COUNTERS + COMPRESS_COUNTERS
+
+
+def lint_counter_schema(registered) -> list[str]:
+    """Counter-schema lint for the scrub_*/compress_* families: given
+    the counter names a daemon actually registers (perf-counter keys),
+    return a list of problems — a family member missing from the
+    daemon, or a daemon counter in either namespace that the rules
+    here don't know about (which would scrape without a standing rate
+    rule).  Empty list = schema and rules agree."""
+    have = set(registered)
+    want = set(SCRUB_COUNTERS) | set(COMPRESS_COUNTERS)
+    problems = []
+    for c in sorted(want - have):
+        problems.append(f"missing counter: {c} (in rules, "
+                        f"not registered by daemon)")
+    prefixes = ("scrub_", "compress_", "bluestore_compressed_")
+    stray = {c for c in have
+             if c.startswith(prefixes) or c == "scrubs"} - want
+    for c in sorted(stray):
+        problems.append(f"unruled counter: {c} (registered by "
+                        f"daemon, no recording rule)")
+    return problems
 
 #: SLO_BURN-aligned bad-fraction recording rules: fraction of
 #: observations ABOVE the bound over the rate window — the PromQL

@@ -441,6 +441,55 @@ OPTIONS: list[Option] = [
            "scrub weight", min=0.001),
     Option("osd_mclock_scrub_lim", float, 0.0, OptionLevel.ADVANCED,
            "scrub limit (ops/s; 0 unlimited)", min=0.0),
+    # continuous folded deep scrub (osd/scrub.py auto-scrub scheduler)
+    Option("osd_scrub_auto", bool, True, OptionLevel.BASIC,
+           "background deep-scrub scheduler: each OSD continuously "
+           "re-verifies its own stored shard bytes per PG in folded "
+           "CRC launches (ec/verify.py through the batching seam), "
+           "under the scrub mclock class",
+           see_also=("osd_scrub_min_interval",
+                     "osd_scrub_max_interval")),
+    Option("osd_scrub_min_interval", float, 86400.0,
+           OptionLevel.BASIC,
+           "seconds between deep-scrub passes of one PG (a pass ends "
+           "when the cursor wraps); the default keeps short-lived "
+           "test clusters quiet — deployments tune it down",
+           min=0.0, max=30 * 86400.0),
+    Option("osd_scrub_max_interval", float, 7 * 86400.0,
+           OptionLevel.ADVANCED,
+           "hard deadline: a PG whose last pass finished longer ago "
+           "than this scrubs next regardless of load ordering",
+           min=0.0, max=365 * 86400.0),
+    Option("osd_scrub_chunk_max", int, 25, OptionLevel.ADVANCED,
+           "objects verified per scrub chunk (one scheduler grant / "
+           "one cursor advance; ref osd_scrub_chunk_max)",
+           min=1, max=4096),
+    Option("osd_scrub_fold", str, "auto", OptionLevel.ADVANCED,
+           "folded-verify backend: auto (device CRC tree on real "
+           "accelerators, one native C sweep per launch on CPU "
+           "hosts), device (force the jit graph — the CPU-jax tier-1 "
+           "smoke), native (force the host sweep)",
+           enum_values=("auto", "device", "native")),
+    # inline store compression defaults (per-pool options override;
+    # reference BlueStore bluestore_compression_* semantics)
+    Option("osd_compression_mode", str, "none", OptionLevel.BASIC,
+           "default pool compression mode: none, passive (compress "
+           "only hinted/whole-object writes), aggressive (compress "
+           "everything compressible)",
+           enum_values=("none", "passive", "aggressive")),
+    Option("osd_compression_algorithm", str, "czlib",
+           OptionLevel.BASIC,
+           "default pool compression algorithm (compress/registry.py "
+           "plugin name)"),
+    Option("osd_compression_required_ratio", float, 0.875,
+           OptionLevel.ADVANCED,
+           "store the compressed blob only when compressed/raw <= "
+           "this ratio; otherwise the raw bytes land and reads pay "
+           "nothing", min=0.0, max=1.0),
+    Option("osd_compression_min_blob_size", int, 4096,
+           OptionLevel.ADVANCED,
+           "blobs smaller than this never compress (header-dominated "
+           "wins are noise)", min=0, max=1 << 30),
     # multi-tenant QoS (qos/): per-tenant dmclock sub-queues under the
     # client class + the adaptive recovery-reservation controller
     Option("osd_qos_max_tenants", int, 64, OptionLevel.ADVANCED,

@@ -124,7 +124,8 @@ def test_saturate_smoke_point():
     row = sweep["points"][0]
     assert row["invariants"] == {"no_deadlock": True,
                                  "queues_bounded": True,
-                                 "recovery_completes": True}, row
+                                 "recovery_completes": True,
+                                 "scrub_completes": True}, row
     # the burst really ran: both op classes measured on the steady leg
     steady = row["steady"]
     assert steady["achieved_per_s"] > 0

@@ -82,6 +82,11 @@ def test_rules_reference_only_emitted_metrics():
     from ceph_tpu.utils.perf import CounterType
     for h in ("op_lat_us", "ec_batch_wait_us", "ec_batch_flush_us"):
         qos_probe.add(h, CounterType.HISTOGRAM)
+    # the background-scrub + inline-compression counter families
+    # (registered zeroed at OSD boot; schema pinned by the lint below)
+    from ceph_tpu.tools.prom_rules import (COMPRESS_COUNTERS,
+                                           SCRUB_COUNTERS)
+    qos_probe.add_many(SCRUB_COUNTERS + COMPRESS_COUNTERS)
     Tracer("qos_probe", perf=qos_probe)  # trace_* counter schema
     import time as _time
     store = MetricsHistoryStore()
@@ -108,7 +113,7 @@ def test_rules_shape_and_rendering():
     # one rule per (histogram, quantile) + one rate rule per tracer /
     # messenger-copy / kv-maintenance / read-scale-out counter + the
     # SLO bad-fraction ratio + the staleness max, records namespaced
-    assert len(rules) == 59
+    assert len(rules) == 71
     assert all(r["record"].startswith("ceph_tpu:") for r in rules)
     hist = [r for r in rules if "histogram_quantile(" in r["expr"]]
     assert len(hist) == 34
@@ -144,7 +149,19 @@ def test_rules_shape_and_rendering():
         "ceph_tpu:daemon_ec_read_tier_hit:rate5m",
         "ceph_tpu:daemon_ec_read_tier_miss:rate5m",
         "ceph_tpu:daemon_ec_read_tier_admit:rate5m",
-        "ceph_tpu:daemon_ec_read_tier_evict:rate5m"}
+        "ceph_tpu:daemon_ec_read_tier_evict:rate5m",
+        "ceph_tpu:daemon_scrubs:rate5m",
+        "ceph_tpu:daemon_scrub_errors:rate5m",
+        "ceph_tpu:daemon_scrub_verified_bytes:rate5m",
+        "ceph_tpu:daemon_scrub_verify_launches:rate5m",
+        "ceph_tpu:daemon_scrub_mismatches:rate5m",
+        "ceph_tpu:daemon_scrub_digest_missing:rate5m",
+        "ceph_tpu:daemon_scrub_auto_chunks:rate5m",
+        "ceph_tpu:daemon_compress_blobs:rate5m",
+        "ceph_tpu:daemon_compress_rejected:rate5m",
+        "ceph_tpu:daemon_compress_decompress:rate5m",
+        "ceph_tpu:daemon_bluestore_compressed_original:rate5m",
+        "ceph_tpu:daemon_bluestore_compressed_allocated:rate5m"}
     assert all("rate(" in r["expr"] and "by (daemon)" in r["expr"]
                for r in rates)
     stale = [r for r in rules
@@ -162,8 +179,8 @@ def test_rules_shape_and_rendering():
         and "ceph_tpu_daemon_op_lat_us_bucket" in slo[0]["expr"]
     text = render(rules)
     assert text.startswith("groups:\n- name: ceph_tpu_latency\n")
-    assert text.count("  - record: ") == 59
-    assert text.count("    expr: ") == 59
+    assert text.count("  - record: ") == 71
+    assert text.count("    expr: ") == 71
     # per-tenant family: the default anchor is standing, and named
     # tenants generate the same rule shape via tenant_histograms
     from ceph_tpu.tools.prom_rules import tenant_histograms
@@ -176,6 +193,45 @@ def test_rules_shape_and_rendering():
     # names sanitize exactly like the scheduler's counter stems
     assert ("ceph_tpu:daemon_mclock_qwait_us_tenant_bul_k_:p50"
             in recs)
+
+
+def test_scrub_compress_counter_schema_lint():
+    """The scrub_*/compress_* families stay in lockstep between the
+    daemon's zeroed registration and the standing rate rules: a
+    counter added to one side without the other fails the lint."""
+    from ceph_tpu.osd.compression import COUNTERS as COMPRESS_DAEMON
+    from ceph_tpu.tools.prom_rules import (COMPRESS_COUNTERS,
+                                           SCRUB_COUNTERS,
+                                           lint_counter_schema)
+    # the exact names the OSD registers zeroed at boot (daemon.py
+    # perf.add_many + compression.COUNTERS)
+    daemon_registered = ("scrubs", "scrub_errors",
+                         "scrub_verified_bytes",
+                         "scrub_verify_launches",
+                         "scrub_mismatches", "scrub_digest_missing",
+                         "scrub_auto_chunks") + COMPRESS_DAEMON
+    assert lint_counter_schema(daemon_registered) == []
+    assert set(COMPRESS_COUNTERS) == set(COMPRESS_DAEMON)
+    # drift in either direction is a loud, named failure
+    missing = lint_counter_schema(daemon_registered[:-1])
+    assert len(missing) == 1 and "missing counter" in missing[0]
+    stray = lint_counter_schema(
+        daemon_registered + ("scrub_new_thing",))
+    assert len(stray) == 1 and "unruled counter" in stray[0]
+    # every family member has a standing rate rule
+    recs = {r["record"] for r in recording_rules()}
+    for c in SCRUB_COUNTERS + COMPRESS_COUNTERS:
+        assert f"ceph_tpu:daemon_{c}:rate5m" in recs
+    # and the LIVE daemon registration passes the lint end-to-end
+    from ceph_tpu.tools.vstart import MiniCluster
+    from tests.test_cluster import make_cfg
+    c = MiniCluster(n_osds=1, cfg=make_cfg()).start()
+    try:
+        osd = next(iter(c.osds.values()))
+        names = list(osd.perf.dump())
+        assert lint_counter_schema(names) == []
+    finally:
+        c.stop()
 
 
 def test_dashboard_pinned_to_emitted_rule_names():
